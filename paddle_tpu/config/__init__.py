@@ -1,0 +1,8 @@
+"""Model configuration & compilation — successor of the reference's
+config stack: ``python/paddle/trainer/config_parser.py`` (layer calls →
+ModelConfig proto), ``python/paddle/v2/topology.py`` (proto from outputs), and
+``TrainerConfig.proto``.  The proto interpreter (GradientMachine/Executor) is
+replaced by trace-to-XLA compilation of the layer DAG."""
+
+from paddle_tpu.config.topology import Topology  # noqa: F401
+from paddle_tpu.config.trainer_config import OptimizationConfig, TrainerConfig  # noqa: F401

@@ -1,0 +1,107 @@
+"""Topology — the compiled form of a layer DAG.
+
+Reference: ``python/paddle/v2/topology.py`` wraps the ModelConfig proto built
+by ``config_parser.py``; C++ ``NeuralNetwork`` then interprets it layer by
+layer (``NeuralNetwork.cpp:245-327``).  Here Topology owns the DAG directly
+and exposes:
+
+- ``param_specs()`` / ``state_specs()`` — what ``parameters.create`` materializes
+  (≅ ParameterConfig extraction);
+- ``forward(...)`` — one pure evaluation of the whole graph, the function that
+  ``jax.jit``/``jax.grad`` consume (≅ GradientMachine::forward, with backward
+  provided by autodiff instead of ``Layer::backward``);
+- ``serialize()`` — a stable JSON description standing in for the protostr
+  golden-file tests (``trainer_config_helpers/tests/configs``)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.parameters import ParamSpec
+from paddle_tpu.layers.base import Context, LayerOutput, StateSpec, evaluate, topo_sort
+
+
+class Topology:
+    def __init__(self, outputs: LayerOutput | Sequence[LayerOutput], extra_layers=None):
+        if isinstance(outputs, LayerOutput):
+            outputs = [outputs]
+        self.outputs: list[LayerOutput] = list(outputs)
+        extra = list(extra_layers) if extra_layers else []
+        self.nodes: list[LayerOutput] = topo_sort(self.outputs + extra)
+        names = [n.name for n in self.nodes]
+        enforce(len(names) == len(set(names)), "duplicate layer names in topology")
+
+    # -- specs ---------------------------------------------------------------
+    def data_layers(self) -> dict[str, LayerOutput]:
+        """Input layers in graph order (≅ Topology.data_layers())."""
+        return {n.name: n for n in self.nodes if n.layer_type == "data"}
+
+    def param_specs(self) -> list[ParamSpec]:
+        seen: dict[str, ParamSpec] = {}
+        for n in self.nodes:
+            for s in n.param_specs:
+                if s.name not in seen:
+                    seen[s.name] = s
+        return list(seen.values())
+
+    def state_specs(self) -> list[StateSpec]:
+        out: list[StateSpec] = []
+        seen = set()
+        for n in self.nodes:
+            for s in n.state_specs:
+                if s.name not in seen:
+                    seen.add(s.name)
+                    out.append(s)
+        return out
+
+    def init_states(self) -> dict[str, jax.Array]:
+        return {
+            s.name: jnp.full(s.shape, s.init_value, s.dtype or jnp.float32)
+            for s in self.state_specs()
+        }
+
+    def metrics(self) -> list[tuple[str, str, str, str]]:
+        """(metric_kind, pred_layer, label_layer, tag) tuples auto-attached by
+        cost layers (≅ classification_cost's auto classification_error
+        evaluator)."""
+        out = []
+        for n in self.nodes:
+            m = n.attrs.get("metric")
+            if m:
+                out.append((m[0], m[1], m[2], n.name))
+        return out
+
+    # -- execution -------------------------------------------------------------
+    def forward(
+        self,
+        params: dict[str, jax.Array],
+        states: dict[str, jax.Array],
+        feed: dict,
+        is_train: bool,
+        key: jax.Array | None = None,
+    ):
+        """Evaluate every node; returns ({layer_name: value}, new_states)."""
+        ctx = Context(is_train=is_train, key=key)
+        return evaluate(self.nodes, ctx, params, states, feed)
+
+    # -- serialization (golden-config tests) ----------------------------------
+    def serialize(self) -> str:
+        doc = {
+            "layers": [n.config_record() for n in self.nodes],
+            "input_layer_names": list(self.data_layers()),
+            "output_layer_names": [o.name for o in self.outputs],
+        }
+        return json.dumps(doc, indent=2, sort_keys=True)
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.serialize().encode()).hexdigest()[:16]
+
+    def proto(self) -> str:
+        """Kept under the v2 name; returns the JSON config text."""
+        return self.serialize()

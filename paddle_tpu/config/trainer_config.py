@@ -1,0 +1,83 @@
+"""Trainer/optimization config — successor of ``proto/TrainerConfig.proto:21-140``
+(OptimizationConfig: batch_size, learning_rate + decay schedule, momentum,
+regularization, gradient clipping, model averaging) and the
+``trainer_config_helpers/optimizers.py settings()`` entry point."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass
+class OptimizationConfig:
+    """≅ TrainerConfig.proto OptimizationConfig."""
+
+    batch_size: int = 1
+    learning_rate: float = 0.01
+    learning_method: str = "sgd"  # sgd|momentum|adam|adagrad|adadelta|rmsprop|...
+    momentum: float = 0.0
+    # lr schedule (≅ LearningRateScheduler.cpp: constant/exp/poly/linear)
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    learning_rate_schedule: str = "constant"
+    learning_rate_warmup_steps: int = 0
+    # regularization (≅ Regularizer.h)
+    l1_rate: float = 0.0
+    l2_rate: float = 0.0
+    # clipping
+    gradient_clipping_threshold: float = 0.0
+    # model averaging (≅ AverageOptimizer)
+    average_window: float = 0.0
+    max_average_window: int = 0
+    # adam etc. hyperparams
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    ada_epsilon: float = 1e-6
+    ada_rou: float = 0.95
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def serialize(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """≅ TrainerConfig.proto: model + optimization + data configs."""
+
+    opt_config: OptimizationConfig = dataclasses.field(default_factory=OptimizationConfig)
+    save_dir: str = ""
+    test_period: int = 0
+    num_passes: int = 1
+
+
+def settings(batch_size: int = 1, learning_rate: float = 0.01,
+             learning_method=None, regularization=None,
+             gradient_clipping_threshold: float = 0.0, model_average=None,
+             learning_rate_decay_a: float = 0.0, learning_rate_decay_b: float = 0.0,
+             learning_rate_schedule: str = "constant", **kw) -> OptimizationConfig:
+    """≅ trainer_config_helpers.optimizers.settings:28-358 — v1 config entry."""
+    cfg = OptimizationConfig(
+        batch_size=batch_size,
+        learning_rate=learning_rate,
+        learning_rate_decay_a=learning_rate_decay_a,
+        learning_rate_decay_b=learning_rate_decay_b,
+        learning_rate_schedule=learning_rate_schedule,
+        gradient_clipping_threshold=gradient_clipping_threshold,
+    )
+    if learning_method is not None:
+        # accepts optimizer objects from paddle_tpu.optimizer or strings
+        cfg.learning_method = getattr(learning_method, "name", str(learning_method))
+        for field in ("momentum", "adam_beta1", "adam_beta2", "adam_epsilon"):
+            if hasattr(learning_method, field):
+                setattr(cfg, field, getattr(learning_method, field))
+    if regularization is not None:
+        cfg.l1_rate = getattr(regularization, "l1_rate", 0.0)
+        cfg.l2_rate = getattr(regularization, "l2_rate", 0.0)
+    if model_average is not None:
+        cfg.average_window = getattr(model_average, "average_window", 0.0)
+        cfg.max_average_window = getattr(model_average, "max_average_window", 0)
+    cfg.extra.update(kw)
+    return cfg

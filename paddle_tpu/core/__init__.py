@@ -1,0 +1,7 @@
+"""Core substrate: places, dtypes, LoD sequences, parameters, RNG, flags, timers.
+
+Replaces the reference's L0/L1 native layers (``paddle/utils``, ``paddle/math``,
+``paddle/platform``, ``paddle/memory``) with JAX-native equivalents: device
+placement is ``jax.Device``/``jax.sharding``, tensors are ``jax.Array`` in HBM,
+allocation is XLA's job, and the Matrix/Vector math surface is ``jax.numpy``.
+"""

@@ -1,0 +1,40 @@
+"""Dtype policy — bfloat16-on-MXU compute with float32 parameters/state.
+
+The reference is float32-or-float64 end to end (``paddle/math/Matrix.h``,
+``real`` typedef).  On TPU the idiomatic policy is mixed precision: parameters
+and optimizer state in float32, matmul/conv inputs cast to bfloat16 so they
+tile onto the MXU, reductions and losses accumulated in float32."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import flags
+
+# canonical dtypes
+float32 = jnp.float32
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+int32 = jnp.int32
+int64 = jnp.int64
+bool_ = jnp.bool_
+
+# The reference's `real`
+real = jnp.float32
+
+
+def compute_dtype():
+    """Dtype for MXU-bound operands (matmul/conv inputs)."""
+    return jnp.bfloat16 if flags.get("bf16") else jnp.float32
+
+
+def param_dtype():
+    """Dtype for parameters and optimizer state — always float32."""
+    return jnp.float32
+
+
+def cast_for_matmul(*arrays):
+    """Cast operands to the compute dtype (no-op if already there)."""
+    dt = compute_dtype()
+    out = tuple(a.astype(dt) if a.dtype != dt else a for a in arrays)
+    return out if len(out) > 1 else out[0]

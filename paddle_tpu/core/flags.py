@@ -1,0 +1,115 @@
+"""Central runtime-flag registry — successor of ``paddle/utils/Flags.h:19-43``.
+
+The reference declares ~60 gflags centrally (``use_gpu``, ``trainer_count``,
+``trainer_id``, ``num_gradient_servers``, ``port``, ``saving_period``, …) and
+reads them from every layer of the C++ stack.  Here flags are a typed registry
+with env-var override (``PADDLE_TPU_<NAME>``) and CLI parsing, shared by the
+trainer CLI and the Python API.  CUDA-era flags are replaced by TPU-era ones
+(``use_tpu``, ``mesh_shape``) per the north-star requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    parser: Callable[[str], Any]
+    value: Any = None
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+def define(name: str, default: Any, help: str = "") -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"flag {name!r} already defined")
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    flag = _Flag(name, default, help, parser)
+    env = os.environ.get(f"PADDLE_TPU_{name.upper()}")
+    if env is not None:
+        flag.value = parser(env)
+    _REGISTRY[name] = flag
+
+
+def get(name: str) -> Any:
+    f = _REGISTRY[name]
+    return f.default if f.value is None else f.value
+
+
+def set(name: str, value: Any) -> None:  # noqa: A001 - mirrors gflags SetCommandLineOption
+    f = _REGISTRY[name]
+    f.value = value
+
+
+def parse_args(argv: list[str]) -> list[str]:
+    """Parse ``--name=value`` / ``--name value`` style args; returns leftovers."""
+    rest: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--"):
+            body = a[2:]
+            if "=" in body:
+                k, v = body.split("=", 1)
+            else:
+                k = body
+                if k in _REGISTRY and not isinstance(_REGISTRY[k].default, bool):
+                    i += 1
+                    v = argv[i] if i < len(argv) else ""
+                else:
+                    v = "true"
+            if k in _REGISTRY:
+                f = _REGISTRY[k]
+                f.value = f.parser(v)
+            else:
+                rest.append(a)
+        else:
+            rest.append(a)
+        i += 1
+    return rest
+
+
+def all_flags() -> dict[str, Any]:
+    return {n: get(n) for n in _REGISTRY}
+
+
+# --- The central flag set (TPU-era rewrite of Flags.h:19-43) -----------------
+define("use_tpu", True, "run compute on TPU when available (was: use_gpu)")
+define("trainer_count", 1, "data-parallel replicas on this host (mesh batch axis)")
+define("trainer_id", 0, "distinct id of this trainer process")
+define("num_hosts", 1, "number of participating hosts (was: num_gradient_servers)")
+define("mesh_shape", "", "device mesh as 'dp,tp' or 'dp,tp,pp' (empty = all-dp)")
+define("seed", 1, "global RNG seed (0 = nondeterministic)")
+define("log_period", 100, "log every N batches")
+define("test_period", 0, "test every N batches (0 = every pass)")
+define("saving_period", 1, "checkpoint every N passes")
+define("save_dir", "", "checkpoint output directory")
+define("init_model_path", "", "checkpoint to warm-start from")
+define("start_pass", 0, "first pass number when resuming")
+define("show_parameter_stats_period", 0, "dump parameter stats every N batches")
+define("enable_grad_share", True, "bucket gradients for all-reduce overlap")
+define("dot_period", 1, "print a progress dot every N batches")
+define("prev_batch_state", False, "carry RNN state across batches")
+define("loadsave_parameters_in_pserver", False, "kept for API compat; no-op on TPU")
+define("rdma_tcp", "tcp", "kept for API compat; ICI/DCN is used on TPU")
+define("with_timer", False, "enable Stat timers (was: WITH_TIMER build flag)")
+define("debug_nans", False, "enable jax nan-checking (was: feenableexcept)")
+define("bf16", True, "compute in bfloat16 on the MXU where safe")

@@ -1,0 +1,87 @@
+"""Parameter initializers — successor of ``Parameter::randomize`` and Fluid's
+``python/paddle/v2/framework/initializer.py`` (Constant/Uniform/Normal/Xavier/MSRA).
+
+The reference's default strategy (``paddle/parameter/Parameter.cpp``) is
+uniform in ±sqrt(3/width) scaled by ``initial_std``/``initial_mean`` from
+ParameterConfig; Xavier/MSRA appear in Fluid.  All are pure functions of a JAX
+key here."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def constant(value: float = 0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+def uniform(low: float = -1.0, high: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=low, maxval=high)
+
+    return init
+
+
+def normal(mean: float = 0.0, std: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [H, W, Cin, Cout] (NHWC-native layout)
+    rf = math.prod(shape[:-2])
+    return shape[-2] * rf, shape[-1] * rf
+
+
+def xavier(uniform_dist: bool = True, scale: float = 1.0):
+    """Glorot init (Fluid XavierInitializer)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        if uniform_dist:
+            lim = scale * math.sqrt(6.0 / (fan_in + fan_out))
+            return jax.random.uniform(key, shape, dtype, minval=-lim, maxval=lim)
+        std = scale * math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def msra(uniform_dist: bool = False, scale: float = 1.0):
+    """He init (Fluid MSRAInitializer) — the right default for ReLU convs."""
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        if uniform_dist:
+            lim = scale * math.sqrt(6.0 / fan_in)
+            return jax.random.uniform(key, shape, dtype, minval=-lim, maxval=lim)
+        std = scale * math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def paddle_default(initial_mean: float = 0.0, initial_std: float | None = None):
+    """The reference's default: N(mean, std) with std = 1/sqrt(width) when
+    unspecified (``Parameter.cpp`` randomize with initial_strategy=0)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        std = initial_std
+        if std is None:
+            width = shape[0] if len(shape) >= 2 else (shape[0] if shape else 1)
+            std = 1.0 / math.sqrt(max(width, 1))
+        return initial_mean + std * jax.random.normal(key, shape, dtype)
+
+    return init

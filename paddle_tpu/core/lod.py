@@ -1,0 +1,158 @@
+"""Variable-length / nested sequence representation — the TPU-native successor
+of the reference's LoD machinery.
+
+The reference threads ragged sequences through the whole stack as offset
+vectors: ``Argument.sequenceStartPositions`` / ``subSequenceStartPositions``
+(``paddle/parameter/Argument.h:84-90``) in v2, generalized to ``LoD`` (a list
+of offset levels) on ``LoDTensor`` in Fluid (``paddle/framework/lod_tensor.h:57,82``).
+Its RNN engine reorders ragged batches into same-length groups
+(``paddle/gserver/layers/SequenceToBatch.cpp``) to run timesteps in parallel.
+
+XLA wants static shapes, so the TPU-native representation is *dense padded data
++ integer lengths*, carried as a pytree that flows through jit unchanged:
+
+- level-1 sequences: ``data[B, T, ...]`` + ``length[B]``
+- level-2 (nested) sequences: ``data[B, S, T, ...]`` + ``seq_length[B]``
+  (#subsequences per batch item) + ``sub_length[B, S]`` (length of each).
+
+Masks are derived, never stored.  Conversion from Python ragged lists pads to
+the bucket ceiling (see :func:`bucket_length`) so recompilation is bounded:
+same-bucket batches reuse the compiled step, mirroring how SequenceToBatch
+amortizes ragged batches without padding waste on every length."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bucket_length(n: int, buckets: Sequence[int] = (16, 32, 64, 128, 256, 512, 1024)) -> int:
+    """Smallest bucket >= n; doubles beyond the table. Bounds jit recompiles."""
+    for b in buckets:
+        if n <= b:
+            return b
+    b = buckets[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SequenceBatch:
+    """A batch of level-1 variable-length sequences (≅ Argument with
+    sequenceStartPositions, or a LoDTensor with one LoD level)."""
+
+    data: jax.Array  # [B, T, ...] padded
+    length: jax.Array  # [B] int32, true lengths
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, T] validity mask."""
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return (t[None, :] < self.length[:, None]).astype(dtype)
+
+    def last_step(self) -> jax.Array:
+        """[B, ...] the last valid timestep of each sequence (≅ LastInstanceLayer /
+        ``last_seq`` in trainer_config_helpers/layers.py)."""
+        idx = jnp.maximum(self.length - 1, 0)
+        return jax.vmap(lambda d, i: d[i])(self.data, idx)
+
+    def first_step(self) -> jax.Array:
+        """[B, ...] the first timestep (≅ first_seq)."""
+        return self.data[:, 0]
+
+    def replace_data(self, data: jax.Array) -> "SequenceBatch":
+        return SequenceBatch(data=data, length=self.length)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NestedSequenceBatch:
+    """A batch of level-2 (sequence-of-sequence) data (≅ LoD with two levels /
+    subSequenceStartPositions)."""
+
+    data: jax.Array  # [B, S, T, ...]
+    seq_length: jax.Array  # [B] number of valid subsequences
+    sub_length: jax.Array  # [B, S] length of each subsequence
+
+    def outer_mask(self, dtype=jnp.float32) -> jax.Array:
+        s = jnp.arange(self.data.shape[1], dtype=jnp.int32)
+        return (s[None, :] < self.seq_length[:, None]).astype(dtype)
+
+    def inner_mask(self, dtype=jnp.float32) -> jax.Array:
+        t = jnp.arange(self.data.shape[2], dtype=jnp.int32)
+        m = (t[None, None, :] < self.sub_length[:, :, None]).astype(dtype)
+        return m * self.outer_mask(dtype)[:, :, None]
+
+    def flatten_outer(self) -> SequenceBatch:
+        """Collapse [B, S, T, ...] -> [B*S, T, ...] keeping inner lengths,
+        the way the reference's sub-nested sequence layers iterate subsequences."""
+        b, s = self.data.shape[:2]
+        return SequenceBatch(
+            data=self.data.reshape((b * s,) + self.data.shape[2:]),
+            length=self.sub_length.reshape(b * s),
+        )
+
+
+def pad_sequences(
+    seqs: Sequence[np.ndarray], max_len: int | None = None, bucket: bool = True, pad_value=0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged list -> (padded [B, T, ...], lengths [B]).  Host-side."""
+    lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    t = int(max_len if max_len is not None else (lengths.max() if len(seqs) else 1) or 1)
+    if bucket and max_len is None:
+        t = bucket_length(t)
+    first = np.asarray(seqs[0])
+    trailing = first.shape[1:]
+    out = np.full((len(seqs), t) + trailing, pad_value, dtype=first.dtype)
+    for i, s in enumerate(seqs):
+        s = np.asarray(s)
+        out[i, : len(s)] = s[:t]
+    return out, np.minimum(lengths, t)
+
+
+def from_ragged(seqs: Sequence[np.ndarray], max_len: int | None = None) -> SequenceBatch:
+    data, length = pad_sequences(seqs, max_len=max_len)
+    return SequenceBatch(data=jnp.asarray(data), length=jnp.asarray(length))
+
+
+def from_nested_ragged(nested: Sequence[Sequence[np.ndarray]]) -> NestedSequenceBatch:
+    """List of list of arrays -> NestedSequenceBatch (two LoD levels)."""
+    b = len(nested)
+    s = bucket_length(max((len(x) for x in nested), default=1), (4, 8, 16, 32, 64))
+    t = bucket_length(
+        max((len(sub) for x in nested for sub in x), default=1)
+    )
+    first = np.asarray(nested[0][0])
+    trailing = first.shape[1:]
+    data = np.zeros((b, s, t) + trailing, dtype=first.dtype)
+    seq_len = np.zeros((b,), dtype=np.int32)
+    sub_len = np.zeros((b, s), dtype=np.int32)
+    for i, subs in enumerate(nested):
+        seq_len[i] = min(len(subs), s)
+        for j, sub in enumerate(subs[:s]):
+            sub = np.asarray(sub)
+            sub_len[i, j] = min(len(sub), t)
+            data[i, j, : sub_len[i, j]] = sub[:t]
+    return NestedSequenceBatch(
+        data=jnp.asarray(data), seq_length=jnp.asarray(seq_len), sub_length=jnp.asarray(sub_len)
+    )
+
+
+def to_ragged(batch: SequenceBatch) -> list[np.ndarray]:
+    """Device -> host ragged list (for evaluators / user code)."""
+    data = np.asarray(batch.data)
+    length = np.asarray(batch.length)
+    return [data[i, : length[i]] for i in range(data.shape[0])]

@@ -1,0 +1,33 @@
+"""Logging — successor of ``paddle/utils/Logging.h`` (glog-compatible custom
+logger).  Pluggable like the reference's ``installFailureFunction``; defaults
+to Python logging with glog-style formatting."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FMT = "%(levelname).1s %(asctime)s.%(msecs)03d %(name)s] %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+_root = logging.getLogger("paddle_tpu")
+if not _root.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    _root.addHandler(_h)
+    _root.setLevel(logging.INFO)
+    _root.propagate = False
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def set_level(level: int | str) -> None:
+    _root.setLevel(level)
+
+
+info = _root.info
+warning = _root.warning
+error = _root.error
+debug = _root.debug

@@ -1,0 +1,198 @@
+"""Parameter store — successor of ``paddle/parameter/Parameter.h:37-60`` and the
+Python surface ``python/paddle/v2/parameters.py:44``.
+
+The reference's ``Parameter`` holds typed buffers (PARAMETER_VALUE/GRADIENT/
+MOMENTUM/...) mutated in place by optimizers; the Python ``Parameters`` object
+gives numpy get/set and tar serialization (``to_tar:328`` / ``from_tar:358``).
+
+Here values live as a flat ``{name: jax.Array}`` pytree (the functional train
+step returns new values; gradients and optimizer slots are separate pytrees
+owned by the optimizer state, not hidden buffer slots).  The ``Parameters``
+class keeps the v2 contract: mapping interface, numpy in/out, tar round-trip."""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import tarfile
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.enforce import enforce
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Static description of one parameter (≅ ParameterConfig proto fields)."""
+
+    name: str
+    shape: tuple[int, ...]
+    initializer: Callable  # (key, shape, dtype) -> array
+    dtype: Any = jnp.float32
+    is_static: bool = False  # frozen (ParameterAttribute.is_static)
+    learning_rate: float = 1.0  # per-param LR scale
+    decay_rate: float | None = None  # per-param L2 override
+    gradient_clipping_threshold: float | None = None
+    sparse: bool = False  # embedding-style row-sparse grads
+    sharding: tuple[str | None, ...] | None = None  # mesh axes per dim (tensor parallel)
+
+    def init(self, key) -> jax.Array:
+        return self.initializer(key, self.shape, self.dtype)
+
+
+class Parameters:
+    """v2-compatible parameter collection backed by a jax pytree."""
+
+    def __init__(self):
+        self._specs: dict[str, ParamSpec] = {}
+        self._values: dict[str, jax.Array] = {}
+
+    # -- construction ---------------------------------------------------------
+    def add(self, spec: ParamSpec) -> None:
+        if spec.name in self._specs:
+            # shared parameters (same ParamAttr name on two layers) are legal
+            enforce(
+                self._specs[spec.name].shape == spec.shape,
+                f"shared parameter {spec.name!r} shape mismatch: "
+                f"{self._specs[spec.name].shape} vs {spec.shape}",
+            )
+            return
+        self._specs[spec.name] = spec
+
+    def init_missing(self, key=None) -> None:
+        """Materialize values for all specs that don't have one yet."""
+        missing = [n for n in self._specs if n not in self._values]
+        if not missing:
+            return
+        if key is None:
+            keys = [rng.next_key() for _ in missing]
+        else:
+            keys = list(jax.random.split(key, len(missing)))
+        for name, k in zip(missing, keys):
+            self._values[name] = self._specs[name].init(k)
+
+    @classmethod
+    def from_specs(cls, specs: list[ParamSpec], key=None) -> "Parameters":
+        p = cls()
+        for s in specs:
+            p.add(s)
+        p.init_missing(key)
+        return p
+
+    # -- mapping interface (v2 contract) --------------------------------------
+    def names(self) -> list[str]:
+        return list(self._specs)
+
+    def keys(self) -> list[str]:
+        return self.names()
+
+    def has_key(self, key: str) -> bool:
+        return key in self._specs
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._specs
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._specs)
+
+    def __len__(self) -> int:
+        return len(self._specs)
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        """numpy copy of the value (reference: ``Parameters.get``)."""
+        return np.asarray(self._values[key])
+
+    def __setitem__(self, key: str, value) -> None:
+        spec = self._specs.get(key)
+        enforce(spec is not None, f"no parameter {key!r}")
+        value = jnp.asarray(value, dtype=spec.dtype)
+        enforce(
+            value.shape == spec.shape,
+            f"parameter {key!r}: shape {value.shape} != spec {spec.shape}",
+        )
+        self._values[key] = value
+
+    def get(self, key: str) -> np.ndarray:
+        return self[key]
+
+    def set(self, key: str, value) -> None:
+        self[key] = value
+
+    def get_shape(self, key: str) -> tuple[int, ...]:
+        return self._specs[key].shape
+
+    def spec(self, key: str) -> ParamSpec:
+        return self._specs[key]
+
+    # -- pytree bridge (what the jitted step consumes/produces) ---------------
+    def as_dict(self) -> dict[str, jax.Array]:
+        return dict(self._values)
+
+    def update_from(self, values: dict[str, jax.Array]) -> None:
+        self._values.update(values)
+
+    def trainable_names(self) -> list[str]:
+        return [n for n, s in self._specs.items() if not s.is_static]
+
+    # -- serialization (to_tar/from_tar contract, v2/parameters.py:296-358) ---
+    def to_tar(self, f) -> None:
+        """Write all parameters into an uncompressed tar stream: one ``<name>``
+        raw-float member + one ``<name>.json`` shape/dtype sidecar each."""
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name, spec in self._specs.items():
+                arr = np.asarray(self._values[name])
+                payload = arr.tobytes()
+                ti = tarfile.TarInfo(name=name)
+                ti.size = len(payload)
+                tar.addfile(ti, io.BytesIO(payload))
+                meta = json.dumps(
+                    {"shape": list(arr.shape), "dtype": arr.dtype.name}
+                ).encode()
+                mi = tarfile.TarInfo(name=name + ".json")
+                mi.size = len(meta)
+                tar.addfile(mi, io.BytesIO(meta))
+
+    @classmethod
+    def from_tar(cls, f) -> "Parameters":
+        from paddle_tpu.core import initializer as init_mod
+
+        p = cls()
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            members = {m.name: m for m in tar.getmembers()}
+            for name, m in members.items():
+                if name.endswith(".json"):
+                    continue
+                meta = json.loads(tar.extractfile(members[name + ".json"]).read())
+                raw = tar.extractfile(m).read()
+                arr = np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(
+                    meta["shape"]
+                )
+                p._specs[name] = ParamSpec(
+                    name=name,
+                    shape=tuple(meta["shape"]),
+                    initializer=init_mod.constant(0.0),
+                    dtype=jnp.dtype(meta["dtype"]),
+                )
+                p._values[name] = jnp.asarray(arr)
+        return p
+
+    def init_from_tar(self, f) -> None:
+        """Load values for matching names from a tar (warm start)."""
+        other = Parameters.from_tar(f)
+        for name in other.names():
+            if name in self._specs:
+                self[name] = other[name]
+
+
+def create(topology_or_specs) -> Parameters:
+    """``paddle.parameters.create(topology)`` v2 entry point."""
+    if hasattr(topology_or_specs, "param_specs"):
+        specs = topology_or_specs.param_specs()
+    else:
+        specs = list(topology_or_specs)
+    return Parameters.from_specs(specs)

@@ -1,0 +1,76 @@
+"""Device places — the TPU-native successor of ``paddle/platform/place.h``.
+
+The reference models devices as a ``boost::variant<CPUPlace, GPUPlace>``
+(``paddle/platform/place.h:24-55``) with a per-place ``DeviceContext`` carrying
+streams and cuBLAS/cuDNN handles (``device_context.h:38-94``).  On TPU the
+equivalents are ``jax.Device`` objects from the PJRT client; there are no
+streams or library handles to manage (XLA owns scheduling), so a Place here is
+a thin, hashable selector that resolves to a concrete ``jax.Device`` and acts
+as the target for ``jax.device_put`` / jit placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    """Base device selector. ``device_id`` indexes into the platform's devices."""
+
+    device_id: int = 0
+
+    platform: str = ""  # overridden by subclasses
+
+    def device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if d.platform == self.platform]
+        if not devs:  # fall back to whatever the default backend offers
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self) -> str:  # e.g. TPUPlace(0)
+        return f"{type(self).__name__}({self.device_id})"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class CPUPlace(Place):
+    platform: str = "cpu"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class TPUPlace(Place):
+    """TPU device selector (the reference's GPUPlace analog, CUDA-free)."""
+
+    platform: str = "tpu"
+
+    def device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+@functools.cache
+def is_compiled_with_tpu() -> bool:
+    """True when an accelerator backend is live (axon/tpu), analogous to the
+    reference's ``WITH_GPU`` build flag + ``hl_get_device_count`` probe."""
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+_default_place: Place | None = None
+
+
+def set_default_place(place: Place) -> None:
+    global _default_place
+    _default_place = place
+
+
+def default_place() -> Place:
+    """The place used when none is given — TPU if available, else CPU
+    (reference: gflag ``use_gpu`` in ``paddle/utils/Flags.h:19``)."""
+    if _default_place is not None:
+        return _default_place
+    return TPUPlace() if is_compiled_with_tpu() else CPUPlace()

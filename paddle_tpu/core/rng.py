@@ -1,0 +1,42 @@
+"""RNG plumbing — functional JAX keys behind a seeded global stream.
+
+The reference seeds thread-local RNGs from gflag ``seed``
+(``paddle/utils/Util.cpp`` ThreadLocalRand).  Here a process-global key is
+split on demand; jitted code takes keys as explicit arguments (dropout etc.),
+keeping steps pure/replayable."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from paddle_tpu.core import flags
+
+_key: jax.Array | None = None
+
+
+def seed(s: int | None = None) -> None:
+    global _key
+    if s is None:
+        s = flags.get("seed")
+    if s == 0:  # nondeterministic, like the reference's seed=0
+        s = time.time_ns() & 0x7FFFFFFF
+    _key = jax.random.key(s)
+
+
+def next_key() -> jax.Array:
+    """Split one subkey off the global stream."""
+    global _key
+    if _key is None:
+        seed()
+    _key, sub = jax.random.split(_key)
+    return sub
+
+
+def next_keys(n: int) -> jax.Array:
+    global _key
+    if _key is None:
+        seed()
+    _key, *subs = jax.random.split(_key, n + 1)
+    return jax.numpy.stack(subs)

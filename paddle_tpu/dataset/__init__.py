@@ -1,0 +1,10 @@
+"""Datasets — successor of ``python/paddle/v2/dataset`` (mnist, cifar, imdb,
+uci_housing, movielens, wmt14, conll05, imikolov, sentiment …).
+
+The reference auto-downloads from the network; this environment has zero
+egress, so each dataset module serves deterministic synthetic data with the
+SAME sample schema (shapes/dtypes/vocab sizes) as the original, loading real
+files instead when present under ``~/.cache/paddle_tpu/dataset`` (same cache
+layout idea as ``v2/dataset/common.py``)."""
+
+from paddle_tpu.dataset import cifar, imdb, mnist, uci_housing  # noqa: F401

@@ -1,0 +1,33 @@
+"""Dataset cache/helpers — successor of ``python/paddle/v2/dataset/common.py``
+(DATA_HOME cache dir, md5 check, cluster_files_split)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def data_path(*parts: str) -> str:
+    return os.path.join(DATA_HOME, *parts)
+
+
+def have_file(*parts: str) -> bool:
+    return os.path.exists(data_path(*parts))
+
+
+def synthetic_rng(name: str, split: str) -> np.random.Generator:
+    """Deterministic per-(dataset, split) generator so train/test differ but
+    every run sees identical data (crc32, not hash(): immune to per-process
+    str-hash salting)."""
+    import zlib
+
+    seed = zlib.crc32(f"{name}/{split}".encode()) % (2**31)
+    return np.random.default_rng(seed)
+
+
+def cluster_files_split(files: list[str], trainer_count: int, trainer_id: int) -> list[str]:
+    """≅ common.cluster_files_split: shard a file list across trainers."""
+    return files[trainer_id::trainer_count]

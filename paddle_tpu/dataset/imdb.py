@@ -1,0 +1,54 @@
+"""IMDB sentiment — schema-compatible with ``python/paddle/v2/dataset/imdb.py``:
+samples are (word_id_sequence, label in {0,1}).  Synthetic fallback generates
+sequences from two class-conditional unigram distributions over a 5k vocab."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+VOCAB_SIZE = 5148  # mirrors the reference's imdb.word_dict() size ballpark
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def word_dict() -> dict[str, int]:
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _class_dists():
+    rng = np.random.default_rng(999)
+    pos = rng.dirichlet(np.ones(VOCAB_SIZE) * 0.05)
+    neg = rng.dirichlet(np.ones(VOCAB_SIZE) * 0.05)
+    return pos, neg
+
+
+_DISTS = None
+
+
+def _synthetic(split: str, n: int):
+    global _DISTS
+    if _DISTS is None:
+        _DISTS = _class_dists()
+    rng = common.synthetic_rng("imdb", split)
+    for _ in range(n):
+        label = int(rng.integers(0, 2))
+        dist = _DISTS[label]
+        length = int(rng.integers(20, 120))
+        seq = rng.choice(VOCAB_SIZE, size=length, p=dist)
+        yield list(map(int, seq)), label
+
+
+def train(word_idx=None):
+    def reader():
+        yield from _synthetic("train", TRAIN_SIZE)
+
+    return reader
+
+
+def test(word_idx=None):
+    def reader():
+        yield from _synthetic("test", TEST_SIZE)
+
+    return reader

@@ -1,0 +1,87 @@
+"""MNIST — schema-compatible with ``python/paddle/v2/dataset/mnist.py``:
+samples are (image[784] float32 in [-1,1], label int in [0,10)).
+
+With no network egress, serves synthetic class-conditional digit blobs:
+each class is a fixed smooth prototype image + per-sample noise/shift, which
+a LeNet separates well — enough for convergence tests and benchmarks.  Real
+idx files under the cache dir are used when available."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+
+
+def _prototypes() -> np.ndarray:
+    rng = np.random.default_rng(12345)
+    protos = np.zeros((10, 28, 28), np.float32)
+    yy, xx = np.mgrid[0:28, 0:28]
+    for c in range(10):
+        img = np.zeros((28, 28), np.float32)
+        for _ in range(3 + c % 4):
+            cx, cy = rng.uniform(6, 22, 2)
+            sx, sy = rng.uniform(2.0, 5.0, 2)
+            img += np.exp(-(((xx - cx) / sx) ** 2 + ((yy - cy) / sy) ** 2))
+        protos[c] = img / img.max()
+    return protos
+
+
+_PROTOS = None
+
+
+def _synthetic(split: str, n: int):
+    global _PROTOS
+    if _PROTOS is None:
+        _PROTOS = _prototypes()
+    rng = common.synthetic_rng("mnist", split)
+    labels = rng.integers(0, 10, n)
+    for i in range(n):
+        c = int(labels[i])
+        dx, dy = rng.integers(-2, 3, 2)
+        img = np.roll(np.roll(_PROTOS[c], dy, axis=0), dx, axis=1)
+        img = img + rng.normal(0, 0.15, (28, 28)).astype(np.float32)
+        img = np.clip(img, 0, 1) * 2.0 - 1.0
+        yield img.reshape(784).astype(np.float32), c
+
+
+def _read_idx(img_path: str, lbl_path: str):
+    with gzip.open(lbl_path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    with gzip.open(img_path, "rb") as f:
+        _, n, r, c = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), np.uint8).reshape(n, r * c)
+    for i in range(n):
+        yield images[i].astype(np.float32) / 127.5 - 1.0, int(labels[i])
+
+
+def train():
+    def reader():
+        img = common.data_path("mnist", "train-images-idx3-ubyte.gz")
+        lbl = common.data_path("mnist", "train-labels-idx1-ubyte.gz")
+        if os.path.exists(img) and os.path.exists(lbl):
+            yield from _read_idx(img, lbl)
+        else:
+            yield from _synthetic("train", TRAIN_SIZE)
+
+    return reader
+
+
+def test():
+    def reader():
+        img = common.data_path("mnist", "t10k-images-idx3-ubyte.gz")
+        lbl = common.data_path("mnist", "t10k-labels-idx1-ubyte.gz")
+        if os.path.exists(img) and os.path.exists(lbl):
+            yield from _read_idx(img, lbl)
+        else:
+            yield from _synthetic("test", TEST_SIZE)
+
+    return reader

@@ -1,0 +1,37 @@
+"""UCI housing regression — schema-compatible with
+``python/paddle/v2/dataset/uci_housing.py``: (features[13] float32, price[1]).
+Synthetic fallback: linear ground truth + noise."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+FEATURE_DIM = 13
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+_W = np.random.default_rng(4242).normal(0, 1, FEATURE_DIM).astype(np.float32)
+
+
+def _synthetic(split: str, n: int):
+    rng = common.synthetic_rng("uci_housing", split)
+    for _ in range(n):
+        x = rng.normal(0, 1, FEATURE_DIM).astype(np.float32)
+        y = float(x @ _W + rng.normal(0, 0.1))
+        yield x, np.asarray([y], np.float32)
+
+
+def train():
+    def reader():
+        yield from _synthetic("train", TRAIN_SIZE)
+
+    return reader
+
+
+def test():
+    def reader():
+        yield from _synthetic("test", TEST_SIZE)
+
+    return reader

@@ -1,0 +1,318 @@
+"""Evaluators — successor of ``paddle/gserver/evaluators/Evaluator.cpp:172-1357``
+(classification_error, sum, column_sum, rankauc, precision_recall, pnpair,
+ctc_edit_distance, chunk F1, detection mAP + printers).
+
+Two tiers:
+- in-jit metrics (classification error) computed inside the train step;
+- host-side accumulators here, fed from output values batch by batch, for the
+  metrics that don't belong in compiled code (AUC buckets, edit distance,
+  chunk F1).  ``Evaluator`` mirrors start/eval/finish of the C++ registry."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Evaluator:
+    name = "base"
+
+    def start(self):
+        raise NotImplementedError
+
+    def eval_batch(self, **kw):
+        raise NotImplementedError
+
+    def finish(self) -> dict:
+        raise NotImplementedError
+
+
+class ClassificationError(Evaluator):
+    """≅ classification_error_evaluator."""
+
+    name = "classification_error"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.wrong = 0
+        self.total = 0
+
+    def eval_batch(self, pred=None, label=None, **kw):
+        ids = np.argmax(np.asarray(pred), axis=-1).reshape(-1)
+        lbl = np.asarray(label).reshape(-1)
+        self.wrong += int((ids != lbl).sum())
+        self.total += ids.size
+
+    def finish(self):
+        return {self.name: self.wrong / max(self.total, 1)}
+
+
+class SumEvaluator(Evaluator):
+    """≅ sum_evaluator."""
+
+    name = "sum"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.total = 0.0
+        self.count = 0
+
+    def eval_batch(self, value=None, **kw):
+        v = np.asarray(value)
+        self.total += float(v.sum())
+        self.count += v.size
+
+    def finish(self):
+        return {self.name: self.total}
+
+
+class ColumnSumEvaluator(Evaluator):
+    """≅ column_sum_evaluator."""
+
+    name = "column_sum"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.total = None
+        self.count = 0
+
+    def eval_batch(self, value=None, **kw):
+        v = np.asarray(value).sum(axis=0)
+        self.total = v if self.total is None else self.total + v
+        self.count += 1
+
+    def finish(self):
+        return {self.name: self.total}
+
+
+class AUC(Evaluator):
+    """≅ auc_evaluator (bucketed trapezoid AUC, Fluid auc_op style)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.k = num_thresholds
+        self.start()
+
+    def start(self):
+        self.tp = np.zeros(self.k + 1)
+        self.fp = np.zeros(self.k + 1)
+
+    def eval_batch(self, prob=None, label=None, **kw):
+        p = np.asarray(prob)
+        if p.ndim > 1 and p.shape[-1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        y = np.asarray(label).reshape(-1)
+        for t in range(self.k + 1):
+            thr = t / self.k
+            pred_pos = p >= thr
+            self.tp[t] += int((pred_pos & (y == 1)).sum())
+            self.fp[t] += int((pred_pos & (y == 0)).sum())
+
+    def finish(self):
+        pos = max(self.tp[0], 1e-9)
+        neg = max(self.fp[0], 1e-9)
+        tpr = self.tp / pos
+        fpr = self.fp / neg
+        auc = float(-np.trapezoid(tpr, fpr))
+        return {self.name: auc}
+
+
+class PrecisionRecall(Evaluator):
+    """≅ precision_recall_evaluator (macro over classes + F1)."""
+
+    name = "precision_recall"
+
+    def __init__(self, num_classes: int = 2):
+        self.num_classes = num_classes
+        self.start()
+
+    def start(self):
+        self.tp = np.zeros(self.num_classes)
+        self.fp = np.zeros(self.num_classes)
+        self.fn = np.zeros(self.num_classes)
+
+    def eval_batch(self, pred=None, label=None, **kw):
+        ids = np.argmax(np.asarray(pred), axis=-1).reshape(-1)
+        lbl = np.asarray(label).reshape(-1)
+        for c in range(self.num_classes):
+            self.tp[c] += int(((ids == c) & (lbl == c)).sum())
+            self.fp[c] += int(((ids == c) & (lbl != c)).sum())
+            self.fn[c] += int(((ids != c) & (lbl == c)).sum())
+
+    def finish(self):
+        prec = self.tp / np.maximum(self.tp + self.fp, 1)
+        rec = self.tp / np.maximum(self.tp + self.fn, 1)
+        f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-9)
+        return {
+            "precision": float(prec.mean()),
+            "recall": float(rec.mean()),
+            "F1-score": float(f1.mean()),
+        }
+
+
+class PnpairEvaluator(Evaluator):
+    """≅ pnpair_evaluator: positive-negative pair ordering accuracy."""
+
+    name = "pnpair"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.records: list[tuple[float, int, int]] = []
+
+    def eval_batch(self, score=None, label=None, query=None, **kw):
+        s = np.asarray(score).reshape(-1)
+        y = np.asarray(label).reshape(-1)
+        q = (np.asarray(query).reshape(-1) if query is not None
+             else np.zeros_like(y))
+        self.records.extend(zip(s.tolist(), y.tolist(), q.tolist()))
+
+    def finish(self):
+        pos, neg, tie = 0.0, 0.0, 0.0
+        from collections import defaultdict
+
+        by_q = defaultdict(list)
+        for s, y, q in self.records:
+            by_q[q].append((s, y))
+        for items in by_q.values():
+            for i in range(len(items)):
+                for j in range(i + 1, len(items)):
+                    (si, yi), (sj, yj) = items[i], items[j]
+                    if yi == yj:
+                        continue
+                    hi, lo = (si, sj) if yi > yj else (sj, si)
+                    if hi > lo:
+                        pos += 1
+                    elif hi < lo:
+                        neg += 1
+                    else:
+                        tie += 1
+        total = max(pos + neg + tie, 1e-9)
+        return {self.name: (pos + 0.5 * tie) / total}
+
+
+class ChunkEvaluator(Evaluator):
+    """≅ ChunkEvaluator.cpp: chunk-level F1 for sequence tagging (IOB/IOE/IOBES).
+    Labels encode (chunk_type, tag_type) as in the reference:
+    tag = chunk_type * num_tag_types + tag_id."""
+
+    name = "chunk"
+
+    def __init__(self, chunk_scheme: str = "IOB", num_chunk_types: int = 1):
+        self.scheme = chunk_scheme
+        self.num_chunk_types = num_chunk_types
+        self.start()
+
+    def start(self):
+        self.correct = 0
+        self.infer_total = 0
+        self.label_total = 0
+
+    def _extract(self, tags: list[int]):
+        """Decode chunks as (start, end, type) from an IOB sequence."""
+        chunks = []
+        start, ctype = None, None
+        n_tag = 2 if self.scheme == "IOB" else 2
+        for i, t in enumerate(tags):
+            if t < 0 or t >= self.num_chunk_types * n_tag:
+                inside = False  # O tag
+            else:
+                c, tag = divmod(t, n_tag)
+                inside = True
+            if start is not None:
+                if (not inside) or tag == 0 or c != ctype:
+                    chunks.append((start, i - 1, ctype))
+                    start, ctype = None, None
+            if inside and (tag == 0 or start is None):
+                start, ctype = i, c
+        if start is not None:
+            chunks.append((start, len(tags) - 1, ctype))
+        return set(chunks)
+
+    def eval_batch(self, pred=None, label=None, lengths=None, **kw):
+        p = np.asarray(pred)
+        y = np.asarray(label)
+        if p.ndim == 1:
+            p, y = p[None], y[None]
+        lens = (np.asarray(lengths) if lengths is not None
+                else np.full(p.shape[0], p.shape[1]))
+        for i in range(p.shape[0]):
+            pi = self._extract(p[i, : lens[i]].tolist())
+            yi = self._extract(y[i, : lens[i]].tolist())
+            self.correct += len(pi & yi)
+            self.infer_total += len(pi)
+            self.label_total += len(yi)
+
+    def finish(self):
+        prec = self.correct / max(self.infer_total, 1)
+        rec = self.correct / max(self.label_total, 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-9)
+        return {"precision": prec, "recall": rec, "F1-score": f1}
+
+
+def edit_distance(a: list, b: list) -> int:
+    """Levenshtein distance (core of ctc_error_evaluator)."""
+    m, n = len(a), len(b)
+    dp = list(range(n + 1))
+    for i in range(1, m + 1):
+        prev = dp[0]
+        dp[0] = i
+        for j in range(1, n + 1):
+            cur = dp[j]
+            dp[j] = min(dp[j] + 1, dp[j - 1] + 1, prev + (a[i - 1] != b[j - 1]))
+            prev = cur
+    return dp[n]
+
+
+class CTCError(Evaluator):
+    """≅ CTCErrorEvaluator.cpp: edit distance between greedy CTC decode and
+    the label sequence, normalized by label length."""
+
+    name = "ctc_error"
+
+    def __init__(self, blank: int = 0):
+        self.blank = blank
+        self.start()
+
+    def start(self):
+        self.total_dist = 0.0
+        self.total_len = 0
+
+    @staticmethod
+    def greedy_decode(logits: np.ndarray, blank: int) -> list[int]:
+        ids = np.argmax(logits, axis=-1).tolist()
+        out, prev = [], None
+        for t in ids:
+            if t != prev and t != blank:
+                out.append(t)
+            prev = t
+        return out
+
+    def eval_batch(self, logits=None, label=None, **kw):
+        for lg, lb in zip(logits, label):
+            dec = self.greedy_decode(np.asarray(lg), self.blank)
+            ref = [int(x) for x in lb]
+            self.total_dist += edit_distance(dec, ref)
+            self.total_len += len(ref)
+
+    def finish(self):
+        return {self.name: self.total_dist / max(self.total_len, 1)}
+
+
+REGISTRY = {
+    c.name: c
+    for c in (ClassificationError, SumEvaluator, ColumnSumEvaluator, AUC,
+              PrecisionRecall, PnpairEvaluator, ChunkEvaluator, CTCError)
+}
+
+
+def create(name: str, **kw) -> Evaluator:
+    return REGISTRY[name](**kw)

@@ -1,0 +1,3 @@
+"""Declarative layer API — successor of ``python/paddle/trainer_config_helpers/
+layers.py`` (266 wrappers) + ``python/paddle/v2/layer.py``, compiled to pure
+JAX functions instead of a ModelConfig proto interpreted by C++."""

@@ -1,0 +1,59 @@
+"""Activation objects — successor of ``trainer_config_helpers/activations.py``
+(TanhActivation() etc. passed as ``act=`` to layer helpers)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from paddle_tpu.ops import activations as _ops
+
+
+@dataclasses.dataclass(frozen=True)
+class BaseActivation:
+    name: str
+    fn: Callable
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
+def _mk(name: str) -> Callable[[], BaseActivation]:
+    def ctor(**kwargs):
+        fn = _ops.get(name)
+        if kwargs:
+            base = fn
+            fn = lambda x: base(x, **kwargs)  # noqa: E731
+        return BaseActivation(name=name, fn=fn)
+
+    ctor.__name__ = name
+    return ctor
+
+
+LinearActivation = _mk("linear")
+IdentityActivation = LinearActivation
+SigmoidActivation = _mk("sigmoid")
+TanhActivation = _mk("tanh")
+ReluActivation = _mk("relu")
+BReluActivation = _mk("brelu")
+SoftReluActivation = _mk("softrelu")
+STanhActivation = _mk("stanh")
+AbsActivation = _mk("abs")
+SquareActivation = _mk("square")
+ExpActivation = _mk("exponential")
+LogActivation = _mk("log")
+SoftmaxActivation = _mk("softmax")
+SequenceSoftmaxActivation = _mk("softmax")  # applied over time in layer impl
+ELUActivation = _mk("elu")
+LeakyReluActivation = _mk("leaky_relu")
+GeluActivation = _mk("gelu")
+SwishActivation = _mk("swish")
+
+
+def get(act):
+    """Normalize act argument: None -> linear; str -> registry; object -> itself."""
+    if act is None:
+        return BaseActivation("linear", _ops.identity)
+    if isinstance(act, str):
+        return BaseActivation(act, _ops.get(act))
+    return act

@@ -1,0 +1,1428 @@
+"""The ``paddle.layer`` surface — v2-compatible layer constructors.
+
+Reference parity map (``trainer_config_helpers/layers.py`` line cites):
+``data``/data_layer:72, ``fc``/fc_layer:999, ``embedding``:1045,
+``img_conv``:2379, ``img_pool``:2576, ``batch_norm``:2841, ``addto``:2975,
+``concat``:3041, ``dropout``:3650(dropout_layer), ``lstmemory``:1431,
+``grumemory``:1593, ``recurrent``:3732(recurrent_layer), ``pooling``:1268,
+``first_seq``/``last_seq``:1348/1303, ``expand``:1767, ``cos_sim``:2196,
+``classification_cost``:4390, ``cross_entropy_cost``, ``square_error_cost``,
+``max_id``:4335, ``crf``:4583, ``ctc``:4480, plus the math family
+(mixed/projections live in ``mixed.py``).
+
+Each constructor returns a :class:`LayerOutput` node; no proto, no C++ — the
+node carries a pure JAX forward closure compiled later by ``Topology``."""
+
+from __future__ import annotations
+
+import math as _pymath
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializer as I
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.lod import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.core.parameters import ParamSpec
+from paddle_tpu.layers import activation as act_mod
+from paddle_tpu.layers import pooling as pool_mod
+from paddle_tpu.layers.attr import ExtraAttr, ParamAttr, param_attr_or_default
+from paddle_tpu.layers.base import (
+    Context,
+    LayerOutput,
+    StateSpec,
+    gen_name,
+    is_sequence,
+    like,
+    map_data,
+    raw,
+)
+from paddle_tpu.layers.data_type import InputType, SeqType
+from paddle_tpu.ops import loss as loss_ops
+from paddle_tpu.ops import math as math_ops
+from paddle_tpu.ops import nn as nn_ops
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.ops.embedding import lookup as emb_lookup
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _pname(attr: ParamAttr | None, layer_name: str, suffix: str) -> str:
+    if attr is not None and attr.name:
+        return attr.name
+    return f"_{layer_name}.{suffix}"
+
+
+def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
+    a = param_attr_or_default(attr)
+    return ParamSpec(
+        name=_pname(a, layer_name, suffix),
+        shape=tuple(shape),
+        initializer=a.make_initializer(default_init),
+        is_static=a.is_static,
+        learning_rate=a.learning_rate,
+        decay_rate=a.l2_rate,
+        gradient_clipping_threshold=a.gradient_clipping_threshold,
+        sparse=a.sparse_update,
+        **kw,
+    )
+
+
+def _maybe_dropout(node: LayerOutput, layer_attr: ExtraAttr | None) -> LayerOutput:
+    if layer_attr is None or not layer_attr.drop_rate:
+        return node
+    return dropout(input=node, dropout_rate=layer_attr.drop_rate)
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+
+def data(name: str, type: InputType, height: int = 0, width: int = 0) -> LayerOutput:
+    """≅ v2 paddle.layer.data / data_layer (layers.py:72)."""
+    h, w, c = height or type.height, width or type.width, type.channels
+    if not (h and w) and c:
+        side = int(_pymath.sqrt(type.dim // c))
+        if side * side * c == type.dim:
+            h = w = side
+    return LayerOutput(
+        name=name,
+        layer_type="data",
+        size=type.dim,
+        height=h,
+        width=w,
+        depth=c or 1,
+        attrs={"data_type": type.kind, "seq_type": type.seq_type, "dim": type.dim},
+    )
+
+
+data_layer = data
+
+
+# ---------------------------------------------------------------------------
+# fully connected / embedding
+# ---------------------------------------------------------------------------
+
+
+def fc(
+    input,
+    size: int,
+    act=None,
+    param_attr: ParamAttr | Sequence[ParamAttr] | None = None,
+    bias_attr=None,
+    layer_attr: ExtraAttr | None = None,
+    name: str | None = None,
+) -> LayerOutput:
+    """≅ fc_layer (layers.py:999): multi-input weighted sum + bias + act.
+    Sequence inputs are handled per-timestep (flattened [B*T, D] matmul —
+    one big MXU call, like the reference's flattened Argument gemm)."""
+    inputs = _as_list(input)
+    name = name or gen_name("fc_layer")
+    pattrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    specs = []
+    for i, (inp, pa) in enumerate(zip(inputs, pattrs)):
+        in_size = inp.size
+        specs.append(
+            _wspec(pa, name, f"w{i}", (in_size, size), I.xavier())
+        )
+    use_bias = bias_attr is not False
+    if use_bias:
+        bspec = _wspec(
+            bias_attr if isinstance(bias_attr, ParamAttr) else None,
+            name,
+            "wbias",
+            (size,),
+            I.constant(0.0),
+        )
+        specs.append(bspec)
+    activation = act_mod.get(act)
+
+    def fwd(ctx: Context, params, states, *parents):
+        def compute(flats):
+            y = None
+            for i, x in enumerate(flats):
+                x2 = x.reshape(x.shape[0], -1) if x.ndim > 2 else x
+                t = math_ops.matmul(x2, params[specs[i].name])
+                y = t if y is None else y + t
+            if use_bias:
+                y = y + params[bspec.name]
+            return activation(y)
+
+        if any(is_sequence(p) for p in parents):
+            ref = next(p for p in parents if is_sequence(p))
+            b, t = ref.data.shape[:2]
+            flats = []
+            for p in parents:
+                d = raw(p)
+                flats.append(d.reshape(b * t, -1))
+            y = compute(flats)
+            return SequenceBatch(data=y.reshape(b, t, size), length=ref.length)
+        return compute([raw(p) for p in parents])
+
+    return _maybe_dropout(
+        LayerOutput(
+            name=name,
+            layer_type="fc",
+            size=size,
+            parents=tuple(inputs),
+            param_specs=tuple(specs),
+            fn=fwd,
+            attrs={"size": size, "active_type": activation.name},
+        ),
+        layer_attr,
+    )
+
+
+fc_layer = fc
+
+
+def embedding(
+    input: LayerOutput,
+    size: int,
+    param_attr: ParamAttr | None = None,
+    name: str | None = None,
+    padding_idx: int | None = None,
+) -> LayerOutput:
+    """≅ embedding_layer (layers.py:1045) / TableProjection.  Sparse-update
+    semantics come from XLA's scatter-add gather gradient (SelectedRows analog)."""
+    name = name or gen_name("embedding_layer")
+    vocab = input.size
+    spec = _wspec(
+        param_attr, name, "w0", (vocab, size), I.paddle_default(0.0, None), sparse=True
+    )
+
+    def fwd(ctx, params, states, ids):
+        table = params[spec.name]
+        return map_data(lambda d: emb_lookup(table, d, padding_idx), ids)
+
+    return LayerOutput(
+        name=name,
+        layer_type="embedding",
+        size=size,
+        parents=(input,),
+        param_specs=(spec,),
+        fn=fwd,
+        attrs={"size": size, "vocab": vocab},
+    )
+
+
+embedding_layer = embedding
+
+
+# ---------------------------------------------------------------------------
+# image layers (NHWC internally; accepts flat [B, C*H*W] v2 input)
+# ---------------------------------------------------------------------------
+
+
+def _to_nhwc(x: jax.Array, channels: int, height: int, width: int) -> jax.Array:
+    """v2 data layers feed flat CHW rows; image layers reshape on entry."""
+    if x.ndim == 4:
+        return x
+    b = x.shape[0]
+    return x.reshape(b, channels, height, width).transpose(0, 2, 3, 1)
+
+
+def _conv_out(sz, k, s, p):
+    return (sz + 2 * p - k) // s + 1
+
+
+def img_conv(
+    input: LayerOutput,
+    filter_size,
+    num_filters: int,
+    num_channels: int | None = None,
+    stride=1,
+    padding=0,
+    groups: int = 1,
+    act=None,
+    param_attr: ParamAttr | None = None,
+    bias_attr=None,
+    shared_biases: bool = True,
+    layer_attr: ExtraAttr | None = None,
+    name: str | None = None,
+    trans: bool = False,
+    dilation=1,
+) -> LayerOutput:
+    """≅ img_conv_layer (layers.py:2379) over ExpandConvLayer/CudnnConvLayer;
+    XLA conv on NHWC replaces im2col+gemm (paddle/function/GemmConvOp.cpp)."""
+    name = name or gen_name("conv")
+    kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    c_in = num_channels or input.depth
+    h_in, w_in = input.height, input.width
+    if not (h_in and w_in):
+        side = int(_pymath.sqrt(input.size // c_in))
+        h_in = w_in = side
+    if trans:
+        h_out = (h_in - 1) * sh + kh - 2 * ph
+        w_out = (w_in - 1) * sw + kw - 2 * pw
+    else:
+        h_out = _conv_out(h_in, kh, sh, ph)
+        w_out = _conv_out(w_in, kw, sw, pw)
+    wspec = _wspec(
+        param_attr, name, "w0", (kh, kw, c_in // groups, num_filters), I.msra()
+    )
+    specs = [wspec]
+    use_bias = bias_attr is not False
+    if use_bias:
+        bspec = _wspec(
+            bias_attr if isinstance(bias_attr, ParamAttr) else None,
+            name, "wbias", (num_filters,), I.constant(0.0),
+        )
+        specs.append(bspec)
+    activation = act_mod.get(act)
+
+    def fwd(ctx, params, states, x):
+        x = _to_nhwc(raw(x), c_in, h_in, w_in)
+        if trans:
+            y = nn_ops.conv2d_transpose(x, params[wspec.name], (sh, sw), (ph, pw))
+        else:
+            y = nn_ops.conv2d(
+                x, params[wspec.name], (sh, sw), (ph, pw), dilation=dilation, groups=groups
+            )
+        if use_bias:
+            y = y + params[bspec.name]
+        return activation(y)
+
+    return _maybe_dropout(
+        LayerOutput(
+            name=name,
+            layer_type="exconvt" if trans else "exconv",
+            size=num_filters * h_out * w_out,
+            parents=(input,),
+            param_specs=tuple(specs),
+            fn=fwd,
+            height=h_out,
+            width=w_out,
+            depth=num_filters,
+            attrs={
+                "filter_size": [kh, kw], "stride": [sh, sw], "padding": [ph, pw],
+                "num_filters": num_filters, "groups": groups, "trans": trans,
+                "active_type": activation.name,
+            },
+        ),
+        layer_attr,
+    )
+
+
+img_conv_layer = img_conv
+
+
+def img_pool(
+    input: LayerOutput,
+    pool_size,
+    num_channels: int | None = None,
+    pool_type=None,
+    stride=1,
+    padding=0,
+    layer_attr: ExtraAttr | None = None,
+    name: str | None = None,
+    ceil_mode: bool = True,
+) -> LayerOutput:
+    """≅ img_pool_layer (layers.py:2576). Reference default is ceil mode."""
+    name = name or gen_name("pool")
+    kh, kw = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    ptype = pool_mod.get(pool_type)
+    c = num_channels or input.depth
+    h_in, w_in = input.height, input.width
+    if not (h_in and w_in):
+        side = int(_pymath.sqrt(input.size // c))
+        h_in = w_in = side
+
+    def osz(sz, k, s, p):
+        if ceil_mode:
+            return int(_pymath.ceil((sz + 2 * p - k) / s)) + 1
+        return (sz + 2 * p - k) // s + 1
+
+    h_out, w_out = osz(h_in, kh, sh, ph), osz(w_in, kw, sw, pw)
+    # extra right/bottom padding for ceil mode
+    eh = max((h_out - 1) * sh + kh - 2 * ph - h_in, 0)
+    ew = max((w_out - 1) * sw + kw - 2 * pw - w_in, 0)
+
+    def fwd(ctx, params, states, x):
+        x = _to_nhwc(raw(x), c, h_in, w_in)
+        if ptype == "max":
+            xp = jnp.pad(
+                x, ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0)),
+                constant_values=-jnp.inf,
+            )
+            return nn_ops.max_pool2d(xp, (kh, kw), (sh, sw), 0)
+        # average pooling excludes padding from the divisor (the reference's
+        # cuDNN EXCLUDE_PADDING mode): reduce a ones-mask alongside the data
+        xp = jnp.pad(x, ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0)))
+        summed = nn_ops.avg_pool2d(xp, (kh, kw), (sh, sw), 0) * (kh * kw)
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        counts = nn_ops.avg_pool2d(
+            jnp.pad(ones, ((0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0))),
+            (kh, kw), (sh, sw), 0,
+        ) * (kh * kw)
+        return summed / jnp.maximum(counts, 1.0)
+
+    return _maybe_dropout(
+        LayerOutput(
+            name=name,
+            layer_type="pool",
+            size=c * h_out * w_out,
+            parents=(input,),
+            fn=fwd,
+            height=h_out,
+            width=w_out,
+            depth=c,
+            attrs={"pool_type": ptype, "pool_size": [kh, kw], "stride": [sh, sw]},
+        ),
+        layer_attr,
+    )
+
+
+img_pool_layer = img_pool
+
+
+def batch_norm(
+    input: LayerOutput,
+    act=None,
+    num_channels: int | None = None,
+    bias_attr=None,
+    param_attr: ParamAttr | None = None,
+    use_global_stats: bool | None = None,
+    moving_average_fraction: float = 0.9,
+    epsilon: float = 1e-5,
+    layer_attr: ExtraAttr | None = None,
+    name: str | None = None,
+) -> LayerOutput:
+    """≅ batch_norm_layer (layers.py:2841) over BatchNormalizationLayer.
+    Moving stats are explicit StateSpecs (pure in/out), not hidden buffers."""
+    name = name or gen_name("batch_norm")
+    c = num_channels or (input.depth if input.depth > 1 else input.size)
+    is_image = bool(input.height and input.width)
+    gamma = _wspec(param_attr, name, "w0", (c,), I.constant(1.0))
+    beta = _wspec(
+        bias_attr if isinstance(bias_attr, ParamAttr) else None,
+        name, "wbias", (c,), I.constant(0.0),
+    )
+    mean_s = StateSpec(f"_{name}.mean", (c,), 0.0)
+    var_s = StateSpec(f"_{name}.var", (c,), 1.0)
+    activation = act_mod.get(act)
+
+    def fwd(ctx, params, states, x):
+        xr = raw(x)
+        if is_image:
+            xr = _to_nhwc(xr, c, input.height, input.width)
+        training = ctx.is_train if use_global_stats is None else (not use_global_stats)
+        y, nm, nv = nn_ops.batch_norm(
+            xr, params[gamma.name], params[beta.name],
+            states[mean_s.name], states[var_s.name],
+            is_train=training, momentum=moving_average_fraction, eps=epsilon,
+        )
+        y = activation(y)
+        return like(x, y) if not is_image else y, {mean_s.name: nm, var_s.name: nv}
+
+    return _maybe_dropout(
+        LayerOutput(
+            name=name,
+            layer_type="batch_norm",
+            size=input.size,
+            parents=(input,),
+            param_specs=(gamma, beta),
+            state_specs=(mean_s, var_s),
+            fn=fwd,
+            height=input.height,
+            width=input.width,
+            depth=input.depth,
+            attrs={"channels": c, "epsilon": epsilon, "active_type": activation.name},
+        ),
+        layer_attr,
+    )
+
+
+batch_norm_layer = batch_norm
+
+
+def img_cmrnorm(
+    input: LayerOutput, size: int = 5, scale: float = 0.0001, power: float = 0.75,
+    num_channels: int | None = None, name: str | None = None,
+) -> LayerOutput:
+    """≅ img_cmrnorm_layer (LRN across channels, CMRProjectionNormLayer).
+    The reference divides alpha by the window size (config_parser.py:1362
+    ``norm_conf.scale /= norm.size``)."""
+    name = name or gen_name("norm")
+    c = num_channels or input.depth
+    eff_scale = scale / size
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, input.height, input.width)
+        return nn_ops.cross_map_normal(xr, size, eff_scale, power)
+
+    return LayerOutput(
+        name=name, layer_type="norm", size=input.size, parents=(input,), fn=fwd,
+        height=input.height, width=input.width, depth=input.depth,
+        attrs={"size": size, "scale": scale, "power": power},
+    )
+
+
+img_cmrnorm_layer = img_cmrnorm
+
+
+def maxout(input: LayerOutput, groups: int, num_channels: int | None = None,
+           name: str | None = None) -> LayerOutput:
+    """≅ maxout_layer (MaxOutLayer)."""
+    name = name or gen_name("maxout")
+    c = num_channels or input.depth
+    c_out = c // groups
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, input.height, input.width)
+        return nn_ops.maxout(xr, groups)
+
+    return LayerOutput(
+        name=name, layer_type="maxout", size=input.size // groups,
+        parents=(input,), fn=fwd,
+        height=input.height, width=input.width, depth=c_out,
+        attrs={"groups": groups},
+    )
+
+
+maxout_layer = maxout
+
+
+def bilinear_interp(input: LayerOutput, out_size_x: int, out_size_y: int,
+                    name: str | None = None) -> LayerOutput:
+    """≅ bilinear_interp_layer."""
+    name = name or gen_name("bilinear_interp")
+    c = input.depth
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, input.height, input.width)
+        return nn_ops.bilinear_interp(xr, out_size_y, out_size_x)
+
+    return LayerOutput(
+        name=name, layer_type="bilinear_interp", size=c * out_size_x * out_size_y,
+        parents=(input,), fn=fwd, height=out_size_y, width=out_size_x, depth=c,
+        attrs={"out_size_x": out_size_x, "out_size_y": out_size_y},
+    )
+
+
+bilinear_interp_layer = bilinear_interp
+
+
+def spp(input: LayerOutput, pyramid_height: int, num_channels: int | None = None,
+        pool_type=None, name: str | None = None) -> LayerOutput:
+    """≅ spp_layer (SpatialPyramidPoolLayer)."""
+    name = name or gen_name("spp")
+    c = num_channels or input.depth
+    ptype = pool_mod.get(pool_type)
+    bins = sum(4**i for i in range(pyramid_height))
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, input.height, input.width)
+        return nn_ops.spatial_pyramid_pool(xr, pyramid_height, ptype)
+
+    return LayerOutput(
+        name=name, layer_type="spp", size=c * bins, parents=(input,), fn=fwd,
+        attrs={"pyramid_height": pyramid_height, "pool_type": ptype},
+    )
+
+
+spp_layer = spp
+
+
+def pad(input: LayerOutput, pad_c=None, pad_h=None, pad_w=None,
+        name: str | None = None) -> LayerOutput:
+    """≅ pad_layer (paddle/function PadOp)."""
+    name = name or gen_name("pad")
+    pc, ph, pw = pad_c or [0, 0], pad_h or [0, 0], pad_w or [0, 0]
+    c, h, w = input.depth, input.height, input.width
+    c2, h2, w2 = c + sum(pc), h + sum(ph), w + sum(pw)
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, h, w)
+        return nn_ops.pad(xr, pc, ph, pw)
+
+    return LayerOutput(
+        name=name, layer_type="pad", size=c2 * h2 * w2, parents=(input,), fn=fwd,
+        height=h2, width=w2, depth=c2, attrs={"pad_c": pc, "pad_h": ph, "pad_w": pw},
+    )
+
+
+pad_layer = pad
+
+
+def crop(input: LayerOutput, offset, shape, name: str | None = None) -> LayerOutput:
+    """≅ crop_layer (paddle/function CropOp)."""
+    name = name or gen_name("crop")
+    c, h, w = input.depth, input.height, input.width
+    oh, ow = shape
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, h, w)
+        return nn_ops.crop(xr, offset, shape)
+
+    return LayerOutput(
+        name=name, layer_type="crop", size=c * oh * ow, parents=(input,), fn=fwd,
+        height=oh, width=ow, depth=c, attrs={"offset": list(offset), "shape": list(shape)},
+    )
+
+
+crop_layer = crop
+
+
+def rotate(input: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ rotate_layer."""
+    name = name or gen_name("rotate")
+    c, h, w = input.depth, input.height, input.width
+
+    def fwd(ctx, params, states, x):
+        return nn_ops.rotate(_to_nhwc(raw(x), c, h, w))
+
+    return LayerOutput(
+        name=name, layer_type="rotate", size=input.size, parents=(input,), fn=fwd,
+        height=w, width=h, depth=c,
+    )
+
+
+rotate_layer = rotate
+
+
+def block_expand(input: LayerOutput, block_x: int, block_y: int,
+                 stride_x: int, stride_y: int, padding_x: int = 0, padding_y: int = 0,
+                 num_channels: int | None = None, name: str | None = None) -> LayerOutput:
+    """≅ block_expand_layer (im2col -> sequence, used by OCR CRNN)."""
+    name = name or gen_name("blockexpand")
+    c = num_channels or input.depth
+    h, w = input.height, input.width
+    out_dim = block_x * block_y * c
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c, h, w)
+        patches, oh, ow = nn_ops.block_expand(
+            xr, block_y, block_x, stride_y, stride_x, padding_y, padding_x
+        )
+        b = patches.shape[0]
+        length = jnp.full((b,), patches.shape[1], jnp.int32)
+        return SequenceBatch(data=patches, length=length)
+
+    return LayerOutput(
+        name=name, layer_type="blockexpand", size=out_dim, parents=(input,), fn=fwd,
+        attrs={"block_x": block_x, "block_y": block_y, "stride_x": stride_x,
+               "stride_y": stride_y},
+    )
+
+
+block_expand_layer = block_expand
+
+
+# ---------------------------------------------------------------------------
+# element-wise / structural
+# ---------------------------------------------------------------------------
+
+
+def addto(input, act=None, bias_attr=None, name: str | None = None,
+          layer_attr: ExtraAttr | None = None) -> LayerOutput:
+    """≅ addto_layer (AddtoLayer): elementwise sum of equal-shaped inputs."""
+    inputs = _as_list(input)
+    name = name or gen_name("addto")
+    activation = act_mod.get(act)
+    use_bias = isinstance(bias_attr, ParamAttr) or bias_attr is True
+    specs = ()
+    if use_bias:
+        bspec = _wspec(
+            bias_attr if isinstance(bias_attr, ParamAttr) else None,
+            name, "wbias", (inputs[0].size,), I.constant(0.0),
+        )
+        specs = (bspec,)
+
+    def fwd(ctx, params, states, *parents):
+        y = raw(parents[0])
+        for p in parents[1:]:
+            y = y + raw(p)
+        if use_bias:
+            y = y + params[bspec.name]
+        return like(parents[0], activation(y))
+
+    return _maybe_dropout(
+        LayerOutput(
+            name=name, layer_type="addto", size=inputs[0].size, parents=tuple(inputs),
+            param_specs=specs, fn=fwd,
+            height=inputs[0].height, width=inputs[0].width, depth=inputs[0].depth,
+            attrs={"active_type": activation.name},
+        ),
+        layer_attr,
+    )
+
+
+addto_layer = addto
+
+
+def concat(input, act=None, name: str | None = None,
+           layer_attr: ExtraAttr | None = None) -> LayerOutput:
+    """≅ concat_layer (ConcatenateLayer): feature-dim concat."""
+    inputs = _as_list(input)
+    name = name or gen_name("concat")
+    activation = act_mod.get(act)
+    total = sum(i.size for i in inputs)
+    same_image = all(i.height == inputs[0].height and i.width == inputs[0].width
+                     and i.height for i in inputs)
+
+    def fwd(ctx, params, states, *parents):
+        if same_image and all(raw(p).ndim == 4 for p in parents):
+            y = jnp.concatenate([raw(p) for p in parents], axis=-1)
+            return activation(y)
+        vals = [raw(p) for p in parents]
+        if is_sequence(parents[0]):
+            y = jnp.concatenate(vals, axis=-1)
+            return SequenceBatch(data=activation(y), length=parents[0].length)
+        vals = [v.reshape(v.shape[0], -1) for v in vals]
+        return activation(jnp.concatenate(vals, axis=-1))
+
+    depth = sum(i.depth for i in inputs) if same_image else 1
+    return _maybe_dropout(
+        LayerOutput(
+            name=name, layer_type="concat", size=total, parents=tuple(inputs), fn=fwd,
+            height=inputs[0].height if same_image else 0,
+            width=inputs[0].width if same_image else 0,
+            depth=depth,
+            attrs={"active_type": activation.name},
+        ),
+        layer_attr,
+    )
+
+
+concat_layer = concat
+
+
+def dropout(input: LayerOutput, dropout_rate: float, name: str | None = None) -> LayerOutput:
+    """≅ dropout_layer (layers.py:3650)."""
+    name = name or gen_name("dropout")
+
+    def fwd(ctx, params, states, x):
+        if not ctx.is_train or dropout_rate <= 0:
+            return x
+        key = ctx.key_for(name)
+        return map_data(lambda d: nn_ops.dropout(d, dropout_rate, key, True), x)
+
+    return LayerOutput(
+        name=name, layer_type="dropout", size=input.size, parents=(input,), fn=fwd,
+        height=input.height, width=input.width, depth=input.depth,
+        attrs={"dropout_rate": dropout_rate},
+    )
+
+
+dropout_layer = dropout
+
+
+def slice(input: LayerOutput, start: int, end: int, name: str | None = None) -> LayerOutput:
+    """≅ slice feature columns [start, end)."""
+    name = name or gen_name("slice")
+
+    def fwd(ctx, params, states, x):
+        return map_data(lambda d: d[..., start:end], x)
+
+    return LayerOutput(
+        name=name, layer_type="slice", size=end - start, parents=(input,), fn=fwd,
+        attrs={"start": start, "end": end},
+    )
+
+
+def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0,
+            name: str | None = None) -> LayerOutput:
+    """≅ cos_sim (CosSimLayer)."""
+    name = name or gen_name("cos")
+
+    def fwd(ctx, params, states, xa, xb):
+        return math_ops.cos_sim(raw(xa), raw(xb), scale)[:, None]
+
+    return LayerOutput(
+        name=name, layer_type="cos", size=1, parents=(a, b), fn=fwd,
+        attrs={"scale": scale},
+    )
+
+
+def trans(input: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ trans_layer (TransLayer): matrix transpose of the feature block."""
+    name = name or gen_name("trans")
+
+    def fwd(ctx, params, states, x):
+        return jnp.swapaxes(raw(x), -1, -2)
+
+    return LayerOutput(name=name, layer_type="trans", size=input.size,
+                       parents=(input,), fn=fwd)
+
+
+trans_layer = trans
+
+
+def interpolation(input, weight: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ interpolation_layer: w*a + (1-w)*b."""
+    a, b = input
+    name = name or gen_name("interpolation")
+
+    def fwd(ctx, params, states, xa, xb, w):
+        return math_ops.interpolation(raw(xa), raw(xb), raw(w))
+
+    return LayerOutput(name=name, layer_type="interpolation", size=a.size,
+                       parents=(a, b, weight), fn=fwd)
+
+
+interpolation_layer = interpolation
+
+
+def power(input: LayerOutput, weight: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ power_layer."""
+    name = name or gen_name("power")
+
+    def fwd(ctx, params, states, x, w):
+        return math_ops.power(raw(x), raw(w))
+
+    return LayerOutput(name=name, layer_type="power", size=input.size,
+                       parents=(input, weight), fn=fwd)
+
+
+power_layer = power
+
+
+def scaling(input: LayerOutput, weight: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ scaling_layer."""
+    name = name or gen_name("scaling")
+
+    def fwd(ctx, params, states, x, w):
+        return like(x, math_ops.scaling(raw(x), raw(w)))
+
+    return LayerOutput(name=name, layer_type="scaling", size=input.size,
+                       parents=(input, weight), fn=fwd)
+
+
+scaling_layer = scaling
+
+
+def slope_intercept(input: LayerOutput, slope: float = 1.0, intercept: float = 0.0,
+                    name: str | None = None) -> LayerOutput:
+    """≅ slope_intercept_layer."""
+    name = name or gen_name("slope_intercept")
+
+    def fwd(ctx, params, states, x):
+        return map_data(lambda d: math_ops.slope_intercept(d, slope, intercept), x)
+
+    return LayerOutput(name=name, layer_type="slope_intercept", size=input.size,
+                       parents=(input,), fn=fwd,
+                       attrs={"slope": slope, "intercept": intercept})
+
+
+slope_intercept_layer = slope_intercept
+
+
+def sum_to_one_norm(input: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ sum_to_one_norm_layer."""
+    name = name or gen_name("sum_to_one_norm")
+
+    def fwd(ctx, params, states, x):
+        return map_data(math_ops.sum_to_one_norm, x)
+
+    return LayerOutput(name=name, layer_type="sum_to_one_norm", size=input.size,
+                       parents=(input,), fn=fwd)
+
+
+sum_to_one_norm_layer = sum_to_one_norm
+
+
+def row_l2_norm(input: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ row_l2_norm_layer."""
+    name = name or gen_name("row_l2_norm")
+
+    def fwd(ctx, params, states, x):
+        return map_data(math_ops.l2_normalize, x)
+
+    return LayerOutput(name=name, layer_type="row_l2_norm", size=input.size,
+                       parents=(input,), fn=fwd)
+
+
+row_l2_norm_layer = row_l2_norm
+
+
+# ---------------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------------
+
+
+def pooling(input: LayerOutput, pooling_type=None, name: str | None = None,
+            layer_attr: ExtraAttr | None = None) -> LayerOutput:
+    """≅ pooling_layer (layers.py:1268, SequencePoolLayer): seq -> vector."""
+    name = name or gen_name("seqpool")
+    ptype = pool_mod.get(pooling_type) if pooling_type is not None else "max"
+
+    fns = {
+        "max": seq_ops.seq_pool_max,
+        "average": seq_ops.seq_pool_avg,
+        "sum": seq_ops.seq_pool_sum,
+        "sqrt": seq_ops.seq_pool_sqrt,
+    }
+
+    def fwd(ctx, params, states, x):
+        if isinstance(x, NestedSequenceBatch):
+            x = x.flatten_outer()
+        return fns[ptype](x)
+
+    return LayerOutput(
+        name=name, layer_type="seqpool", size=input.size, parents=(input,), fn=fwd,
+        attrs={"pool_type": ptype},
+    )
+
+
+pooling_layer = pooling
+
+
+def last_seq(input: LayerOutput, name: str | None = None, **kw) -> LayerOutput:
+    """≅ last_seq (layers.py:1303, SequenceLastInstanceLayer)."""
+    name = name or gen_name("last_seq")
+
+    def fwd(ctx, params, states, x):
+        return seq_ops.seq_last(x)
+
+    return LayerOutput(name=name, layer_type="seqlastins", size=input.size,
+                       parents=(input,), fn=fwd)
+
+
+def first_seq(input: LayerOutput, name: str | None = None, **kw) -> LayerOutput:
+    """≅ first_seq (layers.py:1348)."""
+    name = name or gen_name("first_seq")
+
+    def fwd(ctx, params, states, x):
+        return seq_ops.seq_first(x)
+
+    return LayerOutput(name=name, layer_type="seqfirstins", size=input.size,
+                       parents=(input,), fn=fwd)
+
+
+def expand(input: LayerOutput, expand_as: LayerOutput, name: str | None = None,
+           **kw) -> LayerOutput:
+    """≅ expand_layer (layers.py:1767, ExpandLayer)."""
+    name = name or gen_name("expand")
+
+    def fwd(ctx, params, states, x, ref):
+        return seq_ops.expand(raw(x) if not is_sequence(x) else seq_ops.seq_first(x), ref)
+
+    return LayerOutput(name=name, layer_type="expand", size=input.size,
+                       parents=(input, expand_as), fn=fwd)
+
+
+expand_layer = expand
+
+
+def seq_concat(a: LayerOutput, b: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ seq_concat_layer (SequenceConcatLayer)."""
+    name = name or gen_name("seqconcat")
+
+    def fwd(ctx, params, states, xa, xb):
+        return seq_ops.seq_concat(xa, xb)
+
+    return LayerOutput(name=name, layer_type="seqconcat", size=a.size,
+                       parents=(a, b), fn=fwd)
+
+
+seq_concat_layer = seq_concat
+
+
+def seq_reshape(input: LayerOutput, reshape_size: int, name: str | None = None,
+                **kw) -> LayerOutput:
+    """≅ seq_reshape_layer (SequenceReshapeLayer)."""
+    name = name or gen_name("seqreshape")
+
+    def fwd(ctx, params, states, x):
+        return seq_ops.seq_reshape(x, reshape_size)
+
+    return LayerOutput(name=name, layer_type="seqreshape", size=reshape_size,
+                       parents=(input,), fn=fwd, attrs={"reshape_size": reshape_size})
+
+
+seq_reshape_layer = seq_reshape
+
+
+def seq_slice(input: LayerOutput, starts=None, ends=None, name: str | None = None) -> LayerOutput:
+    """≅ seq_slice_layer (SequenceSliceLayer); starts/ends are layers holding
+    per-row indices."""
+    name = name or gen_name("seq_slice")
+    parents = [input] + [p for p in (starts, ends) if p is not None]
+
+    def fwd(ctx, params, states, x, *se):
+        t = x.max_len
+        s = raw(se[0]).reshape(-1).astype(jnp.int32) if starts is not None else jnp.zeros(
+            (x.batch_size,), jnp.int32
+        )
+        e = (
+            raw(se[-1]).reshape(-1).astype(jnp.int32)
+            if ends is not None
+            else x.length
+        )
+        return seq_ops.seq_slice(x, s, e)
+
+    return LayerOutput(name=name, layer_type="seq_slice", size=input.size,
+                       parents=tuple(parents), fn=fwd)
+
+
+seq_slice_layer = seq_slice
+
+
+def context_projection_layer(
+    input: LayerOutput, context_len: int, context_start: int | None = None,
+    padding_attr=False, name: str | None = None,
+) -> LayerOutput:
+    """Standalone context projection (≅ ContextProjection via mixed_layer)."""
+    name = name or gen_name("context_projection")
+    start = context_start if context_start is not None else -(context_len // 2)
+    trainable = isinstance(padding_attr, ParamAttr) or padding_attr is True
+    specs = ()
+    if trainable:
+        n_pad = max(-start, 0) + max(start + context_len - 1, 0)
+        pspec = _wspec(
+            padding_attr if isinstance(padding_attr, ParamAttr) else None,
+            name, "w0", (max(n_pad, 1), input.size), I.constant(0.0),
+        )
+        specs = (pspec,)
+
+    def fwd(ctx, params, states, x):
+        pw = params[specs[0].name] if trainable else None
+        return seq_ops.context_projection(x, context_len, start, pw)
+
+    return LayerOutput(
+        name=name, layer_type="context_projection", size=input.size * context_len,
+        parents=(input,), param_specs=specs, fn=fwd,
+        attrs={"context_len": context_len, "context_start": start},
+    )
+
+
+def row_conv(input: LayerOutput, context_len: int, act=None,
+             param_attr: ParamAttr | None = None, name: str | None = None) -> LayerOutput:
+    """≅ row_conv_layer (RowConvLayer, DeepSpeech2 lookahead)."""
+    name = name or gen_name("row_conv")
+    wspec = _wspec(param_attr, name, "w0", (context_len, input.size), I.constant(0.0))
+    activation = act_mod.get(act)
+
+    def fwd(ctx, params, states, x):
+        y = seq_ops.row_conv(x, params[wspec.name])
+        return SequenceBatch(data=activation(y.data), length=y.length)
+
+    return LayerOutput(name=name, layer_type="row_conv", size=input.size,
+                       parents=(input,), param_specs=(wspec,), fn=fwd,
+                       attrs={"context_len": context_len})
+
+
+row_conv_layer = row_conv
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+# ---------------------------------------------------------------------------
+
+
+def recurrent(input: LayerOutput, act=None, bias_attr=None,
+              param_attr: ParamAttr | None = None, reverse: bool = False,
+              name: str | None = None) -> LayerOutput:
+    """≅ recurrent_layer (layers.py:3732, RecurrentLayer): input is the
+    pre-projected sequence; only h_{t-1} @ U runs in the scan."""
+    name = name or gen_name("recurrent")
+    d = input.size
+    wspec = _wspec(param_attr, name, "w0", (d, d), I.paddle_default())
+    specs = [wspec]
+    use_bias = bias_attr is not False
+    if use_bias:
+        bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                       name, "wbias", (d,), I.constant(0.0))
+        specs.append(bspec)
+    activation = act_mod.get(act) if act is not None else act_mod.TanhActivation()
+
+    def fwd(ctx, params, states, x):
+        eye = jnp.eye(d, dtype=jnp.float32)
+        b = params[bspec.name] if use_bias else None
+        out, _ = rnn_ops.simple_rnn(
+            x, eye, params[wspec.name], b, activation=activation, reverse=reverse
+        )
+        return out
+
+    return LayerOutput(name=name, layer_type="recurrent", size=d, parents=(input,),
+                       param_specs=tuple(specs), fn=fwd,
+                       attrs={"reverse": reverse, "active_type": activation.name})
+
+
+recurrent_layer = recurrent
+
+
+def lstmemory(input: LayerOutput, reverse: bool = False, act=None,
+              gate_act=None, state_act=None, bias_attr=None,
+              param_attr: ParamAttr | None = None, name: str | None = None,
+              **kw) -> LayerOutput:
+    """≅ lstmemory (layers.py:1431, LstmLayer): expects input of size 4*D
+    already projected (the reference requires a preceding fc/mixed of size
+    4*size).  Output size D = input.size/4."""
+    name = name or gen_name("lstmemory")
+    d = input.size // 4
+    wspec = _wspec(param_attr, name, "w0", (d, 4 * d), I.paddle_default())
+    specs = [wspec]
+    use_bias = bias_attr is not False
+    if use_bias:
+        bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                       name, "wbias", (4 * d,), I.constant(0.0))
+        specs.append(bspec)
+    ga = act_mod.get(gate_act) if gate_act else act_mod.SigmoidActivation()
+    sa = act_mod.get(state_act) if state_act else act_mod.TanhActivation()
+
+    def fwd(ctx, params, states, x):
+        b_, t = x.batch_size, x.max_len
+        xw = x.data.reshape(b_, t, 4 * d)
+        if use_bias:
+            xw = xw + params[bspec.name]
+        init = rnn_ops.LSTMState(
+            h=jnp.zeros((b_, d), jnp.float32), c=jnp.zeros((b_, d), jnp.float32)
+        )
+
+        def step(state, xt):
+            return rnn_ops.lstm_cell(xt, state, params[wspec.name], ga, sa)
+
+        last, ys = rnn_ops._masked_scan(
+            step, SequenceBatch(xw, x.length), init, reverse=reverse
+        )
+        return SequenceBatch(data=ys.h, length=x.length)
+
+    return LayerOutput(name=name, layer_type="lstmemory", size=d, parents=(input,),
+                       param_specs=tuple(specs), fn=fwd,
+                       attrs={"reverse": reverse})
+
+
+def grumemory(input: LayerOutput, reverse: bool = False, act=None,
+              gate_act=None, bias_attr=None, param_attr: ParamAttr | None = None,
+              name: str | None = None, **kw) -> LayerOutput:
+    """≅ grumemory (layers.py:1593, GruLayer): input size 3*D pre-projected."""
+    name = name or gen_name("gru")
+    d = input.size // 3
+    wspec = _wspec(param_attr, name, "w0", (d, 2 * d), I.paddle_default())
+    wcspec = _wspec(None, name, "w1", (d, d), I.paddle_default())
+    specs = [wspec, wcspec]
+    use_bias = bias_attr is not False
+    if use_bias:
+        bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                       name, "wbias", (3 * d,), I.constant(0.0))
+        specs.append(bspec)
+    ga = act_mod.get(gate_act) if gate_act else act_mod.SigmoidActivation()
+    sa = act_mod.get(act) if act else act_mod.TanhActivation()
+
+    def fwd(ctx, params, states, x):
+        b_, t = x.batch_size, x.max_len
+        xw = x.data.reshape(b_, t, 3 * d)
+        if use_bias:
+            xw = xw + params[bspec.name]
+        init = jnp.zeros((b_, d), jnp.float32)
+
+        def step(h, xt):
+            return rnn_ops.gru_cell(xt, h, params[wspec.name], params[wcspec.name], ga, sa)
+
+        last, ys = rnn_ops._masked_scan(
+            step, SequenceBatch(xw, x.length), init, reverse=reverse
+        )
+        return SequenceBatch(data=ys, length=x.length)
+
+    return LayerOutput(name=name, layer_type="gmemory", size=d, parents=(input,),
+                       param_specs=tuple(specs), fn=fwd, attrs={"reverse": reverse})
+
+
+# ---------------------------------------------------------------------------
+# output / decoding layers
+# ---------------------------------------------------------------------------
+
+
+def max_id(input: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ maxid_layer (MaxIdLayer)."""
+    name = name or gen_name("maxid")
+
+    def fwd(ctx, params, states, x):
+        return map_data(lambda d: jnp.argmax(d, axis=-1).astype(jnp.int32), x)
+
+    return LayerOutput(name=name, layer_type="maxid", size=1, parents=(input,), fn=fwd)
+
+
+maxid_layer = max_id
+
+
+def sampling_id(input: LayerOutput, name: str | None = None) -> LayerOutput:
+    """≅ sampling_id_layer (SamplingIdLayer): sample from the row distribution."""
+    name = name or gen_name("sampling_id")
+
+    def fwd(ctx, params, states, x):
+        key = ctx.key_for(name)
+        logits = jnp.log(jnp.maximum(raw(x), 1e-20))
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    return LayerOutput(name=name, layer_type="sampling_id", size=1,
+                       parents=(input,), fn=fwd)
+
+
+sampling_id_layer = sampling_id
+
+
+def eos(input: LayerOutput, eos_id: int, name: str | None = None) -> LayerOutput:
+    """≅ eos_layer (EosIdCheckLayer)."""
+    name = name or gen_name("eos")
+
+    def fwd(ctx, params, states, x):
+        return (raw(x) == eos_id).astype(jnp.int32)
+
+    return LayerOutput(name=name, layer_type="eos_id", size=1, parents=(input,),
+                       fn=fwd, attrs={"eos_id": eos_id})
+
+
+eos_layer = eos
+
+
+# ---------------------------------------------------------------------------
+# cost layers
+# ---------------------------------------------------------------------------
+
+
+def _cost_node(name, ltype, parents, fwd, attrs=None, specs=()):
+    return LayerOutput(
+        name=name, layer_type=ltype, size=1, parents=tuple(parents),
+        param_specs=tuple(specs), fn=fwd, attrs=dict(attrs or {}),
+    )
+
+
+def _mean_over_batch(per_example):
+    return jnp.mean(per_example)
+
+
+def classification_cost(input: LayerOutput, label: LayerOutput, weight=None,
+                        name: str | None = None, evaluator=None,
+                        coeff: float = 1.0) -> LayerOutput:
+    """≅ classification_cost (layers.py:4390): input is post-softmax probs;
+    adds a classification-error metric like the reference's auto evaluator."""
+    name = name or gen_name("cost")
+    parents = [input, label] + ([weight] if weight is not None else [])
+
+    def fwd(ctx, params, states, probs, lbl, *w):
+        p = raw(probs)
+        y = raw(lbl).reshape(-1)
+        ce = loss_ops.cross_entropy(p, y)
+        if w:
+            ce = ce * raw(w[0]).reshape(-1)
+        return coeff * _mean_over_batch(ce)
+
+    node = _cost_node(name, "multi-class-cross-entropy", parents, fwd,
+                      {"coeff": coeff})
+    node.attrs["metric"] = ("classification_error", input.name, label.name)
+    return node
+
+
+def cross_entropy_cost(input: LayerOutput, label: LayerOutput,
+                       name: str | None = None, coeff: float = 1.0) -> LayerOutput:
+    """≅ cross_entropy (CostLayer MultiClassCrossEntropy)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, probs, lbl):
+        return coeff * _mean_over_batch(
+            loss_ops.cross_entropy(raw(probs), raw(lbl).reshape(-1))
+        )
+
+    return _cost_node(name, "multi-class-cross-entropy", [input, label], fwd)
+
+
+cross_entropy = cross_entropy_cost
+
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha: float = 0.1,
+                                name=None) -> LayerOutput:
+    """≅ cross_entropy_with_selfnorm (CostLayer)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, probs, lbl):
+        p = raw(probs)
+        ce = loss_ops.cross_entropy(p, raw(lbl).reshape(-1))
+        z = jnp.sum(p, axis=-1)
+        return _mean_over_batch(ce + softmax_selfnorm_alpha * jnp.log(z) ** 2)
+
+    return _cost_node(name, "multi_class_cross_entropy_with_selfnorm",
+                      [input, label], fwd)
+
+
+def square_error_cost(input: LayerOutput, label: LayerOutput,
+                      name: str | None = None, coeff: float = 1.0) -> LayerOutput:
+    """≅ square_error_cost / regression_cost (SumOfSquaresCostLayer)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, pred, lbl):
+        return coeff * _mean_over_batch(loss_ops.square_error(raw(pred), raw(lbl)))
+
+    return _cost_node(name, "square_error", [input, label], fwd)
+
+
+regression_cost = square_error_cost
+
+
+def mse_cost(input, label, name=None, coeff: float = 1.0):
+    return square_error_cost(input, label, name=name, coeff=coeff)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None,
+                                     coeff: float = 1.0) -> LayerOutput:
+    """≅ multi_binary_label_cross_entropy (MultiBinaryLabelCrossEntropy)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, p, lbl):
+        return coeff * _mean_over_batch(
+            loss_ops.binary_cross_entropy(raw(p), raw(lbl))
+        )
+
+    return _cost_node(name, "multi_binary_label_cross_entropy", [input, label], fwd)
+
+
+def smooth_l1_cost(input, label, name=None, coeff: float = 1.0) -> LayerOutput:
+    """≅ smooth_l1_cost (SmoothL1CostLayer)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, p, lbl):
+        return coeff * _mean_over_batch(loss_ops.smooth_l1(raw(p), raw(lbl)))
+
+    return _cost_node(name, "smooth_l1", [input, label], fwd)
+
+
+def huber_regression_cost(input, label, delta: float = 1.0, name=None,
+                          coeff: float = 1.0) -> LayerOutput:
+    """≅ huber_regression_cost."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, p, lbl):
+        return coeff * _mean_over_batch(loss_ops.huber_regression(raw(p), raw(lbl), delta))
+
+    return _cost_node(name, "huber_regression", [input, label], fwd)
+
+
+def huber_classification_cost(input, label, name=None, coeff: float = 1.0) -> LayerOutput:
+    """≅ huber_classification_cost (HuberTwoClassification)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, p, lbl):
+        return coeff * _mean_over_batch(
+            loss_ops.huber_classification(raw(p), raw(lbl))
+        )
+
+    return _cost_node(name, "huber_classification", [input, label], fwd)
+
+
+def rank_cost(left: LayerOutput, right: LayerOutput, label: LayerOutput,
+              weight=None, name=None, coeff: float = 1.0) -> LayerOutput:
+    """≅ rank_cost (RankingCost)."""
+    name = name or gen_name("cost")
+    parents = [left, right, label] + ([weight] if weight is not None else [])
+
+    def fwd(ctx, params, states, l, r, lbl, *w):
+        c = loss_ops.rank_cost(raw(l), raw(r), raw(lbl))
+        if w:
+            c = c * raw(w[0]).reshape(-1)
+        return coeff * _mean_over_batch(c)
+
+    return _cost_node(name, "rank-cost", parents, fwd)
+
+
+def lambda_cost(input: LayerOutput, score: LayerOutput, NDCG_num: int = 5,
+                max_sort_size: int = -1, name=None) -> LayerOutput:
+    """≅ lambda_cost (LambdaCost) over a sequence of scores."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, x, s):
+        return _mean_over_batch(
+            loss_ops.lambda_cost(raw(x), raw(s), x.mask() if is_sequence(x) else
+                                 jnp.ones(raw(x).shape[:2]), NDCG_num)
+        )
+
+    return _cost_node(name, "lambda_cost", [input, score], fwd)
+
+
+def sum_cost(input: LayerOutput, name=None) -> LayerOutput:
+    """≅ sum_cost (SumCostLayer)."""
+    name = name or gen_name("cost")
+
+    def fwd(ctx, params, states, x):
+        return jnp.mean(loss_ops.sum_cost(raw(x)))
+
+    return _cost_node(name, "sum_cost", [input], fwd)
+
+
+def nce(input, label, num_classes: int, num_neg_samples: int = 10,
+        param_attr=None, bias_attr=None, name=None) -> LayerOutput:
+    """≅ nce_layer (NCELayer) with uniform noise sampling."""
+    name = name or gen_name("nce")
+    inputs = _as_list(input)
+    enforce(len(inputs) == 1, "nce: single hidden input supported")
+    d = inputs[0].size
+    wspec = _wspec(param_attr, name, "w0", (num_classes, d), I.paddle_default())
+    bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                   name, "wbias", (num_classes,), I.constant(0.0))
+
+    def fwd(ctx, params, states, x, lbl):
+        key = ctx.key_for(name)
+        b = raw(x).shape[0]
+        noise = jax.random.randint(key, (b, num_neg_samples), 0, num_classes)
+        c = loss_ops.nce_loss(raw(x), params[wspec.name], params[bspec.name],
+                              raw(lbl).reshape(-1).astype(jnp.int32), noise, num_classes)
+        return _mean_over_batch(c)
+
+    return _cost_node(name, "nce", [inputs[0], label], fwd,
+                      specs=[wspec, bspec])
+
+
+nce_layer = nce
+
+
+def hsigmoid(input, label, num_classes: int, param_attr=None, bias_attr=None,
+             name=None) -> LayerOutput:
+    """≅ hsigmoid (HierarchicalSigmoidLayer)."""
+    name = name or gen_name("hsigmoid")
+    inputs = _as_list(input)
+    d = sum(i.size for i in inputs)
+    wspec = _wspec(param_attr, name, "w0", (num_classes - 1, d), I.paddle_default())
+    bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                   name, "wbias", (num_classes - 1,), I.constant(0.0))
+
+    def fwd(ctx, params, states, *parents):
+        xs = [raw(p) for p in parents[:-1]]
+        x = jnp.concatenate([v.reshape(v.shape[0], -1) for v in xs], axis=-1)
+        lbl = raw(parents[-1]).reshape(-1).astype(jnp.int32)
+        return _mean_over_batch(
+            loss_ops.hsigmoid_loss(x, params[wspec.name], params[bspec.name],
+                                   lbl, num_classes)
+        )
+
+    return _cost_node(name, "hsigmoid", inputs + [label], fwd, specs=[wspec, bspec])
+
+
+hsigmoid_layer = hsigmoid
+
+
+# populated lazily to avoid import cycles: crf/ctc/recurrent_group live in
+# sibling modules re-exported here at bottom of file.
+
+
+def mixed(*args, **kwargs):
+    from paddle_tpu.layers import mixed as _m
+
+    return _m.mixed(*args, **kwargs)
+
+
+def __getattr__(name):
+    # lazy re-exports from sibling modules (mixed/crf/ctc/recurrent_group/attention)
+    import importlib
+
+    for modname in ("mixed", "extras", "recurrent_group"):
+        try:
+            mod = importlib.import_module(f"paddle_tpu.layers.{modname}")
+        except ImportError:
+            continue
+        if hasattr(mod, name):
+            obj = getattr(mod, name)
+            globals()[name] = obj
+            return obj
+    raise AttributeError(f"module 'paddle_tpu.layer' has no attribute {name!r}")

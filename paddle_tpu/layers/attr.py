@@ -1,0 +1,61 @@
+"""Attribute objects — successor of ``trainer_config_helpers/attrs.py``
+(ParameterAttribute / ExtraLayerAttribute): per-parameter init, LR scale,
+decay, sparsity, and per-layer dropout/device hints."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class ParamAttr:
+    """≅ ParameterAttribute (attrs.py): controls one parameter's init/decay/LR."""
+
+    name: str | None = None  # share parameters by giving two layers one name
+    is_static: bool = False
+    initial_std: float | None = None
+    initial_mean: float | None = None
+    initial_max: float | None = None  # uniform bounds
+    initial_min: float | None = None
+    learning_rate: float = 1.0
+    l2_rate: float | None = None  # per-param decay override
+    sparse_update: bool = False
+    gradient_clipping_threshold: float | None = None
+    initializer: Callable | None = None  # direct override
+
+    def make_initializer(self, default: Callable) -> Callable:
+        from paddle_tpu.core import initializer as I
+
+        if self.initializer is not None:
+            return self.initializer
+        if self.initial_max is not None or self.initial_min is not None:
+            lo = self.initial_min if self.initial_min is not None else -1.0
+            hi = self.initial_max if self.initial_max is not None else 1.0
+            return I.uniform(lo, hi)
+        if self.initial_std is not None or self.initial_mean is not None:
+            return I.paddle_default(self.initial_mean or 0.0, self.initial_std)
+        return default
+
+
+ParameterAttribute = ParamAttr  # reference alias
+
+
+@dataclasses.dataclass
+class ExtraAttr:
+    """≅ ExtraLayerAttribute: layer-level knobs (dropout etc.)."""
+
+    drop_rate: float = 0.0
+    device: int | None = None  # kept for API compat; sharding supersedes it
+    error_clipping_threshold: float | None = None
+
+
+ExtraLayerAttribute = ExtraAttr
+
+
+def param_attr_or_default(attr: ParamAttr | None) -> ParamAttr:
+    return attr if attr is not None else ParamAttr()
+
+
+def to_kwargs(obj: Any) -> dict:
+    return dataclasses.asdict(obj) if obj is not None else {}

@@ -1,0 +1,70 @@
+"""Input type declarations — successor of ``python/paddle/v2/data_type.py`` /
+``trainer/PyDataProvider2.py`` InputType (dense_vector, integer_value,
+sparse_binary_vector, and their _sequence variants)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class SeqType:
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+class DataKind:
+    DENSE = "dense"
+    INTEGER = "integer"
+    SPARSE_BINARY = "sparse_binary"
+    SPARSE_FLOAT = "sparse_float"
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    dim: int
+    seq_type: int = SeqType.NO_SEQUENCE
+    kind: str = DataKind.DENSE
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+
+def dense_vector(dim: int, height: int = 0, width: int = 0, channels: int = 0) -> InputType:
+    return InputType(dim, SeqType.NO_SEQUENCE, DataKind.DENSE, height, width, channels)
+
+
+def dense_array(dim, **kw) -> InputType:  # alias used by some demos
+    return dense_vector(dim, **kw)
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType(value_range, SeqType.NO_SEQUENCE, DataKind.INTEGER)
+
+
+def sparse_binary_vector(dim: int) -> InputType:
+    return InputType(dim, SeqType.NO_SEQUENCE, DataKind.SPARSE_BINARY)
+
+
+def sparse_float_vector(dim: int) -> InputType:
+    return InputType(dim, SeqType.NO_SEQUENCE, DataKind.SPARSE_FLOAT)
+
+
+def dense_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SeqType.SEQUENCE, DataKind.DENSE)
+
+
+def integer_value_sequence(value_range: int) -> InputType:
+    return InputType(value_range, SeqType.SEQUENCE, DataKind.INTEGER)
+
+
+def sparse_binary_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SeqType.SEQUENCE, DataKind.SPARSE_BINARY)
+
+
+def integer_value_sub_sequence(value_range: int) -> InputType:
+    return InputType(value_range, SeqType.SUB_SEQUENCE, DataKind.INTEGER)
+
+
+def dense_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, SeqType.SUB_SEQUENCE, DataKind.DENSE)

@@ -1,0 +1,134 @@
+"""Composite network helpers — successor of
+``trainer_config_helpers/networks.py`` (simple_img_conv_pool, img_conv_group,
+simple_lstm, bidirectional_lstm, simple_gru, vgg_16_network …)."""
+
+from __future__ import annotations
+
+from paddle_tpu.layers import activation as act_mod
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import pooling as pool_mod
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size, name=None,
+                         pool_type=None, act=None, groups=1, conv_stride=1,
+                         conv_padding=0, num_channel=None, param_attr=None,
+                         pool_stride=1, pool_padding=0, **kw):
+    """≅ networks.simple_img_conv_pool."""
+    conv = layer.img_conv(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel, stride=conv_stride, padding=conv_padding,
+        groups=groups, act=act, param_attr=param_attr,
+        name=f"{name}_conv" if name else None,
+    )
+    return layer.img_pool(
+        input=conv, pool_size=pool_size, pool_type=pool_type,
+        stride=pool_stride, padding=pool_padding,
+        name=f"{name}_pool" if name else None,
+    )
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   pool_stride=1, pool_type=None, **kw):
+    """≅ networks.img_conv_group (the VGG building block)."""
+    tmp = input
+    if not isinstance(conv_padding, list):
+        conv_padding = [conv_padding] * len(conv_num_filter)
+    if not isinstance(conv_batchnorm_drop_rate, list):
+        conv_batchnorm_drop_rate = [conv_batchnorm_drop_rate] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        tmp = layer.img_conv(
+            input=tmp, filter_size=conv_filter_size, num_filters=nf,
+            num_channels=num_channels if i == 0 else None,
+            padding=conv_padding[i],
+            act=act_mod.LinearActivation() if conv_with_batchnorm else conv_act,
+        )
+        if conv_with_batchnorm:
+            tmp = layer.batch_norm(input=tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i]:
+                tmp = layer.dropout(input=tmp, dropout_rate=conv_batchnorm_drop_rate[i])
+    return layer.img_pool(input=tmp, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type or pool_mod.MaxPooling())
+
+
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None, **kw):
+    """≅ networks.simple_lstm: fc(4*size) -> lstmemory."""
+    fc = layer.fc(input=input, size=size * 4, act=act_mod.LinearActivation(),
+                  param_attr=mat_param_attr, bias_attr=bias_param_attr,
+                  name=f"{name}_transform" if name else None)
+    return layer.lstmemory(input=fc, reverse=reverse, param_attr=inner_param_attr,
+                           act=act, gate_act=gate_act, state_act=state_act,
+                           name=name)
+
+
+def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
+               gru_param_attr=None, act=None, gate_act=None, **kw):
+    """≅ networks.simple_gru: fc(3*size) -> grumemory."""
+    fc = layer.fc(input=input, size=size * 3, act=act_mod.LinearActivation(),
+                  param_attr=mixed_param_attr,
+                  name=f"{name}_transform" if name else None)
+    return layer.grumemory(input=fc, reverse=reverse, param_attr=gru_param_attr,
+                           act=act, gate_act=gate_act, name=name)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False, **kw):
+    """≅ networks.bidirectional_lstm: fwd+bwd simple_lstm, concat."""
+    fwd = simple_lstm(input=input, size=size, name=f"{name}_fw" if name else None)
+    bwd = simple_lstm(input=input, size=size, reverse=True,
+                      name=f"{name}_bw" if name else None)
+    if return_seq:
+        return layer.concat(input=[fwd, bwd])
+    f_last = layer.last_seq(input=fwd)
+    b_first = layer.first_seq(input=bwd)
+    return layer.concat(input=[f_last, b_first])
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False, **kw):
+    """≅ networks.bidirectional_gru."""
+    fwd = simple_gru(input=input, size=size, name=f"{name}_fw" if name else None)
+    bwd = simple_gru(input=input, size=size, reverse=True,
+                     name=f"{name}_bw" if name else None)
+    if return_seq:
+        return layer.concat(input=[fwd, bwd])
+    f_last = layer.last_seq(input=fwd)
+    b_first = layer.first_seq(input=bwd)
+    return layer.concat(input=[f_last, b_first])
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None, context_proj_param_attr=None,
+                       fc_param_attr=None, fc_act=None, **kw):
+    """≅ networks.sequence_conv_pool (text conv: context window + fc + pool)."""
+    proj = layer.context_projection_layer(
+        input=input, context_len=context_len, context_start=context_start,
+        padding_attr=context_proj_param_attr or False,
+        name=f"{name}_proj" if name else None,
+    )
+    fc = layer.fc(input=proj, size=hidden_size, act=fc_act or act_mod.TanhActivation(),
+                  param_attr=fc_param_attr, name=f"{name}_fc" if name else None)
+    return layer.pooling(input=fc, pooling_type=pool_type or pool_mod.MaxPooling(),
+                         name=f"{name}_pool" if name else None)
+
+
+def text_conv_pool(input, context_len=5, hidden_size=128, **kw):
+    return sequence_conv_pool(input, context_len, hidden_size, **kw)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """≅ networks.vgg_16_network."""
+    tmp = input_image
+    for i, (n, nf) in enumerate([(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]):
+        tmp = img_conv_group(
+            input=tmp, conv_num_filter=[nf] * n, pool_size=2,
+            num_channels=num_channels if i == 0 else None,
+            conv_act=act_mod.ReluActivation(), pool_stride=2,
+            pool_type=pool_mod.MaxPooling(),
+        )
+    tmp = layer.fc(input=tmp, size=4096, act=act_mod.ReluActivation())
+    tmp = layer.dropout(input=tmp, dropout_rate=0.5)
+    tmp = layer.fc(input=tmp, size=4096, act=act_mod.ReluActivation())
+    tmp = layer.dropout(input=tmp, dropout_rate=0.5)
+    return layer.fc(input=tmp, size=num_classes, act=act_mod.SoftmaxActivation())

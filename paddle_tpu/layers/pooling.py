@@ -1,0 +1,49 @@
+"""Pooling-type objects — successor of ``trainer_config_helpers/poolings.py``
+(MaxPooling/AvgPooling/SumPooling/SqrtAvgPooling passed to ``pooling_layer``)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class BasePoolingType:
+    name: str
+
+
+class MaxPooling(BasePoolingType):
+    def __init__(self):
+        super().__init__("max")
+
+
+class AvgPooling(BasePoolingType):
+    def __init__(self):
+        super().__init__("average")
+
+
+class SumPooling(BasePoolingType):
+    def __init__(self):
+        super().__init__("sum")
+
+
+class SqrtAvgPooling(BasePoolingType):
+    """Sum scaled by 1/sqrt(len) — reference 'average-sqrt' mode."""
+
+    def __init__(self):
+        super().__init__("sqrt")
+
+
+class CudnnMaxPooling(MaxPooling):  # API-compat aliases (no cudnn on TPU)
+    pass
+
+
+class CudnnAvgPooling(AvgPooling):
+    pass
+
+
+def get(p) -> str:
+    if p is None:
+        return "max"
+    if isinstance(p, str):
+        return p
+    return p.name

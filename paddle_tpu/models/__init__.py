@@ -1,0 +1,5 @@
+"""Model zoo — the reference's demo/benchmark configs rebuilt on the new API:
+``v1_api_demo/mnist/light_mnist.py`` (LeNet), ``benchmark/paddle/image/*``
+(alexnet/googlenet/resnet/vgg/smallnet), ``benchmark/paddle/rnn/rnn.py``
+(IMDB LSTM), plus the book models the north star names (seq2seq NMT,
+Wide&Deep CTR, OCR CRNN)."""

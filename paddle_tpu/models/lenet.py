@@ -1,0 +1,36 @@
+"""LeNet-style MNIST convnet — rebuild of
+``v1_api_demo/mnist/light_mnist.py`` (conv-pool ×2 + fc softmax)."""
+
+from __future__ import annotations
+
+from paddle_tpu.layers import activation as act
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type
+from paddle_tpu.layers.networks import simple_img_conv_pool
+
+
+def lenet(img=None, class_num: int = 10):
+    """Returns (predict LayerOutput, images data layer, label data layer)."""
+    if img is None:
+        img = layer.data(
+            name="pixel", type=data_type.dense_vector(784, channels=1)
+        )
+    conv_pool_1 = simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, num_channel=1,
+        pool_size=2, pool_stride=2, act=act.ReluActivation(), name="c1",
+    )
+    conv_pool_2 = simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50,
+        pool_size=2, pool_stride=2, act=act.ReluActivation(), name="c2",
+    )
+    predict = layer.fc(
+        input=conv_pool_2, size=class_num, act=act.SoftmaxActivation()
+    )
+    label = layer.data(name="label", type=data_type.integer_value(class_num))
+    return predict, img, label
+
+
+def lenet_cost(class_num: int = 10):
+    predict, img, label = lenet(class_num=class_num)
+    cost = layer.classification_cost(input=predict, label=label)
+    return cost, predict, img, label

@@ -1,0 +1,10 @@
+"""Functional op library — the XLA-native replacement of the reference's three
+kernel layers: ``paddle/cuda`` (hl_* CUDA HAL), ``paddle/math`` (Matrix ops),
+and ``paddle/function`` (device-tagged kernel registry).
+
+Every op is a pure function on jax arrays; device dispatch (the reference's
+CPU/GPU REGISTER_TYPED_FUNC split, ``Function.h:165-207``) is XLA's job, and
+the CPU-stub mechanism of ``paddle/cuda/include/stub`` maps to jax backends.
+Hot fused kernels live in ``paddle_tpu.ops.pallas``."""
+
+from paddle_tpu.ops import activations, embedding, loss, math, nn, rnn, sequence  # noqa: F401

@@ -1,0 +1,136 @@
+"""Activation functions — successor of ``paddle/gserver/activations/
+ActivationFunction.cpp`` (sigmoid/softmax/relu/brelu/tanh/stanh/softrelu/abs/
+square/exponential/log identity registry) and Fluid's 20 activation ops
+(``paddle/operators/activation_op.cc``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+identity = lambda x: x  # noqa: E731
+linear = identity
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0):
+    """Bounded relu (reference BReluActivation: clip to [0, 24])."""
+    return jnp.clip(x, t_min, t_max)
+
+
+def softrelu(x, threshold: float = 40.0):
+    """log(1+exp(x)), input clipped like the reference's SoftReluActivation."""
+    return jax.nn.softplus(jnp.clip(x, -threshold, threshold))
+
+
+def stanh(x, scale_a: float = 2.0 / 3.0, scale_b: float = 1.7159):
+    """Scaled tanh (reference STanhActivation: 1.7159 * tanh(2x/3))."""
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def abs_act(x):
+    return jnp.abs(x)
+
+
+def square(x):
+    return x * x
+
+
+def exponential(x):
+    return jnp.exp(x)
+
+
+def log_act(x):
+    return jnp.log(x)
+
+
+def sqrt_act(x):
+    return jnp.sqrt(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def leaky_relu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def swish(x):
+    return jax.nn.swish(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+# registry keyed by the reference's activation type strings
+# (ActivationFunction::create names)
+REGISTRY = {
+    "": identity,
+    "linear": identity,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "relu": relu,
+    "brelu": brelu,
+    "softrelu": softrelu,
+    "stanh": stanh,
+    "abs": abs_act,
+    "square": square,
+    "exponential": exponential,
+    "log": log_act,
+    "sqrt": sqrt_act,
+    "reciprocal": reciprocal,
+    "softmax": softmax,
+    "elu": elu,
+    "leaky_relu": leaky_relu,
+    "relu6": relu6,
+    "gelu": gelu,
+    "swish": swish,
+    "softsign": softsign,
+    "hard_sigmoid": hard_sigmoid,
+    "thresholded_relu": thresholded_relu,
+}
+
+
+def get(name: str):
+    return REGISTRY[name]

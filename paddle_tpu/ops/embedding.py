@@ -1,0 +1,27 @@
+"""Embedding lookup — successor of ``TableProjection``/``lookup_table_op`` and
+the sparse-row machinery (``paddle/math/SparseRowMatrix.h:204-299``,
+``SelectedRows``).
+
+On TPU the table is a dense HBM array (shardable over a mesh axis — see
+``paddle_tpu.parallel``); lookup is a gather the MXU-adjacent hardware does
+well, and "sparse update" semantics (only touched rows change) fall out of
+XLA's scatter-add gradient for gather — no pserver prefetch needed
+(replaces ``TrainerInternal.cpp:93-97`` remote sparse prefetch)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def lookup(table: jax.Array, ids: jax.Array, padding_idx: int | None = None) -> jax.Array:
+    """table[V, D] gathered by integer ids of any shape -> [..., D]."""
+    out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        keep = (ids != padding_idx)[..., None]
+        out = jnp.where(keep, out, 0.0)
+    return out
+
+
+def one_hot(ids: jax.Array, depth: int, dtype=jnp.float32) -> jax.Array:
+    return jax.nn.one_hot(ids.astype(jnp.int32), depth, dtype=dtype)

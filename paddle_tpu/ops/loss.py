@@ -1,0 +1,154 @@
+"""Cost functions — successor of ``paddle/gserver/layers/CostLayer.cpp``
+(~15 cost layer types) and Fluid's cross_entropy/softmax_with_cross_entropy/
+smooth_l1/huber/rank ops.  All return per-example costs [B]; the trainer takes
+the batch mean like ``Argument::sum`` over the cost layer output."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(probs: jax.Array, label: jax.Array, eps: float = 1e-10) -> jax.Array:
+    """-log p[label] with integer labels (≅ MultiClassCrossEntropy).
+    ``probs`` are post-softmax, as in the v2 classification_cost contract."""
+    p = jnp.take_along_axis(probs, label[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return -jnp.log(p + eps)
+
+
+def softmax_cross_entropy_with_logits(logits: jax.Array, label: jax.Array) -> jax.Array:
+    """Fused, numerically-stable version (≅ Fluid softmax_with_cross_entropy_op);
+    the one compiled train steps should use."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, label[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def soft_cross_entropy(probs: jax.Array, soft_label: jax.Array, eps: float = 1e-10) -> jax.Array:
+    """Cross entropy against a distribution (≅ soft_binary_class_cross_entropy)."""
+    return -jnp.sum(soft_label * jnp.log(probs + eps), axis=-1)
+
+
+def binary_cross_entropy(p: jax.Array, label: jax.Array, eps: float = 1e-10) -> jax.Array:
+    """Element-wise BCE summed over features (≅ MultiBinaryLabelCrossEntropy)."""
+    label = label.astype(p.dtype)
+    ce = -(label * jnp.log(p + eps) + (1.0 - label) * jnp.log(1.0 - p + eps))
+    return jnp.sum(ce, axis=-1) if ce.ndim > 1 else ce
+
+
+def sigmoid_cross_entropy_with_logits(logits: jax.Array, label: jax.Array) -> jax.Array:
+    z = label.astype(logits.dtype)
+    ce = jnp.maximum(logits, 0) - logits * z + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(ce, axis=-1) if ce.ndim > 1 else ce
+
+
+def square_error(pred: jax.Array, label: jax.Array) -> jax.Array:
+    """Sum-of-squares cost (≅ SumOfSquaresCostLayer, v2 square_error_cost:
+    0.5 * ||pred - label||^2 per row)."""
+    d = pred - label.astype(pred.dtype)
+    return 0.5 * jnp.sum(d * d, axis=-1)
+
+
+def smooth_l1(pred: jax.Array, label: jax.Array, delta: float = 1.0) -> jax.Array:
+    """(≅ SmoothL1CostLayer / Fluid smooth_l1_op)."""
+    d = jnp.abs(pred - label.astype(pred.dtype))
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return jnp.sum(loss, axis=-1)
+
+
+def huber_regression(pred: jax.Array, label: jax.Array, delta: float = 1.0) -> jax.Array:
+    """(≅ HuberRegressionLoss)."""
+    d = jnp.abs(pred - label.astype(pred.dtype))
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return jnp.sum(loss, axis=-1) if loss.ndim > 1 else loss
+
+
+def huber_classification(pred: jax.Array, label: jax.Array) -> jax.Array:
+    """Two-class huber (≅ HuberTwoClassification): labels {0,1} -> y in {-1,1}."""
+    y = 2.0 * label.astype(pred.dtype) - 1.0
+    z = pred[:, 0] if pred.ndim > 1 else pred
+    yz = y * z
+    return jnp.where(yz < -1.0, -4.0 * yz, jnp.where(yz < 1.0, (1.0 - yz) ** 2, 0.0))
+
+
+def hinge(pred: jax.Array, label: jax.Array) -> jax.Array:
+    y = 2.0 * label.astype(pred.dtype) - 1.0
+    z = pred[:, 0] if pred.ndim > 1 else pred
+    return jnp.maximum(0.0, 1.0 - y * z)
+
+
+def rank_cost(left: jax.Array, right: jax.Array, label: jax.Array) -> jax.Array:
+    """Pairwise rank cost (≅ RankingCost): o = left-right,
+    C = -label*o + log(1+exp(o))."""
+    o = (left - right).reshape(-1)
+    lbl = label.astype(o.dtype).reshape(-1)
+    return jnp.log1p(jnp.exp(o)) - lbl * o
+
+
+def lambda_cost(score: jax.Array, label: jax.Array, mask: jax.Array, ndcg_num: int = 5):
+    """LambdaRank cost over a (padded) list (≅ LambdaCost).  Simplified:
+    pairwise logistic weighted by |ΔNDCG| is approximated by pairwise logistic
+    on valid pairs — adequate for parity tests, documented divergence."""
+    s = score[..., 0] if score.ndim > 2 else score
+    diff = s[:, :, None] - s[:, None, :]
+    lbl = label.astype(s.dtype)
+    pref = jnp.sign(lbl[:, :, None] - lbl[:, None, :])
+    valid = mask[:, :, None] * mask[:, None, :]
+    pair_loss = jnp.log1p(jnp.exp(-pref * diff)) * (pref != 0) * valid
+    return jnp.sum(pair_loss, axis=(1, 2)) / jnp.maximum(jnp.sum(valid, axis=(1, 2)), 1.0)
+
+
+def multi_binary_label_cross_entropy(p: jax.Array, labels: jax.Array) -> jax.Array:
+    return binary_cross_entropy(p, labels)
+
+
+def sum_cost(x: jax.Array) -> jax.Array:
+    """(≅ SumCostLayer): sum over features."""
+    return jnp.sum(x, axis=-1) if x.ndim > 1 else x
+
+
+def nce_loss(
+    embed: jax.Array,  # [B, D] hidden
+    w: jax.Array,  # [V, D] output embedding table
+    b: jax.Array,  # [V]
+    label: jax.Array,  # [B] int
+    noise_ids: jax.Array,  # [B, K] sampled negative classes
+    num_classes: int,
+) -> jax.Array:
+    """Noise-contrastive estimation (≅ NCELayer) with uniform noise dist."""
+    k = noise_ids.shape[-1]
+    log_noise = jnp.log(jnp.asarray(k / num_classes, embed.dtype))
+    pos_logit = jnp.sum(embed * w[label], axis=-1) + b[label]
+    neg_logit = jnp.einsum("bd,bkd->bk", embed, w[noise_ids]) + b[noise_ids]
+    pos_loss = jax.nn.softplus(-(pos_logit - log_noise))
+    neg_loss = jax.nn.softplus(neg_logit - log_noise)
+    return pos_loss + jnp.sum(neg_loss, axis=-1)
+
+
+def hsigmoid_loss(
+    x: jax.Array,  # [B, D]
+    w: jax.Array,  # [num_classes-1, D] internal-node weights
+    b: jax.Array,  # [num_classes-1]
+    label: jax.Array,  # [B]
+    num_classes: int,
+) -> jax.Array:
+    """Hierarchical sigmoid over a complete binary tree (≅ HierarchicalSigmoidLayer,
+    ``paddle/math/MathFunctions`` binary-code path)."""
+    code_len = max((num_classes - 1).bit_length(), 1)
+    idx = label.astype(jnp.int32) + num_classes  # leaf position in heap order
+
+    def body(carry, _):
+        idx, loss = carry
+        parent = idx // 2
+        is_right = (idx % 2).astype(x.dtype)
+        active = (parent >= 1).astype(x.dtype)
+        node = jnp.maximum(parent - 1, 0)  # heap node 1.. -> row 0..
+        logit = jnp.sum(x * w[node], axis=-1) + b[node]
+        # reference codes: sign = 1 - 2*code (left=+ right=-)
+        y = 1.0 - 2.0 * is_right
+        loss = loss + active * jax.nn.softplus(-y * logit)
+        return (parent, loss), None
+
+    (_, total), _ = jax.lax.scan(
+        body, (idx, jnp.zeros(x.shape[0], x.dtype)), None, length=code_len
+    )
+    return total

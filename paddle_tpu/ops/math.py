@@ -1,0 +1,69 @@
+"""Dense math — successor of ``paddle/math/Matrix.h`` (``Matrix::mul`` and
+friends) routed through the MXU via bf16 matmuls with f32 accumulation."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dt
+
+
+def matmul(a: jax.Array, b: jax.Array, transpose_a=False, transpose_b=False) -> jax.Array:
+    """MXU matmul: operands cast to the compute dtype (bf16 by default),
+    accumulated in float32 (≅ Matrix::mul -> hl_matrix_mul/cuBLAS gemm)."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    a, b = dt.cast_for_matmul(a, b)
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+
+
+def linear(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
+    """x @ w + b over the trailing dim; supports any leading batch dims."""
+    y = matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def cos_sim(a: jax.Array, b: jax.Array, scale: float = 1.0, eps: float = 1e-8) -> jax.Array:
+    """Row-wise cosine similarity (≅ CosSimLayer / paddle/function CosSim op)."""
+    dot = jnp.sum(a * b, axis=-1)
+    na = jnp.sqrt(jnp.sum(a * a, axis=-1) + eps)
+    nb = jnp.sqrt(jnp.sum(b * b, axis=-1) + eps)
+    return scale * dot / (na * nb)
+
+
+def outer_prod(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched outer product (≅ OuterProdLayer)."""
+    return jnp.einsum("bi,bj->bij", a, b)
+
+
+def sum_to_one_norm(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Normalize rows to sum 1 (≅ SumToOneNormLayer)."""
+    return x / (jnp.sum(x, axis=-1, keepdims=True) + eps)
+
+
+def l2_normalize(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+
+
+def interpolation(x: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+    """w*x + (1-w)*y with w a [B,1] weight (≅ InterpolationLayer)."""
+    return w * x + (1.0 - w) * y
+
+
+def slope_intercept(x: jax.Array, slope: float = 1.0, intercept: float = 0.0) -> jax.Array:
+    return slope * x + intercept
+
+
+def power(x: jax.Array, p: jax.Array) -> jax.Array:
+    """Row-wise x ** p with p a [B,1] exponent (≅ PowerLayer)."""
+    return jnp.power(x, p)
+
+
+def scaling(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Row-wise scalar scale (≅ ScalingLayer)."""
+    return w * x

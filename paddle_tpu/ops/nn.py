@@ -1,0 +1,276 @@
+"""NN primitives: conv/pool/norm/dropout — successor of the reference's
+cuDNN-backed layers (``paddle/cuda/hl_cuda_cudnn.cc``, ``ConvBaseLayer``,
+``PoolLayer``, ``BatchNormalizationLayer``/``CudnnBatchNormLayer``,
+``CMRProjectionNormLayer``) and the im2col/GemmConv stack in
+``paddle/function/GemmConvOp.cpp``.
+
+TPU-native choices: NHWC layout (XLA's preferred TPU conv layout), bf16 conv
+operands with f32 accumulation, ``lax.reduce_window`` pooling, and batch-norm
+as a pure function returning updated running stats (no mutable buffers)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import dtype as dt
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def conv2d(
+    x: jax.Array,  # [N, H, W, Cin]
+    w: jax.Array,  # [KH, KW, Cin // groups, Cout]
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups: int = 1,
+) -> jax.Array:
+    """2-D convolution, NHWC (≅ ExpandConvLayer/CudnnConvLayer via GemmConv)."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        ph, pw = _pair(padding)
+        pad = [(ph, ph), (pw, pw)]
+    # bf16 operands tile onto the MXU; the f32 upcast after keeps downstream
+    # math stable.  (preferred_element_type=f32 with bf16 operands breaks the
+    # conv transpose rule in jax 0.9, so we round to bf16 and upcast.)
+    x, w = dt.cast_for_matmul(x, w)
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+    return y.astype(jnp.float32)
+
+
+def conv2d_transpose(
+    x: jax.Array, w: jax.Array, stride=1, padding=0, groups: int = 1
+) -> jax.Array:
+    """Transposed conv (≅ ConvTransLayer / conv2d_transpose_op)."""
+    stride = _pair(stride)
+    ph, pw = _pair(padding)
+    x, w = dt.cast_for_matmul(x, w)
+    y = lax.conv_transpose(
+        x,
+        w,
+        strides=stride,
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        transpose_kernel=True,
+    )
+    return y.astype(jnp.float32)
+
+
+def depthwise_conv2d(x: jax.Array, w: jax.Array, stride=1, padding=0) -> jax.Array:
+    """Depthwise conv (≅ paddle/function DepthwiseConvOp)."""
+    cin = x.shape[-1]
+    return conv2d(x, w, stride=stride, padding=padding, groups=cin)
+
+
+def max_pool2d(x: jax.Array, ksize, stride=None, padding=0) -> jax.Array:
+    kh, kw = _pair(ksize)
+    sh, sw = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(padding)
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+    )
+
+
+def avg_pool2d(x: jax.Array, ksize, stride=None, padding=0, exclude_pad: bool = True) -> jax.Array:
+    """Average pooling; ``exclude_pad`` matches the reference's CudnnPool
+    EXCLUDE_PADDING mode (divide by the true window size at borders)."""
+    kh, kw = _pair(ksize)
+    sh, sw = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(padding)
+    summed = lax.reduce_window(
+        x,
+        0.0,
+        lax.add,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+    )
+    if exclude_pad and (ph or pw):
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        counts = lax.reduce_window(
+            ones,
+            0.0,
+            lax.add,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+        )
+        return summed / counts
+    return summed / (kh * kw)
+
+
+def global_avg_pool2d(x: jax.Array) -> jax.Array:
+    return jnp.mean(x, axis=(1, 2))
+
+
+def batch_norm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    is_train: bool,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+):
+    """Batch normalization over all but the last (channel) axis.
+
+    Returns (y, new_running_mean, new_running_var).  The reference keeps
+    moving stats as extra parameter buffers updated in the layer
+    (``BatchNormBaseLayer``); here they are explicit state in/out so the
+    train step stays pure.
+    """
+    x32 = x.astype(jnp.float32)
+    if is_train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = lax.rsqrt(var + eps) * scale
+    y = (x32 - mean) * inv + bias
+    return y.astype(x.dtype) if x.dtype != jnp.float32 else y, new_mean, new_var
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+
+
+def cross_map_normal(
+    x: jax.Array, size: int = 5, scale: float = 1e-4, pow_: float = 0.75
+) -> jax.Array:
+    """Local response normalization across channels (≅ CMRProjectionNormLayer /
+    paddle/function/CrossMapNormalOp, Fluid lrn_op). NHWC."""
+    sq = x * x
+    half = size // 2
+    # sum over a channel window via padded cumulative trick
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    window = sum(
+        padded[..., i : i + x.shape[-1]] for i in range(size)
+    )
+    denom = jnp.power(1.0 + scale * window, pow_)
+    return x / denom
+
+
+def dropout(x: jax.Array, rate: float, key: jax.Array, is_train: bool) -> jax.Array:
+    """Inverted dropout (≅ dropout_layer via ComputeDropoutMask)."""
+    if not is_train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def spatial_pyramid_pool(x: jax.Array, pyramid_height: int, pool_type: str = "max") -> jax.Array:
+    """SPP layer (≅ SpatialPyramidPoolLayer): concat pooled bins at scales
+    1,2,4,... Requires H/W divisible handling via padding."""
+    n, h, w, c = x.shape
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2**lvl
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        ph, pw = kh * bins - h, kw * bins - w
+        xp = jnp.pad(
+            x,
+            ((0, 0), (0, ph), (0, pw), (0, 0)),
+            constant_values=-jnp.inf if pool_type == "max" else 0.0,
+        )
+        if pool_type == "max":
+            p = max_pool2d(xp, (kh, kw), (kh, kw))
+        else:
+            p = avg_pool2d(xp, (kh, kw), (kh, kw))
+        outs.append(p.reshape(n, -1))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def bilinear_interp(x: jax.Array, out_h: int, out_w: int) -> jax.Array:
+    """Bilinear resize NHWC (≅ BilinearInterpLayer)."""
+    return jax.image.resize(
+        x, (x.shape[0], out_h, out_w, x.shape[3]), method="bilinear"
+    )
+
+
+def maxout(x: jax.Array, groups: int) -> jax.Array:
+    """Maxout over channel groups (≅ MaxOutLayer)."""
+    n, h, w, c = x.shape
+    return jnp.max(x.reshape(n, h, w, c // groups, groups), axis=-1)
+
+
+def pad(x: jax.Array, pad_c, pad_h, pad_w) -> jax.Array:
+    """Channel/spatial padding (≅ PadLayer / paddle/function PadOp), NHWC."""
+    return jnp.pad(
+        x,
+        (
+            (0, 0),
+            tuple(pad_h),
+            tuple(pad_w),
+            tuple(pad_c),
+        ),
+    )
+
+
+def crop(x: jax.Array, offsets, shape) -> jax.Array:
+    """Crop to `shape` starting at `offsets` (≅ CropLayer), NHWC."""
+    return lax.dynamic_slice(x, (0, *offsets, 0), (x.shape[0], *shape, x.shape[3]))
+
+
+def resize(x: jax.Array, size: int) -> jax.Array:
+    """Reshape rows to a new feature size (≅ ResizeLayer)."""
+    return x.reshape(-1, size)
+
+
+def featmap_expand(x: jax.Array, num_filters: int, as_row: bool = True) -> jax.Array:
+    """Expand each feature map (≅ FeatureMapExpandLayer)."""
+    if as_row:
+        return jnp.repeat(x, num_filters, axis=-1)
+    return jnp.tile(x, (1, num_filters))
+
+
+def block_expand(x: jax.Array, block_h: int, block_w: int, stride_h: int, stride_w: int,
+                 pad_h: int = 0, pad_w: int = 0):
+    """im2col as a layer (≅ BlockExpandLayer / paddle/function BlockExpandOp):
+    NHWC image -> sequence of flattened blocks, scanning left-right top-down."""
+    n, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (pad_h, pad_h), (pad_w, pad_w), (0, 0)))
+    patches = lax.conv_general_dilated_patches(
+        xp.astype(jnp.float32),
+        filter_shape=(block_h, block_w),
+        window_strides=(stride_h, stride_w),
+        padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )  # [N, outH, outW, C*bh*bw]
+    n_, oh, ow, f = patches.shape
+    return patches.reshape(n_, oh * ow, f), oh, ow
+
+
+def rotate(x: jax.Array) -> jax.Array:
+    """90° CCW rotation of feature maps (≅ RotateLayer), NHWC."""
+    return jnp.rot90(x, k=1, axes=(1, 2))
+
+
+def flip_lr(x: jax.Array) -> jax.Array:
+    return x[:, :, ::-1, :]

@@ -1,0 +1,185 @@
+"""Recurrent cells + masked scans — successor of the reference's hand-written
+LSTM/GRU CUDA kernels (``paddle/cuda/src/hl_cuda_lstm.cu``,
+``hl_gpu_gru.cuh``), ``LstmLayer``/``GruLayer``, and the SequenceToBatch
+batch-parallel scheduler (``paddle/gserver/layers/SequenceToBatch.cpp``).
+
+TPU-native design: the whole input projection (x @ W for all gates, the bulk
+of the FLOPs) is hoisted OUT of the recurrence as one big MXU matmul over
+[B*T, D]; only the small recurrent matmul runs inside ``lax.scan``.  Ragged
+batches use masks to freeze state past each row's length — the same effect as
+SequenceToBatch's same-length grouping, without data movement.
+
+Gate layout follows the reference (``hl_lstm_ops``): LSTM gates ordered
+[input, forget, cell(candidate), output]; GRU gates [update, reset, candidate].
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.ops import activations as act
+from paddle_tpu.ops.math import matmul
+
+
+class LSTMState(NamedTuple):
+    h: jax.Array  # [B, D]
+    c: jax.Array  # [B, D]
+
+
+def lstm_cell(
+    xw: jax.Array,  # [B, 4D] precomputed x @ W_x (+ bias)
+    state: LSTMState,
+    w_h: jax.Array,  # [D, 4D]
+    gate_act=act.sigmoid,
+    state_act=act.tanh,
+) -> LSTMState:
+    d = state.h.shape[-1]
+    gates = xw + matmul(state.h, w_h)
+    i = gate_act(gates[:, 0 * d : 1 * d])
+    f = gate_act(gates[:, 1 * d : 2 * d])
+    g = state_act(gates[:, 2 * d : 3 * d])
+    o = gate_act(gates[:, 3 * d : 4 * d])
+    c = f * state.c + i * g
+    h = o * state_act(c)
+    return LSTMState(h=h, c=c)
+
+
+def gru_cell(
+    xw: jax.Array,  # [B, 3D] precomputed x @ W_x (+ bias)
+    h: jax.Array,  # [B, D]
+    w_h: jax.Array,  # [D, 2D] update+reset recurrent weights
+    w_hc: jax.Array,  # [D, D] candidate recurrent weights
+    gate_act=act.sigmoid,
+    state_act=act.tanh,
+) -> jax.Array:
+    d = h.shape[-1]
+    ur = xw[:, : 2 * d] + matmul(h, w_h)
+    u = gate_act(ur[:, :d])
+    r = gate_act(ur[:, d : 2 * d])
+    c = state_act(xw[:, 2 * d :] + matmul(r * h, w_hc))
+    # reference gru: h' = u*h + (1-u)*c  (hl_gpu_gru.cuh frameOutput)
+    return u * h + (1.0 - u) * c
+
+
+def _masked_scan(step, x: SequenceBatch, init_state, reverse: bool = False):
+    """Run `step` over time with per-row freezing past length.
+
+    step: (state, xt[B, ...]) -> new_state; state is a pytree of [B, D] arrays.
+    """
+    mask = x.mask()  # [B, T]
+    xs = jnp.swapaxes(x.data, 0, 1)  # [T, B, ...]
+    ms = jnp.swapaxes(mask, 0, 1)  # [T, B]
+
+    def body(state, inp):
+        xt, mt = inp
+        new = step(state, xt)
+        mt = mt[:, None]
+        frozen = jax.tree.map(lambda n, o: mt * n + (1.0 - mt) * o, new, state)
+        return frozen, frozen
+
+    last, ys = jax.lax.scan(body, init_state, (xs, ms), reverse=reverse)
+    ys = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), ys)  # [B, T, D]
+    return last, ys
+
+
+def lstm(
+    x: SequenceBatch,  # data [B, T, Din] already projected? no: raw input
+    w_x: jax.Array,  # [Din, 4D]
+    w_h: jax.Array,  # [D, 4D]
+    b: jax.Array | None,  # [4D]
+    reverse: bool = False,
+    gate_act=act.sigmoid,
+    state_act=act.tanh,
+    init: LSTMState | None = None,
+):
+    """Full LSTM over a ragged batch. Returns (SequenceBatch of h, last LSTMState).
+
+    (≅ LstmLayer with lstmemory semantics: the reference's ``lstmemory`` takes
+    a pre-projected input from a preceding mixed/fc layer; here w_x may be
+    identity-folded by passing the projection separately — the layer API keeps
+    the reference contract.)
+    """
+    b_, t = x.batch_size, x.max_len
+    d = w_h.shape[0]
+    xw = matmul(x.data.reshape(b_ * t, -1), w_x)
+    if b is not None:
+        xw = xw + b
+    xw = xw.reshape(b_, t, 4 * d)
+    if init is None:
+        init = LSTMState(
+            h=jnp.zeros((b_, d), jnp.float32), c=jnp.zeros((b_, d), jnp.float32)
+        )
+
+    def step(state, xt):
+        return lstm_cell(xt, state, w_h, gate_act, state_act)
+
+    last, ys = _masked_scan(step, SequenceBatch(xw, x.length), init, reverse=reverse)
+    return SequenceBatch(data=ys.h, length=x.length), last
+
+
+def gru(
+    x: SequenceBatch,  # [B, T, Din]
+    w_x: jax.Array,  # [Din, 3D]
+    w_h: jax.Array,  # [D, 2D]
+    w_hc: jax.Array,  # [D, D]
+    b: jax.Array | None,  # [3D]
+    reverse: bool = False,
+    gate_act=act.sigmoid,
+    state_act=act.tanh,
+    init: jax.Array | None = None,
+):
+    """Full GRU over a ragged batch. Returns (SequenceBatch of h, last h)."""
+    b_, t = x.batch_size, x.max_len
+    d = w_h.shape[0]
+    xw = matmul(x.data.reshape(b_ * t, -1), w_x)
+    if b is not None:
+        xw = xw + b
+    xw = xw.reshape(b_, t, 3 * d)
+    if init is None:
+        init = jnp.zeros((b_, d), jnp.float32)
+
+    def step(h, xt):
+        return gru_cell(xt, h, w_h, w_hc, gate_act, state_act)
+
+    last, ys = _masked_scan(step, SequenceBatch(xw, x.length), init, reverse=reverse)
+    return SequenceBatch(data=ys, length=x.length), last
+
+
+def simple_rnn(
+    x: SequenceBatch,
+    w_x: jax.Array,  # [Din, D]
+    w_h: jax.Array,  # [D, D]
+    b: jax.Array | None,
+    activation=act.tanh,
+    reverse: bool = False,
+    init: jax.Array | None = None,
+):
+    """Vanilla RNN (≅ RecurrentLayer): h_t = act(x_t W + h_{t-1} U + b)."""
+    b_, t = x.batch_size, x.max_len
+    d = w_h.shape[0]
+    xw = matmul(x.data.reshape(b_ * t, -1), w_x)
+    if b is not None:
+        xw = xw + b
+    xw = xw.reshape(b_, t, d)
+    if init is None:
+        init = jnp.zeros((b_, d), jnp.float32)
+
+    def step(h, xt):
+        return activation(xt + matmul(h, w_h))
+
+    last, ys = _masked_scan(step, SequenceBatch(xw, x.length), init, reverse=reverse)
+    return SequenceBatch(data=ys, length=x.length), last
+
+
+def bidirectional(fwd_fn, bwd_fn, x: SequenceBatch):
+    """Run forward+reverse passes and concat features (≅ bidirectional_lstm
+    in trainer_config_helpers/networks.py)."""
+    f, _ = fwd_fn(x)
+    r, _ = bwd_fn(x)
+    return SequenceBatch(
+        data=jnp.concatenate([f.data, r.data], axis=-1), length=x.length
+    )

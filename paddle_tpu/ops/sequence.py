@@ -1,0 +1,162 @@
+"""Sequence ops over padded+masked batches — successor of the reference's
+sequence layer family (``SequencePoolLayer``, ``ExpandLayer``,
+``SequenceConcatLayer``, ``SequenceSliceLayer``, ``SequenceReshapeLayer``,
+``ContextProjection``, ``RowConvLayer``, ``SubSequenceLayer`` …) and
+``paddle/operators/sequence_*``.
+
+Where the reference walks sequenceStartPositions offsets, these ops use the
+[B, T] mask derived from lengths — same semantics, static shapes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.lod import SequenceBatch
+
+
+def _mask(x: SequenceBatch):
+    m = x.mask()
+    extra = (1,) * (x.data.ndim - 2)
+    return m.reshape(m.shape + extra)
+
+
+def seq_pool_sum(x: SequenceBatch) -> jax.Array:
+    return jnp.sum(x.data * _mask(x), axis=1)
+
+
+def seq_pool_avg(x: SequenceBatch) -> jax.Array:
+    s = seq_pool_sum(x)
+    n = jnp.maximum(x.length.astype(s.dtype), 1.0)
+    return s / n.reshape((-1,) + (1,) * (s.ndim - 1))
+
+
+def seq_pool_sqrt(x: SequenceBatch) -> jax.Array:
+    """Sum scaled by 1/sqrt(len) (reference SequencePoolLayer 'sqrt' mode)."""
+    s = seq_pool_sum(x)
+    n = jnp.maximum(x.length.astype(s.dtype), 1.0)
+    return s / jnp.sqrt(n).reshape((-1,) + (1,) * (s.ndim - 1))
+
+
+def seq_pool_max(x: SequenceBatch) -> jax.Array:
+    m = _mask(x)
+    neg = jnp.asarray(-1e30, x.data.dtype)
+    return jnp.max(jnp.where(m > 0, x.data, neg), axis=1)
+
+
+def seq_last(x: SequenceBatch) -> jax.Array:
+    return x.last_step()
+
+
+def seq_first(x: SequenceBatch) -> jax.Array:
+    return x.first_step()
+
+
+def expand(x: jax.Array, ref: SequenceBatch) -> SequenceBatch:
+    """Broadcast per-sequence vector x[B, D] across ref's timesteps
+    (≅ ExpandLayer / seq_expand_op)."""
+    t = ref.max_len
+    data = jnp.broadcast_to(
+        x[:, None], (x.shape[0], t) + x.shape[1:]
+    )
+    return SequenceBatch(data=data, length=ref.length)
+
+
+def seq_concat(a: SequenceBatch, b: SequenceBatch) -> SequenceBatch:
+    """Concatenate each pair of sequences in time (≅ SequenceConcatLayer).
+    Output max_len = a.T + b.T; b's rows are shifted to start at a's length."""
+    ta, tb = a.max_len, b.max_len
+    t_out = ta + tb
+    d = a.data.shape[2:]
+    out = jnp.zeros((a.batch_size, t_out) + d, a.data.dtype)
+    out = out.at[:, :ta].set(a.data * _mask(a))
+    # scatter b at offset a.length per row
+    pos = jnp.arange(tb, dtype=jnp.int32)[None, :] + a.length[:, None]  # [B, tb]
+    bm = b.mask()
+    onehot = (pos[:, :, None] == jnp.arange(t_out, dtype=jnp.int32)[None, None, :]).astype(
+        a.data.dtype
+    ) * bm[:, :, None]
+    bdata = b.data.reshape(b.batch_size, tb, -1)
+    scattered = jnp.einsum("bto,btd->bod", onehot, bdata).reshape((a.batch_size, t_out) + d)
+    return SequenceBatch(data=out + scattered, length=a.length + b.length)
+
+
+def seq_slice(x: SequenceBatch, starts: jax.Array, ends: jax.Array) -> SequenceBatch:
+    """Slice each sequence to [start, end) (≅ SequenceSliceLayer), keeping the
+    original padded width."""
+    t = x.max_len
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :] + starts[:, None]
+    onehot = (pos[:, :, None] == jnp.arange(t, dtype=jnp.int32)[None, None, :]).astype(
+        x.data.dtype
+    )
+    flat = x.data.reshape(x.batch_size, t, -1)
+    gathered = jnp.einsum("bto,bod->btd", onehot, flat).reshape(x.data.shape)
+    new_len = jnp.clip(ends - starts, 0, t)
+    return SequenceBatch(data=gathered, length=new_len)
+
+
+def seq_reshape(x: SequenceBatch, new_dim: int) -> SequenceBatch:
+    """Re-chunk the flattened sequence to rows of new_dim (≅ SequenceReshapeLayer).
+    Only well-defined when len*dim % new_dim == 0 per row; padded version uses
+    max_len."""
+    b, t = x.batch_size, x.max_len
+    d = int(jnp.prod(jnp.asarray(x.data.shape[2:])))
+    total = t * d
+    new_t = total // new_dim
+    data = x.data.reshape(b, new_t, new_dim)
+    new_len = (x.length * d) // new_dim
+    return SequenceBatch(data=data, length=new_len)
+
+
+def context_projection(
+    x: SequenceBatch, context_len: int, context_start: int, pad_weights: jax.Array | None = None
+) -> SequenceBatch:
+    """Concat a sliding window of timesteps per position (≅ ContextProjection /
+    ``paddle/function/ContextProjectionOp.cpp``).  Out-of-range positions are
+    zero, or learned padding rows when ``pad_weights`` ([context_len-?, D]) is
+    given (trainable_padding)."""
+    b, t = x.batch_size, x.max_len
+    d = x.data.shape[-1]
+    m = x.mask()[:, :, None]
+    xm = x.data * m
+    cols = []
+    for i in range(context_len):
+        off = context_start + i
+        shifted = jnp.roll(xm, -off, axis=1)
+        idx = jnp.arange(t) + off
+        valid_row = (idx >= 0) & (idx < t)
+        valid = valid_row[None, :, None] & (
+            (idx[None, :] < x.length[:, None])[:, :, None] if off > 0 else jnp.bool_(True)
+        )
+        col = jnp.where(valid, shifted, 0.0)
+        if pad_weights is not None:
+            # learned padding: start pads use row (i) , end pads use trailing rows
+            if off < 0:
+                col = jnp.where(valid, col, pad_weights[i][None, None, :])
+            elif off > 0:
+                pad_row = pad_weights[pad_weights.shape[0] - (context_len - 1 - i) - 1]
+                beyond = (idx[None, :] >= x.length[:, None])[:, :, None] & valid_row[None, :, None]
+                col = jnp.where(beyond, pad_row[None, None, :], col)
+        cols.append(col)
+    out = jnp.concatenate(cols, axis=-1) * m
+    return SequenceBatch(data=out, length=x.length)
+
+
+def row_conv(x: SequenceBatch, w: jax.Array) -> SequenceBatch:
+    """Lookahead row convolution (≅ RowConvLayer / paddle/function RowConvOp):
+    y[t] = sum_{i=0..k-1} w[i] * x[t+i], per feature."""
+    k = w.shape[0]
+    m = x.mask()[:, :, None]
+    xm = x.data * m
+    out = jnp.zeros_like(xm)
+    for i in range(k):
+        shifted = jnp.roll(xm, -i, axis=1)
+        valid = (jnp.arange(x.max_len) + i < x.max_len)[None, :, None]
+        out = out + jnp.where(valid, shifted, 0.0) * w[i][None, None, :]
+    return SequenceBatch(data=out * m, length=x.length)
+
+
+def scatter_pos_encoding(x: SequenceBatch) -> jax.Array:
+    """Relative position of each step in [0,1] (helper for linear_comb etc.)."""
+    t = jnp.arange(x.max_len, dtype=jnp.float32)[None, :]
+    return t / jnp.maximum(x.length[:, None].astype(jnp.float32) - 1.0, 1.0)

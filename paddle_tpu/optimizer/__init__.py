@@ -1,0 +1,432 @@
+"""Optimizers — successor of ``paddle/parameter/FirstOrderOptimizer.h:24-346``
+(SGD/SparseMomentum/Adagrad/AdaDelta/RMSProp/DecayedAdagrad/Adam/Adamax +
+OptimizerWithGradientClipping), composed like the reference's
+``OptimizerWithRegularizer`` / ``AverageOptimizer`` wrappers
+(``ParameterOptimizer.cpp:175``), plus the LR schedules of
+``LearningRateScheduler.cpp`` and the v2 Python surface
+``python/paddle/v2/optimizer.py``.
+
+Design: each optimizer is a pure (init, update) pair over the parameter
+pytree — the update runs INSIDE the jitted train step, fused with the
+backward pass by XLA (the reference pipelines per-parameter updates with
+backward via UpdateCallback; XLA's scheduler provides the same overlap for
+free).  Per-parameter attributes (learning-rate scale, decay override, static)
+come from ParamSpecs, mirroring ParameterConfig."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.parameters import ParamSpec
+
+# ---------------------------------------------------------------------------
+# regularization & model-average config objects (v2 API surface)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class L1Regularization:
+    rate: float = 0.0
+
+    @property
+    def l1_rate(self):
+        return self.rate
+
+
+@dataclasses.dataclass
+class L2Regularization:
+    rate: float = 0.0
+
+    @property
+    def l2_rate(self):
+        return self.rate
+
+
+@dataclasses.dataclass
+class ModelAverage:
+    """≅ AverageOptimizer (do_average in FirstOrderOptimizer.h): EMA of
+    parameters used for eval; window is a fraction of passes."""
+
+    average_window: float = 0.0
+    max_average_window: int = 10000
+
+
+# ---------------------------------------------------------------------------
+# LR schedules (≅ LearningRateScheduler.cpp registry)
+# ---------------------------------------------------------------------------
+
+
+def make_lr_schedule(base_lr: float, schedule: str = "constant", a: float = 0.0,
+                     b: float = 0.0, warmup_steps: int = 0) -> Callable:
+    """Returns lr(step) — schedules: constant, exp (a^(t/b)), discexp,
+    poly ((1+a*t)^-b), linear (max(lr - a*t, b)), manual not supported."""
+
+    def lr(step):
+        t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        if schedule in ("constant", ""):
+            out = base_lr
+        elif schedule == "poly":
+            out = base_lr * jnp.power(1.0 + a * t, -b)
+        elif schedule == "caffe_poly":
+            out = base_lr * jnp.power(1.0 - t / a, b)
+        elif schedule in ("exp", "discexp"):
+            tt = jnp.floor(t / b) * b if schedule == "discexp" else t
+            out = base_lr * jnp.power(a, tt / b)
+        elif schedule == "linear":
+            out = jnp.maximum(base_lr - a * t, b)
+        elif schedule == "inv_sqrt":
+            out = base_lr / jnp.sqrt(jnp.maximum(t, 1.0))
+        else:
+            raise ValueError(f"unknown lr schedule {schedule!r}")
+        if warmup_steps:
+            out = out * jnp.minimum((t + 1.0) / warmup_steps, 1.0)
+        return out
+
+    return lr
+
+
+# ---------------------------------------------------------------------------
+# Optimizer base + family
+# ---------------------------------------------------------------------------
+
+
+class Optimizer:
+    """Base: subclasses define slot init + per-tensor update rule."""
+
+    name = "base"
+
+    def __init__(self, learning_rate: float = 0.01, regularization=None,
+                 gradient_clipping_threshold: float = 0.0, model_average=None,
+                 learning_rate_schedule: str = "constant",
+                 learning_rate_decay_a: float = 0.0, learning_rate_decay_b: float = 0.0,
+                 learning_rate_warmup_steps: int = 0, **kw):
+        self.learning_rate = learning_rate
+        self.l1_rate = getattr(regularization, "l1_rate", 0.0) if regularization else 0.0
+        self.l2_rate = getattr(regularization, "l2_rate", 0.0) if regularization else 0.0
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.model_average = model_average
+        self.lr_fn = make_lr_schedule(
+            learning_rate, learning_rate_schedule, learning_rate_decay_a,
+            learning_rate_decay_b, learning_rate_warmup_steps,
+        )
+        self.extra = kw
+
+    # -- subclass hooks -------------------------------------------------------
+    def slot_init(self, p: jax.Array) -> Any:
+        return ()
+
+    def tensor_update(self, g, p, slots, lr, step):
+        """Return (delta, new_slots) with delta to be SUBTRACTED from p."""
+        raise NotImplementedError
+
+    # -- pytree-level API -----------------------------------------------------
+    def init(self, params: dict[str, jax.Array],
+             specs: dict[str, ParamSpec] | None = None) -> dict:
+        slots = {k: self.slot_init(v) for k, v in params.items()}
+        state = {"step": jnp.zeros((), jnp.int32), "slots": slots}
+        if self.model_average is not None and self.model_average.average_window > 0:
+            state["avg"] = jax.tree.map(jnp.copy, params)
+            state["avg_count"] = jnp.zeros((), jnp.float32)
+        return state
+
+    def apply(
+        self,
+        grads: dict[str, jax.Array],
+        params: dict[str, jax.Array],
+        state: dict,
+        specs: dict[str, ParamSpec] | None = None,
+    ) -> tuple[dict[str, jax.Array], dict]:
+        """One optimizer step; returns (new_params, new_state).  Composition
+        order matches the reference: decay/regularize -> clip -> method."""
+        specs = specs or {}
+        step = state["step"]
+        lr = self.lr_fn(step)
+
+        # global gradient clipping (OptimizerWithGradientClipping clips by
+        # per-tensor threshold; we honor per-param then global threshold)
+        def clip(g, spec):
+            th = None
+            if spec is not None and spec.gradient_clipping_threshold:
+                th = spec.gradient_clipping_threshold
+            elif self.gradient_clipping_threshold:
+                th = self.gradient_clipping_threshold
+            if th:
+                norm = jnp.sqrt(jnp.sum(g * g) + 1e-12)
+                g = g * jnp.minimum(1.0, th / norm)
+            return g
+
+        new_params = {}
+        new_slots = {}
+        for name, p in params.items():
+            spec = specs.get(name)
+            if spec is not None and spec.is_static:
+                new_params[name] = p
+                new_slots[name] = state["slots"][name]
+                continue
+            g = grads[name].astype(jnp.float32)
+            # L2/L1 regularization folded into the gradient
+            # (≅ OptimizerWithRegularizerEveryNumBatches with n=1)
+            l2 = spec.decay_rate if (spec is not None and spec.decay_rate is not None) else self.l2_rate
+            if l2:
+                g = g + l2 * p
+            if self.l1_rate:
+                g = g + self.l1_rate * jnp.sign(p)
+            g = clip(g, spec)
+            plr = lr * (spec.learning_rate if spec is not None else 1.0)
+            delta, slots = self.tensor_update(g, p, state["slots"][name], plr, step)
+            new_params[name] = p - delta
+            new_slots[name] = slots
+
+        new_state = dict(state)
+        new_state["step"] = step + 1
+        new_state["slots"] = new_slots
+        if "avg" in state:
+            # EMA model average (AverageOptimizer semantics approximated by EMA
+            # with window-derived decay)
+            w = max(self.model_average.max_average_window, 1)
+            decay = jnp.minimum(
+                (state["avg_count"] + 1.0) / (state["avg_count"] + 2.0),
+                1.0 - 1.0 / w,
+            )
+            new_state["avg"] = jax.tree.map(
+                lambda a, p: decay * a + (1.0 - decay) * p, state["avg"], new_params
+            )
+            new_state["avg_count"] = state["avg_count"] + 1.0
+        return new_params, new_state
+
+    # v2 compat shim: ``optimizer.create_*_updater`` existed; the Trainer now
+    # owns the update step, so these are thin markers.
+    def to_setting_kwargs(self):
+        return {"learning_rate": self.learning_rate, "learning_method": self.name}
+
+
+class SGD(Optimizer):
+    """Plain SGD (≅ SgdOptimizer / sgd_op)."""
+
+    name = "sgd"
+
+    def tensor_update(self, g, p, slots, lr, step):
+        return lr * g, slots
+
+
+class Momentum(Optimizer):
+    """Heavy-ball momentum (≅ SgdOptimizer with momentum / momentum_op).
+    v' = m*v + g ; p -= lr * v  (torch-style, matching the reference's
+    momentum buffer update in TrainingAlgorithmOp.cu)."""
+
+    name = "momentum"
+
+    def __init__(self, momentum: float = 0.9, use_nesterov: bool = False, **kw):
+        super().__init__(**kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def slot_init(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        v = self.momentum * slots["velocity"] + g
+        delta = lr * (g + self.momentum * v) if self.use_nesterov else lr * v
+        return delta, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """≅ AdamParameterOptimizer (FirstOrderOptimizer.h:…Adam) / adam_op."""
+
+    name = "adam"
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, **kw):
+        super().__init__(**kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def slot_init(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["v"] + (1 - self.beta2) * g * g
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        vhat = v / (1 - jnp.power(self.beta2, t))
+        return lr * mhat / (jnp.sqrt(vhat) + self.epsilon), {"m": m, "v": v}
+
+
+class Adamax(Optimizer):
+    """≅ AdamaxParameterOptimizer."""
+
+    name = "adamax"
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999, epsilon=1e-8, **kw):
+        super().__init__(**kw)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def slot_init(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * slots["u"], jnp.abs(g))
+        delta = lr / (1 - jnp.power(self.beta1, t)) * m / (u + 1e-12)
+        return delta, {"m": m, "u": u}
+
+
+class AdaGrad(Optimizer):
+    """≅ AdagradParameterOptimizer / adagrad_op."""
+
+    name = "adagrad"
+
+    def __init__(self, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.epsilon = epsilon
+
+    def slot_init(self, p):
+        return {"accum": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        accum = slots["accum"] + g * g
+        return lr * g / (jnp.sqrt(accum) + self.epsilon), {"accum": accum}
+
+
+class DecayedAdaGrad(Optimizer):
+    """≅ DecayedAdagradParameterOptimizer / decayed_adagrad_op."""
+
+    name = "decayed_adagrad"
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def slot_init(self, p):
+        return {"accum": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        accum = self.rho * slots["accum"] + (1 - self.rho) * g * g
+        return lr * g / (jnp.sqrt(accum) + self.epsilon), {"accum": accum}
+
+
+class AdaDelta(Optimizer):
+    """≅ AdaDeltaParameterOptimizer (rou/epsilon naming from the reference)."""
+
+    name = "adadelta"
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def slot_init(self, p):
+        return {"accum_g": jnp.zeros_like(p), "accum_x": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        ag = self.rho * slots["accum_g"] + (1 - self.rho) * g * g
+        dx = jnp.sqrt((slots["accum_x"] + self.epsilon) / (ag + self.epsilon)) * g
+        ax = self.rho * slots["accum_x"] + (1 - self.rho) * dx * dx
+        return lr * dx, {"accum_g": ag, "accum_x": ax}
+
+
+class RMSProp(Optimizer):
+    """≅ RMSPropParameterOptimizer (with mean-gradient term, as the reference
+    implements Graves-RMSProp) / rmsprop_op."""
+
+    name = "rmsprop"
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6,
+                 momentum: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.rho, self.epsilon, self.momentum = rho, epsilon, momentum
+
+    def slot_init(self, p):
+        return {
+            "accum_g": jnp.zeros_like(p),
+            "accum_mean": jnp.zeros_like(p),
+            "mom": jnp.zeros_like(p),
+        }
+
+    def tensor_update(self, g, p, slots, lr, step):
+        ag = self.rho * slots["accum_g"] + (1 - self.rho) * g * g
+        am = self.rho * slots["accum_mean"] + (1 - self.rho) * g
+        denom = jnp.sqrt(ag - am * am + self.epsilon)
+        mom = self.momentum * slots["mom"] + lr * g / denom
+        return mom, {"accum_g": ag, "accum_mean": am, "mom": mom}
+
+
+class Ftrl(Optimizer):
+    """≅ Fluid ftrl_op (proximal FTRL)."""
+
+    name = "ftrl"
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, lr_power: float = -0.5, **kw):
+        super().__init__(**kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def slot_init(self, p):
+        return {"n": jnp.zeros_like(p), "z": jnp.zeros_like(p)}
+
+    def tensor_update(self, g, p, slots, lr, step):
+        n, z = slots["n"], slots["z"]
+        n_new = n + g * g
+        sigma = (jnp.power(n_new, -self.lr_power) - jnp.power(jnp.maximum(n, 1e-38), -self.lr_power)) / lr
+        z_new = z + g - sigma * p
+        p_new = jnp.where(
+            jnp.abs(z_new) <= self.l1,
+            0.0,
+            -(z_new - jnp.sign(z_new) * self.l1)
+            / (jnp.power(n_new, -self.lr_power) / lr + 2 * self.l2),
+        )
+        return p - p_new, {"n": n_new, "z": z_new}
+
+
+class ProximalGD(Optimizer):
+    """≅ Fluid proximal_gd_op (L1/L2 proximal step)."""
+
+    name = "proximal_gd"
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.l1, self.l2 = l1, l2
+
+    def tensor_update(self, g, p, slots, lr, step):
+        prox = p - lr * g
+        p_new = (
+            jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0)
+            / (1.0 + lr * self.l2)
+        )
+        return p - p_new, slots
+
+
+OPTIMIZERS = {
+    c.name: c
+    for c in (SGD, Momentum, Adam, Adamax, AdaGrad, DecayedAdaGrad, AdaDelta,
+              RMSProp, Ftrl, ProximalGD)
+}
+
+
+def from_config(cfg) -> Optimizer:
+    """Build from an OptimizationConfig (≅ ParameterOptimizer::create:175)."""
+    cls = OPTIMIZERS[cfg.learning_method]
+    kw = dict(
+        learning_rate=cfg.learning_rate,
+        gradient_clipping_threshold=cfg.gradient_clipping_threshold,
+        learning_rate_schedule=cfg.learning_rate_schedule,
+        learning_rate_decay_a=cfg.learning_rate_decay_a,
+        learning_rate_decay_b=cfg.learning_rate_decay_b,
+        learning_rate_warmup_steps=cfg.learning_rate_warmup_steps,
+    )
+    if cfg.l1_rate:
+        kw["regularization"] = L1Regularization(cfg.l1_rate)
+    elif cfg.l2_rate:
+        kw["regularization"] = L2Regularization(cfg.l2_rate)
+    if cfg.average_window:
+        kw["model_average"] = ModelAverage(cfg.average_window, cfg.max_average_window or 10000)
+    if cls is Momentum:
+        kw["momentum"] = cfg.momentum
+    if cls is Adam:
+        kw.update(beta1=cfg.adam_beta1, beta2=cfg.adam_beta2, epsilon=cfg.adam_epsilon)
+    if cls in (AdaDelta, DecayedAdaGrad, RMSProp):
+        kw.update(rho=cfg.ada_rou, epsilon=cfg.ada_epsilon)
+    return cls(**kw)

@@ -1,0 +1,13 @@
+"""Parallelism over the device mesh — the replacement for the reference's
+entire distribution stack: ``MultiGradientMachine`` (intra-node DP threads +
+software ring all-reduce, ``MultiGradientMachine.h:44-98``), the C++ pserver
+(``paddle/pserver``), the Go cloud runtime (``go/pserver``, ``go/master``),
+and Fluid's NCCL ops (``operators/nccl_op.cc:66``).
+
+On TPU all of it becomes shardings on a ``jax.sharding.Mesh``: batch-sharded
+inputs give data parallelism with XLA-inserted ICI all-reduce; weight-sharded
+params give tensor parallelism; ``shard_map`` + ``ppermute`` give pipeline and
+ring-attention sequence parallelism.  See ``paddle_tpu.parallel.collectives``
+for the op-level surface matching ``NCCLAllReduce``/``Reduce``/``Bcast``."""
+
+from paddle_tpu.parallel.mesh import MeshContext, get_mesh, make_mesh  # noqa: F401

@@ -1,0 +1,14 @@
+"""Reader framework — successor of ``python/paddle/v2/reader``: a reader is a
+zero-arg callable returning an iterator of samples; decorators compose them."""
+
+from paddle_tpu.reader.decorator import (  # noqa: F401
+    batch,
+    buffered,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
+from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401

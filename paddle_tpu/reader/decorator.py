@@ -1,0 +1,164 @@
+"""Reader decorators — successor of ``python/paddle/v2/reader/decorator.py:26-233``
+(map_readers, shuffle, chain, compose, buffered, firstn, xmap_readers) and
+``paddle.batch``.  Multiprocessing xmap is implemented with threads (the
+reference uses threads too); the TPU input pipeline wants the host CPU free,
+so heavy preprocessing should move into readers ahead of time."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable, Iterable
+
+
+def map_readers(func: Callable, *readers):
+    """Apply func to the items of several readers zipped together."""
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Buffered shuffle (reference semantics: fill buf, shuffle, drain)."""
+
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+
+    return chained
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into tuples; flattens nested tuples like the reference."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        for items in zip(*[r() for r in readers]):
+            yield sum((make_tuple(i) for i in items), ())
+
+    return composed
+
+
+def buffered(reader, size: int):
+    """Double-buffered async read-ahead (≅ DataProvider's
+    getNextBatchFromBuffer:375 background loading)."""
+
+    end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for e in reader():
+                    q.put(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over a reader with worker threads (≅ xmap_readers)."""
+
+    end = object()
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feeder():
+            for i, e in enumerate(reader()):
+                in_q.put((i, e))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, e = item
+                out_q.put((i, mapper(e)))
+
+        threading.Thread(target=feeder, daemon=True).start()
+        workers = [threading.Thread(target=worker, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending: dict[int, object] = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group samples into lists (≅ paddle.batch; tail partial batch included,
+    matching the v2 contract).  Pass drop_last=True on TPU hot paths: partial
+    batches force a recompile and break mesh divisibility."""
+
+    def batch_reader():
+        b = []
+        for e in reader():
+            b.append(e)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,85 @@
+"""DataFeeder — successor of ``python/paddle/v2/data_feeder.py:28``
+(DataProviderConverter → SWIG Arguments).  Converts a Python batch (list of
+sample tuples) into the jit feed dict: dense arrays, int ids, or
+SequenceBatch/NestedSequenceBatch for *_sequence types.  Sparse inputs are
+densified host-side (the TPU path treats them as dense one/multi-hot rows —
+embedding lookups take the integer-sequence path instead)."""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.lod import from_nested_ragged, from_ragged
+from paddle_tpu.layers.data_type import DataKind, SeqType
+
+
+class DataFeeder:
+    def __init__(self, data_types: Mapping[str, object] | Sequence[tuple],
+                 feeding: Mapping[str, int] | Sequence[str] | None = None):
+        """data_types: {layer_name: InputType} or [(name, InputType), ...];
+        feeding: {layer_name: index in sample tuple} (defaults to order)."""
+        if not isinstance(data_types, Mapping):
+            data_types = dict(data_types)
+        self.types = dict(data_types)
+        if feeding is None:
+            self.feeding = {n: i for i, n in enumerate(self.types)}
+        elif isinstance(feeding, Mapping):
+            self.feeding = dict(feeding)
+        else:
+            self.feeding = {n: i for i, n in enumerate(feeding)}
+
+    def __call__(self, batch):
+        return self.feed(batch)
+
+    def feed(self, batch) -> dict:
+        out = {}
+        for name, itype in self.types.items():
+            enforce(
+                name in self.feeding,
+                f"feeding map is missing data layer {name!r} "
+                f"(feeding keys: {sorted(self.feeding)})",
+            )
+            idx = self.feeding[name]
+            col = [sample[idx] for sample in batch]
+            out[name] = self._convert(col, itype, name)
+        return out
+
+    def _convert(self, col, itype, name):
+        kind, seq = itype.kind, itype.seq_type
+        if seq == SeqType.NO_SEQUENCE:
+            if kind == DataKind.DENSE:
+                arr = np.asarray(col, dtype=np.float32).reshape(len(col), -1)
+                enforce(
+                    arr.shape[1] == itype.dim,
+                    f"data layer {name!r} expects dim {itype.dim}, "
+                    f"got samples of dim {arr.shape[1]}",
+                )
+                return jnp.asarray(arr)
+            if kind == DataKind.INTEGER:
+                return jnp.asarray(np.asarray(col, dtype=np.int32).reshape(len(col)))
+            if kind == DataKind.SPARSE_BINARY:
+                dense = np.zeros((len(col), itype.dim), np.float32)
+                for i, ids in enumerate(col):
+                    dense[i, np.asarray(list(ids), dtype=np.int64)] = 1.0
+                return jnp.asarray(dense)
+            if kind == DataKind.SPARSE_FLOAT:
+                dense = np.zeros((len(col), itype.dim), np.float32)
+                for i, pairs in enumerate(col):
+                    for j, v in pairs:
+                        dense[i, j] = v
+                return jnp.asarray(dense)
+        elif seq == SeqType.SEQUENCE:
+            if kind == DataKind.INTEGER:
+                seqs = [np.asarray(s, dtype=np.int32) for s in col]
+            else:
+                seqs = [np.asarray(s, dtype=np.float32) for s in col]
+            return from_ragged(seqs)
+        elif seq == SeqType.SUB_SEQUENCE:
+            dt = np.int32 if kind == DataKind.INTEGER else np.float32
+            nested = [[np.asarray(s, dtype=dt) for s in subs] for subs in col]
+            return from_nested_ragged(nested)
+        enforce(False, f"unsupported input type for {name!r}: {itype}")

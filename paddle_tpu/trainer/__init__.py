@@ -1,0 +1,5 @@
+"""Training orchestration — successor of ``paddle/trainer`` (Trainer.cpp pass
+loop, TrainerInternal.cpp batch loop, the ParameterUpdater family) and the v2
+Python loop ``python/paddle/v2/trainer.py:24`` (SGD.train:124)."""
+
+from paddle_tpu.trainer.trainer import SGD  # noqa: F401

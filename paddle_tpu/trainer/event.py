@@ -1,0 +1,59 @@
+"""Training events — successor of ``python/paddle/v2/event.py``: objects handed
+to the user's event_handler during ``SGD.train`` (BeginPass/EndPass/
+BeginIteration/EndIteration/EndForwardBackward, with TestResult)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+class WithMetric:
+    def __init__(self, evaluator):
+        self.evaluator = evaluator  # dict metric_name -> value
+
+    @property
+    def metrics(self) -> dict:
+        return dict(self.evaluator or {})
+
+
+@dataclasses.dataclass
+class BeginPass:
+    pass_id: int
+
+
+@dataclasses.dataclass
+class EndPass(WithMetric):
+    pass_id: int
+    evaluator: Any = None
+
+    def __init__(self, pass_id, evaluator=None, gm=None):
+        self.pass_id = pass_id
+        WithMetric.__init__(self, evaluator)
+
+
+@dataclasses.dataclass
+class BeginIteration:
+    pass_id: int
+    batch_id: int
+
+
+class EndForwardBackward:
+    def __init__(self, pass_id, batch_id, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.gm = gm
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, evaluator=None, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        WithMetric.__init__(self, evaluator)
+
+
+class TestResult(WithMetric):
+    def __init__(self, evaluator=None, cost: float = 0.0):
+        self.cost = cost
+        WithMetric.__init__(self, evaluator)

@@ -1,0 +1,84 @@
+"""Inference — successor of ``python/paddle/v2/inference.py:10-111``
+(Inference.infer: test-mode forward returning numpy outputs) and the C
+serving path (``paddle/capi/gradient_machine.h``; see ``native/`` for the
+C-ABI equivalent)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.config.topology import Topology
+from paddle_tpu.core.lod import SequenceBatch, to_ragged
+from paddle_tpu.core.parameters import Parameters
+from paddle_tpu.layers.base import LayerOutput
+from paddle_tpu.reader.feeder import DataFeeder
+from paddle_tpu.trainer.step import build_forward
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters):
+        if isinstance(output_layer, LayerOutput):
+            output_layer = [output_layer]
+        self.topology = Topology(output_layer)
+        self.parameters = parameters
+        for spec in self.topology.param_specs():
+            self.parameters.add(spec)
+        self.parameters.init_missing()
+        self.output_names = [o.name for o in output_layer]
+        self._fwd = build_forward(self.topology, self.output_names)
+        # states (e.g. BN moving stats) load from parameters when present
+        self.states = {}
+        for s in self.topology.state_specs():
+            if s.name in self.parameters:
+                self.states[s.name] = self.parameters[s.name]
+            else:
+                import jax.numpy as jnp
+
+                self.states[s.name] = jnp.full(s.shape, s.init_value)
+
+    def _feeder(self, feeding):
+        from paddle_tpu.layers.data_type import InputType
+
+        types = {
+            name: InputType(
+                dim=n.attrs["dim"],
+                seq_type=n.attrs.get("seq_type", 0),
+                kind=n.attrs.get("data_type", "dense"),
+            )
+            for name, n in self.topology.data_layers().items()
+        }
+        return DataFeeder(types, feeding)
+
+    def infer(self, input, feeding=None, field="value", batch_size: int | None = None):
+        feeder = self._feeder(feeding)
+        params = {n: self.parameters[n] for n in self.parameters.names()}
+        batches = [input] if batch_size is None else [
+            input[i : i + batch_size] for i in range(0, len(input), batch_size)
+        ]
+        outs: list[list] = [[] for _ in self.output_names]
+        for b in batches:
+            feed = feeder(b)
+            results = self._fwd(params, self.states, feed)
+            for i, r in enumerate(results):
+                if isinstance(r, SequenceBatch):
+                    outs[i].extend(to_ragged(r))
+                else:
+                    outs[i].append(np.asarray(r))
+        final = []
+        for chunks in outs:
+            if chunks and isinstance(chunks[0], np.ndarray) and all(
+                isinstance(c, np.ndarray) and c.ndim == chunks[0].ndim for c in chunks
+            ):
+                try:
+                    final.append(np.concatenate(chunks, axis=0))
+                    continue
+                except ValueError:
+                    pass
+            final.append(chunks)
+        return final[0] if len(final) == 1 else final
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(
+        input, feeding=feeding, field=field
+    )

@@ -1,0 +1,105 @@
+"""Jitted train/eval step builder — the compiled replacement of
+``GradientMachine::forwardBackward`` + ``ParameterUpdater::update``.
+
+One XLA program per (topology, optimizer, feed-shape bucket) does: forward,
+backward (``jax.grad``), gradient all-reduce over the mesh ``data`` axis
+(XLA inserts ICI collectives from the shardings — replacing
+``MultiGradientMachine``'s software ring and the pserver round-trip of
+``RemoteParameterUpdater``), optimizer update, and metric computation.  The
+reference pipelines per-parameter updates with backward via UpdateCallback
+(``TrainerInternal.cpp:99-111``); XLA's scheduler provides that overlap."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.topology import Topology
+from paddle_tpu.layers.base import is_sequence, raw
+from paddle_tpu.parallel.mesh import MeshContext
+
+
+def _compute_metrics(metric_specs, values) -> dict[str, jax.Array]:
+    out = {}
+    for kind, pred_name, label_name, tag in metric_specs:
+        pred, label = values[pred_name], values[label_name]
+        if kind == "classification_error":
+            p, l = raw(pred), raw(label)
+            if is_sequence(pred):
+                mask = pred.mask()
+                ids = jnp.argmax(p, axis=-1)
+                err = (ids != raw(label)).astype(jnp.float32) * mask
+                out["classification_error_evaluator"] = jnp.sum(err) / jnp.maximum(
+                    jnp.sum(mask), 1.0
+                )
+            else:
+                ids = jnp.argmax(p, axis=-1)
+                out["classification_error_evaluator"] = jnp.mean(
+                    (ids != l.reshape(ids.shape)).astype(jnp.float32)
+                )
+    return out
+
+
+def build_train_step(topology: Topology, optimizer, mesh: MeshContext | None = None):
+    """Returns jitted fn: (params, opt_state, states, feed, key)
+    -> (params, opt_state, states, cost, metrics)."""
+    specs = {s.name: s for s in topology.param_specs()}
+    trainable = {n for n, s in specs.items() if not s.is_static}
+    metric_specs = topology.metrics()
+    out_names = [o.name for o in topology.outputs]
+
+    def step(params, opt_state, states, feed, key):
+        train_p = {k: v for k, v in params.items() if k in trainable}
+        static_p = {k: v for k, v in params.items() if k not in trainable}
+
+        def loss_fn(tp):
+            allp = {**static_p, **tp}
+            values, new_states = topology.forward(allp, states, feed, True, key)
+            cost = functools.reduce(
+                lambda a, b: a + b, [jnp.sum(values[n]) for n in out_names]
+            )
+            metrics = _compute_metrics(metric_specs, values)
+            return cost, (new_states, metrics)
+
+        (cost, (new_states, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(train_p)
+        new_train, new_opt = optimizer.apply(grads, train_p, opt_state, specs)
+        new_params = {**static_p, **new_train}
+        return new_params, new_opt, new_states, cost, metrics
+
+    donate = (0, 1, 2)
+    if mesh is not None:
+        with mesh.mesh:
+            return jax.jit(step, donate_argnums=donate)
+    return jax.jit(step, donate_argnums=donate)
+
+
+def build_eval_step(topology: Topology, mesh: MeshContext | None = None):
+    """Jitted test/inference forward: (params, states, feed) -> (values of
+    outputs, cost scalar, metrics) with is_train=False."""
+    metric_specs = topology.metrics()
+    out_names = [o.name for o in topology.outputs]
+
+    def step(params, states, feed):
+        values, _ = topology.forward(params, states, feed, False, jax.random.key(0))
+        cost = functools.reduce(
+            lambda a, b: a + b, [jnp.sum(values[n]) for n in out_names]
+        )
+        metrics = _compute_metrics(metric_specs, values)
+        return {n: values[n] for n in values}, cost, metrics
+
+    return jax.jit(step)
+
+
+def build_forward(topology: Topology, output_names: list[str]):
+    """Inference forward returning selected layer values."""
+
+    def fwd(params, states, feed):
+        values, _ = topology.forward(params, states, feed, False, jax.random.key(0))
+        return [values[n] for n in output_names]
+
+    return jax.jit(fwd)

@@ -1,0 +1,216 @@
+"""The v2 SGD trainer — keeps the contract of
+``python/paddle/v2/trainer.py:24`` (``SGD.train:124``: reader → DataFeeder →
+forwardBackward → update → events) while replacing the SWIG GradientMachine +
+ParameterUpdater stack with one jitted, mesh-sharded train step.
+
+The updater lifecycle the reference exposes (startPass/startBatch/update/
+finishBatch/finishPass, ``ParameterUpdater.h:38``) collapses into the compiled
+step; pass/batch iteration stays in Python exactly as in v2."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import jax
+import numpy as np
+
+from paddle_tpu.config.topology import Topology
+from paddle_tpu.core import flags, rng
+from paddle_tpu.core import logger as log
+from paddle_tpu.core import stat
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.core.parameters import Parameters
+from paddle_tpu.layers.base import LayerOutput
+from paddle_tpu.parallel.mesh import MeshContext, get_mesh
+from paddle_tpu.reader.feeder import DataFeeder
+from paddle_tpu.trainer import event as v2_event
+from paddle_tpu.trainer.step import build_eval_step, build_train_step
+
+
+def _feed_signature(feed: dict) -> tuple:
+    sig = []
+    for k in sorted(feed):
+        v = feed[k]
+        if isinstance(v, SequenceBatch):
+            sig.append((k, tuple(v.data.shape), str(v.data.dtype), "seq"))
+        else:
+            sig.append((k, tuple(v.shape), str(v.dtype)))
+    return tuple(sig)
+
+
+class SGD:
+    """v2 ``paddle.trainer.SGD``.
+
+    :param cost: the cost LayerOutput to minimize.
+    :param parameters: ``paddle.parameters.create(topology)`` result.
+    :param update_equation: a ``paddle_tpu.optimizer.Optimizer``.
+    :param extra_layers: additional layers to keep alive (e.g. for evaluators).
+    :param is_local: kept for API compat; distribution now comes from the mesh.
+    :param mesh: optional MeshContext; default = all devices on the data axis.
+    """
+
+    def __init__(self, cost, parameters: Parameters, update_equation,
+                 extra_layers=None, is_local: bool = True, pserver_spec=None,
+                 use_etcd: bool = False, mesh: MeshContext | None = None):
+        if isinstance(cost, LayerOutput):
+            cost = [cost]
+        self.topology = Topology(cost, extra_layers=extra_layers)
+        self.parameters = parameters
+        for spec in self.topology.param_specs():
+            self.parameters.add(spec)
+        self.parameters.init_missing()
+        self.optimizer = update_equation
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.states = self.topology.init_states()
+        # warm-started Parameters may carry BN moving stats (saved as static
+        # entries by save_parameter_to_tar) — load them back
+        for sname in list(self.states):
+            if sname in self.parameters:
+                self.states[sname] = jax.numpy.asarray(self.parameters[sname])
+        self._specs = {s.name: s for s in self.topology.param_specs()}
+        self._trainable = {n for n, s in self._specs.items() if not s.is_static}
+        self._opt_state = None
+        self._train_step = None
+        self._eval_step = None
+        self._compiled_sigs: set = set()
+        self.__gradient_machine__ = self  # v2 attr some user code touches
+
+    # -- internal -------------------------------------------------------------
+    def _params_dict(self):
+        return {n: jax.numpy.asarray(self.parameters[n]) for n in self.parameters.names()}
+
+    def _ensure_built(self):
+        if self._train_step is None:
+            self._train_step = build_train_step(self.topology, self.optimizer, self.mesh)
+            self._eval_step = build_eval_step(self.topology, self.mesh)
+
+    def _default_feeder(self, feeding):
+        dl = self.topology.data_layers()
+        types = {}
+        for name, node in dl.items():
+            from paddle_tpu.layers.data_type import DataKind, InputType
+
+            types[name] = InputType(
+                dim=node.attrs["dim"],
+                seq_type=node.attrs.get("seq_type", 0),
+                kind=node.attrs.get("data_type", DataKind.DENSE),
+            )
+        return DataFeeder(types, feeding)
+
+    # -- the v2 train loop ----------------------------------------------------
+    def train(self, reader, num_passes: int = 1,
+              event_handler: Callable | None = None, feeding=None):
+        """reader yields BATCHES (lists of sample tuples), i.e. the output of
+        ``paddle.batch(...)`` exactly as in v2."""
+        if event_handler is None:
+            event_handler = _default_event_handler
+        self._ensure_built()
+        feeder = self._default_feeder(feeding)
+        params = self.mesh.replicate(self._params_dict())
+        states = self.mesh.replicate(self.states)
+        if self._opt_state is None:
+            opt_state = self.optimizer.init(
+                {k: params[k] for k in self._trainable}, self._specs
+            )
+            opt_state = self.mesh.replicate(opt_state)
+        else:
+            opt_state = self._opt_state
+
+        start_pass = flags.get("start_pass")
+        for pass_id in range(start_pass, num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            batch_costs, batch_metrics = [], []
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                with stat.timer("feed"):
+                    feed = feeder(data_batch)
+                    feed = self.mesh.shard_batch(feed)
+                sig = _feed_signature(feed)
+                if sig not in self._compiled_sigs:
+                    self._compiled_sigs.add(sig)
+                    if len(self._compiled_sigs) > 1:
+                        log.info("train step: compiling new feed signature %s", sig)
+                with stat.timer("forwardBackward+update"):
+                    params, opt_state, states, cost, metrics = self._train_step(
+                        params, opt_state, states, feed, rng.next_key()
+                    )
+                event_handler(v2_event.EndForwardBackward(pass_id, batch_id, self))
+                cost_f = float(cost)
+                metrics_f = {k: float(v) for k, v in metrics.items()}
+                batch_costs.append(cost_f)
+                batch_metrics.append(metrics_f)
+                event_handler(
+                    v2_event.EndIteration(pass_id, batch_id, cost_f, metrics_f, self)
+                )
+            # write back for checkpoint/event access
+            self.parameters.update_from(params)
+            self.states = dict(states)
+            self._opt_state = opt_state
+            avg_metrics = _mean_dicts(batch_metrics)
+            event_handler(v2_event.EndPass(pass_id, avg_metrics))
+            save_dir = flags.get("save_dir")
+            if save_dir and (pass_id % max(flags.get("saving_period"), 1) == 0):
+                self.save_parameter_to_tar_path(
+                    os.path.join(save_dir, f"pass-{pass_id:05d}.tar")
+                )
+            stat.global_stat.print_all_status()
+
+    def test(self, reader, feeding=None) -> v2_event.TestResult:
+        """≅ SGD.test: forward-only over a reader of batches."""
+        self._ensure_built()
+        feeder = self._default_feeder(feeding)
+        params = self._params_dict()
+        states = self.states
+        costs, metrics_list, n = [], [], 0
+        for data_batch in reader():
+            feed = self.mesh.shard_batch(feeder(data_batch))
+            _, cost, metrics = self._eval_step(params, states, feed)
+            costs.append(float(cost))
+            metrics_list.append({k: float(v) for k, v in metrics.items()})
+            n += 1
+        enforce(n > 0, "test reader yielded no batches")
+        return v2_event.TestResult(_mean_dicts(metrics_list), float(np.mean(costs)))
+
+    # -- checkpointing (ParamUtil / Parameters.to_tar parity) -----------------
+    def save_parameter_to_tar(self, f) -> None:
+        self._merge_states_into_parameters()
+        self.parameters.to_tar(f)
+
+    def save_parameter_to_tar_path(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            self.save_parameter_to_tar(f)
+        log.info("saved checkpoint %s", path)
+
+    def _merge_states_into_parameters(self):
+        from paddle_tpu.core import initializer as I
+        from paddle_tpu.core.parameters import ParamSpec
+
+        for name, v in self.states.items():
+            arr = np.asarray(v)
+            if name not in self.parameters:
+                self.parameters.add(ParamSpec(
+                    name=name, shape=tuple(arr.shape),
+                    initializer=I.constant(0.0), is_static=True,
+                ))
+            self.parameters._values[name] = jax.numpy.asarray(arr)
+
+
+def _mean_dicts(dicts: list[dict]) -> dict:
+    if not dicts:
+        return {}
+    keys = dicts[0].keys()
+    return {k: float(np.mean([d[k] for d in dicts if k in d])) for k in keys}
+
+
+def _default_event_handler(e) -> None:
+    if isinstance(e, v2_event.EndIteration):
+        if e.batch_id % flags.get("log_period") == 0:
+            log.info(
+                "Pass %d, Batch %d, Cost %f, %s", e.pass_id, e.batch_id, e.cost,
+                e.metrics,
+            )
+    elif isinstance(e, v2_event.EndPass):
+        log.info("Pass %d done, %s", e.pass_id, e.metrics)

@@ -1,0 +1,2 @@
+"""Utility tools — successor of ``python/paddle/utils`` (merge_model,
+plotcurve, image preprocessing) and assorted trainer tooling."""

@@ -1,0 +1,95 @@
+"""Core substrate tests: places, flags, LoD sequences, parameters tar
+round-trip (reference test analogs: test_Matrix/test_Argument semantics +
+v2 parameters tests)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.core import flags, initializer, lod
+from paddle_tpu.core.parameters import Parameters, ParamSpec
+
+
+def test_places():
+    p = paddle_tpu.CPUPlace()
+    assert p.device().platform == "cpu"
+    assert repr(p) == "CPUPlace(0)"
+
+
+def test_flags_env_and_parse():
+    assert flags.get("trainer_count") == 1
+    rest = flags.parse_args(["--trainer_count=4", "positional", "--log_period", "10"])
+    assert flags.get("trainer_count") == 4
+    assert flags.get("log_period") == 10
+    assert rest == ["positional"]
+    flags.set("trainer_count", 1)
+    flags.set("log_period", 100)
+
+
+def test_sequence_batch_mask_and_last():
+    seqs = [np.ones((3, 4)), 2 * np.ones((5, 4)), 3 * np.ones((1, 4))]
+    sb = lod.from_ragged(seqs)
+    assert sb.data.shape[0] == 3
+    assert sb.max_len == 16  # bucketed
+    np.testing.assert_array_equal(np.asarray(sb.length), [3, 5, 1])
+    mask = np.asarray(sb.mask())
+    assert mask.sum() == 9
+    last = np.asarray(sb.last_step())
+    np.testing.assert_allclose(last[1], 2 * np.ones(4))
+    ragged = lod.to_ragged(sb)
+    assert [len(r) for r in ragged] == [3, 5, 1]
+
+
+def test_nested_sequences():
+    nested = [
+        [np.ones((2, 3)), np.ones((4, 3))],
+        [np.ones((1, 3))],
+    ]
+    nb = lod.from_nested_ragged(nested)
+    np.testing.assert_array_equal(np.asarray(nb.seq_length), [2, 1])
+    assert np.asarray(nb.inner_mask()).sum() == 7
+    flat = nb.flatten_outer()
+    assert flat.batch_size == nb.data.shape[0] * nb.data.shape[1]
+
+
+def test_parameters_tar_roundtrip():
+    specs = [
+        ParamSpec("w", (3, 4), initializer.xavier()),
+        ParamSpec("b", (4,), initializer.constant(0.5)),
+    ]
+    p = Parameters.from_specs(specs, key=jax.random.key(0))
+    np.testing.assert_allclose(p["b"], 0.5 * np.ones(4))
+    buf = io.BytesIO()
+    p.to_tar(buf)
+    buf.seek(0)
+    q = Parameters.from_tar(buf)
+    assert set(q.names()) == {"w", "b"}
+    np.testing.assert_allclose(q["w"], p["w"])
+
+
+def test_parameters_shared_and_shape_check():
+    specs = [
+        ParamSpec("shared", (2, 2), initializer.constant(1.0)),
+        ParamSpec("shared", (2, 2), initializer.constant(1.0)),
+    ]
+    p = Parameters.from_specs(specs)
+    assert len(p) == 1
+    with pytest.raises(Exception):
+        p["shared"] = np.zeros((3, 3))
+
+
+def test_initializers_shapes():
+    k = jax.random.key(1)
+    for init in [
+        initializer.xavier(),
+        initializer.msra(),
+        initializer.uniform(-0.1, 0.1),
+        initializer.normal(0, 1),
+        initializer.paddle_default(),
+    ]:
+        v = init(k, (8, 16), jnp.float32)
+        assert v.shape == (8, 16)

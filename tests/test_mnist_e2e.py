@@ -1,0 +1,75 @@
+"""PR1 end-to-end slice (SURVEY §7.3): MNIST LeNet through the v2 API —
+reader → DataFeeder → topology → jitted train step (forward, jax.grad, SGD
+update) → events → Parameters tar round trip → inference.  Mirrors the
+reference's test_TrainerOnePass / api/test/testTrain.py."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.lenet import lenet_cost
+
+
+def test_mnist_lenet_one_pass_learns():
+    cost, predict, img, label = lenet_cost()
+    parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+    optimizer = paddle.optimizer.Momentum(
+        momentum=0.9, learning_rate=0.05,
+        regularization=paddle.optimizer.L2Regularization(rate=1e-4),
+    )
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters, update_equation=optimizer
+    )
+
+    events = []
+
+    def handler(e):
+        events.append(type(e).__name__)
+        if isinstance(e, paddle.event.EndIteration):
+            assert np.isfinite(e.cost)
+
+    reader = paddle.reader.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(), buf_size=512),
+        batch_size=64,
+    )
+    small = paddle.reader.firstn(reader, 30)  # 30 batches is plenty to learn blobs
+    trainer.train(reader=small, num_passes=2, event_handler=handler)
+
+    assert "BeginPass" in events and "EndPass" in events
+    assert "EndIteration" in events
+
+    result = trainer.test(
+        reader=paddle.reader.batch(paddle.dataset.mnist.test(), batch_size=64)
+    )
+    err = result.metrics["classification_error_evaluator"]
+    assert err < 0.25, f"model did not learn: error={err}"
+
+
+def test_parameters_tar_and_inference_consistency():
+    cost, predict, img, label = lenet_cost()
+    parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.SGD(learning_rate=0.01),
+    )
+    reader = paddle.reader.batch(paddle.dataset.mnist.train(), batch_size=32)
+    trainer.train(reader=paddle.reader.firstn(reader, 3), num_passes=1)
+
+    buf = io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+    loaded = paddle.parameters.Parameters.from_tar(buf)
+
+    samples = [s for _, s in zip(range(8), paddle.dataset.mnist.test()())]
+    probs1 = paddle.infer(
+        output_layer=predict, parameters=trainer.parameters,
+        input=[(s[0],) for s in samples],
+    )
+    probs2 = paddle.infer(
+        output_layer=predict, parameters=loaded,
+        input=[(s[0],) for s in samples],
+    )
+    np.testing.assert_allclose(probs1, probs2, rtol=1e-5, atol=1e-6)
+    assert probs1.shape == (8, 10)
+    np.testing.assert_allclose(probs1.sum(axis=1), 1.0, rtol=1e-4)

@@ -1,0 +1,206 @@
+"""Op-level numeric tests, following the reference's compare-two-
+implementations pattern (SURVEY §4): each op is checked against a plain
+numpy reference, and gradient-carrying ops against finite differences
+(the ``op_test.py`` check_grad analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags
+from paddle_tpu.core.lod import SequenceBatch, from_ragged
+from paddle_tpu.ops import loss as L
+from paddle_tpu.ops import math as M
+from paddle_tpu.ops import nn as N
+from paddle_tpu.ops import rnn as R
+from paddle_tpu.ops import sequence as S
+
+
+@pytest.fixture(autouse=True)
+def f32_compute():
+    """Numeric comparisons want f32 matmuls."""
+    flags.set("bf16", False)
+    yield
+    flags.set("bf16", True)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at x (LayerGradUtil analog)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(f, x, rtol=2e-2, atol=2e-3):
+    ana = np.asarray(jax.grad(lambda a: f(a))(jnp.asarray(x, jnp.float32)))
+    num = numeric_grad(lambda a: float(f(jnp.asarray(a, jnp.float32))), x)
+    np.testing.assert_allclose(ana, num, rtol=rtol, atol=atol)
+
+
+def test_matmul_matches_numpy(rng_np):
+    a = rng_np.normal(size=(4, 5)).astype(np.float32)
+    b = rng_np.normal(size=(5, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(M.matmul(a, b)), a @ b, rtol=1e-5)
+
+
+def test_conv2d_matches_manual(rng_np):
+    x = rng_np.normal(size=(2, 5, 5, 3)).astype(np.float32)
+    w = rng_np.normal(size=(3, 3, 3, 4)).astype(np.float32)
+    y = np.asarray(N.conv2d(x, w, stride=1, padding=1))
+    assert y.shape == (2, 5, 5, 4)
+    # check one output element by hand
+    xp = np.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    ref = np.sum(xp[0, 0:3, 0:3, :, None] * w, axis=(0, 1, 2))
+    np.testing.assert_allclose(y[0, 0, 0], ref, rtol=1e-4)
+
+
+def test_pooling(rng_np):
+    x = rng_np.normal(size=(1, 4, 4, 2)).astype(np.float32)
+    mx = np.asarray(N.max_pool2d(x, 2, 2))
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(2, 4))
+    np.testing.assert_allclose(mx, ref, rtol=1e-5, atol=1e-6)
+    av = np.asarray(N.avg_pool2d(x, 2, 2))
+    ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(2, 4))
+    np.testing.assert_allclose(av, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batch_norm_train_and_infer(rng_np):
+    x = rng_np.normal(2.0, 3.0, size=(16, 4)).astype(np.float32)
+    scale, bias = np.ones(4, np.float32), np.zeros(4, np.float32)
+    rm, rv = np.zeros(4, np.float32), np.ones(4, np.float32)
+    y, nm, nv = N.batch_norm(jnp.asarray(x), scale, bias, rm, rv, True, momentum=0.0)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=0), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(axis=0), 1, atol=1e-2)
+    # momentum=0 -> running stats == batch stats
+    np.testing.assert_allclose(np.asarray(nm), x.mean(axis=0), rtol=1e-4)
+    y2, _, _ = N.batch_norm(jnp.asarray(x), scale, bias, nm, nv, False)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y), atol=1e-4)
+
+
+def test_softmax_cross_entropy_grad(rng_np):
+    logits = rng_np.normal(size=(3, 5))
+    labels = np.array([0, 2, 4])
+
+    def f(lg):
+        return jnp.mean(L.softmax_cross_entropy_with_logits(lg, jnp.asarray(labels)))
+
+    check_grad(f, logits)
+
+
+def test_square_error_grad(rng_np):
+    pred = rng_np.normal(size=(4, 3))
+    label = rng_np.normal(size=(4, 3)).astype(np.float32)
+
+    def f(p):
+        return jnp.mean(L.square_error(p, jnp.asarray(label)))
+
+    check_grad(f, pred)
+
+
+def test_seq_pooling(rng_np):
+    seqs = [rng_np.normal(size=(n, 3)).astype(np.float32) for n in (2, 5, 1)]
+    sb = from_ragged(seqs)
+    np.testing.assert_allclose(
+        np.asarray(S.seq_pool_sum(sb)), np.stack([s.sum(0) for s in seqs]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(S.seq_pool_avg(sb)), np.stack([s.mean(0) for s in seqs]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(S.seq_pool_max(sb)), np.stack([s.max(0) for s in seqs]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(S.seq_pool_sqrt(sb)),
+        np.stack([s.sum(0) / np.sqrt(len(s)) for s in seqs]),
+        rtol=1e-5,
+    )
+
+
+def test_seq_expand_and_first_last(rng_np):
+    seqs = [rng_np.normal(size=(n, 2)).astype(np.float32) for n in (3, 2)]
+    sb = from_ragged(seqs)
+    vec = rng_np.normal(size=(2, 4)).astype(np.float32)
+    ex = S.expand(jnp.asarray(vec), sb)
+    assert ex.data.shape == (2, sb.max_len, 4)
+    np.testing.assert_allclose(np.asarray(ex.data[0, 2]), vec[0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(S.seq_first(sb)[1]), seqs[1][0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(S.seq_last(sb)[0]), seqs[0][-1], rtol=1e-6)
+
+
+def test_context_projection(rng_np):
+    seqs = [np.arange(12, dtype=np.float32).reshape(4, 3)]
+    sb = from_ragged(seqs)
+    out = S.context_projection(sb, context_len=3, context_start=-1)
+    o = np.asarray(out.data[0])
+    # position 0: [pad, x0, x1]
+    np.testing.assert_allclose(o[0, :3], 0)
+    np.testing.assert_allclose(o[0, 3:6], seqs[0][0], rtol=1e-6)
+    np.testing.assert_allclose(o[0, 6:9], seqs[0][1], rtol=1e-6)
+    # position 3 (last): [x2, x3, pad]
+    np.testing.assert_allclose(o[3, 0:3], seqs[0][2], rtol=1e-6)
+    np.testing.assert_allclose(o[3, 6:9], 0)
+
+
+def test_lstm_masked_equivalence(rng_np):
+    """Padded ragged batch must give the same result as per-sequence runs."""
+    din, d = 3, 4
+    w_x = rng_np.normal(size=(din, 4 * d)).astype(np.float32) * 0.3
+    w_h = rng_np.normal(size=(d, 4 * d)).astype(np.float32) * 0.3
+    b = np.zeros(4 * d, np.float32)
+    seqs = [rng_np.normal(size=(n, din)).astype(np.float32) for n in (3, 6)]
+    sb = from_ragged(seqs)
+    out, last = R.lstm(sb, w_x, w_h, b)
+    for i, s in enumerate(seqs):
+        single = SequenceBatch(
+            data=jnp.asarray(s[None]), length=jnp.asarray([len(s)])
+        )
+        o1, l1 = R.lstm(single, w_x, w_h, b)
+        np.testing.assert_allclose(
+            np.asarray(out.data[i, : len(s)]), np.asarray(o1.data[0, : len(s)]),
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(last.h[i]), np.asarray(l1.h[0]), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_gru_shapes(rng_np):
+    din, d = 3, 5
+    sb = from_ragged([rng_np.normal(size=(4, din)).astype(np.float32)])
+    out, last = R.gru(
+        sb,
+        rng_np.normal(size=(din, 3 * d)).astype(np.float32),
+        rng_np.normal(size=(d, 2 * d)).astype(np.float32),
+        rng_np.normal(size=(d, d)).astype(np.float32),
+        np.zeros(3 * d, np.float32),
+    )
+    assert out.data.shape == (1, sb.max_len, d)
+    assert last.shape == (1, d)
+
+
+def test_cos_sim(rng_np):
+    a = rng_np.normal(size=(4, 8)).astype(np.float32)
+    b = rng_np.normal(size=(4, 8)).astype(np.float32)
+    got = np.asarray(M.cos_sim(a, b))
+    ref = np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_cross_map_normal(rng_np):
+    x = rng_np.normal(size=(2, 3, 3, 8)).astype(np.float32)
+    y = np.asarray(N.cross_map_normal(x, size=5, scale=1e-4, pow_=0.75))
+    # reference formula at channel c
+    c = 4
+    window = (x[..., 2:7] ** 2).sum(-1)
+    ref = x[..., c] / (1 + 1e-4 * window) ** 0.75
+    np.testing.assert_allclose(y[..., c], ref, rtol=1e-4)
