@@ -142,7 +142,8 @@ def fc(
             I.constant(0.0),
         )
         specs.append(bspec)
-    activation = act_mod.get(act)
+    # reference fc_layer default act is Tanh (@wrap_act_default(), layers.py:997)
+    activation = act_mod.get(act) if act is not None else act_mod.TanhActivation()
 
     def fwd(ctx: Context, params, states, *parents):
         def compute(flats):
@@ -278,7 +279,8 @@ def img_conv(
             name, "wbias", (num_filters,), I.constant(0.0),
         )
         specs.append(bspec)
-    activation = act_mod.get(act)
+    # reference img_conv_layer default act is ReLU (layers.py:2374)
+    activation = act_mod.get(act) if act is not None else act_mod.ReluActivation()
 
     def fwd(ctx, params, states, x):
         x = _to_nhwc(raw(x), c_in, h_in, w_in)
@@ -411,7 +413,8 @@ def batch_norm(
     )
     mean_s = StateSpec(f"_{name}.mean", (c,), 0.0)
     var_s = StateSpec(f"_{name}.var", (c,), 1.0)
-    activation = act_mod.get(act)
+    # reference batch_norm_layer default act is ReLU (layers.py:2975)
+    activation = act_mod.get(act) if act is not None else act_mod.ReluActivation()
 
     def fwd(ctx, params, states, x):
         xr = raw(x)
