@@ -1,0 +1,83 @@
+"""Headline benchmark — AlexNet training, ms/batch at batch size 64.
+
+The reference's own headline number (benchmark/README.md:31-38): 195 ms/batch
+on 1x Tesla K40m (cuDNN 5.1).  Here: the full jitted train step (forward,
+backward, momentum update — the same work TrainerInternal::trainOneBatch
+does per batch) on one TPU chip.  Prints ONE JSON line;
+``vs_baseline`` = reference_ms / our_ms (>1 means faster than the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_MS = 195.0  # AlexNet bs64, 1x K40m — benchmark/README.md:31-38
+BATCH = 64
+
+
+def main() -> None:
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.layers import base
+    from paddle_tpu.models import image as M
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.trainer.step import build_train_step
+
+    base.reset_name_counters()
+    cost, predict, img, label = M.alexnet_cost()
+    topo = Topology(cost)
+    opt = Momentum(momentum=0.9, learning_rate=0.01 / BATCH)
+    specs = {s.name: s for s in topo.param_specs()}
+
+    params = paddle.parameters.create(topo).as_dict()
+    opt_state = opt.init(params, specs)
+    states = topo.init_states()
+    step = build_train_step(topo, opt)
+
+    rng = np.random.default_rng(0)
+    feed = {
+        "image": jax.device_put(
+            rng.normal(size=(BATCH, 227 * 227 * 3)).astype(np.float32)
+        ),
+        "label": jax.device_put(rng.integers(0, 1000, size=(BATCH,))),
+    }
+    key = jax.random.key(0)
+
+    def run(n):
+        """n chained steps + one scalar readback.  The readback (not
+        block_until_ready, which the tunneled backend does not honor) forces
+        execution; its ~constant RTT is cancelled by the two-point method."""
+        nonlocal params, opt_state, states
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, opt_state, states, c, _ = step(
+                params, opt_state, states, feed, key
+            )
+        float(c)
+        return time.perf_counter() - t0
+
+    run(3)  # compile + warmup
+    n1, n2 = 5, 55
+    t_small = min(run(n1) for _ in range(2))
+    t_large = min(run(n2) for _ in range(2))
+    ms = max(t_large - t_small, 1e-9) / (n2 - n1) * 1000.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_train_ms_per_batch_bs64",
+                "value": round(ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(REFERENCE_MS / ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,7 @@ def _pname(attr: ParamAttr | None, layer_name: str, suffix: str) -> str:
 
 def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
     a = param_attr_or_default(attr)
-    return ParamSpec(
+    fields = dict(
         name=_pname(a, layer_name, suffix),
         shape=tuple(shape),
         initializer=a.make_initializer(default_init),
@@ -70,8 +70,10 @@ def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
         decay_rate=a.l2_rate,
         gradient_clipping_threshold=a.gradient_clipping_threshold,
         sparse=a.sparse_update,
-        **kw,
+        sharding=a.sharding,
     )
+    fields.update(kw)  # layer-specific overrides (e.g. embedding sparse=True)
+    return ParamSpec(**fields)
 
 
 def _maybe_dropout(node: LayerOutput, layer_attr: ExtraAttr | None) -> LayerOutput:

@@ -23,6 +23,10 @@ class ParamAttr:
     sparse_update: bool = False
     gradient_clipping_threshold: float | None = None
     initializer: Callable | None = None  # direct override
+    # mesh axis name (or None) per weight dim — tensor-parallel sharding over
+    # the pjit mesh; the capability upgrade over the reference's per-layer
+    # device placement (ParallelNeuralNetwork.h:34 deviceId pinning)
+    sharding: tuple | None = None
 
     def make_initializer(self, default: Callable) -> Callable:
         from paddle_tpu.core import initializer as I

@@ -79,7 +79,17 @@ class MeshContext:
         MultiGradientMachine's per-thread full copies."""
         if spec_axes is None:
             return self.replicated()
-        return NamedSharding(self.mesh, P(*spec_axes))
+        # known axes absent from this mesh degrade to replicated (a
+        # TP-annotated model still runs on a pure-DP mesh); unknown names are
+        # errors, not silent replication
+        present = set(self.mesh.axis_names)
+        for a in spec_axes:
+            enforce(
+                a is None or a in present or a in AXES,
+                f"unknown mesh axis {a!r} in param sharding {spec_axes}",
+            )
+        axes = [a if a in present else None for a in spec_axes]
+        return NamedSharding(self.mesh, P(*axes))
 
     def shard_batch(self, tree):
         """Place a feed pytree with batch-dim sharding (device_put is async)."""
@@ -101,6 +111,17 @@ class MeshContext:
     def replicate(self, tree):
         sh = self.replicated()
         return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+    def place_params(self, values: dict, specs: dict) -> dict:
+        """Place each parameter per its ParamSpec.sharding (tensor parallel);
+        unsharded params are replicated — the pure-DP layout that reproduces
+        MultiGradientMachine's per-replica full copies."""
+        out = {}
+        for name, v in values.items():
+            spec = specs.get(name)
+            axes = getattr(spec, "sharding", None) if spec is not None else None
+            out[name] = jax.device_put(v, self.param_sharding(axes, v.ndim))
+        return out
 
 
 def get_mesh(shape: dict[str, int] | None = None) -> MeshContext:
