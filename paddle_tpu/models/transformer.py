@@ -1,0 +1,196 @@
+"""Transformer LM — the long-context flagship (new capability; the 2017
+reference predates transformers, its sequence flagship being the
+MixedLayer-attention NMT demo).  Designed TPU-first:
+
+- pre-LN decoder blocks under ``lax.scan`` over stacked layer params (one
+  compiled block, S iterations — fast compiles at any depth);
+- ``jax.checkpoint`` per block (rematerialisation trades FLOPs for HBM);
+- 4D parallelism on one ``{data, seq, model, pipe}`` mesh:
+  * dp  — batch dim sharded over ``data`` (gradient all-reduce over ICI);
+  * tp  — Megatron pattern: qkv/mlp-in weights column-sharded over
+    ``model``, wo/mlp-out row-sharded, so each block needs exactly two
+    activation all-reduces (inserted by GSPMD from the shardings);
+  * sp  — ring attention over ``seq`` (ops/attention.py) with the sequence
+    dim of activations sharded;
+  * pp  — blocks split into stages via parallel/pipeline.py (optional).
+
+Everything is pure functions over a params pytree; sharding is data, not
+code: ``param_shardings`` returns a matching pytree of PartitionSpecs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 8
+    embed_dim: int = 512
+    mlp_dim: int = 2048
+    max_seq_len: int = 2048
+    dtype: object = jnp.float32
+    remat: bool = True
+    # attention implementation: "exact" | "blockwise" | "ring" (ring needs a
+    # mesh with a seq axis and activations sharded over it)
+    attn_impl: str = "exact"
+    attn_block_size: int = 512
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
+    """Stacked-layer params: block weights have leading dim num_layers."""
+    e, h, m, v_sz = cfg.embed_dim, cfg.num_heads * cfg.head_dim, cfg.mlp_dim, cfg.vocab_size
+    s = cfg.num_layers
+    k = iter(jax.random.split(key, 12))
+    norm = lambda *shape: jax.random.normal(next(k), shape, cfg.dtype)
+    return {
+        "embed": norm(v_sz, e) * (e ** -0.5),
+        "pos_embed": norm(cfg.max_seq_len, e) * 0.02,
+        "blocks": {
+            "ln1_g": jnp.ones((s, e), cfg.dtype),
+            "ln1_b": jnp.zeros((s, e), cfg.dtype),
+            "wq": norm(s, e, h) * (e ** -0.5),
+            "wk": norm(s, e, h) * (e ** -0.5),
+            "wv": norm(s, e, h) * (e ** -0.5),
+            "wo": norm(s, h, e) * (h ** -0.5) / (2 * s) ** 0.5,
+            "ln2_g": jnp.ones((s, e), cfg.dtype),
+            "ln2_b": jnp.zeros((s, e), cfg.dtype),
+            "w_in": norm(s, e, m) * (e ** -0.5),
+            "b_in": jnp.zeros((s, m), cfg.dtype),
+            "w_out": norm(s, m, e) * (m ** -0.5) / (2 * s) ** 0.5,
+            "b_out": jnp.zeros((s, e), cfg.dtype),
+        },
+        "ln_f_g": jnp.ones((e,), cfg.dtype),
+        "ln_f_b": jnp.zeros((e,), cfg.dtype),
+    }
+
+
+def param_shardings(cfg: TransformerConfig) -> dict:
+    """PartitionSpec pytree matching init_params — the Megatron TP layout
+    (axis names degrade to replicated if absent from the mesh via
+    MeshContext.param_sharding semantics; used directly with NamedSharding
+    they must exist)."""
+    col, row = P(None, None, "model"), P(None, "model", None)
+    return {
+        "embed": P("model", None),  # vocab-sharded table (in-mesh pserver)
+        "pos_embed": P(),
+        "blocks": {
+            "ln1_g": P(), "ln1_b": P(),
+            "wq": col, "wk": col, "wv": col,
+            "wo": row,
+            "ln2_g": P(), "ln2_b": P(),
+            "w_in": col, "b_in": P(None, "model"),
+            "w_out": row, "b_out": P(),
+        },
+        "ln_f_g": P(), "ln_f_b": P(),
+    }
+
+
+def place_params(params: dict, mesh, cfg: TransformerConfig | None = None) -> dict:
+    """device_put per the TP layout, degrading absent axes to replicated."""
+    present = set(mesh.axis_names)
+
+    def fix(spec):
+        return P(*[a if a in present else None for a in spec])
+
+    specs = jax.tree.map(
+        fix, param_shardings(cfg or TransformerConfig()),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)), params, specs
+    )
+
+
+from paddle_tpu.ops.nn import layer_norm as _ln  # shared with the v2 path
+
+
+def _attention(cfg: TransformerConfig, q, k, v, mesh):
+    if cfg.attn_impl == "ring":
+        assert mesh is not None and "seq" in mesh.axis_names, (
+            "ring attention needs a mesh with a 'seq' axis"
+        )
+        return attn_ops.attention_with_sequence_parallel(
+            q, k, v, mesh, causal=True,
+            head_axis="model" if "model" in mesh.axis_names else None,
+        )
+    if cfg.attn_impl == "blockwise":
+        return attn_ops.blockwise_attention(
+            q, k, v, block_size=cfg.attn_block_size, causal=True
+        )
+    t = q.shape[1]
+    return attn_ops.dot_product_attention(
+        q, k, v, mask=attn_ops.causal_mask(t, t)
+    )
+
+
+def _block(cfg: TransformerConfig, mesh, x, layer):
+    """One pre-LN decoder block; x [B, T, E]."""
+    b, t, e = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+    q = (h @ layer["wq"]).reshape(b, t, nh, hd)
+    k = (h @ layer["wk"]).reshape(b, t, nh, hd)
+    v = (h @ layer["wv"]).reshape(b, t, nh, hd)
+    a = _attention(cfg, q, k, v, mesh)
+    x = x + a.reshape(b, t, nh * hd) @ layer["wo"]
+    h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+    h = jax.nn.gelu(h @ layer["w_in"] + layer["b_in"])
+    return x + h @ layer["w_out"] + layer["b_out"]
+
+
+def forward(cfg: TransformerConfig, params: dict, ids: jax.Array,
+            mesh=None) -> jax.Array:
+    """ids [B, T] -> logits [B, T, V]."""
+    b, t = ids.shape
+    x = params["embed"][ids] + params["pos_embed"][:t][None]
+
+    block = functools.partial(_block, cfg, mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    return x @ params["embed"].T
+
+
+def loss_fn(cfg: TransformerConfig, params: dict, ids: jax.Array,
+            mesh=None) -> jax.Array:
+    """Next-token mean cross-entropy (targets = ids shifted left)."""
+    logits = forward(cfg, params, ids[:, :-1], mesh=mesh)
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def build_train_step(cfg: TransformerConfig, optimizer, mesh=None):
+    """(params, opt_state, ids) -> (params, opt_state, loss), jitted.
+    With a mesh: batch sharded ("data","seq" on time), params per TP layout;
+    GSPMD inserts every collective."""
+
+    def step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, ids, mesh=mesh)
+        )(params)
+        new_params, new_opt = optimizer.apply_tree(grads, params, opt_state)
+        return new_params, new_opt, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -198,6 +198,39 @@ class Optimizer:
             new_state["avg_count"] = state["avg_count"] + 1.0
         return new_params, new_state
 
+    # -- generic-pytree API (models outside the name-keyed Topology world,
+    # e.g. the transformer family) --------------------------------------------
+    def init_tree(self, params) -> dict:
+        leaves = jax.tree.leaves(params)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": [self.slot_init(p) for p in leaves],
+        }
+
+    def apply_tree(self, grads, params, state) -> tuple[Any, dict]:
+        """Same update rule over an arbitrary params pytree (no per-param
+        specs; global clip/decay only)."""
+        step = state["step"]
+        lr = self.lr_fn(step)
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_g = jax.tree.leaves(grads)
+        new_p, new_s = [], []
+        for g, p, s in zip(leaves_g, leaves_p, state["slots"]):
+            g = g.astype(jnp.float32)
+            if self.l2_rate:
+                g = g + self.l2_rate * p
+            if self.l1_rate:
+                g = g + self.l1_rate * jnp.sign(p)
+            if self.gradient_clipping_threshold:
+                norm = jnp.sqrt(jnp.sum(g * g) + 1e-12)
+                g = g * jnp.minimum(1.0, self.gradient_clipping_threshold / norm)
+            delta, s2 = self.tensor_update(g, p, s, lr, step)
+            new_p.append(p - delta)
+            new_s.append(s2)
+        return jax.tree.unflatten(treedef, new_p), {
+            "step": step + 1, "slots": new_s,
+        }
+
     # v2 compat shim: ``optimizer.create_*_updater`` existed; the Trainer now
     # owns the update step, so these are thin markers.
     def to_setting_kwargs(self):

@@ -1,0 +1,93 @@
+"""Collective ops — the op-level surface of the reference's three comm
+backends (``paddle/operators/nccl_op.cc:66-191`` NCCLAllReduce/Reduce/Bcast,
+the pserver scatter/gather of ``ParameterClient2``, and the Go pserver RPC),
+expressed as XLA ICI collectives usable inside ``shard_map``.
+
+Inside compiled programs these lower to ICI all-reduce / all-gather /
+reduce-scatter / collective-permute; across slices XLA routes them over DCN.
+No host-side transport exists or is needed — the "network" is the compiler's
+problem, which is the whole point of the TPU-native redesign (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    """≅ NCCLAllReduce (nccl_op.cc:66); the gradient-sync primitive that
+    replaces ParameterServer2::addGradient + getParameter round-trips."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` from every device on the mesh axis."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    """Sum-reduce then scatter shards — the ZeRO/“sharded grads” primitive."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    """≅ NCCLBcast: every device gets root's value."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def permute(x, axis_name: str, perm: list[tuple[int, int]]):
+    """≅ collective-permute (pipeline-stage handoff, ring rotation)."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the mesh axis ring."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def psum_tree(tree, axis_name: str):
+    """All-reduce every leaf of a pytree (the whole-gradient sync)."""
+    return jax.tree.map(lambda g: lax.psum(g, axis_name), tree)
+
+
+def on_mesh(mesh, fn, in_specs, out_specs):
+    """Run ``fn`` (which uses the collectives above) under shard_map."""
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+
+
+def pmean_tree(tree, axis_name: str):
+    """Mean-all-reduce every leaf of a pytree."""
+    return jax.tree.map(lambda g: lax.pmean(g, axis_name), tree)
+
+
+def data_parallel_mean_grads(mesh, grads):
+    """Host-callable gradient mean-all-reduce over the ``data`` axis for
+    eager use; in the jitted train step XLA inserts this automatically from
+    shardings."""
+    fn = shard_map(
+        functools.partial(pmean_tree, axis_name="data"),
+        mesh=mesh,
+        in_specs=jax.tree.map(lambda _: P("data"), grads),
+        out_specs=jax.tree.map(lambda _: P("data"), grads),
+        check_vma=False,
+    )
+    return fn(grads)

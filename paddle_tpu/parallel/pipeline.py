@@ -1,0 +1,102 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+The reference's closest capability is per-layer device placement
+(``ParallelNeuralNetwork.h:34-105``: layers pinned to deviceId, one worker
+thread per device) — a capability this upgrades to a real GPipe schedule:
+identical-shaped stages (e.g. transformer blocks) hold their stage's
+parameters (stacked pytree leading axis sharded over ``pipe``), microbatches
+stream into stage 0, activations hand off stage-to-stage via
+``lax.ppermute`` (ICI collective-permute), and autodiff reverses the
+schedule for the backward pass.  Bubble fraction = (S-1)/(M+S-1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _stage_loop(stage_fn, n_micro: int, axis_name: str, params, x_mb):
+    """Runs inside shard_map: params is this stage's slice (leading dim 1);
+    x_mb is [n_micro, mb, ...] microbatches (replicated)."""
+    stage = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    params = jax.tree.map(lambda p: p[0], params)
+    total = n_micro + n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]  # forward handoff chain
+
+    mb_shape = jax.tree.map(lambda a: a[0], x_mb)
+    state = jax.tree.map(jnp.zeros_like, mb_shape)  # activation in flight
+    outs = jax.tree.map(
+        lambda a: jnp.zeros_like(a), x_mb
+    )  # collected at the last stage
+
+    def step(t, carry):
+        state, outs = carry
+        # stage 0 ingests microbatch t (or zeros once drained)
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        fresh = jax.tree.map(lambda a: a[mb_idx], x_mb)
+        ingest = (stage == 0) & (t < n_micro)
+        cur = jax.tree.map(
+            lambda f, s: jnp.where(ingest, f, s), fresh, state
+        )
+        y = stage_fn(params, cur)
+        # last stage commits finished microbatch t-(S-1)
+        out_idx = t - (n_stages - 1)
+        commit = (stage == n_stages - 1) & (out_idx >= 0)
+        outs = jax.tree.map(
+            lambda o, yy: o.at[jnp.clip(out_idx, 0, n_micro - 1)].set(
+                jnp.where(commit, yy, o[jnp.clip(out_idx, 0, n_micro - 1)])
+            ),
+            outs, y,
+        )
+        state = jax.tree.map(
+            lambda a: lax.ppermute(a, axis_name, perm), y
+        )
+        return state, outs
+
+    _, outs = lax.fori_loop(0, total, step, (state, outs))
+    # only the last stage holds real outputs; share them ring-wide
+    outs = jax.tree.map(
+        lambda o: lax.psum(
+            jnp.where(stage == n_stages - 1, o, jnp.zeros_like(o)), axis_name
+        ),
+        outs,
+    )
+    return outs
+
+
+def pipeline_apply(
+    stage_fn,
+    stacked_params,
+    x,
+    n_microbatches: int,
+    mesh,
+    axis_name: str = "pipe",
+):
+    """Apply ``n_stages`` sequential stages (same shape in/out) to ``x``.
+
+    stacked_params: pytree with leading dim = n_stages (sharded over
+    ``axis_name``); x: [B, ...] batch, split into ``n_microbatches``.
+    Returns stage_{S-1}(...stage_0(x)) exactly (GPipe semantics).
+    """
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    assert b % n_microbatches == 0, (b, n_microbatches)
+    mb = b // n_microbatches
+    x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    p_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(
+        functools.partial(_stage_loop, stage_fn, n_microbatches, axis_name),
+        mesh=mesh,
+        in_specs=(p_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    outs = fn(stacked_params, x_mb)
+    return outs.reshape((b,) + outs.shape[2:])

@@ -1,0 +1,123 @@
+"""Attention numerics: blockwise == exact, ring == exact (on the 8-device
+virtual mesh), plus gradient agreement — the compare-two-implementations
+pattern of the reference's test_matrixCompare/Compare2Function harnesses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.ops import attention as A
+
+
+def _qkv(b=2, t=32, h=4, d=8, seed=0):
+    r = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(r.normal(size=(b, t, h, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_blockwise_matches_exact():
+    q, k, v = _qkv()
+    ref = A.dot_product_attention(q, k, v)
+    out = A.blockwise_attention(q, k, v, block_size=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_blockwise_causal_matches_exact():
+    q, k, v = _qkv(t=33)  # non-divisible by block
+    mask = A.causal_mask(33, 33)
+    ref = A.dot_product_attention(q, k, v, mask=mask)
+    out = A.blockwise_attention(q, k, v, block_size=8, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_blockwise_grads_match():
+    q, k, v = _qkv(t=16)
+
+    def loss_exact(q, k, v):
+        return jnp.sum(A.dot_product_attention(q, k, v) ** 2)
+
+    def loss_block(q, k, v):
+        return jnp.sum(A.blockwise_attention(q, k, v, block_size=4) ** 2)
+
+    g_ref = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_block, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_exact(causal):
+    q, k, v = _qkv(b=2, t=32, h=2, d=4)
+    mask = A.causal_mask(32, 32) if causal else None
+    ref = A.dot_product_attention(q, k, v, mask=mask)
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4), ("seq",))
+    out = A.attention_with_sequence_parallel(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_grads_match():
+    q, k, v = _qkv(b=1, t=16, h=2, d=4)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4), ("seq",))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            A.attention_with_sequence_parallel(q, k, v, mesh, causal=True) ** 2
+        )
+
+    def loss_exact(q, k, v):
+        m = A.causal_mask(16, 16)
+        return jnp.sum(A.dot_product_attention(q, k, v, mask=m) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_mha_shapes_and_causal():
+    b, t, e, hds = 2, 10, 16, 16
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(b, t, e)).astype(np.float32))
+    w = lambda m, n: jnp.asarray(r.normal(size=(m, n)).astype(np.float32) * 0.1)
+    out = A.multi_head_attention(
+        x, x, w(e, hds), w(e, hds), w(e, hds), w(hds, e), num_heads=4, causal=True
+    )
+    assert out.shape == (b, t, e)
+    # causal: early positions unaffected by corrupting later positions
+    wq, wk, wv, wo = w(e, hds), w(e, hds), w(e, hds), w(hds, e)
+    o1 = A.multi_head_attention(x, x, wq, wk, wv, wo, num_heads=4, causal=True)
+    o2 = A.multi_head_attention(
+        x.at[:, 5:, :].set(123.0), x.at[:, 5:, :].set(123.0),
+        wq, wk, wv, wo, num_heads=4, causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(o1[:, :5]), np.asarray(o2[:, :5]), atol=1e-5
+    )
+
+
+def test_collectives_surface():
+    from paddle_tpu.parallel import collective as C
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4), ("data",))
+    x = jnp.arange(8.0).reshape(4, 2)
+
+    def body(x):
+        s = C.all_reduce(x, "data")
+        g = C.all_gather(x, "data")
+        b = C.broadcast(x, "data", root=2)
+        r = C.ring_shift(x, "data")
+        return s, g, b, r
+
+    fn = C.on_mesh(mesh, body, in_specs=(P("data"),),
+                   out_specs=(P("data"), P("data"), P("data"), P("data")))
+    s, g, b, r = fn(x)
+    np.testing.assert_allclose(np.asarray(s)[0], x.sum(0))  # every shard = total
+    assert np.asarray(g).shape == (16, 2)
+    np.testing.assert_allclose(np.asarray(b)[0], np.asarray(x)[2])
+    np.testing.assert_allclose(np.asarray(r)[1], np.asarray(x)[0])

@@ -1,0 +1,88 @@
+"""Transformer LM: single-device correctness, attn-impl equivalence, and the
+full 3-axis (data x seq x model) sharded train step on the virtual mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.optimizer import Adam
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=64, num_layers=2, num_heads=2, embed_dim=16, mlp_dim=32,
+        max_seq_len=32, remat=False,
+    )
+    base.update(kw)
+    return T.TransformerConfig(**base)
+
+
+def test_forward_shapes_and_loss():
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)))
+    logits = T.forward(cfg, params, ids)
+    assert logits.shape == (2, 16, 64)
+    loss = T.loss_fn(cfg, params, ids)
+    assert np.isfinite(float(loss))
+    assert float(loss) < 2 * np.log(64)
+
+
+def test_attn_impls_agree():
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)))
+    ref = T.forward(cfg, params, ids)
+    blk = T.forward(
+        dataclasses.replace(cfg, attn_impl="blockwise", attn_block_size=4),
+        params, ids,
+    )
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(ref), atol=1e-4)
+
+
+def test_train_step_learns():
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.key(0))
+    opt = Adam(learning_rate=1e-2)
+    state = opt.init_tree(params)
+    step = T.build_train_step(cfg, opt)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 16)))
+    losses = []
+    for _ in range(10):
+        params, state, loss = step(params, state, ids)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_sharded_train_step_dp_tp_sp():
+    """2x2x2 mesh: batch over data, sequence over seq (ring attention),
+    weights over model — the full 3D parallel train step."""
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "seq", "model"))
+    cfg = _cfg(attn_impl="ring")
+    params = T.init_params(cfg, jax.random.key(0))
+    params = T.place_params(params, mesh, cfg)
+    opt = Adam(learning_rate=1e-2)
+    state = opt.init_tree(params)
+    step = T.build_train_step(cfg, opt, mesh=mesh)
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 17)))
+    # tokens: ids[:, :-1] has T=16 -> sharded 2-way over seq
+    ids = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    l0 = None
+    for _ in range(5):
+        params, state, loss = step(params, state, ids)
+        if l0 is None:
+            l0 = float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < l0
+
+    # sharded result == single-device result (first step loss)
+    cfg1 = _cfg()
+    params1 = T.init_params(cfg1, jax.random.key(0))
+    ids1 = jnp.asarray(np.asarray(ids))
+    loss1 = float(T.loss_fn(cfg1, params1, ids1))
+    np.testing.assert_allclose(l0, loss1, atol=1e-3)
