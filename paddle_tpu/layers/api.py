@@ -1200,6 +1200,29 @@ def _mean_over_batch(per_example):
     return jnp.mean(per_example)
 
 
+def _seq_aware_ce(probs_value, label_value, ce_fn, weight_value=None):
+    """Cross-entropy that treats each valid timestep of a sequence batch as
+    one instance (the reference flattens sequences into instances via
+    Argument; padding must not contribute).  ``weight_value`` (optional) is a
+    per-sequence or per-timestep instance weight folded into the mask."""
+    p = raw(probs_value)
+    y = raw(label_value)
+    if is_sequence(probs_value) or is_sequence(label_value):
+        seq = probs_value if is_sequence(probs_value) else label_value
+        v = p.shape[-1]
+        ce = ce_fn(p.reshape(-1, v), y.reshape(-1))
+        m = seq.mask().reshape(-1)
+        if weight_value is not None:
+            wv = raw(weight_value)
+            if wv.ndim == 1 or wv.shape == (seq.batch_size, 1):
+                wv = jnp.broadcast_to(
+                    wv.reshape(seq.batch_size, 1),
+                    (seq.batch_size, seq.max_len))
+            m = m * wv.reshape(-1)
+        return jnp.sum(ce * m) / jnp.clip(jnp.sum(m), 1e-9)
+    return None  # caller falls back to dense path
+
+
 def classification_cost(input: LayerOutput, label: LayerOutput, weight=None,
                         name: str | None = None, evaluator=None,
                         coeff: float = 1.0) -> LayerOutput:
@@ -1209,6 +1232,10 @@ def classification_cost(input: LayerOutput, label: LayerOutput, weight=None,
     parents = [input, label] + ([weight] if weight is not None else [])
 
     def fwd(ctx, params, states, probs, lbl, *w):
+        seq_ce = _seq_aware_ce(probs, lbl, loss_ops.cross_entropy,
+                               w[0] if w else None)
+        if seq_ce is not None:
+            return coeff * seq_ce
         p = raw(probs)
         y = raw(lbl).reshape(-1)
         ce = loss_ops.cross_entropy(p, y)
@@ -1228,6 +1255,9 @@ def cross_entropy_cost(input: LayerOutput, label: LayerOutput,
     name = name or gen_name("cost")
 
     def fwd(ctx, params, states, probs, lbl):
+        seq_ce = _seq_aware_ce(probs, lbl, loss_ops.cross_entropy)
+        if seq_ce is not None:
+            return coeff * seq_ce
         return coeff * _mean_over_batch(
             loss_ops.cross_entropy(raw(probs), raw(lbl).reshape(-1))
         )

@@ -135,6 +135,12 @@ def evaluate(
     values: dict[str, Value] = {}
     new_states = dict(states)
     for node in topo_sort(nodes):
+        if node.fn is None and node.name in feed:
+            # leaves only — data layers and injected recurrent_group leaves
+            # (placeholders, memories).  Computed layers (fn set) are never
+            # shadowed by a same-named feed key.
+            values[node.name] = feed[node.name]
+            continue
         if node.layer_type == "data":
             enforce(node.name in feed, f"missing feed for data layer {node.name!r}")
             values[node.name] = feed[node.name]

@@ -1,0 +1,213 @@
+"""Structured-prediction + assorted layers: CRF, CTC, and friends.
+
+Reference parity: ``crf_layer`` (layers.py:5065, CRFLayer),
+``crf_decoding_layer`` (layers.py:5134, CRFDecodingLayer), ``ctc_layer``
+(layers.py:5189 — blank is the LAST category index), ``warp_ctc_layer``
+(layers.py:5251 — blank configurable, default 0), ``linear_comb_layer``
+(layers.py:5875), ``out_prod_layer`` (layers.py:4068), ``repeat_layer``
+(layers.py:1807), ``kmax_seq_score`` (layers.py:6371)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializer as I
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.layers.api import _wspec
+from paddle_tpu.layers.base import LayerOutput, gen_name, is_sequence, raw
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import ctc as ctc_ops
+
+
+def crf(input: LayerOutput, label: LayerOutput, size: int | None = None,
+        weight: LayerOutput | None = None, param_attr=None,
+        name: str | None = None) -> LayerOutput:
+    """CRF negative log-likelihood cost (≅ crf_layer / LinearChainCRF).
+    ``input`` are per-step emission scores [*, size]; parameter is the
+    reference's [size+2, size] start/end/transition matrix.  To share the
+    transitions with ``crf_decoding``, give both the same param_attr name."""
+    name = name or gen_name("crf_layer")
+    size = size or input.size
+    w = _wspec(param_attr, name, "w", (size + 2, size), I.constant(0.0))
+    parents = [input, label] + ([weight] if weight is not None else [])
+
+    def fwd(ctx, params, states, emis, lbl, *wgt):
+        enforce(is_sequence(emis), "crf expects sequence emissions")
+        lbl_seq = lbl if is_sequence(lbl) else SequenceBatch(
+            raw(lbl), emis.length)
+        nll = crf_ops.crf_nll(emis, lbl_seq, params[w.name])  # [B]
+        if wgt:
+            nll = nll * raw(wgt[0]).reshape(-1)
+        return jnp.mean(nll)
+
+    return LayerOutput(name=name, layer_type="crf", size=1,
+                       parents=tuple(parents), param_specs=(w,), fn=fwd,
+                       attrs={"num_classes": size})
+
+
+crf_layer = crf
+
+
+def crf_decoding(input: LayerOutput, size: int | None = None,
+                 label: LayerOutput | None = None, param_attr=None,
+                 name: str | None = None) -> LayerOutput:
+    """Viterbi decode (≅ crf_decoding_layer).  Without ``label``: outputs the
+    best path ids as an int sequence.  With ``label``: outputs a 0/1 error
+    indicator per sequence (1 = path differs), like the reference."""
+    name = name or gen_name("crf_decoding_layer")
+    size = size or input.size
+    w = _wspec(param_attr, name, "w", (size + 2, size), I.constant(0.0))
+    parents = [input] + ([label] if label is not None else [])
+
+    def fwd(ctx, params, states, emis, *lbl):
+        enforce(is_sequence(emis), "crf_decoding expects sequence emissions")
+        path = crf_ops.crf_decode(emis, params[w.name])
+        if not lbl:
+            return path
+        y = raw(lbl[0]).astype(jnp.int32)
+        mask = emis.mask()
+        diff = (path.data != y) & (mask > 0)
+        return jnp.any(diff, axis=1).astype(jnp.float32)[:, None]
+
+    return LayerOutput(name=name, layer_type="crf_decoding",
+                       size=(1 if label is not None else size),
+                       parents=tuple(parents), param_specs=(w,), fn=fwd,
+                       attrs={"num_classes": size})
+
+
+crf_decoding_layer = crf_decoding
+
+
+def ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
+        name: str | None = None, norm_by_times: bool = False) -> LayerOutput:
+    """CTC cost (≅ ctc_layer / CTCLayer): ``input`` is post-softmax
+    probabilities with ``size = num_classes + 1`` and blank = size-1 (the
+    reference's convention for ctc_layer)."""
+    name = name or gen_name("ctc_layer")
+    size = size or input.size
+    blank = size - 1
+
+    def fwd(ctx, params, states, probs, lbl):
+        enforce(is_sequence(probs) and is_sequence(lbl),
+                "ctc expects sequence probs and labels")
+        loss = ctc_ops.ctc_loss_from_probs(
+            probs.data, probs.length, raw(lbl).astype(jnp.int32), lbl.length,
+            blank=blank)
+        if norm_by_times:
+            loss = loss / jnp.maximum(probs.length.astype(loss.dtype), 1.0)
+        return jnp.mean(loss)
+
+    return LayerOutput(name=name, layer_type="ctc", size=1,
+                       parents=(input, label), fn=fwd,
+                       attrs={"blank": blank, "norm_by_times": norm_by_times})
+
+
+ctc_layer = ctc
+
+
+def warp_ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
+             blank: int = 0, norm_by_times: bool = False,
+             name: str | None = None) -> LayerOutput:
+    """warp-ctc parity (≅ warp_ctc_layer / WarpCTCLayer): ``input`` is
+    pre-softmax activations; softmax happens inside, blank defaults to 0."""
+    name = name or gen_name("warp_ctc_layer")
+    size = size or input.size
+
+    def fwd(ctx, params, states, logits, lbl):
+        enforce(is_sequence(logits) and is_sequence(lbl),
+                "warp_ctc expects sequence logits and labels")
+        log_probs = jax.nn.log_softmax(logits.data, axis=-1)
+        loss = ctc_ops.ctc_loss(
+            log_probs, logits.length, raw(lbl).astype(jnp.int32), lbl.length,
+            blank=blank)
+        if norm_by_times:
+            loss = loss / jnp.maximum(logits.length.astype(loss.dtype), 1.0)
+        return jnp.mean(loss)
+
+    return LayerOutput(name=name, layer_type="warp_ctc", size=1,
+                       parents=(input, label), fn=fwd,
+                       attrs={"blank": blank, "norm_by_times": norm_by_times})
+
+
+warp_ctc_layer = warp_ctc
+
+
+def out_prod(input1: LayerOutput, input2: LayerOutput,
+             name: str | None = None) -> LayerOutput:
+    """Outer product of two vectors per batch row (≅ out_prod_layer)."""
+    name = name or gen_name("out_prod_layer")
+
+    def fwd(ctx, params, states, a, b):
+        av, bv = raw(a), raw(b)
+        return jnp.einsum("bi,bj->bij", av, bv).reshape(av.shape[0], -1)
+
+    return LayerOutput(name=name, layer_type="out_prod",
+                       size=input1.size * input2.size,
+                       parents=(input1, input2), fn=fwd)
+
+
+out_prod_layer = out_prod
+
+
+def linear_comb(weights: LayerOutput, vectors: LayerOutput, size: int,
+                name: str | None = None) -> LayerOutput:
+    """out = w (1xM) * V (MxN), per row (≅ linear_comb_layer)."""
+    name = name or gen_name("linear_comb_layer")
+    m = weights.size
+
+    def fwd(ctx, params, states, w, v):
+        wv, vv = raw(w), raw(v)
+        return jnp.einsum("bm,bmn->bn", wv, vv.reshape(-1, m, size))
+
+    return LayerOutput(name=name, layer_type="convex_comb", size=size,
+                       parents=(weights, vectors), fn=fwd)
+
+
+linear_comb_layer = linear_comb
+
+
+def repeat(input: LayerOutput, num_repeats: int,
+           name: str | None = None, as_row_vector: bool = True,
+           act=None) -> LayerOutput:
+    """Feature-repeat (≅ repeat_layer): [..., N] -> [..., N*num_repeats]."""
+    from paddle_tpu.layers import activation as act_mod
+    from paddle_tpu.layers.base import map_data
+
+    name = name or gen_name("repeat_layer")
+    a = act_mod.get(act) if act else act_mod.IdentityActivation()
+
+    def fwd(ctx, params, states, x):
+        if as_row_vector:
+            return map_data(lambda d: a(jnp.tile(d, (1,) * (d.ndim - 1)
+                                                 + (num_repeats,))), x)
+        return map_data(
+            lambda d: a(jnp.repeat(d, num_repeats, axis=-1)), x)
+
+    return LayerOutput(name=name, layer_type="featmap_expand",
+                       size=input.size * num_repeats, parents=(input,),
+                       fn=fwd)
+
+
+repeat_layer = repeat
+
+
+def kmax_seq_score(input: LayerOutput, beam_size: int = 1,
+                   name: str | None = None) -> LayerOutput:
+    """Indices of the k highest-scoring steps of a score sequence
+    (≅ kmax_seq_score_layer)."""
+    name = name or gen_name("kmax_seq_score_layer")
+
+    def fwd(ctx, params, states, x):
+        enforce(is_sequence(x), "kmax_seq_score expects a sequence")
+        scores = x.data[..., 0] if x.data.ndim == 3 else x.data  # [B, T]
+        masked = jnp.where(x.mask() > 0, scores, -1e30)
+        _, idx = jax.lax.top_k(masked, beam_size)
+        return idx.astype(jnp.int32)
+
+    return LayerOutput(name=name, layer_type="kmax_seq_score", size=beam_size,
+                       parents=(input,), fn=fwd)
+
+
+kmax_seq_score_layer = kmax_seq_score

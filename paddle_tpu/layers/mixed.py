@@ -1,0 +1,295 @@
+"""Mixed layer + projections/operators.
+
+Reference: ``MixedLayer`` composes cheap sub-units — Projections (one input,
+may own a parameter: ``paddle/gserver/layers/Projection.h``,
+``FullMatrixProjection``, ``TableProjection``, ``ContextProjection``,
+``IdentityProjection``, ``ScalingProjection``, ``DotMulProjection``,
+``TransposedFullMatrixProjection``) and Operators (multi-input, parameter-free:
+``DotMulOperator``, ``ConvOperator``) — summing their outputs
+(``trainer_config_helpers/layers.py:563-998`` helper surface,
+``mixed_layer:739``).  Attention in 2017-Paddle NMT demos is hand-built from
+exactly these pieces, so they are load-bearing for seq2seq parity.
+
+TPU-native: a projection is a pure function on the input value; the mixed
+node's fn sums projection outputs (XLA fuses the adds into the surrounding
+matmuls).  Both the functional form ``mixed(input=[...])`` and the
+``with mixed(size=..) as m: m += proj`` incremental form are supported."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializer as I
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.core.parameters import ParamSpec
+from paddle_tpu.layers import activation as act_mod
+from paddle_tpu.layers.attr import ParamAttr, param_attr_or_default
+from paddle_tpu.layers.base import LayerOutput, gen_name, like, raw
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.ops.embedding import lookup as emb_lookup
+from paddle_tpu.ops.math import matmul
+
+
+@dataclasses.dataclass
+class Projection:
+    """One summand inside a mixed layer (≅ Projection/Operator config)."""
+
+    inputs: tuple[LayerOutput, ...]
+    size: int
+    proj_type: str
+    param_specs: tuple[ParamSpec, ...] = ()
+    # fn(params, *input_values) -> value with same sequence structure
+    fn: Callable = None
+
+
+def _wspec(param_attr, name, shape, default_init) -> ParamSpec:
+    """Single source of truth for ParamAttr -> ParamSpec lives in api._wspec;
+    this shim only adapts mixed's full-name convention (`<base>.<suffix>`)."""
+    from paddle_tpu.layers.api import _wspec as api_wspec
+
+    base, _, suffix = name.rpartition(".")
+    return api_wspec(param_attr, base.lstrip("_"), suffix, shape, default_init)
+
+
+def full_matrix_projection(input: LayerOutput, size: int,
+                           param_attr: ParamAttr | None = None) -> Projection:
+    """out = in @ W  (≅ FullMatrixProjection, layers.py:563)."""
+    w = _wspec(param_attr, gen_name("fm_proj") + ".w", (input.size, size),
+               I.paddle_default())
+
+    def fn(params, v):
+        return like(v, matmul(raw(v).reshape(-1, input.size),
+                              params[w.name]).reshape(raw(v).shape[:-1] + (size,)))
+
+    return Projection(inputs=(input,), size=size, proj_type="fc",
+                      param_specs=(w,), fn=fn)
+
+
+def trans_full_matrix_projection(input: LayerOutput, size: int,
+                                 param_attr: ParamAttr | None = None) -> Projection:
+    """out = in @ W^T — the parameter is stored transposed [size, in]
+    (≅ TransposedFullMatrixProjection, layers.py:619)."""
+    w = _wspec(param_attr, gen_name("trans_fm_proj") + ".w", (size, input.size),
+               I.paddle_default())
+
+    def fn(params, v):
+        return like(v, matmul(raw(v).reshape(-1, input.size),
+                              params[w.name].T).reshape(raw(v).shape[:-1] + (size,)))
+
+    return Projection(inputs=(input,), size=size, proj_type="trans_fc",
+                      param_specs=(w,), fn=fn)
+
+
+def identity_projection(input: LayerOutput, offset: int | None = None,
+                        size: int | None = None) -> Projection:
+    """Pass-through, optionally a feature slice [offset, offset+size)
+    (≅ IdentityProjection / IdentityOffsetProjection, layers.py:744)."""
+    if offset is None:
+        out_size = input.size
+
+        def fn(params, v):
+            return v
+    else:
+        out_size = size or (input.size - offset)
+
+        def fn(params, v):
+            return like(v, raw(v)[..., offset:offset + out_size])
+
+    return Projection(inputs=(input,), size=out_size, proj_type="identity", fn=fn)
+
+
+def scaling_projection(input: LayerOutput,
+                       param_attr: ParamAttr | None = None) -> Projection:
+    """out = w * in with a single learned scalar (≅ ScalingProjection,
+    layers.py:802)."""
+    w = _wspec(param_attr, gen_name("scaling_proj") + ".w", (1,), I.constant(1.0))
+
+    def fn(params, v):
+        return like(v, raw(v) * params[w.name][0])
+
+    return Projection(inputs=(input,), size=input.size, proj_type="scaling",
+                      param_specs=(w,), fn=fn)
+
+
+def dotmul_projection(input: LayerOutput,
+                      param_attr: ParamAttr | None = None) -> Projection:
+    """out = in ⊙ w, elementwise with a learned vector (≅ DotMulProjection,
+    layers.py:845)."""
+    w = _wspec(param_attr, gen_name("dotmul_proj") + ".w", (input.size,),
+               I.uniform(1.0))
+
+    def fn(params, v):
+        return like(v, raw(v) * params[w.name])
+
+    return Projection(inputs=(input,), size=input.size, proj_type="dot_mul",
+                      param_specs=(w,), fn=fn)
+
+
+def table_projection(input: LayerOutput, size: int,
+                     param_attr: ParamAttr | None = None) -> Projection:
+    """Embedding rows summed into the mix: ids -> table[ids]
+    (≅ TableProjection, layers.py:667)."""
+    w = _wspec(param_attr, gen_name("table_proj") + ".w", (input.size, size),
+               I.paddle_default())
+
+    def fn(params, v):
+        return like(v, emb_lookup(params[w.name], raw(v)))
+
+    return Projection(inputs=(input,), size=size, proj_type="table",
+                      param_specs=(w,), fn=fn)
+
+
+def context_projection(input: LayerOutput, context_len: int,
+                       context_start: int | None = None,
+                       padding_attr: ParamAttr | bool | None = False) -> Projection:
+    """Sliding-window concat of neighbor steps over a sequence
+    (≅ ContextProjection, layers.py:889).  Trainable padding not supported;
+    zero padding at sequence boundaries."""
+    enforce(padding_attr is False or padding_attr is None,
+            "trainable context padding is only supported via "
+            "layer.context_projection_layer, not the mixed projection")
+    ctx_start = -(context_len // 2) if context_start is None else context_start
+    out_size = input.size * context_len
+
+    def fn(params, v):
+        enforce(isinstance(v, SequenceBatch),
+                "context_projection needs sequence input")
+        return seq_ops.context_projection(v, context_len, ctx_start)
+
+    return Projection(inputs=(input,), size=out_size, proj_type="context",
+                      fn=fn)
+
+
+def dotmul_operator(a: LayerOutput, b: LayerOutput, scale: float = 1.0) -> Projection:
+    """out = scale * (a ⊙ b) (≅ DotMulOperator, layers.py:921)."""
+    enforce(a.size == b.size, "dotmul_operator inputs must share size")
+
+    def fn(params, va, vb):
+        return like(va, scale * raw(va) * raw(vb))
+
+    return Projection(inputs=(a, b), size=a.size, proj_type="dot_mul_op", fn=fn)
+
+
+def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
+                  num_filters: int, num_channels: int | None = None,
+                  stride: int = 1, padding: int = 0,
+                  filter_size_y: int | None = None, stride_y: int | None = None,
+                  padding_y: int | None = None) -> Projection:
+    """Convolution whose filter comes from another layer's output
+    (≅ ConvOperator, layers.py:680).  filter value is reshaped to
+    [num_filters, C, fh, fw]."""
+    c = num_channels or img.depth
+    fh = filter_size_y or filter_size
+    fw = filter_size
+    sy = stride_y or stride
+    py = padding_y if padding_y is not None else padding
+    h, w = img.height, img.width
+    oh = (h + 2 * py - fh) // sy + 1
+    ow = (w + 2 * padding - fw) // stride + 1
+
+    def fn(params, vimg, vfilt):
+        x = raw(vimg).reshape(-1, c, h, w)
+        k = raw(vfilt).reshape(num_filters, c, fh, fw)
+        out = jax.lax.conv_general_dilated(
+            x, k, window_strides=(sy, stride),
+            padding=((py, py), (padding, padding)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return like(vimg, out.reshape(out.shape[0], -1))
+
+    return Projection(inputs=(img, filter), size=num_filters * oh * ow,
+                      proj_type="conv_op", fn=fn)
+
+
+class MixedLayerOutput(LayerOutput):
+    """LayerOutput that also supports the incremental ``with``/``+=`` form."""
+
+    def __iadd__(self, other: Projection):
+        enforce(isinstance(other, Projection), "mixed += expects a Projection")
+        enforce(not self._finalized, "mixed layer already finalized")
+        self._projections.append(other)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            _finalize_mixed(self)
+        return False
+
+
+def mixed(size: int | None = None, input=None, name: str | None = None,
+          act=None, bias_attr=None, layer_attr=None) -> MixedLayerOutput:
+    """≅ mixed_layer (layers.py:739).  Sums its projection/operator inputs,
+    adds bias, applies activation (default linear)."""
+    name = name or gen_name("mixed")
+    node = MixedLayerOutput(name=name, layer_type="mixed", size=size or 0)
+    node._projections = []
+    node._finalized = False
+    node._act = act_mod.get(act) if act else act_mod.LinearActivation()
+    node._bias_attr = bias_attr
+    if input is not None:
+        projs = input if isinstance(input, (list, tuple)) else [input]
+        for p in projs:
+            enforce(isinstance(p, Projection),
+                    "mixed input must be projections/operators "
+                    "(use fc/identity_projection/... helpers)")
+            node._projections.append(p)
+        _finalize_mixed(node)
+    return node
+
+
+mixed_layer = mixed
+
+
+def _finalize_mixed(node: MixedLayerOutput) -> None:
+    projs = node._projections
+    enforce(len(projs) > 0, f"mixed layer {node.name!r} has no inputs")
+    size = node.size or projs[0].size
+    for p in projs:
+        enforce(p.size == size,
+                f"mixed layer {node.name!r}: projection size {p.size} != {size}")
+    parents: list[LayerOutput] = []
+    for p in projs:
+        for inp in p.inputs:
+            if inp not in parents:
+                parents.append(inp)
+    specs = tuple(s for p in projs for s in p.param_specs)
+    # reference default: mixed_layer has NO bias (wrap_bias_attr_default(
+    # has_bias=False), layers.py:853) — bias only when explicitly requested
+    use_bias = node._bias_attr is True or isinstance(node._bias_attr, ParamAttr)
+    bspec = None
+    if use_bias:
+        battr = node._bias_attr if isinstance(node._bias_attr, ParamAttr) else None
+        bspec = _wspec(battr, f"_{node.name}.wbias", (size,), I.constant(0.0))
+        specs = specs + (bspec,)
+    act = node._act
+    idx_of = {id(n): i for i, n in enumerate(parents)}
+
+    def fwd(ctx, params, states, *parent_values):
+        total = None
+        template = None
+        for p in projs:
+            vals = [parent_values[idx_of[id(inp)]] for inp in p.inputs]
+            out = p.fn(params, *vals)
+            if template is None and isinstance(out, SequenceBatch):
+                template = out
+            total = raw(out) if total is None else total + raw(out)
+        if bspec is not None:
+            total = total + params[bspec.name]
+        total = act(total)
+        if template is not None:
+            return SequenceBatch(data=total, length=template.length)
+        return total
+
+    node.size = size
+    node.parents = tuple(parents)
+    node.param_specs = specs
+    node.fn = fwd
+    node.attrs = {"projections": [p.proj_type for p in projs]}
+    node._finalized = True

@@ -132,3 +132,51 @@ def vgg_16_network(input_image, num_channels, num_classes=1000):
     tmp = layer.fc(input=tmp, size=4096, act=act_mod.ReluActivation())
     tmp = layer.dropout(input=tmp, dropout_rate=0.5)
     return layer.fc(input=tmp, size=num_classes, act=act_mod.SoftmaxActivation())
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None):
+    """Bahdanau additive attention context (≅ networks.simple_attention:1304):
+
+        e_ij = v_a . f(W_a s_{i-1} + U_a h_j);  a_ij = softmax_j(e_ij);
+        c_i = sum_j a_ij h_j
+
+    where U_a h_j is precomputed outside the loop as ``encoded_proj``.  The
+    reference assembles this from mixed/expand/seq-softmax/scaling/pooling
+    layers; here it is one fused node (one small matmul + masked softmax +
+    weighted sum — XLA fuses the lot), with the same parameters: W_a
+    (transform) and v_a (softmax weight).  Works inside recurrent_group steps:
+    ``encoded_sequence``/``encoded_proj`` enter via StaticInput, and
+    ``decoder_state`` is a memory."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import initializer as I
+    from paddle_tpu.layers.api import _wspec
+    from paddle_tpu.layers.base import LayerOutput, gen_name
+
+    name = name or gen_name("simple_attention")
+    proj_size = encoded_proj.size
+    w_spec = _wspec(transform_param_attr, f"{name}_transform", "w",
+                    (decoder_state.size, proj_size), I.paddle_default())
+    v_spec = _wspec(softmax_param_attr, f"{name}_softmax", "w",
+                    (proj_size, 1), I.paddle_default())
+    wact = act_mod.get(weight_act) if weight_act else act_mod.TanhActivation()
+
+    def fwd(ctx, params, states, enc_seq, enc_proj, dec_state):
+        # enc_seq: SequenceBatch [B,T,D]; enc_proj: SequenceBatch [B,T,P];
+        # dec_state: [B,S] (memory value inside a recurrent step)
+        comb = wact(
+            (dec_state @ params[w_spec.name])[:, None, :] + enc_proj.data)
+        scores = (comb @ params[v_spec.name])[..., 0]  # [B, T]
+        mask = enc_seq.mask()
+        scores = jnp.where(mask > 0, scores, -1e9)
+        attn = jnp.exp(scores - scores.max(axis=1, keepdims=True)) * mask
+        attn = attn / jnp.clip(attn.sum(axis=1, keepdims=True), 1e-9)
+        return jnp.einsum("bt,btd->bd", attn, enc_seq.data)
+
+    return LayerOutput(
+        name=name, layer_type="simple_attention", size=encoded_sequence.size,
+        parents=(encoded_sequence, encoded_proj, decoder_state),
+        param_specs=(w_spec, v_spec), fn=fwd,
+        attrs={"proj_size": proj_size})

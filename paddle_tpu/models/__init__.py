@@ -3,3 +3,6 @@
 (alexnet/googlenet/resnet/vgg/smallnet), ``benchmark/paddle/rnn/rnn.py``
 (IMDB LSTM), plus the book models the north star names (seq2seq NMT,
 Wide&Deep CTR, OCR CRNN)."""
+
+from paddle_tpu.models import image, lenet, transformer  # noqa: F401
+from paddle_tpu.models.seqtoseq import seqtoseq_net  # noqa: F401

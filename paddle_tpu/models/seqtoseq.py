@@ -1,0 +1,106 @@
+"""Attention seq2seq NMT — parity model for the reference's machine-translation
+demo (``demo/seqToseq/seqToseq_net.py`` semantics, exercised through
+``trainer_config_helpers``: ``recurrent_group:3862``, ``beam_search:4145``,
+``networks.simple_attention:1304``, and the WMT14 config surface of
+``python/paddle/v2/dataset/wmt14.py``).
+
+Encoder: source embedding -> bidirectional GRU.  Decoder: recurrent_group with
+a GRU step conditioned on a Bahdanau attention context.  Training builds the
+per-timestep cross-entropy cost; generation builds a compiled beam search
+(one ``lax.scan``, top-k pruning — see ``layers/recurrent_group.py``)."""
+
+from __future__ import annotations
+
+from paddle_tpu.layers import activation as act_mod
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type
+from paddle_tpu.layers import networks
+from paddle_tpu.layers.attr import ParamAttr
+from paddle_tpu.layers.mixed import full_matrix_projection, mixed
+from paddle_tpu.layers.recurrent_group import (
+    GeneratedInput,
+    StaticInput,
+    beam_search,
+    gru_step_layer,
+    memory,
+    recurrent_group,
+)
+
+
+def seqtoseq_net(source_dict_dim: int, target_dict_dim: int,
+                 word_vector_dim: int = 64, encoder_size: int = 64,
+                 decoder_size: int = 64, is_generating: bool = False,
+                 beam_size: int = 3, max_length: int = 50):
+    """Returns the cost layer (training) or the beam-search generation layer.
+
+    Mirrors the reference demo's topology: shared source/target embeddings by
+    parameter name, encoder projection precomputed outside the loop, decoder
+    boot from the backward encoder's first step."""
+    src_word_id = layer.data(
+        name="source_language_word",
+        type=data_type.integer_value_sequence(source_dict_dim))
+    src_embedding = layer.embedding(
+        input=src_word_id, size=word_vector_dim,
+        param_attr=ParamAttr(name="_source_language_embedding"))
+
+    src_forward = networks.simple_gru(
+        input=src_embedding, size=encoder_size)
+    src_backward = networks.simple_gru(
+        input=src_embedding, size=encoder_size, reverse=True)
+    encoded_vector = layer.concat(input=[src_forward, src_backward])
+
+    encoded_proj = mixed(
+        size=decoder_size,
+        input=full_matrix_projection(encoded_vector, size=decoder_size))
+
+    backward_first = layer.first_seq(input=src_backward)
+    decoder_boot = mixed(
+        size=decoder_size, act=act_mod.TanhActivation(),
+        input=full_matrix_projection(backward_first, size=decoder_size))
+
+    def gru_decoder_with_attention(enc_vec, enc_proj, current_word):
+        decoder_mem = memory(
+            name="gru_decoder", size=decoder_size, boot_layer=decoder_boot)
+        context = networks.simple_attention(
+            encoded_sequence=enc_vec, encoded_proj=enc_proj,
+            decoder_state=decoder_mem, name="attention")
+        decoder_inputs = mixed(
+            size=decoder_size * 3,
+            input=[full_matrix_projection(context, size=decoder_size * 3),
+                   full_matrix_projection(current_word, size=decoder_size * 3)])
+        gru_step = gru_step_layer(
+            name="gru_decoder", input=decoder_inputs, output_mem=decoder_mem,
+            size=decoder_size)
+        out = layer.fc(input=gru_step, size=target_dict_dim,
+                       act=act_mod.SoftmaxActivation(), bias_attr=True,
+                       name="decoder_prob")
+        return out
+
+    group_input1 = StaticInput(input=encoded_vector, is_seq=True)
+    group_input2 = StaticInput(input=encoded_proj, is_seq=True)
+
+    if not is_generating:
+        trg_embedding = layer.embedding(
+            input=layer.data(
+                name="target_language_word",
+                type=data_type.integer_value_sequence(target_dict_dim)),
+            size=word_vector_dim,
+            param_attr=ParamAttr(name="_target_language_embedding"))
+        decoder = recurrent_group(
+            name="decoder_group", step=gru_decoder_with_attention,
+            input=[group_input1, group_input2, trg_embedding])
+        lbl = layer.data(
+            name="target_language_next_word",
+            type=data_type.integer_value_sequence(target_dict_dim))
+        cost = layer.classification_cost(input=decoder, label=lbl)
+        return cost
+
+    trg_embedding = GeneratedInput(
+        size=target_dict_dim,
+        embedding_name="_target_language_embedding",
+        embedding_size=word_vector_dim)
+    beam_gen = beam_search(
+        name="decoder_group", step=gru_decoder_with_attention,
+        input=[group_input1, group_input2, trg_embedding],
+        bos_id=0, eos_id=1, beam_size=beam_size, max_length=max_length)
+    return beam_gen

@@ -1,0 +1,118 @@
+"""Linear-chain CRF — successor of ``paddle/gserver/layers/LinearChainCRF.cpp``
+(+ ``CRFLayer``/``CRFDecodingLayer``) and Fluid's ``linear_chain_crf_op`` /
+``crf_decoding_op``.
+
+Parameter layout follows the reference (``LinearChainCRF.h``): one matrix of
+shape [C+2, C] where row 0 holds start scores ``a``, row 1 end scores ``b``,
+and rows 2.. the transition matrix ``w`` with ``w[i, j]`` the score of moving
+from state i to state j.
+
+TPU-native: the forward (log-partition) and Viterbi recursions are
+``lax.scan`` over time with [B, C] carries — batched, static-shape, masked
+past each row's length; the reference loops per-sequence on CPU only (CRF
+never had a GPU kernel in 2017-Paddle)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.lod import SequenceBatch
+
+
+def _split_weights(w: jax.Array):
+    a = w[0]  # [C] start
+    b = w[1]  # [C] end
+    trans = w[2:]  # [C, C]
+    return a, b, trans
+
+
+def crf_log_partition(emissions: SequenceBatch, w: jax.Array) -> jax.Array:
+    """log Z per sequence: [B]. emissions.data: [B, T, C]."""
+    a, b, trans = _split_weights(w)
+    x = emissions.data
+    mask = emissions.mask()  # [B, T]
+    alpha0 = a[None, :] + x[:, 0, :]  # [B, C]
+
+    xs = jnp.swapaxes(x[:, 1:, :], 0, 1)  # [T-1, B, C]
+    ms = jnp.swapaxes(mask[:, 1:], 0, 1)  # [T-1, B]
+
+    def step(alpha, inp):
+        xt, mt = inp
+        # logsumexp_i(alpha_i + trans_ij) + x_tj
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, C, C]
+        new = jax.nn.logsumexp(scores, axis=1) + xt  # [B, C]
+        alpha = jnp.where(mt[:, None] > 0, new, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, (xs, ms))
+    return jax.nn.logsumexp(alpha + b[None, :], axis=1)  # [B]
+
+
+def crf_path_score(emissions: SequenceBatch, labels: SequenceBatch,
+                   w: jax.Array) -> jax.Array:
+    """Score of the given label path per sequence: [B]."""
+    a, b, trans = _split_weights(w)
+    x = emissions.data  # [B, T, C]
+    y = labels.data.astype(jnp.int32)  # [B, T]
+    mask = emissions.mask()  # [B, T]
+    bsz, t_len, _ = x.shape
+
+    emit = jnp.take_along_axis(x, y[:, :, None], axis=2)[..., 0]  # [B, T]
+    emit_sum = jnp.sum(emit * mask, axis=1)
+
+    # transitions between consecutive valid steps
+    tr = trans[y[:, :-1], y[:, 1:]]  # [B, T-1]
+    tr_sum = jnp.sum(tr * mask[:, 1:], axis=1)
+
+    start = a[y[:, 0]]
+    last_idx = jnp.maximum(emissions.length - 1, 0)
+    last_lbl = jnp.take_along_axis(y, last_idx[:, None], axis=1)[:, 0]
+    end = b[last_lbl]
+    return start + emit_sum + tr_sum + end
+
+
+def crf_nll(emissions: SequenceBatch, labels: SequenceBatch,
+            w: jax.Array) -> jax.Array:
+    """Per-sequence negative log-likelihood [B] (≅ CRFLayer::forward cost)."""
+    return crf_log_partition(emissions, w) - crf_path_score(
+        emissions, labels, w)
+
+
+def crf_decode(emissions: SequenceBatch, w: jax.Array) -> SequenceBatch:
+    """Viterbi best path (≅ CRFDecodingLayer / crf_decoding_op).
+    Returns a SequenceBatch of int32 label ids [B, T]."""
+    a, b, trans = _split_weights(w)
+    x = emissions.data
+    mask = emissions.mask()
+    bsz, t_len, c = x.shape
+
+    delta0 = a[None, :] + x[:, 0, :]
+    xs = jnp.swapaxes(x[:, 1:, :], 0, 1)
+    ms = jnp.swapaxes(mask[:, 1:], 0, 1)
+
+    def step(delta, inp):
+        xt, mt = inp
+        scores = delta[:, :, None] + trans[None, :, :]  # [B, C_from, C_to]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, C]
+        new = jnp.max(scores, axis=1) + xt
+        delta_new = jnp.where(mt[:, None] > 0, new, delta)
+        # past the end, backpointer is identity so path stays frozen
+        ident = jnp.broadcast_to(jnp.arange(c)[None, :], best_prev.shape)
+        bp = jnp.where(mt[:, None] > 0, best_prev, ident)
+        return delta_new, bp
+
+    delta, bps = jax.lax.scan(step, delta0, (xs, ms))  # bps: [T-1, B, C]
+
+    last_state = jnp.argmax(delta + b[None, :], axis=1)  # [B]
+
+    def back(state, bp):
+        # carry in: s_{t+1}; emit it, step to s_t via the backpointer
+        prev = jnp.take_along_axis(bp, state[:, None], axis=1)[:, 0]
+        return prev, state
+
+    s0, path_tail = jax.lax.scan(back, last_state, bps, reverse=True)
+    # path_tail[t] == s_{t+1}; prepend s_0 -> [s_0 .. s_{T-1}]
+    path = jnp.concatenate([s0[None], path_tail], axis=0)
+    return SequenceBatch(data=jnp.swapaxes(path, 0, 1).astype(jnp.int32),
+                         length=emissions.length)

@@ -79,15 +79,23 @@ def pmean_tree(tree, axis_name: str):
     return jax.tree.map(lambda g: lax.pmean(g, axis_name), tree)
 
 
-def data_parallel_mean_grads(mesh, grads):
-    """Host-callable gradient mean-all-reduce over the ``data`` axis for
-    eager use; in the jitted train step XLA inserts this automatically from
-    shardings."""
+def data_parallel_mean_grads(mesh, stacked_grads):
+    """Eager mean of per-replica gradients (≅ MultiGradientMachine's ring
+    gradient gather, `MultiGradientMachine.h:44-98`): every leaf must be
+    stacked per-device on dim 0 with shape [n_data_devices, ...]; returns the
+    tree of means with the device axis dropped.  Inside a jitted train step
+    you never need this — XLA inserts the all-reduce from shardings."""
+    n = mesh.shape["data"]
+    for leaf in jax.tree.leaves(stacked_grads):
+        if leaf.shape[0] != n:
+            raise ValueError(
+                f"data_parallel_mean_grads expects per-device stacked leaves "
+                f"[{n}, ...]; got leading dim {leaf.shape[0]}")
     fn = shard_map(
-        functools.partial(pmean_tree, axis_name="data"),
+        lambda tree: jax.tree.map(lambda g: lax.pmean(g, "data")[0], tree),
         mesh=mesh,
-        in_specs=jax.tree.map(lambda _: P("data"), grads),
-        out_specs=jax.tree.map(lambda _: P("data"), grads),
+        in_specs=jax.tree.map(lambda _: P("data"), stacked_grads),
+        out_specs=jax.tree.map(lambda _: P(), stacked_grads),
         check_vma=False,
     )
-    return fn(grads)
+    return fn(stacked_grads)

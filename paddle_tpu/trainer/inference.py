@@ -56,19 +56,24 @@ class Inference:
             input[i : i + batch_size] for i in range(0, len(input), batch_size)
         ]
         outs: list[list] = [[] for _ in self.output_names]
+        ragged = [False] * len(self.output_names)
         for b in batches:
             feed = feeder(b)
             results = self._fwd(params, self.states, feed)
             for i, r in enumerate(results):
                 if isinstance(r, SequenceBatch):
                     outs[i].extend(to_ragged(r))
+                    ragged[i] = True
+                elif hasattr(r, "to_list"):  # GeneratedSequence (beam search)
+                    outs[i].extend(r.to_list())
+                    ragged[i] = True
                 else:
                     outs[i].append(np.asarray(r))
         final = []
-        for chunks in outs:
-            if chunks and isinstance(chunks[0], np.ndarray) and all(
-                isinstance(c, np.ndarray) and c.ndim == chunks[0].ndim for c in chunks
-            ):
+        for i, chunks in enumerate(outs):
+            # ragged per-sequence rows stay a python list (one entry per
+            # input row, v2 contract); only dense batch chunks concatenate
+            if not ragged[i] and chunks and isinstance(chunks[0], np.ndarray):
                 try:
                     final.append(np.concatenate(chunks, axis=0))
                     continue
