@@ -40,3 +40,21 @@ def next_keys(n: int) -> jax.Array:
         seed()
     _key, *subs = jax.random.split(_key, n + 1)
     return jax.numpy.stack(subs)
+
+
+def get_state():
+    """Raw key data of the global stream (for checkpointing)."""
+    global _key
+    if _key is None:
+        seed()
+    import numpy as np
+
+    return np.asarray(jax.random.key_data(_key))
+
+
+def set_state(data) -> None:
+    """Restore a stream captured by :func:`get_state` (checkpoint resume)."""
+    global _key
+    import numpy as np
+
+    _key = jax.random.wrap_key_data(jax.numpy.asarray(np.asarray(data)))

@@ -18,17 +18,16 @@ matmuls).  Both the functional form ``mixed(input=[...])`` and the
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Sequence
+from typing import Callable
 
 import jax
-import jax.numpy as jnp
 
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import SequenceBatch
 from paddle_tpu.core.parameters import ParamSpec
 from paddle_tpu.layers import activation as act_mod
-from paddle_tpu.layers.attr import ParamAttr, param_attr_or_default
+from paddle_tpu.layers.attr import ParamAttr
 from paddle_tpu.layers.base import LayerOutput, gen_name, like, raw
 from paddle_tpu.ops import sequence as seq_ops
 from paddle_tpu.ops.embedding import lookup as emb_lookup

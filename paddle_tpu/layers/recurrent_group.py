@@ -30,13 +30,7 @@ from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import SequenceBatch
 from paddle_tpu.core.parameters import ParamSpec
-from paddle_tpu.layers.base import (
-    Context,
-    LayerOutput,
-    evaluate,
-    gen_name,
-    topo_sort,
-)
+from paddle_tpu.layers.base import Context, LayerOutput, evaluate, gen_name
 
 NEG_INF = -1e9
 

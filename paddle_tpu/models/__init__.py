@@ -6,3 +6,4 @@ Wide&Deep CTR, OCR CRNN)."""
 
 from paddle_tpu.models import image, lenet, transformer  # noqa: F401
 from paddle_tpu.models.seqtoseq import seqtoseq_net  # noqa: F401
+from paddle_tpu.models.ctr import wide_and_deep_ctr  # noqa: F401

@@ -1,0 +1,48 @@
+"""Wide & Deep CTR — the sparse/embedding-parallel flagship (SURVEY §7.6):
+replaces the reference's CTR serving path of sparse-row embedding tables kept
+on dedicated sparse pservers (``SparseRowMatrix.h``, sparse updaters) with
+mesh-sharded tables: each embedding parameter carries
+``sharding=("model", None)`` so its rows live row-sharded over the model axis
+(degrading gracefully to replicated on a pure-DP mesh)."""
+
+from __future__ import annotations
+
+from paddle_tpu.layers import activation as act_mod
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type
+from paddle_tpu.layers.attr import ParamAttr
+
+
+def wide_and_deep_ctr(wide_dim: int, categorical_vocab_sizes: list[int],
+                      embedding_size: int = 16,
+                      hidden_sizes: tuple[int, ...] = (64, 32)):
+    """Returns (cost, predict, input_names).
+
+    Inputs: one sparse-binary wide vector, one integer id per categorical
+    field, and an integer label in {0, 1}."""
+    wide_in = layer.data(name="wide_input",
+                         type=data_type.sparse_binary_vector(wide_dim))
+    cat_ins = [
+        layer.data(name=f"cat_{i}", type=data_type.integer_value(v))
+        for i, v in enumerate(categorical_vocab_sizes)
+    ]
+    embs = [
+        layer.embedding(
+            input=c, size=embedding_size,
+            param_attr=ParamAttr(name=f"emb_{i}",
+                                 sharding=("model", None)))
+        for i, c in enumerate(cat_ins)
+    ]
+    deep = layer.concat(input=embs) if len(embs) > 1 else embs[0]
+    for j, h in enumerate(hidden_sizes):
+        deep = layer.fc(input=deep, size=h, act=act_mod.ReluActivation(),
+                        name=f"deep_fc{j}")
+    wide_proj = layer.fc(input=wide_in, size=8,
+                         act=act_mod.LinearActivation(), name="wide_proj")
+    top = layer.concat(input=[wide_proj, deep])
+    predict = layer.fc(input=top, size=2, act=act_mod.SoftmaxActivation(),
+                       name="ctr_predict")
+    label = layer.data(name="label", type=data_type.integer_value(2))
+    cost = layer.classification_cost(input=predict, label=label)
+    input_names = ["wide_input"] + [c.name for c in cat_ins] + ["label"]
+    return cost, predict, input_names

@@ -43,20 +43,29 @@ def seqtoseq_net(source_dict_dim: int, target_dict_dim: int,
         input=src_word_id, size=word_vector_dim,
         param_attr=ParamAttr(name="_source_language_embedding"))
 
+    # every parameter below gets a deterministic name (explicit layer names /
+    # param_attrs) so a generation topology built later in the SAME process
+    # still finds the trained values by name — auto gen_name() counters keep
+    # incrementing across topologies and would orphan the encoder weights
     src_forward = networks.simple_gru(
-        input=src_embedding, size=encoder_size)
+        input=src_embedding, size=encoder_size, name="src_gru_fw")
     src_backward = networks.simple_gru(
-        input=src_embedding, size=encoder_size, reverse=True)
+        input=src_embedding, size=encoder_size, reverse=True,
+        name="src_gru_bw")
     encoded_vector = layer.concat(input=[src_forward, src_backward])
 
     encoded_proj = mixed(
-        size=decoder_size,
-        input=full_matrix_projection(encoded_vector, size=decoder_size))
+        size=decoder_size, name="encoded_proj",
+        input=full_matrix_projection(
+            encoded_vector, size=decoder_size,
+            param_attr=ParamAttr(name="_encoded_proj.w")))
 
     backward_first = layer.first_seq(input=src_backward)
     decoder_boot = mixed(
-        size=decoder_size, act=act_mod.TanhActivation(),
-        input=full_matrix_projection(backward_first, size=decoder_size))
+        size=decoder_size, act=act_mod.TanhActivation(), name="decoder_boot",
+        input=full_matrix_projection(
+            backward_first, size=decoder_size,
+            param_attr=ParamAttr(name="_decoder_boot.w")))
 
     def gru_decoder_with_attention(enc_vec, enc_proj, current_word):
         decoder_mem = memory(
@@ -65,9 +74,13 @@ def seqtoseq_net(source_dict_dim: int, target_dict_dim: int,
             encoded_sequence=enc_vec, encoded_proj=enc_proj,
             decoder_state=decoder_mem, name="attention")
         decoder_inputs = mixed(
-            size=decoder_size * 3,
-            input=[full_matrix_projection(context, size=decoder_size * 3),
-                   full_matrix_projection(current_word, size=decoder_size * 3)])
+            size=decoder_size * 3, name="decoder_inputs",
+            input=[full_matrix_projection(
+                       context, size=decoder_size * 3,
+                       param_attr=ParamAttr(name="_decoder_inputs_ctx.w")),
+                   full_matrix_projection(
+                       current_word, size=decoder_size * 3,
+                       param_attr=ParamAttr(name="_decoder_inputs_word.w"))])
         gru_step = gru_step_layer(
             name="gru_decoder", input=decoder_inputs, output_mem=decoder_mem,
             size=decoder_size)

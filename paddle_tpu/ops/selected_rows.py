@@ -1,0 +1,119 @@
+"""Row-sparse gradients — parity for the reference's two sparse stacks:
+``SelectedRows`` (Fluid, ``paddle/framework/selected_rows.h:19``, produced by
+``lookup_table_op``'s grad) and the v2 sparse-row matrices
+(``paddle/math/SparseRowMatrix.h:204-299``) with their pserver prefetch
+(``TrainerInternal.cpp:93-97``) and sparse optimizer updates.
+
+TPU-native: inside a jitted step XLA's scatter-add gradient of gather IS the
+sparse path, so the train loop needs none of this.  This module exists for
+(a) the Fluid-parity program surface, (b) eager sparse-row optimizer updates
+(embedding-only fine-tuning at CTR scale: touch only the rows a batch saw),
+(c) the regularize-on-touch semantics of the reference's sparse updaters.
+Static shapes throughout: N = ids-per-batch is a compile-time constant."""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=("rows", "values"), meta_fields=("height",))
+@dataclasses.dataclass(frozen=True)
+class SelectedRows:
+    """A tall sparse matrix stored as touched rows only (``selected_rows.h``).
+
+    rows: [N] int32 row indices (duplicates allowed; height = padding/drop
+    sentinel), values: [N, D], height: full table rows (static)."""
+
+    rows: jax.Array
+    values: jax.Array
+    height: int
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros((self.height,) + self.values.shape[1:],
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+
+def embedding_grad(ids: jax.Array, cotangent: jax.Array,
+                   height: int) -> SelectedRows:
+    """The gradient of ``table[ids]`` w.r.t. the table, kept sparse
+    (≅ lookup_table_grad_op emitting SelectedRows)."""
+    return SelectedRows(rows=ids.reshape(-1).astype(jnp.int32),
+                        values=cotangent.reshape(-1, cotangent.shape[-1]),
+                        height=height)
+
+
+def merge_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows (≅ scatter-merge in selected_rows_functor).  Output
+    keeps static size N; unused slots get row index = height (dropped by
+    scatter updates)."""
+    n = sr.rows.shape[0]
+    order = jnp.argsort(sr.rows)
+    rows_s = sr.rows[order]
+    vals_s = sr.values[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), bool), rows_s[1:] != rows_s[:-1]])
+    slot = jnp.cumsum(is_new) - 1  # [N] target slot per sorted entry
+    merged_vals = jnp.zeros_like(vals_s).at[slot].add(vals_s)
+    merged_rows = jnp.full((n,), sr.height, jnp.int32).at[slot].set(rows_s)
+    return SelectedRows(rows=merged_rows, values=merged_vals,
+                        height=sr.height)
+
+
+def sgd_update(table: jax.Array, grad: SelectedRows,
+               lr: float) -> jax.Array:
+    """Touched-rows-only SGD (≅ sgd_op's SelectedRows kernel).  Duplicates
+    accumulate naturally through scatter-add."""
+    return table.at[grad.rows].add(-lr * grad.values, mode="drop")
+
+
+def adagrad_update(table: jax.Array, accum: jax.Array, grad: SelectedRows,
+                   lr: float, epsilon: float = 1e-6):
+    """Sparse Adagrad (≅ adagrad_op SelectedRows path): merge duplicates,
+    update moment and rows only where touched."""
+    g = merge_rows(grad)
+    g2 = jnp.sum(g.values * g.values, axis=-1, keepdims=True) \
+        if accum.ndim == 1 else g.values * g.values
+    if accum.ndim == 1:
+        new_accum = accum.at[g.rows].add(g2[:, 0], mode="drop")
+        denom = jnp.sqrt(new_accum[jnp.clip(g.rows, 0, grad.height - 1)]
+                         )[:, None] + epsilon
+    else:
+        new_accum = accum.at[g.rows].add(g2, mode="drop")
+        denom = jnp.sqrt(
+            new_accum[jnp.clip(g.rows, 0, grad.height - 1)]) + epsilon
+    new_table = table.at[g.rows].add(-lr * g.values / denom, mode="drop")
+    return new_table, new_accum
+
+
+def momentum_update(table: jax.Array, velocity: jax.Array,
+                    grad: SelectedRows, lr: float, mu: float):
+    """Sparse momentum on touched rows.  NOTE on semantics: the reference's
+    SparseMomentumParameterOptimizer (``FirstOrderOptimizer.h:63``) keeps the
+    momentum mathematically equivalent to dense momentum via a catch-up pass;
+    here untouched rows simply keep stale velocity (decayed on next touch) —
+    equivalent for constant lr when every row is touched, and the standard
+    modern approximation otherwise."""
+    g = merge_rows(grad)
+    touched = jnp.clip(g.rows, 0, grad.height - 1)
+    v_rows = velocity[touched]
+    new_v_rows = mu * v_rows + g.values
+    new_velocity = velocity.at[g.rows].set(new_v_rows, mode="drop")
+    new_table = table.at[g.rows].add(-lr * new_v_rows, mode="drop")
+    return new_table, new_velocity
+
+
+def decay_on_touch(table: jax.Array, grad: SelectedRows,
+                   l2_rate: float, lr: float) -> jax.Array:
+    """Regularize-on-touch (reference sparse semantics: L2 applies to a row
+    only when a batch touches it — ``ParameterUpdaterHook``/sparse updater
+    behavior), instead of decaying the whole table every step."""
+    g = merge_rows(grad)
+    touched = jnp.clip(g.rows, 0, grad.height - 1)
+    rows = table[touched]
+    return table.at[g.rows].add(-lr * l2_rate * rows, mode="drop")

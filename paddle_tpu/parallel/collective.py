@@ -11,7 +11,6 @@ problem, which is the whole point of the TPU-native redesign (SURVEY §2.3).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,72 @@
+"""Embedding parallelism — tables sharded over a mesh axis, the TPU-native
+replacement for the reference's sparse parameter-server path (dedicated
+sparse pserver ports + ``SparseRemoteParameterUpdater`` + row prefetch,
+``RemoteParameterUpdater.h``, ``SparseRowMatrix.h:204``): instead of
+prefetching touched rows from a remote host, rows live sharded across the
+mesh and the gather's collective runs over ICI (SURVEY §2.3 row 4).
+
+Two ways to get the same layout:
+
+1. Declarative (preferred): give the embedding parameter
+   ``sharding=("model", None)`` and let pjit place it — XLA inserts the
+   all-gather/psum around the gather automatically.
+2. Explicit (this module): shard_map routines that make the communication
+   pattern visible and testable — each shard gathers its local rows and the
+   partial one-hot results psum over the axis."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.enforce import enforce
+
+
+def shard_table(table: jax.Array, mesh, axis: str = "model") -> jax.Array:
+    """Place a [V, D] table row-sharded over ``axis``."""
+    enforce(table.shape[0] % mesh.shape[axis] == 0,
+            f"table rows {table.shape[0]} not divisible by mesh axis "
+            f"{axis}={mesh.shape[axis]}")
+    return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
+
+
+def sharded_lookup(table: jax.Array, ids: jax.Array, mesh,
+                   axis: str = "model") -> jax.Array:
+    """Gather from a row-sharded table: every device looks up the ids that
+    fall in its shard, others contribute zeros, psum combines.  ids are
+    replicated over ``axis`` (they're usually data-sharded on 'data').
+    Returns [..., D] with the same sharding as ids.
+
+    The backward pass (via shard_map transpose) scatter-adds each shard's
+    cotangent rows locally — exactly the 'sparse update stays on the shard'
+    behavior the reference got from dedicated sparse pservers."""
+    k = mesh.shape[axis]
+    v = table.shape[0]
+    enforce(v % k == 0, "table rows must divide the mesh axis")
+    rows_per = v // k
+
+    def body(tbl_shard, ids_local):
+        idx = lax.axis_index(axis)
+        offset = idx * rows_per
+        local = ids_local.astype(jnp.int32) - offset
+        in_shard = (local >= 0) & (local < rows_per)
+        safe = jnp.clip(local, 0, rows_per - 1)
+        got = jnp.take(tbl_shard, safe, axis=0)
+        got = jnp.where(in_shard[..., None], got, 0.0)
+        return lax.psum(got, axis)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(axis, None), P()),
+                   out_specs=P(), check_vma=False)
+    return fn(table, ids)
+
+
+def replicated_lookup_sharded_grad(table: jax.Array, ids: jax.Array,
+                                   mesh, axis: str = "model") -> jax.Array:
+    """Convenience jit-level alternative: constrain the table's sharding and
+    let XLA pick the collective (path 1 in the module docstring)."""
+    t = jax.lax.with_sharding_constraint(
+        table, NamedSharding(mesh, P(axis, None)))
+    return jnp.take(t, ids.astype(jnp.int32), axis=0)

@@ -101,9 +101,18 @@ class SGD:
 
     # -- the v2 train loop ----------------------------------------------------
     def train(self, reader, num_passes: int = 1,
-              event_handler: Callable | None = None, feeding=None):
+              event_handler: Callable | None = None, feeding=None,
+              checkpoint_dir: str | None = None, checkpoint_period: int = 1,
+              resume: bool = True):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
-        ``paddle.batch(...)`` exactly as in v2."""
+        ``paddle.batch(...)`` exactly as in v2.
+
+        ``checkpoint_dir`` enables full crash-safe checkpoints (parameters +
+        optimizer slots + states + pass cursor, uuid/sha manifest — see
+        ``trainer/checkpoint.py``); with ``resume`` the newest valid one is
+        loaded and training continues from the following pass."""
+        from paddle_tpu.trainer import checkpoint as ckpt
+
         if event_handler is None:
             event_handler = _default_event_handler
         self._ensure_built()
@@ -119,6 +128,27 @@ class SGD:
             opt_state = self._opt_state
 
         start_pass = flags.get("start_pass")
+        if checkpoint_dir and resume:
+            found = ckpt.latest_checkpoint(checkpoint_dir)
+            if found is not None:
+                path, manifest = found
+                cp, copt, cstates, _ = ckpt.load_checkpoint(
+                    path, opt_state_template=opt_state)
+                for name, arr in cp.items():
+                    if name in self.parameters:
+                        self.parameters[name] = arr
+                params = self.mesh.replicate(self._params_dict())
+                if copt is not None:
+                    opt_state = self.mesh.replicate(copt)
+                if cstates:
+                    states = self.mesh.replicate(
+                        {k: jax.numpy.asarray(v) for k, v in cstates.items()})
+                if manifest.get("meta", {}).get("rng") is not None:
+                    rng.set_state(np.asarray(manifest["meta"]["rng"],
+                                             dtype=np.uint32))
+                start_pass = max(start_pass, manifest["pass_id"] + 1)
+                log.info("resumed from %s (pass %d)", path,
+                         manifest["pass_id"])
         for pass_id in range(start_pass, num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             batch_costs, batch_metrics = [], []
@@ -154,6 +184,14 @@ class SGD:
             if save_dir and (pass_id % max(flags.get("saving_period"), 1) == 0):
                 self.save_parameter_to_tar_path(
                     os.path.join(save_dir, f"pass-{pass_id:05d}.tar")
+                )
+            if checkpoint_dir and (pass_id % max(checkpoint_period, 1) == 0):
+                ckpt.save_checkpoint(
+                    checkpoint_dir, pass_id,
+                    {n: np.asarray(params[n]) for n in params},
+                    opt_state=opt_state, states=dict(states),
+                    meta={"avg_metrics": avg_metrics,
+                          "rng": rng.get_state().tolist()},
                 )
             stat.global_stat.print_all_status()
 

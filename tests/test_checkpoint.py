@@ -1,0 +1,112 @@
+"""Checkpoint/resume — mirrors the Go pserver checkpoint tests
+(``go/pserver/service.go:342-391`` behavior: manifest+hash, newest-valid
+recovery) and ParamUtil pass-snapshot semantics."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.trainer import checkpoint as ckpt
+
+
+def _tiny_trainer():
+    from paddle_tpu.layers import api as layer
+    from paddle_tpu.layers import data_type
+
+    x = layer.data(name="x", type=data_type.dense_vector(4))
+    y = layer.data(name="y", type=data_type.dense_vector(1))
+    fc = layer.fc(input=x, size=1, act=paddle.activation.LinearActivation(),
+                  name="out")
+    cost = layer.mse_cost(input=fc, label=y)
+    params = paddle.parameters.create(paddle.topology.Topology(cost))
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Momentum(
+                                momentum=0.9, learning_rate=0.05))
+    return tr
+
+
+def _reader():
+    rs = np.random.RandomState(0)
+    w = np.array([1.0, -2.0, 0.5, 3.0])
+
+    def r():
+        for _ in range(16):
+            x = rs.randn(4).astype(np.float32)
+            yield x, np.array([x @ w], np.float32)
+    return paddle.reader.batch(r, batch_size=8)
+
+
+def test_save_load_roundtrip(tmp_path):
+    d = str(tmp_path)
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    opt = {"m": {"w": jnp.ones((2, 3))}, "step": jnp.zeros(())}
+    states = {"bn.mean": np.full((3,), 0.5, np.float32)}
+    path = ckpt.save_checkpoint(d, 3, params, opt_state=opt, states=states,
+                                meta={"note": "hi"})
+    assert os.path.basename(path) == "pass-00003"
+    found = ckpt.latest_checkpoint(d)
+    assert found is not None and found[1]["pass_id"] == 3
+    template = {"m": {"w": jnp.zeros((2, 3))}, "step": jnp.ones(())}
+    p2, o2, s2, manifest = ckpt.load_checkpoint(path, template)
+    np.testing.assert_array_equal(p2["w"], params["w"])
+    np.testing.assert_array_equal(np.asarray(o2["m"]["w"]), 1.0)
+    np.testing.assert_array_equal(s2["bn.mean"], 0.5)
+    assert manifest["meta"]["note"] == "hi"
+
+
+def test_corrupt_checkpoint_falls_back_to_previous(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 0, {"w": np.zeros(2, np.float32)})
+    ckpt.save_checkpoint(d, 1, {"w": np.ones(2, np.float32)})
+    # corrupt the newest payload
+    with open(os.path.join(d, "pass-00001", "params.npz"), "ab") as f:
+        f.write(b"garbage")
+    path, manifest = ckpt.latest_checkpoint(d)
+    assert manifest["pass_id"] == 0
+    p, _, _, _ = ckpt.load_checkpoint(path)
+    np.testing.assert_array_equal(p["w"], 0.0)
+
+
+def test_gc_keeps_last_n(tmp_path):
+    d = str(tmp_path)
+    for i in range(5):
+        ckpt.save_checkpoint(d, i, {"w": np.zeros(1, np.float32)},
+                             keep_last=2)
+    left = sorted(x for x in os.listdir(d) if x.startswith("pass-"))
+    assert left == ["pass-00003", "pass-00004"]
+
+
+def test_trainer_checkpoint_and_resume(tmp_path):
+    d = str(tmp_path / "ckpt")
+    tr = _tiny_trainer()
+    tr.train(reader=_reader(), num_passes=2, checkpoint_dir=d)
+    assert ckpt.latest_checkpoint(d)[1]["pass_id"] == 1
+    w_after = tr.parameters["_out.w0"].copy()
+
+    # fresh trainer resumes: starts at pass 2, parameters restored
+    tr2 = _tiny_trainer()
+    seen_passes = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.BeginPass):
+            seen_passes.append(e.pass_id)
+
+    tr2.train(reader=_reader(), num_passes=4, checkpoint_dir=d,
+              event_handler=handler)
+    assert seen_passes == [2, 3]
+    # resumed from the saved weights, then kept training
+    assert ckpt.latest_checkpoint(d)[1]["pass_id"] == 3
+
+    # resume with num_passes already done -> trains nothing
+    tr3 = _tiny_trainer()
+    seen = []
+    tr3.train(reader=_reader(), num_passes=4, checkpoint_dir=d,
+              event_handler=lambda e: seen.append(e))
+    assert not any(isinstance(e, paddle.event.EndIteration) for e in seen)
+    np.testing.assert_allclose(
+        tr3.parameters["_out.w0"],
+        ckpt.load_checkpoint(ckpt.latest_checkpoint(d)[0])[0]["_out.w0"])
+    del w_after

@@ -171,3 +171,22 @@ def test_seqtoseq_beam_search_generation():
     # ragged python conversion works
     rows = res.to_list()
     assert len(rows) == 2 and len(rows[0]) == 3
+
+
+def test_seqtoseq_train_generate_share_all_params_same_process():
+    """Building the generation topology AFTER the training one (no counter
+    reset, as a real user script does) must reference the same parameter
+    names, or generation would silently run on fresh random weights."""
+    from paddle_tpu.models.seqtoseq import seqtoseq_net
+
+    cost = seqtoseq_net(20, 17, word_vector_dim=8, encoder_size=8,
+                        decoder_size=8)
+    train_names = {s.name for s in Topology(cost).param_specs()}
+    gen = seqtoseq_net(20, 17, word_vector_dim=8, encoder_size=8,
+                       decoder_size=8, is_generating=True, beam_size=2,
+                       max_length=5)
+    gen_names = {s.name for s in Topology(gen).param_specs()}
+    # every generation parameter except the source-side-only data path must
+    # exist in the trained set
+    missing = gen_names - train_names
+    assert not missing, f"generation params not trained: {missing}"
