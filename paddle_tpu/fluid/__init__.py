@@ -1,0 +1,58 @@
+"""paddle_tpu.fluid — the program-of-operators stack, TPU-native.
+
+Reference: the emerging "Fluid" generation of the reference framework —
+``paddle/framework/`` (ProgramDesc/Scope/Executor, ``executor.cc:87-128``),
+``paddle/operators/`` (~110 ops), and its Python mirror
+``python/paddle/v2/framework/`` (framework.py / layers.py / executor.py /
+backward.py / optimizer.py / io.py / nets.py).
+
+TPU-native redesign, NOT a translation:
+
+- The IR survives: ``Program`` / ``Block`` / ``Operator`` / ``Variable``
+  (reference ``framework/framework.proto:33-145``) — but it is a pure-Python
+  graph, no protobuf interpreter behind it.
+- Execution changes completely: where the reference ``Executor::Run`` walks the
+  op list and launches one kernel per op (``executor.cc:121-123``), our
+  :class:`~paddle_tpu.fluid.executor.Executor` *traces* maximal runs of ops
+  into single functions and hands them to ``jax.jit`` — one XLA program per
+  segment, fused and laid out by the compiler.  Host-side ops (save/load)
+  split segments.
+- Autodiff changes completely: instead of ~110 hand-written ``*_grad`` kernels
+  (reference ``backward.cc:449`` + per-op ``GradOpDescMaker``), backward ops
+  are *derived* from the forward kernel with ``jax.vjp`` — one generic grad
+  kernel serves every op (:mod:`paddle_tpu.fluid.ops`).
+- Optimizers remain ops appended to the program (reference
+  ``operators/sgd_op.cc`` etc.), so ``optimizer.minimize(loss)`` produces a
+  self-contained trainable program that compiles to one fused XLA step.
+"""
+
+from paddle_tpu.fluid import framework, initializer, io, layers, nets, regularizer
+from paddle_tpu.fluid.backward import append_backward_ops
+from paddle_tpu.fluid.executor import Executor, g_scope
+from paddle_tpu.fluid.framework import (
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    unique_name,
+)
+from paddle_tpu.fluid.optimizer import (
+    AdagradOptimizer,
+    AdamaxOptimizer,
+    AdamOptimizer,
+    DecayedAdagradOptimizer,
+    MomentumOptimizer,
+    SGDOptimizer,
+)
+
+__all__ = [
+    "framework", "layers", "nets", "io", "initializer", "regularizer",
+    "append_backward_ops", "Executor", "g_scope",
+    "Program", "Block", "Operator", "Variable", "Parameter",
+    "default_main_program", "default_startup_program", "unique_name",
+    "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "DecayedAdagradOptimizer",
+]

@@ -1,0 +1,151 @@
+"""append_backward_ops — graph-level autodiff over a Program.
+
+Reference: ``paddle/framework/backward.cc:449`` (``AppendBackward``) walks the
+block in reverse, asking each op's ``GradOpDescMaker`` for hand-specified grad
+ops and inserting ``sum`` ops where a variable's gradient has multiple
+contributors.
+
+TPU-native redesign: the reverse walk and grad-accumulation bookkeeping are
+kept (they are graph algorithms, not kernels), but every grad op is the single
+``__generic_grad__`` op whose kernel differentiates the forward kernel with
+``jax.vjp`` (see :mod:`paddle_tpu.fluid.ops`).  No per-op grad makers exist.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.framework import Parameter, Variable, grad_var_name
+
+
+def _float_var(block, name):
+    try:
+        v = block.var(name)
+    except KeyError:
+        return True  # unknown vars: assume differentiable
+    return v.dtype is None or v.dtype.startswith("float") or v.dtype.startswith("bfloat")
+
+
+def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
+    """Append grad ops for ``loss`` to its program; returns [(param, grad_var)].
+
+    Mirrors ``python/paddle/v2/framework/backward.py:6`` in signature and
+    behavior (including the ``sum`` accumulation for fan-out variables).
+    """
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+
+    if parameter_list is not None:
+        params = [block.var(n) if isinstance(n, str) else n for n in parameter_list]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+    params = [p for p in params if p.name not in no_grad]
+    enforce(params, "no trainable parameters to differentiate")
+
+    fwd_ops = list(block.ops)
+
+    # Vars on a grad path: descendants of params intersected with ancestors of
+    # loss (plus the loss itself).
+    desc = {p.name for p in params}
+    for op in fwd_ops:
+        if any(n in desc for n in op.input_names()):
+            desc.update(n for n in op.output_names() if n)
+    anc = {loss.name}
+    for op in reversed(fwd_ops):
+        if any(n in anc for n in op.output_names()):
+            anc.update(n for n in op.input_names() if n)
+    need = ((desc & anc) | {loss.name}) - no_grad
+
+    # Seed: d loss / d loss = 1.
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(name=loss_grad, shape=loss.shape or (), dtype=loss.dtype)
+    block.append_op(
+        "fill_constant", {}, {"Out": [loss_grad]},
+        {"shape": list(loss.shape or ()), "value": 1.0,
+         "dtype": loss.dtype or "float32"})
+
+    # var -> list of pending grad contribution names
+    pending: dict[str, list[str]] = {loss.name: [loss_grad]}
+    finalized: set[str] = {loss.name}
+
+    def _declare(name, like):
+        if not block.has_var(name):
+            try:
+                v = block.var(like)
+                block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+            except KeyError:
+                block.create_var(name=name)
+
+    def get_grad(name: str) -> str | None:
+        lst = pending.get(name)
+        if not lst:
+            return None
+        canon = grad_var_name(name)
+        if name in finalized:
+            return lst[0]
+        _declare(canon, name)
+        if len(lst) == 1:
+            if lst[0] != canon:
+                block.append_op("scale", {"X": [lst[0]]}, {"Out": [canon]},
+                                {"scale": 1.0})
+        else:
+            block.append_op("sum", {"X": list(lst)}, {"Out": [canon]})
+        pending[name] = [canon]
+        finalized.add(name)
+        return canon
+
+    for op in reversed(fwd_ops):
+        # incoming grads for this op's outputs
+        og_inputs = {}
+        has_any = False
+        for slot, names in op.outputs.items():
+            gnames = []
+            for n in names:
+                g = get_grad(n) if n and n in pending else None
+                gnames.append(g or "")
+                has_any = has_any or g is not None
+            og_inputs["OG:" + slot] = gnames
+        if not has_any:
+            continue
+
+        grad_slots = [
+            slot for slot, names in op.inputs.items()
+            if any(n and n in need and _float_var(block, n) for n in names)
+        ]
+        if not grad_slots:
+            continue
+
+        outputs = {}
+        for slot in grad_slots:
+            outs = []
+            for n in op.inputs[slot]:
+                if n and n in need and _float_var(block, n):
+                    k = len(pending.setdefault(n, []))
+                    gname = grad_var_name(n) + ("" if k == 0 else "@RENAME%d" % k)
+                    # reserve the canonical name for the final accumulation
+                    if k == 0:
+                        gname = grad_var_name(n) + "@C0"
+                    _declare(gname, n)
+                    pending[n].append(gname)
+                    outs.append(gname)
+                else:
+                    outs.append("")
+            outputs[slot + "@GRAD"] = outs
+
+        attrs = dict(op.attrs)
+        attrs["__fwd_type__"] = op.type
+        attrs["__grad_slots__"] = grad_slots
+        if "__rng_tag__" not in attrs:
+            outs_flat = op.output_names()
+            attrs["__rng_tag__"] = outs_flat[0] if outs_flat else op.type
+        block.append_op("__generic_grad__", {**op.inputs, **og_inputs},
+                        outputs, attrs)
+
+    params_and_grads = []
+    for p in params:
+        g = get_grad(p.name)
+        enforce(g is not None,
+                "parameter %s does not contribute to the loss" % p.name)
+        params_and_grads.append((p, block.var(g)))
+    return params_and_grads

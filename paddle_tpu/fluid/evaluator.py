@@ -1,0 +1,46 @@
+"""Metric evaluators accumulating across batches.
+
+Reference: ``python/paddle/v2/framework/evaluator.py`` — an Evaluator owns
+per-metric state accumulated over ``exe.run`` calls and reset per pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.fluid import layers
+
+
+class Evaluator:
+    def reset(self):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """Usage::
+
+        acc = evaluator.Accuracy(input=predict, label=label, k=1)
+        ...
+        outs = exe.run(feed=..., fetch_list=[cost] + acc.metrics)
+        acc.update(*outs[1:])
+    """
+
+    def __init__(self, input, label, k=1, **kw):
+        acc_var = layers.accuracy(input=input, label=label, k=k, **kw)
+        self.metrics = [acc_var.states[0], acc_var.states[1]]
+        self.acc_var = acc_var
+        self.reset()
+
+    def reset(self):
+        self._correct = 0.0
+        self._total = 0.0
+
+    def update(self, correct, total):
+        self._correct += float(np.asarray(correct))
+        self._total += float(np.asarray(total))
+
+    def eval(self):
+        return self._correct / max(self._total, 1.0)

@@ -1,0 +1,289 @@
+"""Program / Block / Operator / Variable — the Fluid IR.
+
+Reference: ``paddle/framework/framework.proto:33-145`` (ProgramDesc/BlockDesc/
+OpDesc/VarDesc) and its Python mirror ``python/paddle/v2/framework/framework.py``
+(Variable/Operator/Block/Program/Parameter).  Here the IR is plain Python data
+— it only ever needs to be (a) mutated by layer builders, (b) traced by the
+Executor into a jitted function, and (c) serialized to JSON for
+``save_inference_model``.  No protobuf round-trip, no C++ *Desc mirror classes.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Any, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+_name_counters: collections.defaultdict[str, int] = collections.defaultdict(int)
+
+
+def unique_name(prefix: str) -> str:
+    _name_counters[prefix] += 1
+    return "%s_%d" % (prefix, _name_counters[prefix] - 1)
+
+
+def reset_unique_names() -> None:
+    _name_counters.clear()
+
+
+def grad_var_name(name: str) -> str:
+    return name + "@GRAD"
+
+
+class Variable:
+    """A named slot in a Block (reference VarDesc + python Variable).
+
+    ``lod_level > 0`` marks a LoD (ragged-sequence) tensor; its scope entry is
+    a :class:`paddle_tpu.core.lod.LoDArray`-style pair rather than a bare array.
+    """
+
+    def __init__(self, block: "Block", name: str | None = None, shape=None,
+                 dtype="float32", lod_level: int = 0, persistable: bool = False,
+                 stop_gradient: bool = False):
+        self.block = block
+        self.name = name if name is not None else unique_name("_generated_var")
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = np.dtype(dtype).name if dtype is not None else None
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.op: Operator | None = None  # last writer, for API convenience
+
+    @property
+    def grad_name(self) -> str:
+        return grad_var_name(self.name)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "shape": self.shape, "dtype": self.dtype,
+            "lod_level": self.lod_level, "persistable": self.persistable,
+            "is_parameter": isinstance(self, Parameter),
+        }
+
+    def __repr__(self):
+        return "Variable(%s, shape=%s, dtype=%s)" % (self.name, self.shape, self.dtype)
+
+
+class Parameter(Variable):
+    """A trainable, persistable Variable (reference framework.py Parameter)."""
+
+    def __init__(self, block, name=None, shape=None, dtype="float32", **kw):
+        self.trainable = kw.pop("trainable", True)
+        self.regularizer = kw.pop("regularizer", None)
+        self.optimize_attr = kw.pop("optimize_attr", {"learning_rate": 1.0})
+        super().__init__(block, name=name, shape=shape, dtype=dtype,
+                         persistable=True, **kw)
+        enforce(self.shape is not None, "parameter needs a shape")
+
+
+class Operator:
+    """One op invocation: type + named input/output slots + attrs.
+
+    Reference OpDesc (``framework.proto:54-70``): inputs/outputs are
+    slot-name -> [variable names] multimaps, attrs a typed map.  Kernels for
+    each type live in :mod:`paddle_tpu.fluid.ops`.
+    """
+
+    def __init__(self, block: "Block", type: str,
+                 inputs: dict[str, Sequence[str]] | None = None,
+                 outputs: dict[str, Sequence[str]] | None = None,
+                 attrs: dict[str, Any] | None = None):
+        self.block = block
+        self.type = type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input_names(self) -> list[str]:
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self) -> list[str]:
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def to_dict(self) -> dict:
+        attrs = {}
+        for k, v in self.attrs.items():
+            if isinstance(v, np.ndarray):
+                attrs[k] = {"__ndarray__": v.tolist(), "dtype": v.dtype.name}
+            else:
+                attrs[k] = v
+        return {"type": self.type, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": attrs}
+
+    def __repr__(self):
+        return "Operator(%s: %s -> %s)" % (self.type, self.inputs, self.outputs)
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: dict[str, Variable] = {}
+        self.ops: list[Operator] = []
+
+    @property
+    def parent(self) -> "Block | None":
+        return None if self.parent_idx < 0 else self.program.blocks[self.parent_idx]
+
+    def var(self, name: str) -> Variable:
+        b: Block | None = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent
+        raise KeyError("variable %r not found in block %d" % (name, self.idx))
+
+    def has_var(self, name: str) -> bool:
+        try:
+            self.var(name)
+            return True
+        except KeyError:
+            return False
+
+    def create_var(self, name=None, **kw) -> Variable:
+        v = Variable(self, name=name, **kw)
+        self.vars[v.name] = v
+        return v
+
+    def create_parameter(self, name=None, **kw) -> Parameter:
+        p = Parameter(self, name=name, **kw)
+        self.vars[p.name] = p
+        return p
+
+    def clone_variable(self, var: Variable) -> Variable:
+        """Re-declare ``var`` in this block (reference _clone_var_in_block_)."""
+        if isinstance(var, Parameter):
+            return self.create_parameter(
+                name=var.name, shape=var.shape, dtype=var.dtype,
+                lod_level=var.lod_level, trainable=var.trainable)
+        return self.create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype,
+            lod_level=var.lod_level, persistable=var.persistable)
+
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        for names in op.outputs.values():
+            for n in names:
+                if n in self.vars:
+                    self.vars[n].op = op
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        return op
+
+    def all_parameters(self) -> list[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+
+class Program:
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self._current_idx = 0
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current_idx]
+
+    def create_block(self) -> Block:
+        b = Block(self, len(self.blocks), parent_idx=self._current_idx)
+        self.blocks.append(b)
+        self._current_idx = b.idx
+        return b
+
+    def rollback(self) -> None:
+        self._current_idx = self.current_block().parent_idx
+
+    # -- serialization / slicing --------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "blocks": [{
+                "idx": b.idx, "parent_idx": b.parent_idx,
+                "vars": [v.to_dict() for v in b.vars.values()],
+                "ops": [op.to_dict() for op in b.ops],
+            } for b in self.blocks],
+        }, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "Program":
+        data = json.loads(text)
+        prog = Program()
+        prog.blocks = []
+        for bd in data["blocks"]:
+            blk = Block(prog, bd["idx"], bd["parent_idx"])
+            for vd in bd["vars"]:
+                cls = Parameter if vd.get("is_parameter") else Variable
+                v = cls(blk, name=vd["name"], shape=vd["shape"], dtype=vd["dtype"],
+                        lod_level=vd["lod_level"])
+                v.persistable = vd["persistable"]
+                blk.vars[v.name] = v
+            for od in bd["ops"]:
+                attrs = {}
+                for k, v in od["attrs"].items():
+                    if isinstance(v, dict) and "__ndarray__" in v:
+                        attrs[k] = np.array(v["__ndarray__"], dtype=v["dtype"])
+                    else:
+                        attrs[k] = v
+                blk.ops.append(Operator(blk, od["type"], od["inputs"],
+                                        od["outputs"], attrs))
+            prog.blocks.append(blk)
+        if not prog.blocks:
+            prog.blocks = [Block(prog, 0)]
+        prog._current_idx = 0
+        return prog
+
+    def fingerprint(self) -> str:
+        import hashlib
+        return hashlib.sha1(self.to_json().encode()).hexdigest()
+
+    def clone(self) -> "Program":
+        return Program.from_json(self.to_json())
+
+    def prune(self, targets: Sequence[Variable | str]) -> "Program":
+        """Backward-slice block 0 to the ops needed for ``targets``.
+
+        Reference ``framework/prune.cc`` keeps ops reachable (backwards) from
+        target ops; used by ``save_inference_model``.
+        """
+        target_names = {t if isinstance(t, str) else t.name for t in targets}
+        pruned = self.clone()
+        blk = pruned.global_block()
+        needed = set(target_names)
+        kept: list[Operator] = []
+        for op in reversed(blk.ops):
+            if needed & set(op.output_names()):
+                kept.append(op)
+                needed |= set(op.input_names())
+        blk.ops = list(reversed(kept))
+        live = needed | target_names
+        blk.vars = {n: v for n, v in blk.vars.items() if n in live}
+        return pruned
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def reset_default_programs() -> None:
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+    reset_unique_names()

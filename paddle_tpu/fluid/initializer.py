@@ -1,0 +1,67 @@
+"""Initializers append init ops to the startup program.
+
+Reference: ``python/paddle/v2/framework/initializer.py`` (Constant/Uniform/
+Normal/Xavier — each appends a fill_constant / uniform_random /
+gaussian_random op to the startup block).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, var, block):
+        block.append_op("fill_constant", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "value": self.value,
+                         "dtype": var.dtype})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, seed: int = 0):
+        enforce(low < high, "uniform low must be < high")
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        block.append_op("uniform_random", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "min": self.low,
+                         "max": self.high, "dtype": var.dtype,
+                         "__rng_tag__": "init:" + var.name})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0, seed: int = 0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op("gaussian_random", {}, {"Out": [var.name]},
+                        {"shape": list(var.shape), "mean": self.loc,
+                         "std": self.scale, "dtype": var.dtype,
+                         "__rng_tag__": "init:" + var.name})
+
+
+class XavierInitializer(Initializer):
+    """Glorot init; fan computed like the reference (fan_in = prod(shape[1:]))."""
+
+    def __init__(self, uniform: bool = True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        f_in = self.fan_in if self.fan_in is not None else int(np.prod(var.shape[1:]))
+        f_out = self.fan_out if self.fan_out is not None else var.shape[0]
+        if self.uniform:
+            limit = float(np.sqrt(6.0 / (f_in + f_out)))
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = float(np.sqrt(2.0 / (f_in + f_out)))
+            NormalInitializer(0.0, std, self.seed)(var, block)

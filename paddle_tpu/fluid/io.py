@@ -1,0 +1,107 @@
+"""Persistence — save/load variables and inference models.
+
+Reference: ``python/paddle/v2/framework/io.py`` (save_vars/save_params/
+save_persistables/load_* build throwaway programs of save/load ops and run
+them; ``save_inference_model`` prunes the program to the fetch targets and
+writes it next to the parameters).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.framework import Parameter, Program, Variable
+
+
+def is_parameter(var) -> bool:
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def _collect(main_program, vars, predicate):
+    main_program = main_program or framework.default_main_program()
+    if vars is not None:
+        return list(vars)
+    return [v for v in main_program.global_block().vars.values()
+            if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+    to_save = _collect(main_program, vars, predicate or is_persistable)
+    prog = Program()
+    block = prog.global_block()
+    for v in to_save:
+        block.clone_variable(v)
+        block.append_op("save", {"X": [v.name]}, {},
+                        {"file_path": os.path.join(dirname, v.name + ".npy")})
+    executor.run(prog)
+
+
+def save_params(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program, predicate=is_parameter)
+
+
+def save_persistables(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program, predicate=is_persistable)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None):
+    to_load = _collect(main_program, vars, predicate or is_persistable)
+    prog = Program()
+    block = prog.global_block()
+    for v in to_load:
+        block.clone_variable(v)
+        block.append_op("load", {}, {"Out": [v.name]},
+                        {"file_path": os.path.join(dirname, v.name + ".npy")})
+    executor.run(prog)
+
+
+def load_params(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter)
+
+
+def load_persistables(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable)
+
+
+def load_persistables_if_exist(executor, dirname, main_program=None):
+    if os.path.isdir(dirname):
+        try:
+            load_persistables(executor, dirname, main_program)
+        except FileNotFoundError:
+            pass
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None):
+    """Prune to the inference slice + persist program and parameters."""
+    main_program = main_program or framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    pruned = main_program.prune(target_vars)
+    meta = {
+        "program": json.loads(pruned.to_json()),
+        "feed": list(feeded_var_names),
+        "fetch": [t if isinstance(t, str) else t.name for t in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned)
+
+
+def load_inference_model(dirname, executor):
+    """Returns (program, feed_names, fetch_names)."""
+    path = os.path.join(dirname, "__model__")
+    enforce(os.path.exists(path), "no inference model under %r" % dirname)
+    with open(path) as f:
+        meta = json.load(f)
+    prog = Program.from_json(json.dumps(meta["program"]))
+    load_persistables(executor, dirname, prog)
+    return prog, meta["feed"], meta["fetch"]

@@ -1,0 +1,114 @@
+"""LayerHelper — shared plumbing for fluid layer builders.
+
+Reference: ``python/paddle/v2/framework/layer_helper.py`` — resolves
+param_attr defaults, creates parameters in the main program (with a twin +
+init op in the startup program), creates temp output vars, appends
+activation/bias ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.initializer import (
+    ConstantInitializer,
+    Initializer,
+    UniformInitializer,
+    XavierInitializer,
+)
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        if kwargs.get("name") is None:
+            self.name = framework.unique_name(layer_type)
+        else:
+            self.name = kwargs["name"]
+
+    @property
+    def main_program(self) -> framework.Program:
+        return self.kwargs.get("main_program") or framework.default_main_program()
+
+    @property
+    def startup_program(self) -> framework.Program:
+        return self.kwargs.get("startup_program") or framework.default_startup_program()
+
+    def append_op(self, *args, **kw):
+        return self.main_program.current_block().append_op(*args, **kw)
+
+    def multiple_input(self, name="input"):
+        inputs = self.kwargs.get(name, [])
+        if isinstance(inputs, framework.Variable):
+            return [inputs]
+        return list(inputs)
+
+    def input(self, name="input"):
+        inputs = self.multiple_input(name)
+        enforce(len(inputs) == 1, "%s layer takes one input" % self.layer_type)
+        return inputs[0]
+
+    def create_parameter(self, attr: dict | None, shape, dtype="float32",
+                         suffix="w", initializer: Initializer | None = None):
+        attr = dict(attr or {})
+        name = attr.get("name") or framework.unique_name(
+            ".".join([self.name, suffix]))
+        init = attr.get("initializer") or initializer
+        if init is None:
+            init = (XavierInitializer() if suffix == "w"
+                    else ConstantInitializer(0.0))
+        block = self.main_program.current_block()
+        param = block.create_parameter(
+            name=name, shape=shape, dtype=dtype,
+            trainable=attr.get("trainable", True),
+            regularizer=attr.get("regularizer"),
+            optimize_attr=attr.get("optimize_attr", {"learning_rate": 1.0}))
+        sblock = self.startup_program.global_block()
+        svar = sblock.create_var(name=name, shape=shape, dtype=dtype,
+                                 persistable=True)
+        init(svar, sblock)
+        return param
+
+    def create_tmp_variable(self, dtype="float32", shape=None, lod_level=0):
+        return self.main_program.current_block().create_var(
+            name=framework.unique_name(".".join([self.name, "tmp"])),
+            shape=shape, dtype=dtype, lod_level=lod_level)
+
+    def create_global_variable(self, shape, dtype="float32", persistable=True,
+                               name=None, init_value=0.0):
+        """A persistable non-parameter var (BN running stats, accumulators)."""
+        name = name or framework.unique_name(".".join([self.name, "global"]))
+        block = self.main_program.global_block()
+        var = block.create_var(name=name, shape=shape, dtype=dtype,
+                               persistable=persistable)
+        sblock = self.startup_program.global_block()
+        sblock.create_var(name=name, shape=shape, dtype=dtype, persistable=True)
+        ConstantInitializer(init_value)(var, sblock)
+        return var
+
+    def append_bias_op(self, input_var, bias_attr, dim_start=1, size=None):
+        if bias_attr is False:
+            return input_var
+        size = size if size is not None else input_var.shape[-1]
+        b = self.create_parameter(
+            bias_attr if isinstance(bias_attr, dict) else None,
+            shape=(size,), dtype=input_var.dtype, suffix="b",
+            initializer=ConstantInitializer(0.0))
+        out = self.create_tmp_variable(dtype=input_var.dtype,
+                                       shape=input_var.shape)
+        self.append_op("elementwise_add",
+                       {"X": [input_var.name], "Y": [b.name]},
+                       {"Out": [out.name]}, {"axis": dim_start})
+        return out
+
+    def append_activation(self, input_var, act: str | None = None):
+        act = act if act is not None else self.kwargs.get("act")
+        if act is None:
+            return input_var
+        out = self.create_tmp_variable(dtype=input_var.dtype,
+                                       shape=input_var.shape)
+        self.append_op(act, {"X": [input_var.name]}, {"Out": [out.name]})
+        return out

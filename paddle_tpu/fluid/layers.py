@@ -1,0 +1,349 @@
+"""Fluid layer builders — append ops to the default programs.
+
+Reference: ``python/paddle/v2/framework/layers.py`` (data/fc/embedding/conv2d/
+pool2d/batch_norm/dropout/cross_entropy/accuracy/…, plus auto-generated
+wrappers for simple ops via ``_create_op_func_``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.framework import Variable
+from paddle_tpu.fluid.initializer import ConstantInitializer
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def data(name, shape, dtype="float32", append_batch_size=True, lod_level=0,
+         main_program=None, **kw):
+    prog = main_program or framework.default_main_program()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return prog.global_block().create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=True)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None, main_program=None, startup_program=None):
+    helper = LayerHelper("fc", input=input, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = helper.input().dtype
+    mul_results = []
+    for inp in helper.multiple_input():
+        in_shape = inp.shape
+        # note: `abs` is shadowed by the generated abs layer below
+        w_rows = int(np.prod([d if d >= 0 else -d
+                              for d in in_shape[num_flatten_dims:]]))
+        w = helper.create_parameter(param_attr, shape=(w_rows, size), dtype=dtype)
+        out_shape = tuple(in_shape[:num_flatten_dims]) + (size,)
+        tmp = helper.create_tmp_variable(dtype=dtype, shape=out_shape)
+        helper.append_op("mul", {"X": [inp.name], "Y": [w.name]},
+                         {"Out": [tmp.name]},
+                         {"x_num_col_dims": num_flatten_dims,
+                          "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(dtype=dtype,
+                                              shape=mul_results[0].shape)
+        helper.append_op("sum", {"X": [m.name for m in mul_results]},
+                         {"Out": [pre_bias.name]})
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, dim_start=num_flatten_dims,
+                                    size=size)
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(input, size, param_attr=None, dtype="float32", name=None,
+              main_program=None, startup_program=None):
+    helper = LayerHelper("embedding", name=name, main_program=main_program,
+                         startup_program=startup_program)
+    w = helper.create_parameter(param_attr, shape=tuple(size), dtype=dtype)
+    ishape = input.shape or (-1,)
+    out_shape = tuple(ishape[:-1] if ishape[-1] == 1 else ishape) + (size[1],)
+    out = helper.create_tmp_variable(dtype=dtype, shape=out_shape)
+    helper.append_op("lookup_table", {"W": [w.name], "Ids": [input.name]},
+                     {"Out": [out.name]})
+    return out
+
+
+def _conv_out_dim(size, k, s, p):
+    return (size + 2 * p - k) // s + 1
+
+
+def conv2d(input, num_filters, filter_size, stride=None, padding=None,
+           groups=None, param_attr=None, bias_attr=None, act=None, name=None,
+           main_program=None, startup_program=None):
+    helper = LayerHelper("conv2d", input=input, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = stride or [1, 1]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    padding = padding or [0, 0]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    groups = groups or 1
+    n, c, h, w_ = input.shape
+    enforce(c % groups == 0, "channels %d not divisible by groups %d" % (c, groups))
+    filter_shape = (num_filters, c // groups, filter_size[0], filter_size[1])
+    std = (2.0 / (filter_size[0] * filter_size[1] * c)) ** 0.5
+    from paddle_tpu.fluid.initializer import NormalInitializer
+    filt = helper.create_parameter(param_attr, shape=filter_shape,
+                                   dtype=input.dtype,
+                                   initializer=NormalInitializer(0.0, std))
+    out_shape = (n, num_filters,
+                 _conv_out_dim(h, filter_size[0], stride[0], padding[0]),
+                 _conv_out_dim(w_, filter_size[1], stride[1], padding[1]))
+    pre_bias = helper.create_tmp_variable(dtype=input.dtype, shape=out_shape)
+    helper.append_op("conv2d",
+                     {"Input": [input.name], "Filter": [filt.name]},
+                     {"Output": [pre_bias.name]},
+                     {"strides": stride, "paddings": padding, "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, dim_start=1,
+                                    size=num_filters)
+    return helper.append_activation(pre_act, act)
+
+
+def pool2d(input, pool_size, pool_type="max", pool_stride=None,
+           pool_padding=None, global_pooling=False, name=None,
+           main_program=None, startup_program=None):
+    if isinstance(pool_size, int):
+        pool_size = [pool_size, pool_size]
+    pool_stride = pool_stride or [1, 1]
+    if isinstance(pool_stride, int):
+        pool_stride = [pool_stride, pool_stride]
+    pool_padding = pool_padding or [0, 0]
+    if isinstance(pool_padding, int):
+        pool_padding = [pool_padding, pool_padding]
+    helper = LayerHelper("pool2d", input=input, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    n, c, h, w = input.shape
+    if global_pooling:
+        out_shape = (n, c, 1, 1)
+    else:
+        out_shape = (n, c,
+                     _conv_out_dim(h, pool_size[0], pool_stride[0], pool_padding[0]),
+                     _conv_out_dim(w, pool_size[1], pool_stride[1], pool_padding[1]))
+    out = helper.create_tmp_variable(dtype=input.dtype, shape=out_shape)
+    helper.append_op("pool2d", {"X": [input.name]}, {"Out": [out.name]},
+                     {"ksize": pool_size, "pooling_type": pool_type,
+                      "strides": pool_stride, "paddings": pool_padding,
+                      "global_pooling": global_pooling})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, name=None,
+               main_program=None, startup_program=None):
+    helper = LayerHelper("batch_norm", input=input, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    c = input.shape[1]
+    scale = helper.create_parameter(param_attr, shape=(c,), dtype=input.dtype,
+                                    suffix="scale",
+                                    initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr if isinstance(bias_attr, dict) else None,
+                                   shape=(c,), dtype=input.dtype, suffix="bias",
+                                   initializer=ConstantInitializer(0.0))
+    mean = helper.create_global_variable(shape=(c,), dtype=input.dtype,
+                                         init_value=0.0)
+    variance = helper.create_global_variable(shape=(c,), dtype=input.dtype,
+                                             init_value=1.0)
+    saved_mean = helper.create_tmp_variable(dtype=input.dtype, shape=(c,))
+    saved_var = helper.create_tmp_variable(dtype=input.dtype, shape=(c,))
+    y = helper.create_tmp_variable(dtype=input.dtype, shape=input.shape)
+    helper.append_op(
+        "batch_norm",
+        {"X": [input.name], "Scale": [scale.name], "Bias": [bias.name],
+         "Mean": [mean.name], "Variance": [variance.name]},
+        {"Y": [y.name], "MeanOut": [mean.name], "VarianceOut": [variance.name],
+         "SavedMean": [saved_mean.name], "SavedVariance": [saved_var.name]},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test})
+    return helper.append_activation(y, act)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None,
+            main_program=None, startup_program=None):
+    helper = LayerHelper("dropout", input=x, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    mask = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    helper.append_op("dropout", {"X": [x.name]},
+                     {"Out": [out.name], "Mask": [mask.name]},
+                     {"dropout_prob": dropout_prob, "is_test": is_test,
+                      "__rng_tag__": out.name})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, **kw):
+    helper = LayerHelper("cross_entropy", input=input, **kw)
+    out = helper.create_tmp_variable(dtype=input.dtype,
+                                     shape=(input.shape[0], 1))
+    helper.append_op("cross_entropy",
+                     {"X": [input.name], "Label": [label.name]},
+                     {"Y": [out.name]}, {"soft_label": soft_label})
+    return out
+
+
+def square_error_cost(input, label, **kw):
+    helper = LayerHelper("square_error_cost", input=input, **kw)
+    diff = helper.create_tmp_variable(dtype=input.dtype, shape=input.shape)
+    helper.append_op("elementwise_sub",
+                     {"X": [input.name], "Y": [label.name]},
+                     {"Out": [diff.name]})
+    out = helper.create_tmp_variable(dtype=input.dtype, shape=input.shape)
+    helper.append_op("square", {"X": [diff.name]}, {"Out": [out.name]})
+    return out
+
+
+def accuracy(input, label, k=1, **kw):
+    helper = LayerHelper("accuracy", input=input, **kw)
+    topk_out = helper.create_tmp_variable(dtype=input.dtype,
+                                          shape=(input.shape[0], k))
+    topk_idx = helper.create_tmp_variable(dtype="int64",
+                                          shape=(input.shape[0], k))
+    helper.append_op("top_k", {"X": [input.name]},
+                     {"Out": [topk_out.name], "Indices": [topk_idx.name]},
+                     {"k": k})
+    acc = helper.create_tmp_variable(dtype="float32", shape=())
+    correct = helper.create_tmp_variable(dtype="float32", shape=())
+    total = helper.create_tmp_variable(dtype="float32", shape=())
+    helper.append_op("accuracy",
+                     {"Indices": [topk_idx.name], "Label": [label.name]},
+                     {"Accuracy": [acc.name], "Correct": [correct.name],
+                      "Total": [total.name]})
+    acc.states = [correct, total]
+    return acc
+
+
+def mean(x, **kw):
+    helper = LayerHelper("mean", input=x, **kw)
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=())
+    helper.append_op("mean", {"X": [x.name]}, {"Out": [out.name]})
+    return out
+
+
+def concat(input, axis=0, **kw):
+    helper = LayerHelper("concat", **kw)
+    shape = list(input[0].shape)
+    shape[axis] = sum(i.shape[axis] for i in input)
+    out = helper.create_tmp_variable(dtype=input[0].dtype, shape=tuple(shape))
+    helper.append_op("concat", {"X": [i.name for i in input]},
+                     {"Out": [out.name]}, {"axis": axis})
+    return out
+
+
+def sums(input, **kw):
+    helper = LayerHelper("sums", **kw)
+    out = helper.create_tmp_variable(dtype=input[0].dtype, shape=input[0].shape)
+    helper.append_op("sum", {"X": [i.name for i in input]}, {"Out": [out.name]})
+    return out
+
+
+def cast(x, dtype, **kw):
+    helper = LayerHelper("cast", input=x, **kw)
+    out = helper.create_tmp_variable(dtype=dtype, shape=x.shape)
+    helper.append_op("cast", {"X": [x.name]}, {"Out": [out.name]},
+                     {"out_dtype": dtype})
+    return out
+
+
+def reshape(x, shape, **kw):
+    helper = LayerHelper("reshape", input=x, **kw)
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=tuple(shape))
+    helper.append_op("reshape", {"X": [x.name]}, {"Out": [out.name]},
+                     {"shape": list(shape)})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, **kw):
+    helper = LayerHelper("scale", input=x, **kw)
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    helper.append_op("scale", {"X": [x.name]}, {"Out": [out.name]},
+                     {"scale": scale, "bias": bias})
+    return out
+
+
+def fill_constant(shape, dtype, value, out=None, **kw):
+    helper = LayerHelper("fill_constant", **kw)
+    out = out or helper.create_tmp_variable(dtype=dtype, shape=tuple(shape))
+    helper.append_op("fill_constant", {}, {"Out": [out.name]},
+                     {"shape": list(shape), "value": value, "dtype": dtype})
+    return out
+
+
+def ones(shape, dtype="float32", **kw):
+    return fill_constant(shape, dtype, 1.0, **kw)
+
+
+def zeros(shape, dtype="float32", **kw):
+    return fill_constant(shape, dtype, 0.0, **kw)
+
+
+def increment(x, value=1.0, in_place=True, **kw):
+    helper = LayerHelper("increment", input=x, **kw)
+    out = x if in_place else helper.create_tmp_variable(dtype=x.dtype,
+                                                        shape=x.shape)
+    helper.append_op("increment", {"X": [x.name]}, {"Out": [out.name]},
+                     {"step": value})
+    return out
+
+
+def cos_sim(X, Y, **kw):
+    helper = LayerHelper("cos_sim", **kw)
+    out = helper.create_tmp_variable(dtype=X.dtype, shape=(X.shape[0], 1))
+    xn = helper.create_tmp_variable(dtype=X.dtype, shape=(X.shape[0], 1))
+    yn = helper.create_tmp_variable(dtype=X.dtype, shape=(X.shape[0], 1))
+    helper.append_op("cos_sim", {"X": [X.name], "Y": [Y.name]},
+                     {"Out": [out.name], "XNorm": [xn.name], "YNorm": [yn.name]})
+    return out
+
+
+def _make_unary_layer(op_type):
+    def layer(x, name=None, main_program=None, startup_program=None, **attrs):
+        helper = LayerHelper(op_type, input=x, name=name,
+                             main_program=main_program,
+                             startup_program=startup_program)
+        out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+        helper.append_op(op_type, {"X": [x.name]}, {"Out": [out.name]}, attrs)
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+# generated wrappers, mirroring the reference's _create_op_func_ registry
+for _op in ("sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink",
+            "softshrink", "sqrt", "abs", "ceil", "floor", "round",
+            "reciprocal", "log", "square", "softplus", "softsign", "brelu",
+            "leaky_relu", "soft_relu", "elu", "relu6", "pow", "stanh",
+            "hard_sigmoid", "swish", "softmax"):
+    globals()[_op] = _make_unary_layer(_op)
+
+
+def _make_binary_layer(op_type):
+    def layer(x, y, axis=-1, name=None, main_program=None,
+              startup_program=None):
+        helper = LayerHelper(op_type, input=x, name=name,
+                             main_program=main_program,
+                             startup_program=startup_program)
+        out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+        helper.append_op(op_type, {"X": [x.name], "Y": [y.name]},
+                         {"Out": [out.name]}, {"axis": axis})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+for _op in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+            "elementwise_div", "elementwise_max", "elementwise_min"):
+    globals()[_op] = _make_binary_layer(_op)

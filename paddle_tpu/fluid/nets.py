@@ -1,0 +1,44 @@
+"""Composed networks (reference python/paddle/v2/framework/nets.py)."""
+
+from __future__ import annotations
+
+from paddle_tpu.fluid import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, pool_type="max", param_attr=None,
+                         **kw):
+    conv_out = layers.conv2d(input, num_filters=num_filters,
+                             filter_size=filter_size, param_attr=param_attr,
+                             act=act, **kw)
+    return layers.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, **kw)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=None, pool_stride=1,
+                   pool_type="max", **kw):
+    tmp = input
+    n = len(conv_num_filter)
+    if isinstance(conv_padding, int):
+        conv_padding = [conv_padding] * n
+    if isinstance(conv_filter_size, int):
+        conv_filter_size = [conv_filter_size] * n
+    if not isinstance(conv_with_batchnorm, (list, tuple)):
+        conv_with_batchnorm = [conv_with_batchnorm] * n
+    if conv_batchnorm_drop_rate is None:
+        conv_batchnorm_drop_rate = [0.0] * n
+    elif not isinstance(conv_batchnorm_drop_rate, (list, tuple)):
+        conv_batchnorm_drop_rate = [conv_batchnorm_drop_rate] * n
+    for i in range(n):
+        local_act = None if conv_with_batchnorm[i] else conv_act
+        tmp = layers.conv2d(tmp, num_filters=conv_num_filter[i],
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i], act=local_act, **kw)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act, **kw)
+            if conv_batchnorm_drop_rate[i] > 0:
+                tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i], **kw)
+    return layers.pool2d(tmp, pool_size=pool_size, pool_stride=pool_stride,
+                         pool_type=pool_type, **kw)

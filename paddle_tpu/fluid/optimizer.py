@@ -1,0 +1,239 @@
+"""Fluid optimizers — appended to the program as optimizer ops.
+
+Reference: ``python/paddle/v2/framework/optimizer.py`` (568 LoC): each
+optimizer creates accumulator variables (velocity/moments/beta-pows) and
+appends one optimize op per parameter, so the whole training step — forward,
+backward, update — is a single Program.  Here that single Program becomes a
+single fused XLA computation (see executor.py), which is exactly the shape
+TPUs want: one compiled step, no per-parameter kernel launches.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.backward import append_backward_ops
+from paddle_tpu.fluid.initializer import ConstantInitializer
+from paddle_tpu.fluid.regularizer import append_regularization_ops
+
+
+class Optimizer:
+    def __init__(self, learning_rate: float, global_step=None):
+        self._lr = learning_rate
+        self._global_step = global_step
+        self._accumulators: dict[str, dict[str, framework.Variable]] = {}
+        self._lr_var: framework.Variable | None = None
+
+    # -- accumulator plumbing (reference optimizer.py:_add_accumulator) ------
+
+    def _create_persistable(self, main_block, startup_block, name, shape,
+                            dtype, value):
+        var = main_block.create_var(name=name, shape=shape, dtype=dtype,
+                                    persistable=True)
+        startup_block.create_var(name=name, shape=shape, dtype=dtype,
+                                 persistable=True)
+        ConstantInitializer(value)(var, startup_block)
+        return var
+
+    def _add_accumulator(self, main_block, startup_block, acc_name, param,
+                         fill_value=0.0, shape=None):
+        shape = shape if shape is not None else param.shape
+        name = framework.unique_name("%s_%s_acc" % (param.name, acc_name))
+        var = self._create_persistable(main_block, startup_block, name, shape,
+                                       param.dtype, fill_value)
+        self._accumulators.setdefault(acc_name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, acc_name, param):
+        return self._accumulators[acc_name][param.name]
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _create_accumulators(self, main_block, startup_block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block):
+        pass
+
+    # -- driver --------------------------------------------------------------
+
+    def create_optimization_pass(self, parameters_and_grads, loss,
+                                 startup_program=None):
+        main_block = loss.block
+        startup = (startup_program or framework.default_startup_program())
+        startup_block = startup.global_block()
+        self._lr_var = self._create_persistable(
+            main_block, startup_block,
+            framework.unique_name("learning_rate"), (), "float32", self._lr)
+        self._create_accumulators(
+            main_block, startup_block,
+            [p for p, g in parameters_and_grads if g is not None])
+        ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None:
+                continue
+            ops.append(self._append_optimize_op(main_block, param_and_grad))
+        self._finish_update(main_block)
+        if self._global_step is not None:
+            main_block.append_op("increment",
+                                 {"X": [self._global_step.name]},
+                                 {"Out": [self._global_step.name]},
+                                 {"step": 1.0})
+        return ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = append_backward_ops(loss, parameter_list, no_grad_set)
+        params_grads = append_regularization_ops(params_grads)
+        return self.create_optimization_pass(params_grads, loss,
+                                             startup_program)
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            {"Param": [p.name], "Grad": [g.name],
+             "LearningRate": [self._lr_var.name]},
+            {"ParamOut": [p.name]})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, main_block, startup_block, parameters):
+        for p in parameters:
+            self._add_accumulator(main_block, startup_block, "velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+             "LearningRate": [self._lr_var.name]},
+            {"ParamOut": [p.name], "VelocityOut": [v.name]},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, main_block, startup_block, parameters):
+        for p in parameters:
+            self._add_accumulator(main_block, startup_block, "moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "LearningRate": [self._lr_var.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"epsilon": self._epsilon})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, main_block, startup_block, parameters):
+        for p in parameters:
+            self._add_accumulator(main_block, startup_block, "moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "LearningRate": [self._lr_var.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name]},
+            {"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._beta1_pow = None
+        self._beta2_pow = None
+
+    def _create_accumulators(self, main_block, startup_block, parameters):
+        for p in parameters:
+            self._add_accumulator(main_block, startup_block, "moment1", p)
+            self._add_accumulator(main_block, startup_block, "moment2", p)
+        self._beta1_pow = self._create_persistable(
+            main_block, startup_block, framework.unique_name("beta1_pow"),
+            (), "float32", self._beta1)
+        self._beta2_pow = self._create_persistable(
+            main_block, startup_block, framework.unique_name("beta2_pow"),
+            (), "float32", self._beta2)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        return block.append_op(
+            "adam",
+            {"Param": [p.name], "Grad": [g.name], "Moment1": [m1.name],
+             "Moment2": [m2.name], "Beta1Pow": [self._beta1_pow.name],
+             "Beta2Pow": [self._beta2_pow.name],
+             "LearningRate": [self._lr_var.name]},
+            {"ParamOut": [p.name], "Moment1Out": [m1.name],
+             "Moment2Out": [m2.name]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+    def _finish_update(self, block):
+        block.append_op("beta_pow_update", {"X": [self._beta1_pow.name]},
+                        {"Out": [self._beta1_pow.name]}, {"beta": self._beta1})
+        block.append_op("beta_pow_update", {"X": [self._beta2_pow.name]},
+                        {"Out": [self._beta2_pow.name]}, {"beta": self._beta2})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._beta1_pow = None
+
+    def _create_accumulators(self, main_block, startup_block, parameters):
+        for p in parameters:
+            self._add_accumulator(main_block, startup_block, "moment", p)
+            self._add_accumulator(main_block, startup_block, "inf_norm", p)
+        self._beta1_pow = self._create_persistable(
+            main_block, startup_block, framework.unique_name("beta1_pow"),
+            (), "float32", self._beta1)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        return block.append_op(
+            "adamax",
+            {"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+             "InfNorm": [u.name], "Beta1Pow": [self._beta1_pow.name],
+             "LearningRate": [self._lr_var.name]},
+            {"ParamOut": [p.name], "MomentOut": [m.name],
+             "InfNormOut": [u.name]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+    def _finish_update(self, block):
+        block.append_op("beta_pow_update", {"X": [self._beta1_pow.name]},
+                        {"Out": [self._beta1_pow.name]}, {"beta": self._beta1})

@@ -1,0 +1,69 @@
+"""Weight-decay regularizers appended as ops on the gradients.
+
+Reference: ``python/paddle/v2/framework/regularizer.py`` —
+``append_regularization_ops`` adds decay term ops to each (param, grad) pair
+before the optimizer ops consume them.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.fluid import framework
+
+
+class WeightDecayRegularizer:
+    def append_op(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = regularization_coeff
+
+    def append_op(self, param, grad, block):
+        decay = block.create_var(name=framework.unique_name(param.name + "@L2DECAY"),
+                                 shape=param.shape, dtype=param.dtype)
+        block.append_op("scale", {"X": [param.name]}, {"Out": [decay.name]},
+                        {"scale": self.coeff})
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = regularization_coeff
+
+    def append_op(self, param, grad, block):
+        sign = block.create_var(name=framework.unique_name(param.name + "@SIGN"),
+                                shape=param.shape, dtype=param.dtype)
+        # sign(x) = x / |x|; use clip-free composition of registered ops
+        absx = block.create_var(name=framework.unique_name(param.name + "@ABS"),
+                                shape=param.shape, dtype=param.dtype)
+        block.append_op("abs", {"X": [param.name]}, {"Out": [absx.name]})
+        eps = block.create_var(name=framework.unique_name(param.name + "@ABSE"),
+                               shape=param.shape, dtype=param.dtype)
+        block.append_op("scale", {"X": [absx.name]}, {"Out": [eps.name]},
+                        {"scale": 1.0, "bias": 1e-12})
+        block.append_op("elementwise_div", {"X": [param.name], "Y": [eps.name]},
+                        {"Out": [sign.name]})
+        decay = block.create_var(name=framework.unique_name(param.name + "@L1DECAY"),
+                                 shape=param.shape, dtype=param.dtype)
+        block.append_op("scale", {"X": [sign.name]}, {"Out": [decay.name]},
+                        {"scale": self.coeff})
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads):
+    out = []
+    for param, grad in parameters_and_grads:
+        reg = getattr(param, "regularizer", None)
+        if reg is None or grad is None:
+            out.append((param, grad))
+            continue
+        block = grad.block
+        decay = reg.append_op(param, grad, block)
+        new_grad = block.create_var(
+            name=framework.unique_name(grad.name + "@REG"),
+            shape=param.shape, dtype=param.dtype)
+        block.append_op("sum", {"X": [grad.name, decay.name]},
+                        {"Out": [new_grad.name]})
+        out.append((param, new_grad))
+    return out
