@@ -41,7 +41,8 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: object = jnp.float32
     remat: bool = True
-    # attention implementation: "exact" | "blockwise" | "ring" (ring needs a
+    # attention implementation: "exact" | "blockwise" | "flash" (Pallas
+    # kernel, ops/pallas/flash_attention.py) | "ring" (ring needs a
     # mesh with a seq axis and activations sharded over it)
     attn_impl: str = "exact"
     attn_block_size: int = 512
@@ -132,6 +133,32 @@ def _attention(cfg: TransformerConfig, q, k, v, mesh):
         return attn_ops.blockwise_attention(
             q, k, v, block_size=cfg.attn_block_size, causal=True
         )
+    if cfg.attn_impl == "flash":
+        from paddle_tpu.ops.pallas import flash_attention
+
+        bs = cfg.attn_block_size
+        if mesh is None:
+            return flash_attention(q, k, v, True, None, bs, bs)
+        # pallas_call has no GSPMD partitioning rule — run the kernel
+        # per-device under shard_map (batch over data, heads over model;
+        # sequence sharding needs attn_impl="ring" instead)
+        assert "seq" not in mesh.axis_names, (
+            "attn_impl='flash' does not shard the sequence; use 'ring'"
+        )
+        from jax import shard_map
+
+        spec = P(
+            "data" if "data" in mesh.axis_names else None,
+            None,
+            "model" if "model" in mesh.axis_names else None,
+            None,
+        )
+        fn = shard_map(
+            lambda q, k, v: flash_attention(q, k, v, True, None, bs, bs),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
     t = q.shape[1]
     return attn_ops.dot_product_attention(
         q, k, v, mask=attn_ops.causal_mask(t, t)

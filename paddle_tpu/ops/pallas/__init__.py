@@ -1,0 +1,24 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference ships hand-written CUDA kernels where cuBLAS/cuDNN fall short
+(``paddle/cuda/src/hl_cuda_lstm.cu``, ``hl_top_k.cu``, …).  The TPU-native
+analog is Pallas: MXU/VPU kernels compiled through Mosaic, with the same
+"stub fallback" idea the reference uses for CPU-only builds
+(``paddle/cuda/include/stub/``) realised here as interpret-mode execution on
+non-TPU backends, so every kernel runs everywhere and tests are hermetic.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def default_interpret() -> bool:
+    """True when no TPU is present — run kernels in interpreter mode (the
+    CPU-stub equivalent of the reference's ``paddle/cuda/include/stub/``)."""
+    return jax.default_backend() != "tpu"
+
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+
+__all__ = ["flash_attention", "default_interpret"]

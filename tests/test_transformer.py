@@ -42,6 +42,8 @@ def test_attn_impls_agree():
         params, ids,
     )
     np.testing.assert_allclose(np.asarray(blk), np.asarray(ref), atol=1e-4)
+    flash = T.forward(dataclasses.replace(cfg, attn_impl="flash"), params, ids)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(ref), atol=1e-4)
 
 
 def test_train_step_learns():
@@ -86,3 +88,17 @@ def test_sharded_train_step_dp_tp_sp():
     ids1 = jnp.asarray(np.asarray(ids))
     loss1 = float(T.loss_fn(cfg1, params1, ids1))
     np.testing.assert_allclose(l0, loss1, atol=1e-3)
+
+
+def test_sharded_forward_flash_dp_tp():
+    """flash kernel per-device under shard_map on a data x model mesh."""
+    devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    cfg = _cfg(attn_impl="flash")
+    params = T.init_params(cfg, jax.random.key(0))
+    params = T.place_params(params, mesh, cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 16)))
+    ids = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    logits = jax.jit(lambda p, i: T.forward(cfg, p, i, mesh=mesh))(params, ids)
+    ref = T.forward(_cfg(), params, jnp.asarray(np.asarray(ids)))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=1e-4)
