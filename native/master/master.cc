@@ -1,0 +1,374 @@
+// Elastic input-dispatch master service.
+//
+// Native C++ equivalent of the reference's Go master
+// (go/master/service.go): a dataset is partitioned into tasks; trainers
+// pull tasks, report completion or failure; timed-out or failed tasks are
+// re-queued up to a failure cap; state snapshots to disk (atomic rename)
+// and recovers on restart, so a restarted master resumes mid-pass.  The
+// etcd control plane of the reference maps to local snapshot files here —
+// on TPU pods the scheduler provides process placement, so the queue
+// service itself is the only piece that must survive.
+//
+// Protocol: newline-delimited text over TCP, one command per line.
+//   SET <n>            then n payload lines       -> OK <n_tasks>
+//   GET                -> TASK <id> <epoch> <payload> | WAIT | DONE
+//   FIN <id> <epoch>   -> OK | STALE
+//   FAIL <id> <epoch>  -> OK | STALE
+//   RESET              (done -> todo, next pass)   -> OK
+//   STAT               -> STAT <todo> <pending> <done> <failed>
+//   PING               -> PONG
+//   STOP               -> OK (server exits)
+// Payloads are opaque strings without '\n' (task payloads are usually
+// "file:chunk_begin:chunk_end" specs from the recordio reader).
+//
+// Flags: --port N  --timeout-ms N  --failure-max N  --snapshot PATH
+// With --snapshot, state is persisted after every mutation and recovered
+// at startup (pending tasks are re-queued as todo, mirroring
+// go/master/service.go recover()).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <deque>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Task {
+  int id;
+  int epoch;        // bumped on every dispatch; stale FIN/FAIL are ignored
+  int num_failure;
+  std::string payload;
+};
+
+struct PendingTask {
+  Task task;
+  Clock::time_point deadline;
+};
+
+struct State {
+  std::deque<Task> todo;
+  std::map<int, PendingTask> pending;  // by task id
+  std::vector<Task> done;
+  std::vector<Task> failed;
+  int next_id = 0;
+};
+
+struct Config {
+  int port = 0;
+  int timeout_ms = 30000;
+  int failure_max = 3;
+  std::string snapshot_path;
+};
+
+State g_state;
+Config g_cfg;
+bool g_running = true;
+
+// ---------- snapshot / recover (file-based etcd analog) ----------
+
+void WriteTask(FILE* f, const Task& t) {
+  fprintf(f, "%d %d %d %zu\n", t.id, t.epoch, t.num_failure,
+          t.payload.size());
+  fwrite(t.payload.data(), 1, t.payload.size(), f);
+  fputc('\n', f);
+}
+
+bool ReadTask(FILE* f, Task* t) {
+  size_t len;
+  if (fscanf(f, "%d %d %d %zu", &t->id, &t->epoch, &t->num_failure, &len) !=
+      4)
+    return false;
+  fgetc(f);  // the newline after the header
+  t->payload.resize(len);
+  if (fread(&t->payload[0], 1, len, f) != len) return false;
+  fgetc(f);
+  return true;
+}
+
+void Snapshot() {
+  if (g_cfg.snapshot_path.empty()) return;
+  std::string tmp = g_cfg.snapshot_path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) return;
+  // pending tasks are persisted as todo: a recovered master cannot know
+  // whether their workers survived, so it re-dispatches them
+  fprintf(f, "%d %zu\n", g_state.next_id,
+          g_state.todo.size() + g_state.pending.size());
+  for (const auto& t : g_state.todo) WriteTask(f, t);
+  for (const auto& kv : g_state.pending) WriteTask(f, kv.second.task);
+  fprintf(f, "%zu\n", g_state.done.size());
+  for (const auto& t : g_state.done) WriteTask(f, t);
+  fprintf(f, "%zu\n", g_state.failed.size());
+  for (const auto& t : g_state.failed) WriteTask(f, t);
+  fclose(f);
+  rename(tmp.c_str(), g_cfg.snapshot_path.c_str());
+}
+
+bool Recover() {
+  if (g_cfg.snapshot_path.empty()) return false;
+  FILE* f = fopen(g_cfg.snapshot_path.c_str(), "r");
+  if (!f) return false;
+  State s;
+  size_t n;
+  if (fscanf(f, "%d %zu", &s.next_id, &n) != 2) {
+    fclose(f);
+    return false;
+  }
+  fgetc(f);
+  Task t;
+  for (size_t i = 0; i < n; i++)
+    if (ReadTask(f, &t)) s.todo.push_back(t);
+  if (fscanf(f, "%zu", &n) == 1) {
+    fgetc(f);
+    for (size_t i = 0; i < n; i++)
+      if (ReadTask(f, &t)) s.done.push_back(t);
+  }
+  if (fscanf(f, "%zu", &n) == 1) {
+    fgetc(f);
+    for (size_t i = 0; i < n; i++)
+      if (ReadTask(f, &t)) s.failed.push_back(t);
+  }
+  fclose(f);
+  g_state = std::move(s);
+  return true;
+}
+
+// ---------- queue operations (GetTask / TaskFinished semantics) ----------
+
+void ProcessFailedTask(Task t) {
+  t.num_failure++;
+  if (t.num_failure > g_cfg.failure_max) {
+    g_state.failed.push_back(t);  // discarded for this pass
+  } else {
+    g_state.todo.push_back(t);
+  }
+  Snapshot();
+}
+
+void CheckTimeouts() {
+  auto now = Clock::now();
+  std::vector<int> expired;
+  for (const auto& kv : g_state.pending)
+    if (kv.second.deadline <= now) expired.push_back(kv.first);
+  for (int id : expired) {
+    Task t = g_state.pending[id].task;
+    g_state.pending.erase(id);
+    ProcessFailedTask(t);
+  }
+}
+
+std::string HandleLine(const std::string& line,
+                       std::deque<std::string>* inbox) {
+  std::istringstream ss(line);
+  std::string cmd;
+  ss >> cmd;
+  if (cmd == "PING") return "PONG";
+  if (cmd == "SET") {
+    int n = 0;
+    ss >> n;
+    // payload lines were buffered by the caller
+    for (int i = 0; i < n && !inbox->empty(); i++) {
+      Task t;
+      t.id = g_state.next_id++;
+      t.epoch = 0;
+      t.num_failure = 0;
+      t.payload = inbox->front();
+      inbox->pop_front();
+      g_state.todo.push_back(t);
+    }
+    Snapshot();
+    return "OK " + std::to_string(g_state.todo.size());
+  }
+  if (cmd == "GET") {
+    if (!g_state.todo.empty()) {
+      Task t = g_state.todo.front();
+      g_state.todo.pop_front();
+      t.epoch++;
+      PendingTask p{t, Clock::now() +
+                           std::chrono::milliseconds(g_cfg.timeout_ms)};
+      g_state.pending[t.id] = p;
+      Snapshot();
+      return "TASK " + std::to_string(t.id) + " " +
+             std::to_string(t.epoch) + " " + t.payload;
+    }
+    if (!g_state.pending.empty()) return "WAIT";
+    return "DONE";  // pass complete (or failed-out); RESET starts the next
+  }
+  if (cmd == "FIN" || cmd == "FAIL") {
+    int id = -1, epoch = -1;
+    ss >> id >> epoch;
+    auto it = g_state.pending.find(id);
+    if (it == g_state.pending.end() || it->second.task.epoch != epoch)
+      return "STALE";  // task was already re-dispatched (timeout) or done
+    Task t = it->second.task;
+    g_state.pending.erase(it);
+    if (cmd == "FIN") {
+      t.num_failure = 0;
+      g_state.done.push_back(t);
+      Snapshot();
+    } else {
+      ProcessFailedTask(t);
+    }
+    return "OK";
+  }
+  if (cmd == "RESET") {
+    // next pass: completed and discarded tasks go back to todo
+    for (auto& t : g_state.done) g_state.todo.push_back(t);
+    for (auto& t : g_state.failed) {
+      t.num_failure = 0;
+      g_state.todo.push_back(t);
+    }
+    g_state.done.clear();
+    g_state.failed.clear();
+    Snapshot();
+    return "OK";
+  }
+  if (cmd == "STAT") {
+    return "STAT " + std::to_string(g_state.todo.size()) + " " +
+           std::to_string(g_state.pending.size()) + " " +
+           std::to_string(g_state.done.size()) + " " +
+           std::to_string(g_state.failed.size());
+  }
+  if (cmd == "STOP") {
+    g_running = false;
+    return "OK";
+  }
+  return "ERR unknown command";
+}
+
+// ---------- connection handling (single-threaded poll loop) ----------
+
+struct Conn {
+  int fd;
+  std::string inbuf;
+  std::string outbuf;
+  int expect_payloads = 0;        // >0 while consuming SET payload lines
+  std::string pending_set_line;   // the SET line awaiting its payloads
+  std::deque<std::string> payloads;
+};
+
+void ConsumeLines(Conn* c) {
+  size_t pos;
+  while ((pos = c->inbuf.find('\n')) != std::string::npos) {
+    std::string line = c->inbuf.substr(0, pos);
+    c->inbuf.erase(0, pos + 1);
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (c->expect_payloads > 0) {
+      c->payloads.push_back(line);
+      if (--c->expect_payloads == 0) {
+        c->outbuf += HandleLine(c->pending_set_line, &c->payloads) + "\n";
+        c->payloads.clear();
+      }
+      continue;
+    }
+    if (line.rfind("SET ", 0) == 0) {
+      int n = atoi(line.c_str() + 4);
+      if (n > 0) {
+        c->expect_payloads = n;
+        c->pending_set_line = line;
+        continue;
+      }
+    }
+    std::deque<std::string> empty;
+    c->outbuf += HandleLine(line, &empty) + "\n";
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() { return (i + 1 < argc) ? argv[++i] : ""; };
+    if (a == "--port") g_cfg.port = atoi(next());
+    else if (a == "--timeout-ms") g_cfg.timeout_ms = atoi(next());
+    else if (a == "--failure-max") g_cfg.failure_max = atoi(next());
+    else if (a == "--snapshot") g_cfg.snapshot_path = next();
+  }
+  signal(SIGPIPE, SIG_IGN);
+  if (Recover())
+    fprintf(stderr, "master: recovered %zu todo tasks from snapshot\n",
+            g_state.todo.size());
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(g_cfg.port);
+  if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, (sockaddr*)&addr, &alen);
+  listen(lfd, 64);
+  // the chosen port goes to stdout so a parent process can read it
+  printf("PORT %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  std::map<int, Conn> conns;
+  while (g_running) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({lfd, POLLIN, 0});
+    for (auto& kv : conns) {
+      short ev = POLLIN;
+      if (!kv.second.outbuf.empty()) ev |= POLLOUT;
+      pfds.push_back({kv.first, ev, 0});
+    }
+    poll(pfds.data(), pfds.size(), 50);
+    CheckTimeouts();
+    if (pfds[0].revents & POLLIN) {
+      int cfd = accept(lfd, nullptr, nullptr);
+      if (cfd >= 0) {
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        conns[cfd] = Conn{cfd};
+      }
+    }
+    std::vector<int> closed;
+    for (size_t i = 1; i < pfds.size(); i++) {
+      int fd = pfds[i].fd;
+      Conn& c = conns[fd];
+      if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+        char buf[4096];
+        ssize_t r = recv(fd, buf, sizeof(buf), 0);
+        if (r <= 0) {
+          closed.push_back(fd);
+          continue;
+        }
+        c.inbuf.append(buf, r);
+        ConsumeLines(&c);
+      }
+      if (!c.outbuf.empty()) {
+        ssize_t w = send(fd, c.outbuf.data(), c.outbuf.size(), 0);
+        if (w > 0) c.outbuf.erase(0, w);
+      }
+    }
+    for (int fd : closed) {
+      close(fd);
+      conns.erase(fd);
+    }
+  }
+  Snapshot();
+  for (auto& kv : conns) close(kv.first);
+  close(lfd);
+  return 0;
+}

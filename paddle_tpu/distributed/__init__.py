@@ -1,0 +1,14 @@
+"""Distributed control-plane pieces that live OUTSIDE compiled programs.
+
+Data-plane communication (gradients, activations) is XLA ICI/DCN
+collectives inside jitted steps (paddle_tpu.parallel); what remains
+host-side is the elastic input dispatch the reference implements as the Go
+master (go/master/service.go) — here a native C++ service
+(native/master/master.cc) with this Python client.
+"""
+
+from paddle_tpu.distributed.master import (  # noqa: F401
+    MasterClient,
+    MasterServer,
+    master_reader,
+)

@@ -1,0 +1,167 @@
+"""Client + process manager for the native master service.
+
+Mirrors the reference's Go master client surface
+(go/master/client.go: SetDataset / NextRecord / TaskFinished / TaskFailed,
+consumed from Python via ctypes in python/paddle/v2/master/client.py) —
+here the client speaks the line protocol of native/master/master.cc
+directly over TCP, and ``master_reader`` adapts the task queue to the
+paddle reader convention (a generator of records per pass).
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import time
+
+
+class MasterClient:
+    """Blocking line-protocol client; one socket per client (trainers keep
+    one for their whole life — tasks re-dispatch on disconnect anyway)."""
+
+    def __init__(self, addr: tuple[str, int], timeout: float = 30.0):
+        self._sock = socket.create_connection(addr, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    def _send(self, line: str) -> None:
+        self._sock.sendall(line.encode() + b"\n")
+
+    def _recv_line(self) -> str:
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("master closed the connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return line.decode()
+
+    def _call(self, line: str) -> str:
+        self._send(line)
+        return self._recv_line()
+
+    def ping(self) -> bool:
+        return self._call("PING") == "PONG"
+
+    def set_dataset(self, payloads: list[str]) -> int:
+        """Each payload becomes one task (the partitioning into
+        chunks-per-task groups is the caller's choice of payload)."""
+        for p in payloads:
+            if "\n" in p:
+                raise ValueError("task payloads must be single-line")
+        self._send(f"SET {len(payloads)}")
+        for p in payloads:
+            self._send(p)
+        resp = self._recv_line()
+        assert resp.startswith("OK"), resp
+        return int(resp.split()[1])
+
+    def get_task(self) -> tuple[int, int, str] | None | str:
+        """Returns (id, epoch, payload), "WAIT" (queue busy, retry), or
+        None (pass finished)."""
+        resp = self._call("GET")
+        if resp == "DONE":
+            return None
+        if resp == "WAIT":
+            return "WAIT"
+        _, tid, epoch, payload = resp.split(" ", 3)
+        return int(tid), int(epoch), payload
+
+    def task_finished(self, task_id: int, epoch: int) -> bool:
+        return self._call(f"FIN {task_id} {epoch}") == "OK"
+
+    def task_failed(self, task_id: int, epoch: int) -> bool:
+        return self._call(f"FAIL {task_id} {epoch}") == "OK"
+
+    def reset_pass(self) -> None:
+        assert self._call("RESET") == "OK"
+
+    def stat(self) -> dict:
+        parts = self._call("STAT").split()
+        return dict(zip(("todo", "pending", "done", "failed"),
+                        map(int, parts[1:])))
+
+    def stop_server(self) -> None:
+        try:
+            self._call("STOP")
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class MasterServer:
+    """Spawn the native master as a subprocess on a free localhost port.
+
+    The reference tests its cluster services by launching them in-process
+    on local ports (SURVEY §4); same trick here.
+    """
+
+    def __init__(self, timeout_ms: int = 30000, failure_max: int = 3,
+                 snapshot_path: str | None = None, port: int = 0):
+        from paddle_tpu.distributed.build import master_binary
+
+        cmd = [master_binary(), "--port", str(port),
+               "--timeout-ms", str(timeout_ms),
+               "--failure-max", str(failure_max)]
+        if snapshot_path:
+            cmd += ["--snapshot", snapshot_path]
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        )
+        line = self._proc.stdout.readline().strip()
+        assert line.startswith("PORT "), f"master failed to start: {line!r}"
+        self.port = int(line.split()[1])
+        self.addr = ("127.0.0.1", self.port)
+
+    def client(self, timeout: float = 30.0) -> MasterClient:
+        return MasterClient(self.addr, timeout=timeout)
+
+    def kill(self) -> None:
+        """Simulate a master crash (recovery comes from the snapshot)."""
+        self._proc.kill()
+        self._proc.wait()
+
+    def shutdown(self) -> None:
+        if self._proc.poll() is None:
+            try:
+                self.client(timeout=2.0).stop_server()
+                self._proc.wait(timeout=5.0)
+            except Exception:
+                self._proc.kill()
+                self._proc.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+def master_reader(client: MasterClient, task_to_records,
+                  wait_s: float = 0.05):
+    """Reader-convention generator over master-dispatched tasks.
+
+    ``task_to_records(payload)`` yields the records of one task (e.g.
+    ``recordio.read_task``).  One call iterates one full pass; tasks pulled
+    by crashed trainers re-dispatch to the survivors via the master's
+    timeout, exactly like go/master/client.go NextRecord.
+    """
+    def reader():
+        while True:
+            got = client.get_task()
+            if got is None:
+                return
+            if got == "WAIT":
+                time.sleep(wait_s)
+                continue
+            tid, epoch, payload = got
+            try:
+                yield from task_to_records(payload)
+            except Exception:
+                client.task_failed(tid, epoch)
+                continue
+            client.task_finished(tid, epoch)
+
+    return reader

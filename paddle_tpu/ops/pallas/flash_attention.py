@@ -197,8 +197,10 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
     scale = scale if scale is not None else d ** -0.5
-    block_q = _round_up(block_q, 8)  # sublane-aligned tiles
-    block_k = _round_up(block_k, 8)
+    # sublane-aligned tiles, clamped so short sequences don't pad up to a
+    # full default block (seq 16 with block 512 would do 1000x the work)
+    block_q = min(_round_up(block_q, 8), _round_up(t_q, 8))
+    block_k = min(_round_up(block_k, 8), _round_up(t_k, 8))
     qp, kp, vp = _prep(q, k, v, block_q, block_k)
     bh, tqp, dpad = qp.shape
     tkp = kp.shape[1]
@@ -266,8 +268,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         interpret = default_interpret()
     qp, kp, vp, o, lse, (b, t_q, t_k, h, d) = res
     scale = scale if scale is not None else d ** -0.5
-    block_q = _round_up(block_q, 8)  # same rounding as the forward
-    block_k = _round_up(block_k, 8)
+    block_q = min(_round_up(block_q, 8), _round_up(t_q, 8))  # match fwd
+    block_k = min(_round_up(block_k, 8), _round_up(t_k, 8))
     bh, tqp, dpad = qp.shape
     tkp = kp.shape[1]
     nq, nk = tqp // block_q, tkp // block_k

@@ -1,0 +1,114 @@
+"""Chunked record file format for elastic input dispatch.
+
+The reference's cloud path stores datasets as recordio chunks which the Go
+master partitions into tasks (go/master/service.go SetDataset/partition,
+python/paddle/v2/reader/creator.py recordio).  This is the same idea as a
+small self-contained format: a file is a sequence of chunks, each
+independently readable, so a chunk boundary is a safe task boundary.
+
+Chunk layout:  b"PTRC" | u32 num_records | u32 payload_len | u32 crc32
+               payload = concat(u32 record_len | record_bytes)
+All integers little-endian.  Records are opaque bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+_MAGIC = b"PTRC"
+_HEADER = struct.Struct("<4sIII")
+_LEN = struct.Struct("<I")
+
+
+class Writer:
+    def __init__(self, path: str, max_records_per_chunk: int = 1000):
+        self._f = open(path, "wb")
+        self._max = max_records_per_chunk
+        self._records: list[bytes] = []
+
+    def write(self, record: bytes) -> None:
+        if not isinstance(record, bytes):
+            raise TypeError("records are opaque bytes; serialize first")
+        self._records.append(record)
+        if len(self._records) >= self._max:
+            self._flush_chunk()
+
+    def _flush_chunk(self) -> None:
+        if not self._records:
+            return
+        payload = b"".join(_LEN.pack(len(r)) + r for r in self._records)
+        self._f.write(_HEADER.pack(_MAGIC, len(self._records), len(payload),
+                                   zlib.crc32(payload) & 0xFFFFFFFF))
+        self._f.write(payload)
+        self._records = []
+
+    def close(self) -> None:
+        self._flush_chunk()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def chunk_offsets(path: str) -> list[int]:
+    """Byte offsets of every chunk in the file (the task index)."""
+    offsets = []
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pos = 0
+        while pos < size:
+            f.seek(pos)
+            hdr = f.read(_HEADER.size)
+            magic, _, payload_len, _ = _HEADER.unpack(hdr)
+            if magic != _MAGIC:
+                raise ValueError(f"bad chunk magic at {path}:{pos}")
+            offsets.append(pos)
+            pos += _HEADER.size + payload_len
+    return offsets
+
+
+def read_chunk(path: str, offset: int):
+    """Yield the records of the single chunk at ``offset``."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        magic, n, payload_len, crc = _HEADER.unpack(f.read(_HEADER.size))
+        if magic != _MAGIC:
+            raise ValueError(f"bad chunk magic at {path}:{offset}")
+        payload = f.read(payload_len)
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise ValueError(f"chunk crc mismatch at {path}:{offset}")
+        pos = 0
+        for _ in range(n):
+            (rlen,) = _LEN.unpack_from(payload, pos)
+            pos += _LEN.size
+            yield payload[pos:pos + rlen]
+            pos += rlen
+
+
+def reader(path: str):
+    """Plain (non-elastic) whole-file reader, reader-convention."""
+    def read():
+        for off in chunk_offsets(path):
+            yield from read_chunk(path, off)
+
+    return read
+
+
+def task_payloads(paths: list[str]) -> list[str]:
+    """One master-task payload per chunk: "path:offset"."""
+    out = []
+    for p in paths:
+        for off in chunk_offsets(p):
+            out.append(f"{p}:{off}")
+    return out
+
+
+def read_task(payload: str):
+    """``master_reader`` adapter: payload "path:offset" -> records."""
+    path, off = payload.rsplit(":", 1)
+    yield from read_chunk(path, int(off))

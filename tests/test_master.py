@@ -1,0 +1,164 @@
+"""Native master service: the reference tests its cluster services by
+spawning them in-process on localhost ports (SURVEY §4 —
+test_TrainerOnePass.cpp, go/master/client_test.go); same pattern here
+against the real C++ binary."""
+
+import os
+import time
+
+import pytest
+
+from paddle_tpu.distributed import MasterClient, MasterServer, master_reader
+from paddle_tpu.reader import recordio
+
+
+@pytest.fixture(scope="module")
+def server():
+    with MasterServer(timeout_ms=60000) as s:
+        yield s
+
+
+def test_ping_and_set_get_fin(server):
+    c = server.client()
+    assert c.ping()
+    n = c.set_dataset([f"task-{i}" for i in range(5)])
+    assert n >= 5
+    seen = []
+    while True:
+        got = c.get_task()
+        if got is None:
+            break
+        tid, epoch, payload = got
+        seen.append(payload)
+        assert c.task_finished(tid, epoch)
+    assert sorted(seen) == [f"task-{i}" for i in range(5)]
+    # next pass: RESET re-queues everything
+    c.reset_pass()
+    assert server.client().stat()["todo"] == 5
+    # drain for the following tests in this module-scoped server
+    while (got := c.get_task()) is not None:
+        c.task_finished(got[0], got[1])
+    c.close()
+
+
+def test_two_clients_disjoint_tasks(server):
+    c1, c2 = server.client(), server.client()
+    c1.reset_pass()
+    ids = set()
+    for c in (c1, c2, c1, c2, c1):
+        got = c.get_task()
+        if got in (None, "WAIT"):
+            continue
+        ids.add(got[0])
+        c.task_finished(got[0], got[1])
+    assert len(ids) >= 4  # no task handed to two clients concurrently
+    while (got := c1.get_task()) not in (None, "WAIT"):
+        c1.task_finished(got[0], got[1])
+    c1.close(), c2.close()
+
+
+def test_timeout_redispatch_and_stale_fin():
+    with MasterServer(timeout_ms=300, failure_max=10) as s:
+        c = s.client()
+        c.set_dataset(["only-task"])
+        tid, epoch, _ = c.get_task()
+        assert c.get_task() == "WAIT"  # pending elsewhere, not re-given
+        time.sleep(0.5)  # let it time out
+        got = c.get_task()  # re-dispatched with a new epoch
+        assert got not in (None, "WAIT")
+        tid2, epoch2, _ = got
+        assert tid2 == tid and epoch2 > epoch
+        # the original holder's FIN is stale and must be rejected
+        assert not c.task_finished(tid, epoch)
+        assert c.task_finished(tid2, epoch2)
+        assert c.get_task() is None
+
+
+def test_failure_cap_discards_task():
+    with MasterServer(timeout_ms=60000, failure_max=2) as s:
+        c = s.client()
+        c.set_dataset(["poison", "good"])
+        finished, discarded = [], 0
+        while True:
+            got = c.get_task()
+            if got is None:
+                break
+            if got == "WAIT":
+                time.sleep(0.01)
+                continue
+            tid, epoch, payload = got
+            if payload == "poison":
+                c.task_failed(tid, epoch)
+            else:
+                c.task_finished(tid, epoch)
+                finished.append(payload)
+        st = c.stat()
+        assert finished == ["good"]
+        assert st["failed"] == 1  # poison discarded after failure_max+1 tries
+        assert st["done"] == 1
+
+
+def test_snapshot_recover_after_crash(tmp_path):
+    snap = str(tmp_path / "master.snapshot")
+    s = MasterServer(timeout_ms=60000, snapshot_path=snap)
+    c = s.client()
+    c.set_dataset([f"t{i}" for i in range(6)])
+    tid, epoch, _ = c.get_task()  # one task in flight
+    c.task_finished(tid, epoch)
+    tid2, _, _ = c.get_task()  # a second in flight, never finished
+    s.kill()  # crash, not clean shutdown
+    assert os.path.exists(snap)
+
+    s2 = MasterServer(timeout_ms=60000, snapshot_path=snap)
+    try:
+        c2 = s2.client()
+        st = c2.stat()
+        # done survived; the in-flight task was re-queued as todo
+        assert st["done"] == 1
+        assert st["todo"] == 5
+        remaining = []
+        while (got := c2.get_task()) is not None:
+            c2.task_finished(got[0], got[1])
+            remaining.append(got[2])
+        assert len(remaining) == 5
+    finally:
+        s2.shutdown()
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "data.recordio")
+    with recordio.Writer(path, max_records_per_chunk=10) as w:
+        for i in range(35):
+            w.write(f"rec-{i}".encode())
+    assert len(recordio.chunk_offsets(path)) == 4  # 10+10+10+5
+    got = [r.decode() for r in recordio.reader(path)()]
+    assert got == [f"rec-{i}" for i in range(35)]
+
+
+def test_master_reader_end_to_end(tmp_path):
+    """recordio chunks -> master tasks -> reader generator, two passes,
+    with one simulated worker crash mid-pass."""
+    paths = []
+    for f in range(2):
+        p = str(tmp_path / f"part-{f}.recordio")
+        with recordio.Writer(p, max_records_per_chunk=8) as w:
+            for i in range(20):
+                w.write(f"{f}:{i}".encode())
+        paths.append(p)
+    expected = sorted(f"{f}:{i}" for f in range(2) for i in range(20))
+
+    with MasterServer(timeout_ms=400) as s:
+        c = s.client()
+        c.set_dataset(recordio.task_payloads(paths))
+
+        # a "crashed" worker pulls one task and never reports back
+        dead = s.client()
+        assert dead.get_task() not in (None, "WAIT")
+        dead.close()
+
+        reader = master_reader(c, recordio.read_task)
+        pass1 = sorted(r.decode() for r in reader())
+        assert pass1 == expected  # timeout re-dispatched the dead task
+        c.reset_pass()
+        pass2 = sorted(r.decode() for r in reader())
+        assert pass2 == expected

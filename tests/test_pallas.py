@@ -51,14 +51,23 @@ def test_flash_grads_match_exact(rng_np, causal):
 
 
 def test_flash_cross_attention_rectangular(rng_np):
+    """nq != nk grids, fwd and bwd (encoder-decoder attention shape)."""
     b, h, d = 2, 2, 16
     q = jnp.asarray(rng_np.normal(size=(b, 37, h, d)).astype(np.float32))
     k = jnp.asarray(rng_np.normal(size=(b, 150, h, d)).astype(np.float32))
     v = jnp.asarray(rng_np.normal(size=(b, 150, h, d)).astype(np.float32))
     ref = A.dot_product_attention(q, k, v)
-    out = flash_attention(q, k, v)
+    out = flash_attention(q, k, v, False, None, 32, 64)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+    g_ref = jax.grad(lambda *a: jnp.sum(A.dot_product_attention(*a) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(
+        lambda *a: jnp.sum(flash_attention(*a, False, None, 32, 64) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_fl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
 
 
 def test_flash_under_jit_and_vmap(rng_np):
