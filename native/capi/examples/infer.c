@@ -1,0 +1,65 @@
+/* Minimal C serving example — parity with the reference's
+ * capi/examples/model_inference/dense/main.c: load a merged model, fill an
+ * input matrix, forward, print probabilities.
+ *
+ * Usage: infer <merged_model> <input_dim> <n_rows>
+ * Reads n_rows * input_dim float32 values from stdin (binary), writes each
+ * output row as space-separated floats on stdout.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_capi.h"
+
+#define CHECK(stmt)                                              \
+  do {                                                           \
+    paddle_error e = (stmt);                                     \
+    if (e != kPD_NO_ERROR) {                                     \
+      fprintf(stderr, "FAIL %s -> %d\n", #stmt, (int)e);         \
+      exit(1);                                                   \
+    }                                                            \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s model.tar dim rows\n", argv[0]);
+    return 2;
+  }
+  uint64_t dim = strtoull(argv[2], NULL, 10);
+  uint64_t rows = strtoull(argv[3], NULL, 10);
+
+  char* init_argv[] = {"infer", "--use_cpu"};
+  CHECK(paddle_init(2, init_argv));
+
+  paddle_gradient_machine machine;
+  CHECK(paddle_gradient_machine_load_from_path(&machine, argv[1]));
+
+  paddle_matrix input = paddle_matrix_create(rows, dim);
+  for (uint64_t r = 0; r < rows; r++) {
+    float* row;
+    CHECK(paddle_matrix_get_row(input, r, &row));
+    if (fread(row, sizeof(float), dim, stdin) != dim) {
+      fprintf(stderr, "short read on stdin\n");
+      return 1;
+    }
+  }
+
+  paddle_matrix outs[8];
+  uint64_t n_out = 8;
+  CHECK(paddle_gradient_machine_forward(machine, &input, 1, outs, &n_out));
+
+  for (uint64_t o = 0; o < n_out; o++) {
+    uint64_t h, w;
+    CHECK(paddle_matrix_get_shape(outs[o], &h, &w));
+    for (uint64_t r = 0; r < h; r++) {
+      float* row;
+      CHECK(paddle_matrix_get_row(outs[o], r, &row));
+      for (uint64_t c = 0; c < w; c++) printf("%.6g ", row[c]);
+      printf("\n");
+    }
+    paddle_matrix_destroy(outs[o]);
+  }
+  paddle_matrix_destroy(input);
+  paddle_gradient_machine_destroy(machine);
+  return 0;
+}

@@ -1,0 +1,205 @@
+// C inference ABI implementation — embedded CPython calling
+// paddle_tpu.capi_bridge (see paddle_capi.h for the contract).
+//
+// The reference implements this layer in C++ against its GradientMachine
+// (paddle/capi/gradient_machine.cpp); here the "machine" is a serialized
+// StableHLO program executed by the Python runtime, and this file is only
+// marshalling: float buffers in, float buffers out.
+
+#include "paddle_capi.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Matrix {
+  uint64_t height;
+  uint64_t width;
+  std::vector<float> data;
+};
+
+struct Machine {
+  long handle;  // paddle_tpu.capi_bridge machine handle
+};
+
+bool g_initialized = false;
+
+// Run fn while holding the GIL (paddle_init leaves the GIL released so
+// multiple C threads can call in; see PyEval_SaveThread below).
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* Bridge() {
+  static PyObject* mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("paddle_tpu.capi_bridge");
+  return mod;
+}
+
+}  // namespace
+
+extern "C" {
+
+paddle_error paddle_init(int argc, char** argv) {
+  if (g_initialized) return kPD_NO_ERROR;
+  for (int i = 0; i < argc; i++) {
+    if (strcmp(argv[i], "--use_cpu") == 0) {
+      setenv("JAX_PLATFORMS", "cpu", 1);
+    }
+  }
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  {
+    GILGuard gil;
+    if (!Bridge()) {
+      PyErr_Print();
+      return kPD_UNDEFINED_ERROR;
+    }
+  }
+  // release the GIL acquired by Py_Initialize so callers' threads can
+  // each take it via PyGILState_Ensure
+  if (PyGILState_Check()) PyEval_SaveThread();
+  g_initialized = true;
+  return kPD_NO_ERROR;
+}
+
+paddle_matrix paddle_matrix_create(uint64_t height, uint64_t width) {
+  auto* m = new Matrix{height, width, std::vector<float>(height * width)};
+  return m;
+}
+
+paddle_error paddle_matrix_destroy(paddle_matrix mat) {
+  if (!mat) return kPD_NULLPTR;
+  delete static_cast<Matrix*>(mat);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_get_shape(paddle_matrix mat, uint64_t* height,
+                                     uint64_t* width) {
+  if (!mat) return kPD_NULLPTR;
+  auto* m = static_cast<Matrix*>(mat);
+  if (height) *height = m->height;
+  if (width) *width = m->width;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_get_row(paddle_matrix mat, uint64_t r,
+                                   float** row) {
+  if (!mat || !row) return kPD_NULLPTR;
+  auto* m = static_cast<Matrix*>(mat);
+  if (r >= m->height) return kPD_OUT_OF_RANGE;
+  *row = m->data.data() + r * m->width;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_create_for_inference_with_parameters(
+    paddle_gradient_machine* machine, void* merged_model, uint64_t size) {
+  if (!machine || !merged_model) return kPD_NULLPTR;
+  if (!g_initialized) return kPD_UNDEFINED_ERROR;
+  GILGuard gil;
+  PyObject* ret = PyObject_CallMethod(
+      Bridge(), "create_machine", "y#", static_cast<char*>(merged_model),
+      static_cast<Py_ssize_t>(size));
+  if (!ret) {
+    PyErr_Print();
+    return kPD_PROTOBUF_ERROR;
+  }
+  long h = PyLong_AsLong(ret);
+  Py_DECREF(ret);
+  *machine = new Machine{h};
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_load_from_path(
+    paddle_gradient_machine* machine, const char* path) {
+  if (!machine || !path) return kPD_NULLPTR;
+  FILE* f = fopen(path, "rb");
+  if (!f) return kPD_NULLPTR;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> buf(size);
+  if (fread(buf.data(), 1, size, f) != static_cast<size_t>(size)) {
+    fclose(f);
+    return kPD_UNDEFINED_ERROR;
+  }
+  fclose(f);
+  return paddle_gradient_machine_create_for_inference_with_parameters(
+      machine, buf.data(), size);
+}
+
+paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
+                                             paddle_matrix* in,
+                                             uint64_t n_in,
+                                             paddle_matrix* outs,
+                                             uint64_t* n_out) {
+  if (!machine || !in || !outs || !n_out) return kPD_NULLPTR;
+  auto* mach = static_cast<Machine*>(machine);
+  GILGuard gil;
+
+  uint64_t rows = static_cast<Matrix*>(in[0])->height;
+  PyObject* bufs = PyList_New(n_in);
+  for (uint64_t i = 0; i < n_in; i++) {
+    auto* m = static_cast<Matrix*>(in[i]);
+    if (m->height != rows) {
+      Py_DECREF(bufs);
+      return kPD_OUT_OF_RANGE;
+    }
+    PyList_SET_ITEM(
+        bufs, i,
+        PyBytes_FromStringAndSize(
+            reinterpret_cast<const char*>(m->data.data()),
+            static_cast<Py_ssize_t>(m->data.size() * sizeof(float))));
+  }
+  PyObject* ret = PyObject_CallMethod(Bridge(), "forward", "lOl",
+                                      mach->handle, bufs,
+                                      static_cast<long>(rows));
+  Py_DECREF(bufs);
+  if (!ret) {
+    PyErr_Print();
+    return kPD_UNDEFINED_ERROR;
+  }
+  Py_ssize_t n = PyList_Size(ret);
+  if (*n_out < static_cast<uint64_t>(n)) {
+    Py_DECREF(ret);
+    return kPD_OUT_OF_RANGE;
+  }
+  *n_out = n;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* tup = PyList_GetItem(ret, i);  // (bytes, rows, cols)
+    char* data;
+    Py_ssize_t len;
+    PyBytes_AsStringAndSize(PyTuple_GetItem(tup, 0), &data, &len);
+    uint64_t orows = PyLong_AsUnsignedLongLong(PyTuple_GetItem(tup, 1));
+    uint64_t ocols = PyLong_AsUnsignedLongLong(PyTuple_GetItem(tup, 2));
+    auto* m = static_cast<Matrix*>(paddle_matrix_create(orows, ocols));
+    memcpy(m->data.data(), data, len);
+    outs[i] = m;
+  }
+  Py_DECREF(ret);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_destroy(paddle_gradient_machine machine) {
+  if (!machine) return kPD_NULLPTR;
+  auto* mach = static_cast<Machine*>(machine);
+  if (g_initialized && Py_IsInitialized()) {
+    GILGuard gil;
+    PyObject* r = PyObject_CallMethod(Bridge(), "destroy_machine", "l",
+                                      mach->handle);
+    Py_XDECREF(r);
+  }
+  delete mach;
+  return kPD_NO_ERROR;
+}
+
+}  // extern "C"

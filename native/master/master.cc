@@ -22,9 +22,9 @@
 // "file:chunk_begin:chunk_end" specs from the recordio reader).
 //
 // Flags: --port N  --timeout-ms N  --failure-max N  --snapshot PATH
-// With --snapshot, state is persisted after every mutation and recovered
-// at startup (pending tasks are re-queued as todo, mirroring
-// go/master/service.go recover()).
+// With --snapshot, state is persisted on mutation (throttled to one flush
+// per 100 ms) and recovered at startup (pending tasks are re-queued as
+// todo, mirroring go/master/service.go recover()).
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -80,6 +80,8 @@ struct Config {
 State g_state;
 Config g_cfg;
 bool g_running = true;
+bool g_dirty = false;        // state changed since the last snapshot flush
+Clock::time_point g_last_snapshot = Clock::now();
 
 // ---------- snapshot / recover (file-based etcd analog) ----------
 
@@ -102,7 +104,7 @@ bool ReadTask(FILE* f, Task* t) {
   return true;
 }
 
-void Snapshot() {
+void SnapshotNow() {
   if (g_cfg.snapshot_path.empty()) return;
   std::string tmp = g_cfg.snapshot_path + ".tmp";
   FILE* f = fopen(tmp.c_str(), "w");
@@ -119,6 +121,20 @@ void Snapshot() {
   for (const auto& t : g_state.failed) WriteTask(f, t);
   fclose(f);
   rename(tmp.c_str(), g_cfg.snapshot_path.c_str());
+  g_dirty = false;
+  g_last_snapshot = Clock::now();
+}
+
+// Mutations mark the state dirty; the poll loop flushes at most every
+// 100 ms.  Re-writing the whole file per GET/FIN would make dispatch
+// O(total_tasks); bounded staleness is fine because recovery already
+// tolerates re-dispatching in-flight tasks.
+void Snapshot() { g_dirty = true; }
+
+void MaybeFlushSnapshot() {
+  if (g_dirty && Clock::now() - g_last_snapshot >=
+                     std::chrono::milliseconds(100))
+    SnapshotNow();
 }
 
 bool Recover() {
@@ -183,8 +199,9 @@ std::string HandleLine(const std::string& line,
   if (cmd == "SET") {
     int n = 0;
     ss >> n;
+    int added = 0;
     // payload lines were buffered by the caller
-    for (int i = 0; i < n && !inbox->empty(); i++) {
+    for (int i = 0; i < n && !inbox->empty(); i++, added++) {
       Task t;
       t.id = g_state.next_id++;
       t.epoch = 0;
@@ -194,7 +211,7 @@ std::string HandleLine(const std::string& line,
       g_state.todo.push_back(t);
     }
     Snapshot();
-    return "OK " + std::to_string(g_state.todo.size());
+    return "OK " + std::to_string(added);
   }
   if (cmd == "GET") {
     if (!g_state.todo.empty()) {
@@ -336,10 +353,12 @@ int main(int argc, char** argv) {
     }
     poll(pfds.data(), pfds.size(), 50);
     CheckTimeouts();
+    MaybeFlushSnapshot();
     if (pfds[0].revents & POLLIN) {
       int cfd = accept(lfd, nullptr, nullptr);
       if (cfd >= 0) {
         setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        fcntl(cfd, F_SETFL, fcntl(cfd, F_GETFL) | O_NONBLOCK);
         conns[cfd] = Conn{cfd};
       }
     }
@@ -350,16 +369,19 @@ int main(int argc, char** argv) {
       if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
         char buf[4096];
         ssize_t r = recv(fd, buf, sizeof(buf), 0);
-        if (r <= 0) {
+        if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
           closed.push_back(fd);
           continue;
         }
+        if (r < 0) r = 0;
         c.inbuf.append(buf, r);
         ConsumeLines(&c);
       }
       if (!c.outbuf.empty()) {
-        ssize_t w = send(fd, c.outbuf.data(), c.outbuf.size(), 0);
+        ssize_t w = send(fd, c.outbuf.data(), c.outbuf.size(), MSG_NOSIGNAL);
         if (w > 0) c.outbuf.erase(0, w);
+        else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+          closed.push_back(fd);
       }
     }
     for (int fd : closed) {
@@ -367,7 +389,7 @@ int main(int argc, char** argv) {
       conns.erase(fd);
     }
   }
-  Snapshot();
+  SnapshotNow();
   for (auto& kv : conns) close(kv.first);
   close(lfd);
   return 0;

@@ -1,8 +1,9 @@
-"""On-demand build of the native components (g++; no pip deps).
+"""On-demand build of the native components.
 
 The reference ships its native services through CMake + Docker
 (paddle/scripts/docker/); here the binaries are tiny enough to compile at
-first use and cache under native/build/.
+first use.  native/Makefile is the single source of truth for compiler
+flags and dependencies — this module just invokes it.
 """
 
 from __future__ import annotations
@@ -14,30 +15,18 @@ import threading
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE = os.path.join(_REPO_ROOT, "native")
-_BUILD = os.path.join(_NATIVE, "build")
 _lock = threading.Lock()
 
 
-def native_binary(name: str, sources: list[str], extra_flags: list[str],
-                  shared: bool = False) -> str:
-    """Compile native/<sources> into native/build/<name> if stale; return
-    the binary path."""
-    out = os.path.join(_BUILD, name)
-    srcs = [os.path.join(_NATIVE, s) for s in sources]
+def native_binary(name: str) -> str:
+    """``make -C native build/<name>`` (no-op when fresh); returns its path."""
     with _lock:
-        if os.path.exists(out) and all(
-            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
-        ):
-            return out
-        os.makedirs(_BUILD, exist_ok=True)
-        cmd = ["g++", "-O2", "-std=c++17", "-Wall"]
-        if shared:
-            cmd += ["-shared", "-fPIC"]
-        cmd += ["-o", out + ".tmp"] + srcs + extra_flags
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(out + ".tmp", out)
-    return out
+        subprocess.run(
+            ["make", "-C", _NATIVE, f"build/{name}"],
+            check=True, capture_output=True, text=True,
+        )
+    return os.path.join(_NATIVE, "build", name)
 
 
 def master_binary() -> str:
-    return native_binary("master", ["master/master.cc"], [])
+    return native_binary("master")

@@ -1,0 +1,106 @@
+"""Merge a trained model into one self-contained serving artifact.
+
+Reference parity: ``python/paddle/utils/merge_model.py`` packs the config
+proto + parameter binaries into a single file consumed by the C inference
+API (``paddle/capi``).  The TPU-native artifact is better than a config:
+the jitted forward is serialized as StableHLO via ``jax.export`` with the
+parameters baked in, so serving needs no model code — the C ABI
+(native/capi) just loads and executes it on whatever backend is present.
+
+Tar layout:  meta.json     {inputs: [{name, dim}], outputs: [names], ...}
+             forward.bin   jax.export serialized bytes
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+
+import numpy as np
+
+
+def merge_v2_model(output_layer, parameters, path: str) -> None:
+    """Export ``infer(output_layer, parameters)`` to a single file.
+
+    The exported function takes one dense float32 [batch, dim] array per
+    data layer (batch size symbolic — any batch works at serving time).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export
+
+    from paddle_tpu.trainer.inference import Inference
+
+    inf = Inference(output_layer, parameters)
+    params = {n: inf.parameters[n] for n in inf.parameters.names()}
+    data_layers = inf.topology.data_layers()
+    names = list(data_layers)
+    for n, node in data_layers.items():
+        if node.attrs.get("seq_type", 0) != 0:
+            raise NotImplementedError(
+                "merged serving models take dense inputs; sequence models "
+                "serve through the python Inference API"
+            )
+
+    def serve(*xs):
+        feed = dict(zip(names, xs))
+        outs = inf._fwd(params, inf.states, feed)
+        return tuple(outs)
+
+    (b,) = export.symbolic_shape("b")
+    specs = [
+        jax.ShapeDtypeStruct((b, data_layers[n].attrs["dim"]), jnp.float32)
+        for n in names
+    ]
+    # lower for both platforms so one artifact serves on CPU hosts and TPU
+    # workers alike (jax.export artifacts are platform-specific by default)
+    exp = export.export(jax.jit(serve), platforms=("cpu", "tpu"))(*specs)
+    blob = exp.serialize()
+
+    meta = {
+        "format": "paddle_tpu_merged_model_v1",
+        "inputs": [
+            {"name": n, "dim": int(data_layers[n].attrs["dim"])} for n in names
+        ],
+        "outputs": inf.output_names,
+        "topology_digest": inf.topology.digest(),
+    }
+    with tarfile.open(path, "w") as tar:
+        mb = json.dumps(meta, indent=2).encode()
+        ti = tarfile.TarInfo("meta.json")
+        ti.size = len(mb)
+        tar.addfile(ti, io.BytesIO(mb))
+        ti = tarfile.TarInfo("forward.bin")
+        ti.size = len(blob)
+        tar.addfile(ti, io.BytesIO(blob))
+
+
+class MergedModel:
+    """Load + run a merged artifact (used by capi_bridge and directly)."""
+
+    def __init__(self, data: bytes):
+        from jax import export
+
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            self.meta = json.loads(tar.extractfile("meta.json").read())
+            blob = tar.extractfile("forward.bin").read()
+        self._exported = export.deserialize(blob)
+
+    @classmethod
+    def from_path(cls, path: str) -> "MergedModel":
+        with open(path, "rb") as f:
+            return cls(f.read())
+
+    def forward(self, *inputs: np.ndarray):
+        arrays = []
+        for spec, x in zip(self.meta["inputs"], inputs):
+            x = np.ascontiguousarray(x, dtype=np.float32)
+            if x.ndim != 2 or x.shape[1] != spec["dim"]:
+                raise ValueError(
+                    f"input {spec['name']!r} must be [batch, {spec['dim']}], "
+                    f"got {x.shape}"
+                )
+            arrays.append(x)
+        outs = self._exported.call(*arrays)
+        return [np.asarray(o) for o in outs]

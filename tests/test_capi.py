@@ -1,0 +1,72 @@
+"""C inference ABI: merge a trained model to one artifact, serve it from a
+real C program linked against libpaddle_capi.so, and check the C outputs
+equal python-side inference (the reference tests capi via
+examples/model_inference + gradient_machine tests)."""
+
+import os
+import subprocess
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.build import native_binary
+from paddle_tpu.models.lenet import lenet_cost
+from paddle_tpu.utils.merge_model import MergedModel, merge_v2_model
+
+_NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+def _train_tiny():
+    cost, predict, img, label = lenet_cost()
+    parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.SGD(learning_rate=0.01),
+    )
+    reader = paddle.reader.batch(paddle.dataset.mnist.train(), batch_size=32)
+    trainer.train(reader=paddle.reader.firstn(reader, 3), num_passes=1)
+    return predict, trainer.parameters
+
+
+def test_merge_model_python_roundtrip(tmp_path):
+    predict, parameters = _train_tiny()
+    path = str(tmp_path / "model.tar")
+    merge_v2_model(predict, parameters, path)
+
+    samples = [s for _, s in zip(range(6), paddle.dataset.mnist.test()())]
+    x = np.stack([s[0] for s in samples]).astype(np.float32)
+    ref = paddle.infer(output_layer=predict, parameters=parameters,
+                       input=[(s[0],) for s in samples])
+
+    m = MergedModel.from_path(path)
+    (probs,) = m.forward(x)
+    np.testing.assert_allclose(probs, ref, rtol=1e-5, atol=1e-6)
+    # a different batch size through the same artifact (symbolic batch dim)
+    (probs2,) = m.forward(x[:2])
+    np.testing.assert_allclose(probs2, ref[:2], rtol=1e-5, atol=1e-6)
+
+
+def test_c_program_serves_model(tmp_path):
+    predict, parameters = _train_tiny()
+    model = str(tmp_path / "model.tar")
+    merge_v2_model(predict, parameters, model)
+
+    samples = [s for _, s in zip(range(4), paddle.dataset.mnist.test()())]
+    x = np.stack([s[0] for s in samples]).astype("<f4")
+    ref = paddle.infer(output_layer=predict, parameters=parameters,
+                       input=[(s[0],) for s in samples])
+
+    exe = native_binary("capi_infer")
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(_NATIVE))
+    out = subprocess.run(
+        [exe, model, str(x.shape[1]), str(x.shape[0])],
+        input=x.tobytes(), stdout=subprocess.PIPE, env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout[-2000:]
+    got = np.array([[float(v) for v in line.split()]
+                    for line in out.stdout.decode().strip().splitlines()])
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
