@@ -2,7 +2,7 @@
  * capi/examples/model_inference/dense/main.c: load a merged model, fill an
  * input matrix, forward, print probabilities.
  *
- * Usage: infer <merged_model> <input_dim> <n_rows>
+ * Usage: infer <merged_model> <input_dim> <n_rows> [--use_cpu]
  * Reads n_rows * input_dim float32 values from stdin (binary), writes each
  * output row as space-separated floats on stdout.
  */
@@ -28,11 +28,19 @@ int main(int argc, char** argv) {
   uint64_t dim = strtoull(argv[2], NULL, 10);
   uint64_t rows = strtoull(argv[3], NULL, 10);
 
-  char* init_argv[] = {"infer", "--use_cpu"};
-  CHECK(paddle_init(2, init_argv));
+  CHECK(paddle_init(argc - 1, argv + 1)); /* forwards e.g. --use_cpu */
 
   paddle_gradient_machine machine;
   CHECK(paddle_gradient_machine_load_from_path(&machine, argv[1]));
+
+  uint64_t n_inputs, model_dim;
+  CHECK(paddle_gradient_machine_get_num_inputs(machine, &n_inputs));
+  CHECK(paddle_gradient_machine_get_input_dim(machine, 0, &model_dim));
+  if (n_inputs != 1 || model_dim != dim) {
+    fprintf(stderr, "model wants %llu inputs of dim %llu\n",
+            (unsigned long long)n_inputs, (unsigned long long)model_dim);
+    return 1;
+  }
 
   paddle_matrix input = paddle_matrix_create(rows, dim);
   for (uint64_t r = 0; r < rows; r++) {

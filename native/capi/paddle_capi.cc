@@ -126,6 +126,10 @@ paddle_error paddle_gradient_machine_load_from_path(
   if (!f) return kPD_NULLPTR;
   fseek(f, 0, SEEK_END);
   long size = ftell(f);
+  if (size <= 0 || size > (1L << 33)) {  // dirs give -1; cap at 8 GiB
+    fclose(f);
+    return kPD_PROTOBUF_ERROR;
+  }
   fseek(f, 0, SEEK_SET);
   std::vector<char> buf(size);
   if (fread(buf.data(), 1, size, f) != static_cast<size_t>(size)) {
@@ -143,6 +147,9 @@ paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
                                              paddle_matrix* outs,
                                              uint64_t* n_out) {
   if (!machine || !in || !outs || !n_out) return kPD_NULLPTR;
+  if (n_in == 0) return kPD_OUT_OF_RANGE;
+  for (uint64_t i = 0; i < n_in; i++)
+    if (!in[i]) return kPD_NULLPTR;
   auto* mach = static_cast<Machine*>(machine);
   GILGuard gil;
 
@@ -186,6 +193,37 @@ paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
     outs[i] = m;
   }
   Py_DECREF(ret);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_get_num_inputs(
+    paddle_gradient_machine machine, uint64_t* n) {
+  if (!machine || !n) return kPD_NULLPTR;
+  GILGuard gil;
+  PyObject* r = PyObject_CallMethod(Bridge(), "num_inputs", "l",
+                                    static_cast<Machine*>(machine)->handle);
+  if (!r) {
+    PyErr_Print();
+    return kPD_UNDEFINED_ERROR;
+  }
+  *n = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_get_input_dim(
+    paddle_gradient_machine machine, uint64_t i, uint64_t* dim) {
+  if (!machine || !dim) return kPD_NULLPTR;
+  GILGuard gil;
+  PyObject* r = PyObject_CallMethod(Bridge(), "input_dim", "ll",
+                                    static_cast<Machine*>(machine)->handle,
+                                    static_cast<long>(i));
+  if (!r) {
+    PyErr_Print();
+    return kPD_OUT_OF_RANGE;
+  }
+  *dim = PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
   return kPD_NO_ERROR;
 }
 

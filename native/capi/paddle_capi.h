@@ -57,6 +57,11 @@ paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
                                              paddle_matrix* outs,
                                              uint64_t* n_out);
 paddle_error paddle_gradient_machine_destroy(paddle_gradient_machine machine);
+/* Introspection: input count and per-input feature dim (meta.json order). */
+paddle_error paddle_gradient_machine_get_num_inputs(
+    paddle_gradient_machine machine, uint64_t* n);
+paddle_error paddle_gradient_machine_get_input_dim(
+    paddle_gradient_machine machine, uint64_t i, uint64_t* dim);
 
 #ifdef __cplusplus
 }

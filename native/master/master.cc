@@ -210,7 +210,9 @@ std::string HandleLine(const std::string& line,
       inbox->pop_front();
       g_state.todo.push_back(t);
     }
-    Snapshot();
+    // SET acks imply durability (a lost dataset is not re-dispatchable by
+    // anyone); GET/FIN/FAIL stay throttled — their loss only re-dispatches
+    SnapshotNow();
     return "OK " + std::to_string(added);
   }
   if (cmd == "GET") {

@@ -9,7 +9,16 @@ float32 bytes + dims) so the C side needs no numpy C API.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+# honor JAX_PLATFORMS (set by paddle_init --use_cpu) even when a
+# sitecustomize force-registers another platform: jax.config wins over it
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from paddle_tpu.utils.merge_model import MergedModel
 

@@ -7,4 +7,14 @@ SAME sample schema (shapes/dtypes/vocab sizes) as the original, loading real
 files instead when present under ``~/.cache/paddle_tpu/dataset`` (same cache
 layout idea as ``v2/dataset/common.py``)."""
 
-from paddle_tpu.dataset import cifar, imdb, mnist, uci_housing  # noqa: F401
+from paddle_tpu.dataset import (  # noqa: F401
+    cifar,
+    conll05,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    sentiment,
+    uci_housing,
+    wmt14,
+)

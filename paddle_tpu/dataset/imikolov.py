@@ -1,0 +1,115 @@
+"""imikolov (PTB language-model) — schema-compatible with
+``python/paddle/v2/dataset/imikolov.py``: ``build_dict`` → word→id map with
+``<unk>`` last; ``train/test(word_idx, n)`` yield n-gram id tuples
+(NGRAM) or (src_seq, trg_seq) id lists (SEQ) bracketed by <s>/<e>.
+
+Zero egress: serves a deterministic synthetic corpus from a 2nd-order
+Markov chain over ~1.5k word types with a Zipf unigram prior, so n-gram
+models have real structure to learn.  Real ptb files under the cache dir
+(imikolov/ptb.{train,valid}.txt) are used when present."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+VOCAB = 1500
+TRAIN_SENTENCES = 6000
+TEST_SENTENCES = 600
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _words() -> list[str]:
+    return [f"w{i:04d}" for i in range(VOCAB)]
+
+
+def _sentences(split: str, count: int):
+    """Markov-chain sentences: next word depends on the previous one via a
+    sparse deterministic transition table (same for train/test; the rng
+    differs so the sentences do)."""
+    table_rng = common.synthetic_rng("imikolov", "table")
+    succ = table_rng.integers(0, VOCAB, size=(VOCAB, 8))
+    zipf = 1.0 / np.arange(1, VOCAB + 1)
+    zipf /= zipf.sum()
+    rng = common.synthetic_rng("imikolov", split)
+    words = _words()
+    for _ in range(count):
+        n = int(rng.integers(4, 18))
+        w = int(rng.choice(VOCAB, p=zipf))
+        sent = [words[w]]
+        for _ in range(n - 1):
+            w = int(succ[w, rng.integers(0, 8)])
+            sent.append(words[w])
+        yield sent
+
+
+def _corpus(split: str):
+    fname = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[split]
+    path = common.data_path("imikolov", fname)
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                yield line.strip().split()
+    else:
+        count = TRAIN_SENTENCES if split == "train" else TEST_SENTENCES
+        yield from _sentences(split, count)
+
+
+def word_count(sentences, word_freq=None):
+    if word_freq is None:
+        word_freq = {}
+    for sent in sentences:
+        for w in sent:
+            word_freq[w] = word_freq.get(w, 0) + 1
+        word_freq["<s>"] = word_freq.get("<s>", 0) + 1
+        word_freq["<e>"] = word_freq.get("<e>", 0) + 1
+    return word_freq
+
+
+def build_dict(min_word_freq: int = 50) -> dict[str, int]:
+    word_freq = word_count(_corpus("test"), word_count(_corpus("train")))
+    word_freq.pop("<unk>", None)
+    items = [kv for kv in word_freq.items() if kv[1] > min_word_freq]
+    items.sort(key=lambda kv: (-kv[1], kv[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _reader(split: str, word_idx: dict, n: int, data_type: int):
+    def reader():
+        unk = word_idx["<unk>"]
+        for sent in _corpus(split):
+            if data_type == DataType.NGRAM:
+                assert n > -1, "Invalid gram length"
+                l = ["<s>"] + sent + ["<e>"]
+                if len(l) >= n:
+                    ids = [word_idx.get(w, unk) for w in l]
+                    for i in range(n, len(ids) + 1):
+                        yield tuple(ids[i - n:i])
+            elif data_type == DataType.SEQ:
+                ids = [word_idx.get(w, unk) for w in sent]
+                src = [word_idx["<s>"]] + ids
+                trg = ids + [word_idx["<e>"]]
+                if n > 0 and len(src) > n:
+                    continue
+                yield src, trg
+            else:
+                raise AssertionError("Unknown data type")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader("train", word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader("test", word_idx, n, data_type)

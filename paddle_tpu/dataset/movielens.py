@@ -1,0 +1,134 @@
+"""MovieLens-1M — schema-compatible with
+``python/paddle/v2/dataset/movielens.py``: each sample is
+``[user_id, gender(0/1), age_idx, job_id, movie_id, [category_ids],
+[title_word_ids], [rating]]`` with the same helper surface
+(``movie_categories``, ``max_user_id``, ``max_movie_id``, ``max_job_id``,
+``get_movie_title_dict``, ``age_table``).
+
+Zero egress: ratings are generated from latent user/movie factors plus
+category affinity, so a factorization/recommender model genuinely learns."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+
+N_USERS = 900
+N_MOVIES = 1200
+N_JOBS = 21
+TITLE_VOCAB = 800
+_TRAIN_PER_USER = 18
+_TEST_PER_USER = 3
+_DIM = 6  # latent factor dim for synthetic ratings
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title_ids):
+        self.index = index
+        self.categories = categories
+        self.title_ids = title_ids
+
+    def value(self):
+        return [self.index,
+                [_CATEGORIES.index(c) for c in self.categories],
+                list(self.title_ids)]
+
+
+class UserInfo:
+    def __init__(self, index, is_male, age_idx, job_id):
+        self.index = index
+        self.is_male = is_male
+        self.age = age_idx
+        self.job_id = job_id
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+_META = None
+
+
+def _meta():
+    global _META
+    if _META is not None:
+        return _META
+    rng = common.synthetic_rng("movielens", "meta")
+    movies, users = {}, {}
+    movie_factors = rng.normal(0, 1, (N_MOVIES + 1, _DIM)).astype(np.float32)
+    user_factors = rng.normal(0, 1, (N_USERS + 1, _DIM)).astype(np.float32)
+    for mid in range(1, N_MOVIES + 1):
+        cats = list(rng.choice(_CATEGORIES, size=int(rng.integers(1, 4)),
+                               replace=False))
+        title = rng.integers(1, TITLE_VOCAB, size=int(rng.integers(2, 6)))
+        movies[mid] = MovieInfo(mid, cats, title)
+    for uid in range(1, N_USERS + 1):
+        users[uid] = UserInfo(uid, bool(rng.integers(0, 2)),
+                              int(rng.integers(0, len(age_table))),
+                              int(rng.integers(0, N_JOBS)))
+    _META = (users, movies, user_factors, movie_factors)
+    return _META
+
+
+def _rating(uid: int, mid: int) -> float:
+    users, movies, uf, mf = _meta()
+    score = float(uf[uid] @ mf[mid]) / np.sqrt(_DIM)
+    return float(np.clip(np.round(3.0 + 1.2 * score), 1, 5))
+
+
+def _reader(split: str):
+    def reader():
+        users, movies, _, _ = _meta()
+        rng = common.synthetic_rng("movielens", split)
+        per = _TRAIN_PER_USER if split == "train" else _TEST_PER_USER
+        for uid in range(1, N_USERS + 1):
+            for mid in rng.integers(1, N_MOVIES + 1, size=per):
+                mid = int(mid)
+                yield (users[uid].value() + movies[mid].value()
+                       + [[_rating(uid, mid)]])
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"t{i:03d}": i for i in range(TITLE_VOCAB)}
+
+
+def max_movie_id() -> int:
+    return N_MOVIES
+
+
+def max_user_id() -> int:
+    return N_USERS
+
+
+def max_job_id() -> int:
+    return N_JOBS - 1
+
+
+def movie_info():
+    return _meta()[1]
+
+
+def user_info():
+    return _meta()[0]

@@ -1,0 +1,49 @@
+"""Movie-review sentiment — schema-compatible with
+``python/paddle/v2/dataset/sentiment.py`` (NLTK movie_reviews corpus):
+``get_word_dict()`` → word→id; ``train()``/``test()`` yield
+(word_id_list, label) with label 0=negative, 1=positive.
+
+Zero egress: synthetic reviews mixing polarity words with neutral filler;
+the label is the majority polarity, so a bag-of-words or LSTM classifier
+genuinely learns."""
+
+from __future__ import annotations
+
+from paddle_tpu.dataset import common
+
+VOCAB = 3000
+_N_POLAR = 200  # first _N_POLAR ids: even=positive cue, odd=negative cue
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict():
+    return {f"w{i:04d}": i for i in range(VOCAB)}
+
+
+def _reader(split: str, count: int):
+    def reader():
+        rng = common.synthetic_rng("sentiment", split)
+        for _ in range(count):
+            label = int(rng.integers(0, 2))
+            n = int(rng.integers(20, 120))
+            ids = []
+            for _ in range(n):
+                if rng.random() < 0.25:  # polarity cue word
+                    w = int(rng.integers(0, _N_POLAR // 2)) * 2
+                    # the right-parity cue for this label most of the time
+                    wrong = rng.random() < 0.15
+                    ids.append(w + (1 - label if not wrong else label))
+                else:
+                    ids.append(int(rng.integers(_N_POLAR, VOCAB)))
+            yield ids, label
+
+    return reader
+
+
+def train():
+    return _reader("train", NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _reader("test", NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES)

@@ -1,0 +1,70 @@
+"""WMT14 FR→EN — schema-compatible with
+``python/paddle/v2/dataset/wmt14.py``: ``train/test(dict_size)`` yield
+(src_ids, trg_ids, trg_ids_next) where src is bracketed with <s>/<e>,
+trg = [<s>] + ids, trg_next = ids + [<e>]; ids 0/1/2 are <s>/<e>/<unk>.
+``get_dict(dict_size, reverse)`` returns (src_dict, trg_dict).
+
+Zero egress: a synthetic translation task — the target sequence is the
+source reversed through a fixed word-level bijection — so an
+encoder-decoder with attention genuinely learns alignment."""
+
+from __future__ import annotations
+
+from paddle_tpu.dataset import common
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_IDX, END_IDX, UNK_IDX = 0, 1, 2
+_RESERVED = 3
+
+TRAIN_PAIRS = 4000
+TEST_PAIRS = 400
+
+
+def _mapping(dict_size: int, seed_name: str):
+    rng = common.synthetic_rng("wmt14", seed_name)
+    perm = rng.permutation(dict_size - _RESERVED)
+    return perm
+
+
+def _reader(split: str, dict_size: int, count: int):
+    def reader():
+        perm = _mapping(dict_size, "bijection")
+        rng = common.synthetic_rng("wmt14", split)
+        for _ in range(count):
+            n = int(rng.integers(3, 15))
+            src_core = rng.integers(_RESERVED, dict_size, size=n)
+            # target: reversed source through the fixed bijection
+            trg_core = [int(perm[w - _RESERVED]) + _RESERVED
+                        for w in src_core[::-1]]
+            src_ids = [START_IDX] + [int(w) for w in src_core] + [END_IDX]
+            trg_ids = [START_IDX] + trg_core
+            trg_ids_next = trg_core + [END_IDX]
+            yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(dict_size: int):
+    return _reader("train", dict_size, TRAIN_PAIRS)
+
+
+def test(dict_size: int):
+    return _reader("test", dict_size, TEST_PAIRS)
+
+
+def _make_dict(dict_size: int, prefix: str):
+    d = {START: START_IDX, END: END_IDX, UNK: UNK_IDX}
+    for i in range(_RESERVED, dict_size):
+        d[f"{prefix}{i:05d}"] = i
+    return d
+
+
+def get_dict(dict_size: int, reverse: bool = True):
+    src = _make_dict(dict_size, "f")
+    trg = _make_dict(dict_size, "e")
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

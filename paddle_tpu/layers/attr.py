@@ -56,6 +56,11 @@ class ExtraAttr:
 
 ExtraLayerAttribute = ExtraAttr
 
+# v2 API aliases (python/paddle/v2/attr.py: Param / Extra / ParameterAttribute)
+Param = ParamAttr
+ParameterAttribute = ParamAttr
+Extra = ExtraAttr
+
 
 def param_attr_or_default(attr: ParamAttr | None) -> ParamAttr:
     return attr if attr is not None else ParamAttr()

@@ -1,0 +1,107 @@
+"""GAN — parity with ``v1_api_demo/gan`` (uniform-noise generator vs
+discriminator, alternating updates; the reference drives two
+GradientMachines by hand through the api).  TPU-native: both nets are pure
+functions, the two adversarial steps are two jitted programs sharing
+parameter pytrees — no machinery needed beyond jax.grad.
+
+``gan_trainer``-style usage:
+    gan = GAN(jax.random.key(0))
+    for batch in data:                       # batch [B, x_dim] in [-1, 1]
+        d_loss = gan.train_d(batch)
+        g_loss = gan.train_g()
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.optimizer import Adam
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(k, (m, n), jnp.float32) * np.sqrt(2.0 / m),
+            "b": jnp.zeros((n,), jnp.float32),
+        })
+    return params
+
+
+def _mlp(params, x, final_act=None):
+    for i, p in enumerate(params):
+        x = x @ p["w"] + p["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return final_act(x) if final_act else x
+
+
+class GAN:
+    """MLP GAN on flat data (the reference demo's `uniform` mode; its mnist
+    conv mode maps to swapping _mlp for a conv stack)."""
+
+    def __init__(self, key, x_dim: int = 784, z_dim: int = 64,
+                 hidden: int = 256, lr: float = 2e-4):
+        kg, kd, self._key = jax.random.split(key, 3)
+        self.g_params = _mlp_init(kg, [z_dim, hidden, hidden, x_dim])
+        self.d_params = _mlp_init(kd, [x_dim, hidden, hidden, 1])
+        self.z_dim = z_dim
+        self.g_opt = Adam(learning_rate=lr, beta1=0.5)
+        self.d_opt = Adam(learning_rate=lr, beta1=0.5)
+        self.g_state = self.g_opt.init_tree(self.g_params)
+        self.d_state = self.d_opt.init_tree(self.d_params)
+
+    def _next_key(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def generate(self, n: int) -> jax.Array:
+        z = jax.random.uniform(self._next_key(), (n, self.z_dim),
+                               minval=-1.0, maxval=1.0)
+        return _mlp(self.g_params, z, jnp.tanh)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _d_step(self, d_params, d_state, g_params, real, key):
+        z = jax.random.uniform(key, (real.shape[0], self.z_dim),
+                               minval=-1.0, maxval=1.0)
+        fake = _mlp(g_params, z, jnp.tanh)
+
+        def loss_fn(dp):
+            logit_real = _mlp(dp, real)
+            logit_fake = _mlp(dp, fake)
+            # non-saturating BCE: real -> 1, fake -> 0
+            return jnp.mean(jax.nn.softplus(-logit_real)) + jnp.mean(
+                jax.nn.softplus(logit_fake))
+
+        loss, grads = jax.value_and_grad(loss_fn)(d_params)
+        d_params, d_state = self.d_opt.apply_tree(grads, d_params, d_state)
+        return d_params, d_state, loss
+
+    @functools.partial(jax.jit, static_argnums=(0, 4))
+    def _g_step(self, g_params, g_state, d_params, n, key):
+        z = jax.random.uniform(key, (n, self.z_dim), minval=-1.0, maxval=1.0)
+
+        def loss_fn(gp):
+            fake = _mlp(gp, z, jnp.tanh)
+            return jnp.mean(jax.nn.softplus(-_mlp(d_params, fake)))
+
+        loss, grads = jax.value_and_grad(loss_fn)(g_params)
+        g_params, g_state = self.g_opt.apply_tree(grads, g_params, g_state)
+        return g_params, g_state, loss
+
+    def train_d(self, real_batch) -> float:
+        real = jnp.asarray(real_batch, jnp.float32)
+        self.d_params, self.d_state, loss = self._d_step(
+            self.d_params, self.d_state, self.g_params, real,
+            self._next_key())
+        return float(loss)
+
+    def train_g(self, n: int = 64) -> float:
+        self.g_params, self.g_state, loss = self._g_step(
+            self.g_params, self.g_state, self.d_params, n, self._next_key())
+        return float(loss)

@@ -1,0 +1,58 @@
+"""MovieLens recommender — parity with the reference's recommender demo
+(``python/paddle/v2/tests`` book ch.5 / fluid ``test_recommender_system.py``):
+user tower (id/gender/age/job embeddings → fc) and movie tower (id
+embedding, category pooling, title sequence pooling → fc), fused by scaled
+cosine similarity against the 1–5 rating with square error cost."""
+
+from __future__ import annotations
+
+from paddle_tpu.dataset import movielens
+from paddle_tpu.layers import activation as act
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type
+
+
+def recommender_cost(emb_dim: int = 32, hidden: int = 64):
+    """Returns (cost, prediction, feed_order)."""
+    uid = layer.data(name="user_id",
+                     type=data_type.integer_value(movielens.max_user_id() + 1))
+    gender = layer.data(name="gender_id", type=data_type.integer_value(2))
+    age = layer.data(name="age_id",
+                     type=data_type.integer_value(len(movielens.age_table)))
+    job = layer.data(name="job_id",
+                     type=data_type.integer_value(movielens.max_job_id() + 1))
+    usr_parts = [
+        layer.embedding(input=uid, size=emb_dim),
+        layer.embedding(input=gender, size=emb_dim // 2),
+        layer.embedding(input=age, size=emb_dim // 2),
+        layer.embedding(input=job, size=emb_dim // 2),
+    ]
+    usr = layer.fc(input=layer.concat(input=usr_parts), size=hidden,
+                   act=act.TanhActivation())
+
+    mid = layer.data(name="movie_id",
+                     type=data_type.integer_value(movielens.max_movie_id() + 1))
+    cats = layer.data(
+        name="category_id",
+        type=data_type.integer_value_sequence(
+            len(movielens.movie_categories())),
+    )
+    title = layer.data(
+        name="movie_title",
+        type=data_type.integer_value_sequence(
+            len(movielens.get_movie_title_dict())),
+    )
+    mov_parts = [
+        layer.embedding(input=mid, size=emb_dim),
+        layer.pooling(input=layer.embedding(input=cats, size=emb_dim // 2)),
+        layer.pooling(input=layer.embedding(input=title, size=emb_dim // 2)),
+    ]
+    mov = layer.fc(input=layer.concat(input=mov_parts), size=hidden,
+                   act=act.TanhActivation())
+
+    prediction = layer.cos_sim(a=usr, b=mov, scale=5.0)
+    score = layer.data(name="score", type=data_type.dense_vector(1))
+    cost = layer.square_error_cost(input=prediction, label=score)
+    feed_order = ["user_id", "gender_id", "age_id", "job_id", "movie_id",
+                  "category_id", "movie_title", "score"]
+    return cost, prediction, feed_order

@@ -1,0 +1,53 @@
+"""Semantic-role sequence tagger — parity with the reference's
+``v1_api_demo/sequence_tagging`` and the SRL book demo
+(``demo/semantic_role_labeling``): word + predicate-context-window + mark
+embeddings, a recurrent encoder, per-step emissions, linear-chain CRF cost,
+CRF Viterbi decoding for evaluation."""
+
+from __future__ import annotations
+
+from paddle_tpu.dataset import conll05
+from paddle_tpu.layers import activation as act
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type, extras
+from paddle_tpu.layers.attr import ParamAttr
+
+
+def srl_cost(emb_dim: int = 32, hidden: int = 64):
+    """Returns (cost, decode_error, feed_order)."""
+    word_vocab = conll05.WORD_VOCAB
+    verb_vocab = conll05.VERB_VOCAB
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    n_labels = len(label_dict)
+
+    slots = ["word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+             "ctx_p1_data", "ctx_p2_data"]
+    embs = []
+    shared = ParamAttr(name="word_emb")  # context slots share the word table
+    for s in slots:
+        d = layer.data(name=s, type=data_type.integer_value_sequence(word_vocab))
+        embs.append(layer.embedding(input=d, size=emb_dim, param_attr=shared))
+    verb = layer.data(name="verb_data",
+                      type=data_type.integer_value_sequence(verb_vocab))
+    embs.append(layer.embedding(input=verb, size=emb_dim))
+    mark = layer.data(name="mark_data",
+                      type=data_type.integer_value_sequence(2))
+    embs.append(layer.embedding(input=mark, size=emb_dim // 4))
+
+    feat = layer.fc(input=layer.concat(input=embs), size=hidden,
+                    act=act.TanhActivation())
+    rnn = layer.recurrent(input=feat, act=act.TanhActivation())
+    emission = layer.fc(input=rnn, size=n_labels,
+                        act=act.LinearActivation())
+
+    target = layer.data(name="target",
+                        type=data_type.integer_value_sequence(n_labels))
+    crf_attr = ParamAttr(name="crf_w")
+    cost = extras.crf(input=emission, label=target, size=n_labels,
+                      param_attr=crf_attr)
+    decode_err = extras.crf_decoding(input=emission, size=n_labels,
+                                     label=target, param_attr=crf_attr)
+    feed_order = ["word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+                  "ctx_p1_data", "ctx_p2_data", "verb_data", "mark_data",
+                  "target"]
+    return cost, decode_err, feed_order

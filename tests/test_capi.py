@@ -59,10 +59,11 @@ def test_c_program_serves_model(tmp_path):
 
     exe = native_binary("capi_infer")
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=os.path.dirname(_NATIVE))
+    pypath = os.path.dirname(_NATIVE) + os.pathsep + \
+        os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=pypath)
     out = subprocess.run(
-        [exe, model, str(x.shape[1]), str(x.shape[0])],
+        [exe, model, str(x.shape[1]), str(x.shape[0]), "--use_cpu"],
         input=x.tobytes(), stdout=subprocess.PIPE, env=env, timeout=300,
     )
     assert out.returncode == 0, out.stdout[-2000:]

@@ -1,0 +1,87 @@
+"""Dataset modules: reference-schema conformance + determinism (the
+reference's dataset tests assert sample counts and id ranges —
+python/paddle/v2/dataset/tests/)."""
+
+import numpy as np
+
+from paddle_tpu.dataset import (
+    conll05, imikolov, movielens, sentiment, wmt14,
+)
+
+
+def test_imikolov_ngram_and_seq():
+    word_idx = imikolov.build_dict(min_word_freq=5)
+    assert word_idx["<unk>"] == len(word_idx) - 1
+    assert "<s>" in word_idx and "<e>" in word_idx
+    grams = list(imikolov.train(word_idx, 5)())
+    assert len(grams) > 1000
+    assert all(len(g) == 5 for g in grams[:50])
+    assert all(0 <= w < len(word_idx) for g in grams[:50] for w in g)
+    seqs = list(imikolov.test(word_idx, 0, imikolov.DataType.SEQ)())
+    src, trg = seqs[0]
+    assert src[0] == word_idx["<s>"] and trg[-1] == word_idx["<e>"]
+    assert src[1:] == trg[:-1]
+    # deterministic across calls
+    assert grams[0] == next(iter(imikolov.train(word_idx, 5)()))
+
+
+def test_movielens_schema():
+    samples = list(movielens.train()())
+    assert len(samples) == movielens.N_USERS * movielens._TRAIN_PER_USER
+    uid, gender, age, job, mid, cats, title, rating = samples[0]
+    assert 1 <= uid <= movielens.max_user_id()
+    assert gender in (0, 1)
+    assert 0 <= age < len(movielens.age_table)
+    assert 0 <= job <= movielens.max_job_id()
+    assert 1 <= mid <= movielens.max_movie_id()
+    assert all(0 <= c < len(movielens.movie_categories()) for c in cats)
+    assert all(0 <= t < len(movielens.get_movie_title_dict()) for t in title)
+    assert 1.0 <= rating[0] <= 5.0
+    # ratings reflect latent structure: not all identical
+    ratings = [s[-1][0] for s in samples[:500]]
+    assert len(set(ratings)) > 2
+
+
+def test_conll05_slots_aligned():
+    word_dict, verb_dict, label_dict = conll05.get_dict()
+    assert label_dict["O"] == 0 or "O" in label_dict
+    sample = next(iter(conll05.test()()))
+    assert len(sample) == 9
+    n = len(sample[0])
+    for slot in sample:
+        assert len(slot) == n
+    words, c_n2, c_n1, c_0, c_p1, c_p2, verbs, mark, labels = sample
+    assert sum(mark) == 1  # exactly one predicate
+    assert len(set(verbs)) == 1
+    assert conll05.get_embedding().shape == (conll05.WORD_VOCAB, 32)
+
+
+def test_sentiment_learnable_signal():
+    data = list(sentiment.train()())
+    assert len(data) == sentiment.NUM_TRAINING_INSTANCES
+    # labels decodable from cue-word parity => a classifier can learn
+    correct = 0
+    for ids, label in data[:200]:
+        cues = [w for w in ids if w < sentiment._N_POLAR]
+        votes = sum(1 if w % 2 == 0 else -1 for w in cues)
+        pred = 1 if votes > 0 else 0  # even cue ids signal positive
+        correct += (pred == label)
+    assert correct > 150
+
+
+def test_wmt14_translation_consistent():
+    dict_size = 1000
+    pairs = list(wmt14.train(dict_size)())
+    assert len(pairs) == wmt14.TRAIN_PAIRS
+    src, trg, trg_next = pairs[0]
+    assert src[0] == wmt14.START_IDX and src[-1] == wmt14.END_IDX
+    assert trg[0] == wmt14.START_IDX and trg_next[-1] == wmt14.END_IDX
+    assert trg[1:] == trg_next[:-1]
+    # the mapping is a fixed bijection of the reversed source
+    core_src = src[1:-1]
+    perm = wmt14._mapping(dict_size, "bijection")
+    expect = [int(perm[w - wmt14._RESERVED]) + wmt14._RESERVED
+              for w in core_src[::-1]]
+    assert trg[1:] == expect
+    src_dict, trg_dict = wmt14.get_dict(dict_size)
+    assert src_dict[0] == "<s>" and trg_dict[1] == "<e>"
