@@ -1,2 +1,6 @@
 """Utility tools — successor of ``python/paddle/utils`` (merge_model,
-plotcurve, image preprocessing) and assorted trainer tooling."""
+plotcurve, show_pb, image preprocessing) and assorted trainer tooling."""
+
+from paddle_tpu.utils.merge_model import MergedModel, merge_v2_model  # noqa: F401
+from paddle_tpu.utils.plotcurve import Ploter, parse_log, plotcurve  # noqa: F401
+from paddle_tpu.utils.show_topology import format_topology, show_topology  # noqa: F401

@@ -1,0 +1,96 @@
+"""Image preprocessing — parity with ``python/paddle/v2/image.py``
+(load_image, resize_short, to_chw, center/random crop, flip,
+simple_transform, load_and_transform).  PIL replaces the reference's cv2;
+everything else is numpy."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(data))
+    im = im.convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    from PIL import Image
+
+    im = Image.open(path).convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the SHORTER edge equals ``size``, keeping aspect ratio."""
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    pil = Image.fromarray(im)
+    return np.asarray(pil.resize((new_w, new_h), Image.BILINEAR))
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (grayscale gets a singleton channel first)."""
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True) -> np.ndarray:
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng: np.random.Generator | None = None) -> np.ndarray:
+    rng = rng or np.random.default_rng()
+    h, w = im.shape[:2]
+    h0 = int(rng.integers(0, h - size + 1))
+    w0 = int(rng.integers(0, w - size + 1))
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean: np.ndarray | float | None = None,
+                     rng: np.random.Generator | None = None) -> np.ndarray:
+    """resize_short -> crop (random+flip in train, center in test) ->
+    CHW float32, optionally mean-subtracted — the reference's standard
+    ImageNet-style pipeline."""
+    rng = rng or np.random.default_rng()
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color, rng)
+        if rng.random() > 0.5:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:  # per-channel mean
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(path: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True,
+                       mean=None) -> np.ndarray:
+    return simple_transform(load_image(path, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,75 @@
+"""Training-curve tools — parity with ``python/paddle/utils/plotcurve.py``
+(parse trainer logs, plot cost curves) and ``python/paddle/v2/plot``
+(the notebook ``Ploter``)."""
+
+from __future__ import annotations
+
+import re
+
+_LINE = re.compile(
+    r"Pass (\d+), Batch (\d+), Cost ([-\d.eE+]+)")
+
+
+def parse_log(lines) -> list[tuple[int, int, float]]:
+    """[(pass, batch, cost), ...] from trainer log text lines."""
+    out = []
+    for line in lines:
+        m = _LINE.search(line)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), float(m.group(3))))
+    return out
+
+
+def plotcurve(log_path: str, out_path: str) -> None:
+    """Plot the batch-cost curve of a training log to an image file."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with open(log_path) as f:
+        points = parse_log(f)
+    if not points:
+        raise ValueError(f"no cost lines found in {log_path}")
+    plt.figure(figsize=(8, 4))
+    plt.plot([c for _, _, c in points])
+    plt.xlabel("batch")
+    plt.ylabel("cost")
+    plt.tight_layout()
+    plt.savefig(out_path)
+    plt.close()
+
+
+class Ploter:
+    """≅ paddle.v2.plot.Ploter: append (title, step, value) points, plot on
+    demand; falls back to printing outside notebooks."""
+
+    def __init__(self, *titles: str):
+        self.titles = titles
+        self.data: dict[str, list[tuple[float, float]]] = {
+            t: [] for t in titles
+        }
+
+    def append(self, title: str, step: float, value: float) -> None:
+        self.data[title].append((step, value))
+
+    def reset(self) -> None:
+        for t in self.titles:
+            self.data[t] = []
+
+    def plot(self, path: str | None = None) -> None:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        plt.figure(figsize=(8, 4))
+        for t in self.titles:
+            if self.data[t]:
+                xs, ys = zip(*self.data[t])
+                plt.plot(xs, ys, label=t)
+        plt.legend()
+        plt.tight_layout()
+        if path:
+            plt.savefig(path)
+        plt.close()

@@ -1,0 +1,42 @@
+"""Topology inspection — parity with ``python/paddle/utils/show_pb.py``
+(print a ModelConfig proto) and ``dump_config.py``: human-readable dump of
+a serialized topology (the JSON ModelConfig analog) with parameter
+counts."""
+
+from __future__ import annotations
+
+import json
+import math
+
+
+def format_topology(serialized: str) -> str:
+    doc = json.loads(serialized)
+    lines = []
+    total_params = 0
+    lines.append(f"inputs:  {', '.join(doc['input_layer_names'])}")
+    lines.append(f"outputs: {', '.join(doc['output_layer_names'])}")
+    lines.append(f"{'layer':<28} {'type':<18} {'size':>7}  inputs")
+    for rec in doc["layers"]:
+        n_params = sum(
+            math.prod(int(d) for d in p["shape"])
+            for p in rec.get("params", [])
+        )
+        total_params += n_params
+        lines.append(
+            f"{rec['name']:<28} {rec['type']:<18} {rec['size']:>7}  "
+            f"{','.join(rec['inputs'])}"
+        )
+    lines.append(f"total parameters: {total_params:,}")
+    return "\n".join(lines)
+
+
+def show_topology(topology_or_path) -> None:
+    """Accepts a Topology object, serialized JSON text, or a file path."""
+    if hasattr(topology_or_path, "serialize"):
+        text = topology_or_path.serialize()
+    elif isinstance(topology_or_path, str) and topology_or_path.lstrip().startswith("{"):
+        text = topology_or_path
+    else:
+        with open(topology_or_path) as f:
+            text = f.read()
+    print(format_topology(text))

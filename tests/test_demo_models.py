@@ -42,8 +42,8 @@ def test_vae_elbo_decreases():
     for imgs in _mnist_batches(25):
         losses.append(vae.train_batch((imgs + 1.0) / 2.0))  # to [0,1]
     assert losses[-1] < losses[0] * 0.8
-    x = np.stack([b[0] for b in
-                  [next(mnist.test()()) for _ in range(4)]])
+    src = mnist.test()()
+    x = np.stack([b[0] for b in [next(src) for _ in range(4)]])
     rec = np.asarray(vae.reconstruct((x + 1.0) / 2.0))
     assert rec.shape == (4, 784) and np.all((rec >= 0) & (rec <= 1))
     assert np.asarray(vae.sample(3)).shape == (3, 784)
