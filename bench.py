@@ -28,6 +28,8 @@ def main() -> None:
     from paddle_tpu.optimizer import Momentum
     from paddle_tpu.trainer.step import build_train_step
 
+    import jax.numpy as jnp
+
     base.reset_name_counters()
     cost, predict, img, label = M.alexnet_cost()
     topo = Topology(cost)
@@ -37,7 +39,8 @@ def main() -> None:
     params = paddle.parameters.create(topo).as_dict()
     opt_state = opt.init(params, specs)
     states = topo.init_states()
-    step = build_train_step(topo, opt)
+    # mixed precision: bf16 activations/compute on the MXU, f32 master params
+    step = build_train_step(topo, opt, compute_dtype=jnp.bfloat16)
 
     rng = np.random.default_rng(0)
     feed = {

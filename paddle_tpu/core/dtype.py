@@ -34,7 +34,13 @@ def param_dtype():
 
 
 def cast_for_matmul(*arrays):
-    """Cast operands to the compute dtype (no-op if already there)."""
+    """Cast operands to the compute dtype for the MXU.
+
+    With the ``bf16`` flag off, operands pass through UNCHANGED (the caller's
+    dtype is respected) — so a step built with ``compute_dtype=bfloat16``
+    still computes in bf16 rather than being silently upcast to f32."""
     dt = compute_dtype()
+    if dt == jnp.float32:
+        return arrays if len(arrays) > 1 else arrays[0]
     out = tuple(a.astype(dt) if a.dtype != dt else a for a in arrays)
     return out if len(out) > 1 else out[0]

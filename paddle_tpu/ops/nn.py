@@ -36,9 +36,12 @@ def conv2d(
     else:
         ph, pw = _pair(padding)
         pad = [(ph, ph), (pw, pw)]
-    # bf16 operands tile onto the MXU; the f32 upcast after keeps downstream
-    # math stable.  (preferred_element_type=f32 with bf16 operands breaks the
+    # bf16 operands tile onto the MXU.  Output dtype follows the caller's
+    # input dtype: f32 callers get the stable f32 upcast; an end-to-end bf16
+    # policy (build_train_step compute_dtype) keeps activations bf16, halving
+    # HBM traffic.  (preferred_element_type=f32 with bf16 operands breaks the
     # conv transpose rule in jax 0.9, so we round to bf16 and upcast.)
+    out_dtype = x.dtype
     x, w = dt.cast_for_matmul(x, w)
     y = lax.conv_general_dilated(
         x,
@@ -49,7 +52,7 @@ def conv2d(
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
     )
-    return y.astype(jnp.float32)
+    return y.astype(out_dtype)
 
 
 def conv2d_transpose(
@@ -58,6 +61,7 @@ def conv2d_transpose(
     """Transposed conv (≅ ConvTransLayer / conv2d_transpose_op)."""
     stride = _pair(stride)
     ph, pw = _pair(padding)
+    out_dtype = x.dtype
     x, w = dt.cast_for_matmul(x, w)
     y = lax.conv_transpose(
         x,
@@ -67,7 +71,7 @@ def conv2d_transpose(
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         transpose_kernel=True,
     )
-    return y.astype(jnp.float32)
+    return y.astype(out_dtype)
 
 
 def depthwise_conv2d(x: jax.Array, w: jax.Array, stride=1, padding=0) -> jax.Array:

@@ -43,9 +43,32 @@ def _compute_metrics(metric_specs, values) -> dict[str, jax.Array]:
     return out
 
 
-def build_train_step(topology: Topology, optimizer, mesh: MeshContext | None = None):
+def _cast_floats(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+def _cast_like(tree, ref):
+    return jax.tree.map(
+        lambda x, r: x.astype(r.dtype) if hasattr(r, "dtype") else x,
+        tree, ref,
+    )
+
+
+def build_train_step(topology: Topology, optimizer,
+                     mesh: MeshContext | None = None,
+                     compute_dtype=None):
     """Returns jitted fn: (params, opt_state, states, feed, key)
-    -> (params, opt_state, states, cost, metrics)."""
+    -> (params, opt_state, states, cost, metrics).
+
+    ``compute_dtype=jnp.bfloat16`` enables mixed precision: forward/backward
+    run in bf16 on the MXU while master parameters, optimizer state, and
+    persistent states stay float32 (grads are upcast before the update).
+    """
     specs = {s.name: s for s in topology.param_specs()}
     trainable = {n for n, s in specs.items() if not s.is_static}
     metric_specs = topology.metrics()
@@ -54,19 +77,33 @@ def build_train_step(topology: Topology, optimizer, mesh: MeshContext | None = N
     def step(params, opt_state, states, feed, key):
         train_p = {k: v for k, v in params.items() if k in trainable}
         static_p = {k: v for k, v in params.items() if k not in trainable}
+        if compute_dtype is not None:
+            feed_c = _cast_floats(feed, compute_dtype)
+            static_c = _cast_floats(static_p, compute_dtype)
+        else:
+            feed_c, static_c = feed, static_p
+        # persistent states (BN running stats) stay f32: batch_norm upcasts
+        # internally, and a bf16 EMA accumulator would re-quantize each step
 
         def loss_fn(tp):
-            allp = {**static_p, **tp}
-            values, new_states = topology.forward(allp, states, feed, True, key)
+            if compute_dtype is not None:
+                tp = _cast_floats(tp, compute_dtype)
+            allp = {**static_c, **tp}
+            values, new_states = topology.forward(
+                allp, states, feed_c, True, key)
             cost = functools.reduce(
-                lambda a, b: a + b, [jnp.sum(values[n]) for n in out_names]
+                lambda a, b: a + b,
+                [jnp.sum(values[n], dtype=jnp.float32) for n in out_names]
             )
             metrics = _compute_metrics(metric_specs, values)
             return cost, (new_states, metrics)
 
+        # grads arrive f32 already (cotangent of the bf16 cast upcasts)
         (cost, (new_states, metrics)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(train_p)
+        if compute_dtype is not None:
+            new_states = _cast_like(new_states, states)
         new_train, new_opt = optimizer.apply(grads, train_p, opt_state, specs)
         new_params = {**static_p, **new_train}
         return new_params, new_opt, new_states, cost, metrics

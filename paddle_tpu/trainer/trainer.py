@@ -53,7 +53,9 @@ class SGD:
 
     def __init__(self, cost, parameters: Parameters, update_equation,
                  extra_layers=None, is_local: bool = True, pserver_spec=None,
-                 use_etcd: bool = False, mesh: MeshContext | None = None):
+                 use_etcd: bool = False, mesh: MeshContext | None = None,
+                 compute_dtype=None):
+        self.compute_dtype = compute_dtype  # e.g. jnp.bfloat16 for the MXU
         if isinstance(cost, LayerOutput):
             cost = [cost]
         self.topology = Topology(cost, extra_layers=extra_layers)
@@ -83,7 +85,9 @@ class SGD:
 
     def _ensure_built(self):
         if self._train_step is None:
-            self._train_step = build_train_step(self.topology, self.optimizer, self.mesh)
+            self._train_step = build_train_step(
+                self.topology, self.optimizer, self.mesh,
+                compute_dtype=self.compute_dtype)
             self._eval_step = build_eval_step(self.topology, self.mesh)
 
     def _default_feeder(self, feeding):
