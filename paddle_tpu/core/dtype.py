@@ -41,6 +41,13 @@ def cast_for_matmul(*arrays):
     still computes in bf16 rather than being silently upcast to f32."""
     dt = compute_dtype()
     if dt == jnp.float32:
-        return arrays if len(arrays) > 1 else arrays[0]
+        # respect the caller's dtype, but still unify mixed operands
+        # (lax.conv requires matching dtypes)
+        common = arrays[0].dtype
+        for a in arrays[1:]:
+            common = jnp.promote_types(common, a.dtype)
+        out = tuple(a.astype(common) if a.dtype != common else a
+                    for a in arrays)
+        return out if len(out) > 1 else out[0]
     out = tuple(a.astype(dt) if a.dtype != dt else a for a in arrays)
     return out if len(out) > 1 else out[0]

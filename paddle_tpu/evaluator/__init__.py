@@ -307,10 +307,104 @@ class CTCError(Evaluator):
         return {self.name: self.total_dist / max(self.total_len, 1)}
 
 
+class DetectionMAP(Evaluator):
+    """≅ detection_map evaluator (DetectionMAPEvaluator.cpp): mean average
+    precision over classes at an IoU threshold, 11-point interpolated or
+    integral.  ``eval_batch(detections=[[label,score,x1,y1,x2,y2],...] per
+    image, gts=[[label,x1,y1,x2,y2],...] per image)``."""
+
+    name = "detection_map"
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 ap_version: str = "11point"):
+        self.thr = overlap_threshold
+        self.ap_version = ap_version
+        self.start()
+
+    def start(self):
+        self.dets: list = []   # (class, score, image_id, box)
+        self.gts: dict = {}    # (image_id, class) -> [boxes]
+        self.n_img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        lt = np.maximum(a[:2], b[:2])
+        rb = np.minimum(a[2:], b[2:])
+        wh = np.maximum(rb - lt, 0.0)
+        inter = wh[0] * wh[1]
+        ua = max((a[2]-a[0]) * (a[3]-a[1]), 0) + max(
+            (b[2]-b[0]) * (b[3]-b[1]), 0) - inter
+        return inter / max(ua, 1e-10)
+
+    def eval_batch(self, detections=None, gts=None, **kw):
+        for det_rows, gt_rows in zip(detections, gts):
+            img = self.n_img
+            self.n_img += 1
+            for row in det_rows:
+                if row[0] < 0:
+                    continue
+                self.dets.append((int(row[0]), float(row[1]), img,
+                                  np.asarray(row[2:6], np.float64)))
+            for row in gt_rows:
+                if row[0] < 0:
+                    continue
+                self.gts.setdefault((img, int(row[0])), []).append(
+                    np.asarray(row[1:5], np.float64))
+
+    def _ap(self, recalls, precisions):
+        if self.ap_version == "11point":
+            return float(np.mean([
+                max([p for r, p in zip(recalls, precisions) if r >= t],
+                    default=0.0)
+                for t in np.linspace(0, 1, 11)
+            ]))
+        # integral AP
+        ap, prev_r = 0.0, 0.0
+        for r, p in zip(recalls, precisions):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return float(ap)
+
+    def finish(self):
+        classes = sorted({c for c, _, _, _ in self.dets} |
+                         {c for _, c in self.gts})
+        aps = []
+        for c in classes:
+            n_gt = sum(len(v) for (img, cc), v in self.gts.items() if cc == c)
+            dets = sorted([d for d in self.dets if d[0] == c],
+                          key=lambda d: -d[1])
+            if n_gt == 0:
+                continue
+            used: dict = {}
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for i, (_, score, img, box) in enumerate(dets):
+                cand = self.gts.get((img, c), [])
+                # VOC rule: only the single max-overlap gt counts; if it is
+                # already claimed by a higher-scoring detection, this is FP
+                best, best_iou = -1, 0.0
+                for j, g in enumerate(cand):
+                    iou = self._iou(box, g)
+                    if iou > best_iou:
+                        best, best_iou = j, iou
+                if best >= 0 and best_iou > self.thr and (
+                        img, c, best) not in used:
+                    tp[i] = 1
+                    used[(img, c, best)] = True
+                else:
+                    fp[i] = 1
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            recalls = ctp / n_gt
+            precisions = ctp / np.maximum(ctp + cfp, 1e-10)
+            aps.append(self._ap(recalls, precisions))
+        return {self.name: float(np.mean(aps)) if aps else 0.0}
+
+
 REGISTRY = {
     c.name: c
     for c in (ClassificationError, SumEvaluator, ColumnSumEvaluator, AUC,
-              PrecisionRecall, PnpairEvaluator, ChunkEvaluator, CTCError)
+              PrecisionRecall, PnpairEvaluator, ChunkEvaluator, CTCError,
+              DetectionMAP)
 }
 
 

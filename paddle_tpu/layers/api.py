@@ -1451,7 +1451,7 @@ def __getattr__(name):
     # lazy re-exports from sibling modules (mixed/crf/ctc/recurrent_group/attention)
     import importlib
 
-    for modname in ("mixed", "extras", "recurrent_group"):
+    for modname in ("mixed", "extras", "recurrent_group", "more", "detection"):
         try:
             mod = importlib.import_module(f"paddle_tpu.layers.{modname}")
         except ImportError:

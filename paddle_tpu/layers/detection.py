@@ -1,0 +1,193 @@
+"""SSD detection layers — priorbox, multibox_loss, detection_output.
+
+Reference parity: ``paddle/gserver/layers/PriorBox.cpp`` (per-cell anchor
+generation), ``MultiBoxLossLayer.cpp`` (bipartite+threshold matching,
+smooth-L1 loc loss, softmax conf loss with 3:1 hard-negative mining) and
+``DetectionOutputLayer.cpp`` (decode + per-class NMS + top-k), over the
+box math in ``paddle_tpu/ops/detection.py``.
+
+Ground truth feeds as a dense ``[B, G_max, 5]`` array of
+``[label, xmin, ymin, xmax, ymax]`` rows padded with label -1 (the
+fixed-shape TPU stand-in for the reference's variable-length label
+sequences)."""
+
+from __future__ import annotations
+
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.ops import detection as D
+from paddle_tpu.ops.loss import smooth_l1
+from paddle_tpu.layers.base import LayerOutput, gen_name, raw
+
+
+def priorbox(input: LayerOutput, image_size, min_size, max_size=(),
+             aspect_ratio=(2.0,), variance=(0.1, 0.1, 0.2, 0.2),
+             name: str | None = None) -> LayerOutput:
+    """≅ priorbox (PriorBoxLayer): one anchor set per feature-map cell.
+
+    Per cell: a min_size square, a sqrt(min*max) square per max_size, and
+    a pair of w/h-swapped boxes per aspect ratio.  Output value
+    [P, 8]: corner box + its 4 variances (priors are data-independent;
+    the array is a compile-time constant folded into the XLA program)."""
+    name = name or gen_name("priorbox")
+    fh, fw = input.height, input.width
+    enforce(fh and fw, "priorbox needs a feature-map input with h/w")
+    img_w, img_h = (image_size if isinstance(image_size, (tuple, list))
+                    else (image_size, image_size))
+    mins = [min_size] if np.isscalar(min_size) else list(min_size)
+    maxs = [max_size] if np.isscalar(max_size) else list(max_size)
+
+    boxes = []
+    step_x, step_y = 1.0 / fw, 1.0 / fh
+    for y in range(fh):
+        for x in range(fw):
+            cx, cy = (x + 0.5) * step_x, (y + 0.5) * step_y
+            for i, ms in enumerate(mins):
+                bw, bh = ms / img_w, ms / img_h
+                boxes.append([cx - bw / 2, cy - bh / 2,
+                              cx + bw / 2, cy + bh / 2])
+                if i < len(maxs):
+                    s = _pymath.sqrt(ms * maxs[i])
+                    bw, bh = s / img_w, s / img_h
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+                for ar in aspect_ratio:
+                    for a in (ar, 1.0 / ar):
+                        bw = ms * _pymath.sqrt(a) / img_w
+                        bh = ms / _pymath.sqrt(a) / img_h
+                        boxes.append([cx - bw / 2, cy - bh / 2,
+                                      cx + bw / 2, cy + bh / 2])
+    prior_arr = np.clip(np.asarray(boxes, np.float32), 0.0, 1.0)
+    n_priors = prior_arr.shape[0]
+    var_arr = np.tile(np.asarray(variance, np.float32), (n_priors, 1))
+    value = np.concatenate([prior_arr, var_arr], axis=1)
+
+    def fwd(ctx, params, states, x):
+        return jnp.asarray(value)
+
+    return LayerOutput(name=name, layer_type="priorbox", size=n_priors * 8,
+                       parents=(input,), fn=fwd,
+                       attrs={"num_priors": n_priors})
+
+
+def _gather_preds(loc_layers, conf_layers, num_classes):
+    """Concatenate per-scale conv outputs into [B, P, 4] / [B, P, C]."""
+
+    def cat(vals, last):
+        flat = [raw(v).reshape(raw(v).shape[0], -1, last) for v in vals]
+        return jnp.concatenate(flat, axis=1)
+
+    return cat(loc_layers, 4), cat(conf_layers, num_classes)
+
+
+def multibox_loss(priors: LayerOutput, label: LayerOutput,
+                  loc_layers, conf_layers, num_classes: int,
+                  overlap_threshold: float = 0.5,
+                  neg_pos_ratio: float = 3.0,
+                  name: str | None = None) -> LayerOutput:
+    """≅ multibox_loss (MultiBoxLossLayer).  Class 0 is background;
+    gt labels are 1-based object classes."""
+    name = name or gen_name("multibox_loss")
+    loc_layers = list(loc_layers)
+    conf_layers = list(conf_layers)
+
+    def fwd(ctx, params, states, pri, lbl, *preds):
+        loc_vals = preds[:len(loc_layers)]
+        conf_vals = preds[len(loc_layers):]
+        loc, conf = _gather_preds(loc_vals, conf_vals, num_classes)
+        prior_boxes = pri[:, :4]
+        variance = pri[0, 4:8]
+        gt = raw(lbl)  # [B, G, 5]
+        b, n_p = loc.shape[0], prior_boxes.shape[0]
+
+        def per_image(loc_i, conf_i, gt_i):
+            gt_valid = (gt_i[:, 0] >= 0).astype(jnp.float32)
+            gt_boxes = gt_i[:, 1:5]
+            match_idx, pos = D.match_priors(
+                prior_boxes, gt_boxes, gt_valid, overlap_threshold)
+            n_pos = jnp.sum(pos)
+            # localisation: smooth-L1 on positives
+            matched = gt_boxes[match_idx]
+            target = D.encode_boxes(matched, prior_boxes, variance)
+            loc_l = jnp.sum(smooth_l1(loc_i, target) * pos)  # [P] masked
+            # confidence: softmax CE; target class = gt label+? (labels are
+            # 1-based already, background 0)
+            cls = jnp.where(pos, gt_i[match_idx, 0].astype(jnp.int32), 0)
+            logp = jax.nn.log_softmax(conf_i, axis=-1)
+            ce = -jnp.take_along_axis(logp, cls[:, None], axis=1)[:, 0]
+            # hard negative mining: top (ratio * n_pos) negatives by loss
+            neg_loss = jnp.where(pos, -jnp.inf, ce)
+            order = jnp.argsort(-neg_loss)
+            rank = jnp.zeros((n_p,), jnp.int32).at[order].set(
+                jnp.arange(n_p, dtype=jnp.int32))
+            n_neg = jnp.minimum(
+                (neg_pos_ratio * n_pos).astype(jnp.int32),
+                n_p - n_pos.astype(jnp.int32))
+            neg = (rank < n_neg) & ~pos
+            conf_l = jnp.sum(ce * (pos | neg))
+            return loc_l, conf_l, n_pos
+
+        loc_l, conf_l, n_pos = jax.vmap(per_image)(loc, conf, gt)
+        denom = jnp.maximum(jnp.sum(n_pos), 1.0)
+        return (jnp.sum(loc_l) + jnp.sum(conf_l)) / denom
+
+    return LayerOutput(
+        name=name, layer_type="multibox_loss", size=1,
+        parents=tuple([priors, label] + loc_layers + conf_layers), fn=fwd,
+        attrs={"num_classes": num_classes,
+               "overlap_threshold": overlap_threshold},
+    )
+
+
+def detection_output(priors: LayerOutput, loc_layers, conf_layers,
+                     num_classes: int, nms_threshold: float = 0.45,
+                     nms_top_k: int = 400, keep_top_k: int = 200,
+                     confidence_threshold: float = 0.01,
+                     name: str | None = None) -> LayerOutput:
+    """≅ detection_output (DetectionOutputLayer): decode + per-class NMS.
+
+    Output [B, keep_top_k, 6] rows [label, score, xmin, ymin, xmax, ymax];
+    empty slots have label -1 (fixed shape instead of the reference's
+    variable-row output)."""
+    name = name or gen_name("detection_output")
+    loc_layers = list(loc_layers)
+    conf_layers = list(conf_layers)
+
+    def fwd(ctx, params, states, pri, *preds):
+        loc_vals = preds[:len(loc_layers)]
+        conf_vals = preds[len(loc_layers):]
+        loc, conf = _gather_preds(loc_vals, conf_vals, num_classes)
+        prior_boxes = pri[:, :4]
+        variance = pri[0, 4:8]
+
+        def per_image(loc_i, conf_i):
+            boxes = D.decode_boxes(loc_i, prior_boxes, variance)
+            probs = jax.nn.softmax(conf_i, axis=-1)  # [P, C]
+            outs = []
+            for c in range(1, num_classes):  # class 0 = background
+                idxs, valid = D.nms(
+                    boxes, probs[:, c], nms_threshold,
+                    max_out=min(nms_top_k, boxes.shape[0]),
+                    score_threshold=confidence_threshold)
+                sel = jnp.clip(idxs, 0)
+                rows = jnp.concatenate([
+                    jnp.where(valid, float(c), -1.0)[:, None],
+                    jnp.where(valid, probs[sel, c], 0.0)[:, None],
+                    boxes[sel] * valid[:, None],
+                ], axis=1)
+                outs.append(rows)
+            allrows = jnp.concatenate(outs, axis=0)
+            top = jnp.argsort(-allrows[:, 1])[:keep_top_k]
+            return allrows[top]
+
+        return jax.vmap(per_image)(loc, conf)
+
+    return LayerOutput(
+        name=name, layer_type="detection_output", size=keep_top_k * 6,
+        parents=tuple([priors] + loc_layers + conf_layers), fn=fwd,
+    )

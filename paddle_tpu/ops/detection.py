@@ -1,0 +1,107 @@
+"""SSD detection box math — iou/encode/decode/match/NMS.
+
+Reference parity: ``paddle/gserver/layers/PriorBox.cpp``,
+``MultiBoxLossLayer.cpp``, ``DetectionOutputLayer.cpp`` and their shared
+``DetectionUtil.cpp``.  TPU-first: everything is fixed-shape and masked —
+matching is a dense [priors, gts] IoU argmax, hard-negative mining is a
+top-k over masked losses, and NMS is a fori_loop over a fixed detection
+budget — so the whole pipeline jits.
+
+Boxes are [xmin, ymin, xmax, ymax] in normalized [0, 1] coordinates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def iou_matrix(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[Na, 4] x [Nb, 4] -> [Na, Nb] intersection-over-union."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0.0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0.0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def encode_boxes(gt: jax.Array, priors: jax.Array,
+                 variance=(0.1, 0.1, 0.2, 0.2)) -> jax.Array:
+    """Corner gt boxes -> (cx, cy, w, h) offsets wrt priors (SSD encoding)."""
+    p_wh = priors[:, 2:] - priors[:, :2]
+    p_c = (priors[:, :2] + priors[:, 2:]) / 2
+    g_wh = jnp.maximum(gt[:, 2:] - gt[:, :2], 1e-6)
+    g_c = (gt[:, :2] + gt[:, 2:]) / 2
+    v = jnp.asarray(variance)
+    d_c = (g_c - p_c) / p_wh / v[:2]
+    d_wh = jnp.log(g_wh / p_wh) / v[2:]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(loc: jax.Array, priors: jax.Array,
+                 variance=(0.1, 0.1, 0.2, 0.2)) -> jax.Array:
+    """Inverse of encode_boxes: predicted offsets -> corner boxes."""
+    p_wh = priors[:, 2:] - priors[:, :2]
+    p_c = (priors[:, :2] + priors[:, 2:]) / 2
+    v = jnp.asarray(variance)
+    c = loc[:, :2] * v[:2] * p_wh + p_c
+    wh = jnp.exp(loc[:, 2:] * v[2:]) * p_wh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+def match_priors(priors: jax.Array, gt_boxes: jax.Array, gt_valid: jax.Array,
+                 overlap_threshold: float = 0.5):
+    """Assign each prior its best gt (SSD bipartite + per-prediction match).
+
+    Returns (matched_gt_idx [P], positive_mask [P]).  Invalid gt rows
+    (gt_valid == 0) never match.  Each valid gt's single best prior is
+    forced positive even below the threshold (the reference's bipartite
+    pass), then any prior over the threshold joins.
+    """
+    p, g = priors.shape[0], gt_boxes.shape[0]
+    iou = iou_matrix(priors, gt_boxes) * gt_valid[None, :]  # [P, G]
+    best_gt = jnp.argmax(iou, axis=1)  # [P]
+    pos = jnp.max(iou, axis=1) > overlap_threshold
+    # bipartite pass: each valid gt claims its best prior (scatter; invalid
+    # gts scatter out-of-bounds and are dropped)
+    best_prior = jnp.argmax(iou, axis=0)  # [G]
+    target = jnp.where(gt_valid > 0, best_prior, p)
+    forced_gt = jnp.full((p,), -1, jnp.int32).at[target].set(
+        jnp.arange(g, dtype=jnp.int32), mode="drop")
+    best_gt = jnp.where(forced_gt >= 0, forced_gt, best_gt)
+    return best_gt, pos | (forced_gt >= 0)
+
+
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float = 0.45,
+        max_out: int = 100, score_threshold: float = 0.01):
+    """Fixed-budget greedy NMS: returns (indices [max_out], valid [max_out]).
+
+    jit-friendly: a fori_loop picks the best remaining box max_out times,
+    suppressing overlaps each round."""
+    n = boxes.shape[0]
+    iou = iou_matrix(boxes, boxes)
+    alive = scores > score_threshold
+
+    def body(i, carry):
+        alive, idxs, valid = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        idxs = idxs.at[i].set(jnp.where(ok, best, -1))
+        valid = valid.at[i].set(ok)
+        suppress = (iou[best] >= iou_threshold) & ok
+        alive = alive & ~suppress & (jnp.arange(n) != best)
+        return alive, idxs, valid
+
+    _, idxs, valid = lax.fori_loop(
+        0, max_out, body,
+        (alive, jnp.full((max_out,), -1, jnp.int32),
+         jnp.zeros((max_out,), bool)),
+    )
+    return idxs, valid

@@ -1,0 +1,185 @@
+"""Detection suite (priorbox / multibox_loss / detection_output) + the
+registry-parity layer sweep (prelu, multiplex, tensor, selective_fc, ...).
+The reference tests detection in test_LayerGrad + DetectionUtil tests;
+here: box-math invariants, a planted-box recovery test, and a learning
+test for the loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type, detection as det, more
+from paddle_tpu.ops import detection as D
+
+
+def test_iou_encode_decode_roundtrip(rng_np):
+    boxes = np.sort(rng_np.random((10, 4)).astype(np.float32), axis=-1)
+    priors = np.sort(rng_np.random((7, 4)).astype(np.float32), axis=-1)
+    iou = np.asarray(D.iou_matrix(jnp.asarray(boxes), jnp.asarray(boxes)))
+    np.testing.assert_allclose(np.diag(iou), 1.0, atol=1e-5)
+    assert np.all(iou >= 0) and np.all(iou <= 1 + 1e-6)
+    # encode/decode inverse
+    m = min(len(boxes), len(priors))
+    enc = D.encode_boxes(jnp.asarray(boxes[:m]), jnp.asarray(priors[:m]))
+    dec = D.decode_boxes(enc, jnp.asarray(priors[:m]))
+    np.testing.assert_allclose(np.asarray(dec), boxes[:m], atol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([
+        [0.1, 0.1, 0.4, 0.4],
+        [0.11, 0.11, 0.41, 0.41],  # heavy overlap with 0
+        [0.6, 0.6, 0.9, 0.9],
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idxs, valid = D.nms(boxes, scores, iou_threshold=0.5, max_out=3)
+    kept = [int(i) for i, v in zip(idxs, valid) if bool(v)]
+    assert kept == [0, 2]
+
+
+def test_ssd_pipeline_learns_and_detects():
+    """2-class toy SSD on a 4x4 feature map: the loss decreases and
+    detection_output recovers a planted box."""
+    fm = layer.data(name="feat", type=data_type.dense_vector(4 * 4 * 8),
+                    height=4, width=4)
+    fm.depth = 8
+    priors = det.priorbox(fm, image_size=64, min_size=16,
+                          aspect_ratio=(2.0,))
+    n_priors = priors.attrs["num_priors"]
+    per_cell = n_priors // 16
+    from paddle_tpu.layers import activation as act
+
+    loc = layer.fc(input=fm, size=n_priors * 4, act=act.LinearActivation())
+    conf = layer.fc(input=fm, size=n_priors * 2, act=act.LinearActivation())
+    gt = layer.data(name="gt", type=data_type.dense_vector(2 * 5),
+                    height=2, width=5)
+
+    cost = det.multibox_loss(priors, _as_gt(gt, 2), [loc], [conf],
+                             num_classes=2)
+    out = det.detection_output(priors, [loc], [conf], num_classes=2,
+                               keep_top_k=5)
+
+    parameters = paddle.parameters.create(paddle.topology.Topology([cost, out]))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2),
+    )
+
+    rng = np.random.default_rng(0)
+
+    def reader():
+        # one object at a fixed location keyed by the feature content
+        for _ in range(512):
+            which = int(rng.integers(0, 2))
+            feat = np.zeros((4, 4, 8), np.float32)
+            box = (0.1, 0.1, 0.35, 0.35) if which == 0 else (0.6, 0.55, 0.85, 0.9)
+            cell = (1, 1) if which == 0 else (2, 2)
+            feat[cell[0], cell[1], :] = 1.0
+            feat += rng.normal(0, 0.05, feat.shape)
+            g = np.full((2, 5), -1, np.float32)
+            g[0] = [1, *box]
+            yield feat.reshape(-1), g.reshape(-1)
+
+    feeding = {"feat": 0, "gt": 1}
+    costs = []
+    trainer.train(reader=paddle.reader.batch(reader, 32), num_passes=10,
+                  feeding=feeding,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.5, (costs[0], costs[-1])
+
+    # inference: detection_output finds the planted box
+    feat = np.zeros((4, 4, 8), np.float32)
+    feat[1, 1, :] = 1.0
+    g = np.full((2, 5), -1, np.float32)
+    dets = paddle.infer(output_layer=out, parameters=trainer.parameters,
+                        input=[(feat.reshape(-1), g.reshape(-1))],
+                        feeding=feeding)
+    dets = np.asarray(dets).reshape(-1, 6)
+    best = dets[np.argmax(dets[:, 1])]
+    assert best[0] == 1.0  # class 1 detected
+    iou = float(D.iou_matrix(
+        jnp.asarray(best[None, 2:6]),
+        jnp.asarray([[0.1, 0.1, 0.35, 0.35]]))[0, 0])
+    assert iou > 0.3, (best, iou)
+
+
+def _as_gt(gt_layer, g_max):
+    """View a dense [B, g*5] feed as [B, g, 5] for multibox_loss."""
+    from paddle_tpu.layers.base import LayerOutput, gen_name, raw
+
+    name = gen_name("gt_view")
+
+    def fwd(ctx, params, states, x):
+        v = raw(x)
+        return v.reshape(v.shape[0], g_max, 5)
+
+    return LayerOutput(name=name, layer_type="reshape", size=gt_layer.size,
+                       parents=(gt_layer,), fn=fwd)
+
+
+def test_more_layers_smoke(rng_np):
+    """prelu / multiplex / tensor / selective_fc / conv_shift / scale_shift
+    / resize / data_norm forward semantics."""
+    from paddle_tpu.config.topology import Topology
+
+    x = layer.data(name="x", type=data_type.dense_vector(6))
+    y = layer.data(name="y", type=data_type.dense_vector(6))
+    idx = layer.data(name="idx", type=data_type.integer_value(2))
+    k = layer.data(name="k", type=data_type.dense_vector(3))
+
+    nodes = {
+        "prelu": more.prelu(x),
+        "multiplex": more.multiplex([idx, x, y]),
+        "tensor": more.tensor_layer(x, y, size=3),
+        "selective_fc": more.selective_fc(x, y, size=6),
+        "conv_shift": more.conv_shift(x, k),
+        "scale_shift": more.scale_shift(x),
+        "resize": more.resize(x, 3),
+        "data_norm": more.data_norm(x),
+    }
+    topo = Topology(list(nodes.values()))
+    params = paddle.parameters.create(topo).as_dict()
+    xv = rng_np.normal(size=(2, 6)).astype(np.float32)
+    yv = rng_np.normal(size=(2, 6)).astype(np.float32)
+    kv = np.asarray([0, 1])
+    kern = rng_np.normal(size=(2, 3)).astype(np.float32)
+    values, _ = topo.forward(params, topo.init_states(),
+                             {"x": xv, "y": yv, "idx": kv, "k": kern}, False,
+                             jax.random.key(0))
+    # prelu: slope 0.25 on negatives
+    np.testing.assert_allclose(
+        np.asarray(values[nodes["prelu"].name]),
+        np.where(xv > 0, xv, 0.25 * xv), atol=1e-6)
+    # multiplex row 0 from x, row 1 from y
+    mv = np.asarray(values[nodes["multiplex"].name])
+    np.testing.assert_allclose(mv[0], xv[0], atol=1e-6)
+    np.testing.assert_allclose(mv[1], yv[1], atol=1e-6)
+    assert np.asarray(values[nodes["tensor"].name]).shape == (2, 3)
+    assert np.asarray(values[nodes["resize"].name]).shape == (4, 3)
+    # scale_shift starts as identity (w=1, b=0)
+    np.testing.assert_allclose(
+        np.asarray(values[nodes["scale_shift"].name]), xv, atol=1e-6)
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.evaluator import DetectionMAP
+
+    ev = DetectionMAP(overlap_threshold=0.5)
+    # image 0: one gt of class 1; a perfect detection + a false positive
+    ev.eval_batch(
+        detections=[[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                     [1, 0.3, 0.6, 0.6, 0.9, 0.9]]],
+        gts=[[[1, 0.1, 0.1, 0.4, 0.4]]],
+    )
+    m = ev.finish()["detection_map"]
+    assert 0.99 <= m <= 1.0  # the tp outranks the fp at every threshold
+
+    ev.start()
+    ev.eval_batch(  # detection misses entirely
+        detections=[[[1, 0.9, 0.5, 0.5, 0.6, 0.6]]],
+        gts=[[[1, 0.1, 0.1, 0.4, 0.4]]],
+    )
+    assert ev.finish()["detection_map"] == 0.0
