@@ -562,3 +562,346 @@ def _load(ins, attrs, rng):
     if not path.endswith(".npy"):
         path += ".npy"
     return {"Out": [jnp.asarray(np.load(path))]}
+
+
+# --------------------------------------------------------------------------
+# op-registry breadth batch (operators/*.cc parity): losses, tensor ops,
+# remaining optimizers, comparisons, metrics
+# --------------------------------------------------------------------------
+
+@register_op("sign")
+def _sign(ins, attrs, rng):
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register_op("minus")
+def _minus(ins, attrs, rng):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("gather")
+def _gather(ins, attrs, rng):
+    return {"Out": [ins["X"][0][ins["Index"][0].astype(jnp.int32)]]}
+
+
+@register_op("scatter")
+def _scatter(ins, attrs, rng):
+    ref, idx, upd = ins["Ref"][0], ins["Index"][0], ins["Updates"][0]
+    return {"Out": [ref.at[idx.astype(jnp.int32)].set(upd)]}
+
+
+@register_op("split")
+def _split(ins, attrs, rng):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    if attrs.get("sections"):
+        idx = np.cumsum(attrs["sections"])[:-1]
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, attrs.get("num", 1), axis=axis)
+    return {"Out": list(parts)}
+
+
+@register_op("pad")
+def _pad(ins, attrs, rng):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # flat [lo0, hi0, lo1, hi1, ...]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("crop")
+def _crop(ins, attrs, rng):
+    x = ins["X"][0]
+    offsets = attrs["offsets"]
+    shape = attrs["shape"]
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[sl]]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ins, attrs, rng):
+    x = ins["X"][0]
+    norm = jnp.sqrt(jnp.sum(x * x) + 1e-12)
+    return {"Out": [x * jnp.minimum(1.0, attrs["max_norm"] / norm)]}
+
+
+@register_op("multiplex")
+def _multiplex_op(ins, attrs, rng):
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # [N, B, D]
+    return {"Out": [jnp.take_along_axis(stacked, ids[None, :, None],
+                                        axis=0)[0]]}
+
+
+@register_op("prelu")
+def _prelu_op(ins, attrs, rng):
+    x, a = ins["X"][0], ins["Alpha"][0]
+    if a.size == 1:
+        slope = a.reshape(())
+    elif x.ndim == 4 and a.size == x.shape[1]:  # channel-wise on NCHW
+        slope = a.reshape(1, -1, 1, 1)
+    else:
+        slope = a
+    return {"Out": [jnp.where(x > 0, x, x * slope)]}
+
+
+@register_op("conv_shift")
+def _conv_shift_op(ins, attrs, rng):
+    x, y = ins["X"][0], ins["Y"][0]
+    m = y.shape[-1] // 2
+    idx = (jnp.arange(x.shape[-1])[:, None]
+           + jnp.arange(-m, m + 1)[None, :]) % x.shape[-1]
+    return {"Out": [jnp.einsum("bnk,bk->bn", x[:, idx], y)]}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_like(ins, attrs, rng):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)]
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0),
+                             _np_dtype(attrs.get("dtype", "float32")))]}
+
+
+def _np_dtype(d):
+    # proto enum codes: 2=INT32, 3=INT64, 5=FP32, 6=FP64 (int64 maps to
+    # int32 — the framework-wide id dtype with x64 disabled)
+    return {"float32": jnp.float32, "float64": jnp.float64,
+            "int32": jnp.int32, "int64": jnp.int32,
+            2: jnp.int32, 3: jnp.int32, 5: jnp.float32,
+            6: jnp.float64}.get(d, jnp.float32)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ins, attrs, rng):
+    from paddle_tpu.ops import nn as nn_ops
+
+    x, w = ins["Input"][0], ins["Filter"][0]
+    # fluid stores NCHW + [ci, co, kh, kw]; the kernel wants NHWC +
+    # (kh, kw, co, ci) (lax.conv_transpose transpose_kernel layout)
+    y = nn_ops.conv2d_transpose(
+        x.transpose(0, 2, 3, 1), w.transpose(2, 3, 1, 0),
+        attrs.get("strides", (1, 1)), tuple(attrs.get("paddings", (0, 0))))
+    return {"Output": [y.transpose(0, 3, 1, 2)]}
+
+
+@register_op("pool2d_with_index")
+def _pool2d_with_index(ins, attrs, rng):
+    x = ins["X"][0]  # NCHW
+    b, c, h, w = x.shape
+    if attrs.get("global_pooling"):
+        k, s, p = [h, w], [1, 1], [0, 0]
+    else:
+        k = attrs["ksize"]
+        s = attrs.get("strides", k)
+        p = attrs.get("paddings", [0, 0])
+    if p[0] or p[1]:
+        x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                    constant_values=-jnp.inf)
+    # one patch-extraction op instead of oh*ow slices
+    patches = jax.lax.conv_general_dilated_patches(
+        x, k, s, "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(b, c, k[0] * k[1], oh, ow)
+    out = jnp.max(patches, axis=2)
+    arg = jnp.argmax(patches, axis=2)  # window-local index, like the ref
+    return {"Out": [out], "Mask": [arg.astype(jnp.int32)]}
+
+
+# ---- losses ----
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ins, attrs, rng):
+    x = ins["X"][0]
+    return {"Out": [jnp.sum(x * x).reshape(1)]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ins, attrs, rng):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape(1)]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ins, attrs, rng):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"sub_result": [d],
+            "Out": [jnp.sum(d * d, axis=-1, keepdims=True)]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1_loss(ins, attrs, rng):
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    d = ins["X"][0] - ins["Y"][0]
+    if "InsideWeight" in ins:
+        d = d * ins["InsideWeight"][0]
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / sigma2, 0.5 * d * d * sigma2,
+                     a - 0.5 / sigma2)
+    if "OutsideWeight" in ins:
+        loss = loss * ins["OutsideWeight"][0]
+    return {"Diff": [d], "Out": [jnp.sum(loss, axis=-1, keepdims=True)]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ins, attrs, rng):
+    delta = attrs.get("delta", 1.0)
+    r = ins["Y"][0] - ins["X"][0]
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return {"Residual": [r], "Out": [loss]}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ins, attrs, rng):
+    # binary labels {0,1} -> {-1,1}; quadratically-smoothed hinge
+    y = ins["Y"][0] * 2.0 - 1.0
+    z = ins["X"][0] * y
+    loss = jnp.where(z >= -1.0, jnp.maximum(0.0, 1.0 - z) ** 2, -4.0 * z)
+    return {"IntermediateVal": [z], "Out": [loss]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ins, attrs, rng):
+    o = ins["Left"][0] - ins["Right"][0]
+    t = ins["Label"][0]
+    return {"Out": [jnp.logaddexp(0.0, o) - t * o]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ins, attrs, rng):
+    margin = attrs.get("margin", 0.0)
+    o = ins["X1"][0] - ins["X2"][0]
+    t = ins["Label"][0]
+    act = jnp.maximum(0.0, margin - t * o)
+    return {"Activated": [(act > 0).astype(o.dtype)], "Out": [act]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_xent(ins, attrs, rng):
+    x, t = ins["X"][0], ins["Label"][0]
+    return {"Out": [jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))]}
+
+
+# ---- remaining optimizers as ops ----
+
+@register_op("adadelta")
+def _adadelta(ins, attrs, rng):
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ag, au = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    ag2 = rho * ag + (1 - rho) * g * g
+    upd = -jnp.sqrt(au + eps) / jnp.sqrt(ag2 + eps) * g
+    au2 = rho * au + (1 - rho) * upd * upd
+    return {"ParamOut": [p + upd], "AvgSquaredGradOut": [ag2],
+            "AvgSquaredUpdateOut": [au2]}
+
+
+@register_op("rmsprop")
+def _rmsprop(ins, attrs, rng):
+    rho, eps = attrs.get("decay", 0.9), attrs.get("epsilon", 1e-6)
+    mom = attrs.get("momentum", 0.0)
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mo = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    ms2 = rho * ms + (1 - rho) * g * g
+    mo2 = mom * mo + lr * g / jnp.sqrt(ms2 + eps)
+    return {"ParamOut": [p - mo2], "MeanSquareOut": [ms2],
+            "MomentOut": [mo2]}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ins, attrs, rng):
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    prox = p - lr * g
+    out = (jnp.sign(prox) / (1 + lr * l2)
+           * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0))
+    return {"ParamOut": [out]}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ins, attrs, rng):
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m = ins["Moment"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    m2 = m + g * g
+    alr = lr / jnp.sqrt(m2 + 1e-12)
+    prox = p - alr * g
+    out = (jnp.sign(prox) / (1 + alr * l2)
+           * jnp.maximum(jnp.abs(prox) - alr * l1, 0.0))
+    return {"ParamOut": [out], "MomentOut": [m2]}
+
+
+@register_op("ftrl")
+def _ftrl(ins, attrs, rng):
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    sq2 = sq + g * g
+    sigma = (jnp.power(sq2, -power) - jnp.power(sq, -power)) / lr
+    lin2 = lin + g - sigma * p
+    quad = jnp.power(sq2, -power) / lr + 2 * l2
+    pre = jnp.clip(lin2, -l1, l1) - lin2
+    return {"ParamOut": [pre / quad], "SquaredAccumOut": [sq2],
+            "LinearAccumOut": [lin2]}
+
+
+# ---- comparisons / metrics ----
+
+@register_op("less_than")
+def _less_than(ins, attrs, rng):
+    return {"Out": [ins["X"][0] < ins["Y"][0]]}
+
+
+@register_op("equal")
+def _equal(ins, attrs, rng):
+    return {"Out": [ins["X"][0] == ins["Y"][0]]}
+
+
+@register_op("auc")
+def _auc(ins, attrs, rng):
+    """Batch-local AUC via thresholded confusion counts (the reference auc_op
+    is likewise batch-local; streaming AUC lives in the evaluator)."""
+    probs = ins["Out"][0][:, 1] if ins["Out"][0].ndim == 2 else ins["Out"][0]
+    labels = ins["Label"][0].reshape(-1)
+    thr = jnp.linspace(0.0, 1.0, attrs.get("num_thresholds", 200))
+    pred = probs[None, :] >= thr[:, None]
+    pos = (labels > 0)[None, :]
+    tp = jnp.sum(pred & pos, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred & ~pos, axis=1).astype(jnp.float32)
+    tpr = tp / jnp.maximum(jnp.sum(pos), 1)
+    fpr = fp / jnp.maximum(jnp.sum(~pos), 1)
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc.reshape(1)]}
+
+
+@register_op("precision_recall")
+def _precision_recall(ins, attrs, rng):
+    preds = (ins["Indices"][0].reshape(-1) if "Indices" in ins
+             else jnp.argmax(ins["MaxProbs"][0], axis=-1))
+    labels = ins["Labels"][0].reshape(-1)
+    c = attrs["class_number"]
+    onehot_p = jax.nn.one_hot(preds, c)
+    onehot_l = jax.nn.one_hot(labels, c)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1 - onehot_l), axis=0)
+    fn = jnp.sum((1 - onehot_p) * onehot_l, axis=0)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    recall = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-8)
+    micro_p = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1.0)
+    micro_r = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1.0)
+    micro_f1 = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-8)
+    metrics = jnp.stack([
+        jnp.mean(precision), jnp.mean(recall), jnp.mean(f1),
+        micro_p, micro_r, micro_f1,
+    ])
+    return {"BatchMetrics": [metrics]}

@@ -287,7 +287,9 @@ def img_conv(
     def fwd(ctx, params, states, x):
         x = _to_nhwc(raw(x), c_in, h_in, w_in)
         if trans:
-            y = nn_ops.conv2d_transpose(x, params[wspec.name], (sh, sw), (ph, pw))
+            # lax.conv_transpose(transpose_kernel=True) wants (kh,kw,co,ci)
+            y = nn_ops.conv2d_transpose(
+                x, params[wspec.name].transpose(0, 1, 3, 2), (sh, sw), (ph, pw))
         else:
             y = nn_ops.conv2d(
                 x, params[wspec.name], (sh, sw), (ph, pw), dilation=dilation, groups=groups

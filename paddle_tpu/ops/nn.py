@@ -61,13 +61,16 @@ def conv2d_transpose(
     """Transposed conv (≅ ConvTransLayer / conv2d_transpose_op)."""
     stride = _pair(stride)
     ph, pw = _pair(padding)
+    kh, kw = w.shape[0], w.shape[1]
     out_dtype = x.dtype
     x, w = dt.cast_for_matmul(x, w)
+    # padding here is the FORWARD conv's padding (out = (in-1)s + k - 2p);
+    # lax.conv_transpose pads the dilated input, where that equals k-1-p
     y = lax.conv_transpose(
         x,
         w,
         strides=stride,
-        padding=[(ph, ph), (pw, pw)],
+        padding=[(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         transpose_kernel=True,
     )
