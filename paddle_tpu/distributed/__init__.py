@@ -12,3 +12,4 @@ from paddle_tpu.distributed.master import (  # noqa: F401
     MasterServer,
     master_reader,
 )
+from paddle_tpu.distributed import multihost  # noqa: F401

@@ -1,0 +1,186 @@
+"""Multi-host / multi-slice execution surface.
+
+Reference parity: the trainer fleet plumbing — ``paddle/scripts/
+cluster_train/paddle.py`` (SSH launcher), gflags ``trainer_id`` /
+``num_gradient_servers`` (``utils/Flags.h``), and the Go master/pserver
+control plane.  TPU-native: every host runs the SAME program under
+``jax.distributed``; data-plane communication happens INSIDE compiled
+steps over ICI (intra-slice) and DCN (cross-slice) collectives, so the
+only host-side pieces are initialization, mesh construction, and
+per-host input sharding (this module) plus the elastic master
+(distributed/master.py).
+
+Typical pod usage::
+
+    from paddle_tpu.distributed import multihost as mh
+    mh.initialize()                       # jax.distributed on each host
+    mesh = mh.pod_mesh(data=None, model=4)  # data axis = rest of the pod
+    reader = mh.shard_reader(reader)      # this host's slice of the data
+
+Multi-slice (DCN) usage::
+
+    mesh = mh.multislice_mesh(num_slices=4, model=4)
+    # axes: ("dcn", "data", "model") — put pure data parallelism on "dcn"
+    # so only gradient all-reduces cross the slower DCN links.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+
+# multi-host jobs advertise a coordinator; single-host TPU VMs do NOT
+# (TPU_WORKER_HOSTNAMES exists even on one-host VMs, so it's no signal)
+_CLUSTER_ENV_VARS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                     "MEGASCALE_COORDINATOR_ADDRESS")
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """``jax.distributed.initialize`` with TPU auto-detection.
+
+    On Cloud TPU pods all arguments auto-detect from the environment; on
+    CPU/GPU fleets pass them explicitly (≅ the reference's
+    ``--trainer_id``/``--num_gradient_servers``/``--pservers`` flags).
+    With neither an explicit coordinator nor cluster environment variables
+    this is a no-op (single-process dev/tests) — it deliberately does NOT
+    probe jax first, since touching the backend before
+    ``jax.distributed.initialize`` would poison multi-host init.
+    Initialization failures in a real cluster RAISE (a host silently
+    falling back to single-process would train a disjoint model)."""
+    dist_state = getattr(jax.distributed, "is_initialized", None)
+    if dist_state is not None and jax.distributed.is_initialized():
+        return
+    explicit = coordinator_address is not None
+    if not explicit and not any(os.environ.get(k) for k in _CLUSTER_ENV_VARS):
+        return  # single-process run
+    kwargs = {}
+    if explicit:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def _axis_sizes(n_devices: int, axes: dict[str, int | None]) -> dict[str, int]:
+    """Resolve one ``None`` axis to 'whatever is left'."""
+    sizes = dict(axes)
+    fixed = int(np.prod([v for v in sizes.values() if v]))
+    free = [k for k, v in sizes.items() if v is None]
+    if len(free) > 1:
+        raise ValueError("at most one axis may be None")
+    if free:
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes {fixed}")
+        sizes[free[0]] = n_devices // fixed
+    if int(np.prod(list(sizes.values()))) != n_devices:
+        raise ValueError(f"axes {sizes} != {n_devices} devices")
+    return sizes
+
+
+def pod_mesh(devices=None, **axes: int | None) -> "jax.sharding.Mesh":
+    """Mesh over all devices of this (single-slice) job.
+
+    ``pod_mesh(data=None, model=4)`` — named axes in call order; one axis
+    may be None, taking the remaining device count.  Uses
+    ``mesh_utils.create_device_mesh`` so the axis order maps onto the
+    physical torus (contiguous model groups ride the fastest ICI links)."""
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    if not axes:
+        axes = {"data": None}
+    sizes = _axis_sizes(len(devices), axes)
+    shape = tuple(sizes.values())
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def multislice_mesh(num_slices: int, devices=None,
+                    **ici_axes: int | None) -> "jax.sharding.Mesh":
+    """Mesh whose leading ``dcn`` axis spans slices and remaining axes
+    span each slice's ICI torus.
+
+    Shardings that only batch over ``dcn`` (pure DP) keep all tensor/seq
+    collectives on ICI — the scaling-book recipe for multi-slice.  Devices
+    are grouped by ``slice_index`` when the runtime exposes it (real
+    multi-slice jobs), else split contiguously (tests)."""
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) % num_slices:
+        raise ValueError(f"{len(devices)} devices % {num_slices} slices != 0")
+    per_slice = len(devices) // num_slices
+    if hasattr(devices[0], "slice_index"):
+        by_slice: dict[int, list] = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        groups = [list(devices[i * per_slice:(i + 1) * per_slice])
+                  for i in range(num_slices)]
+    from jax.experimental import mesh_utils
+
+    sizes = _axis_sizes(per_slice, ici_axes or {"data": None})
+    ici_shape = tuple(sizes.values())
+    slice_meshes = []
+    for g in groups:  # torus-map the ICI axes within each slice
+        try:
+            slice_meshes.append(mesh_utils.create_device_mesh(
+                ici_shape, devices=g))
+        except (ValueError, AssertionError):
+            slice_meshes.append(np.asarray(g).reshape(ici_shape))
+    dev_array = np.stack(slice_meshes, axis=0)
+    return Mesh(dev_array, ("dcn",) + tuple(sizes.keys()))
+
+
+def shard_reader(reader, index: int | None = None,
+                 count: int | None = None):
+    """This host reads its element of every COMPLETE round of ``count``
+    samples (≅ cluster_files_split / the Go master handing disjoint
+    tasks).  A trailing partial round is dropped on every host, so all
+    hosts see the same number of samples — otherwise the host with one
+    extra batch would block forever inside its step's collectives."""
+    index = process_index() if index is None else index
+    count = process_count() if count is None else count
+
+    def sharded():
+        round_buf = []
+        for sample in reader():
+            round_buf.append(sample)
+            if len(round_buf) == count:
+                yield round_buf[index]
+                round_buf = []
+
+    return sharded
+
+
+def global_batch(local_arrays, mesh, spec=None):
+    """Assemble per-host arrays into one globally-sharded array
+    (``jax.make_array_from_process_local_data``) — the input side of
+    multi-host data parallelism."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, spec if spec is not None
+                             else P(mesh.axis_names[0]))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        local_arrays,
+    )

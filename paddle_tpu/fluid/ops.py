@@ -707,8 +707,14 @@ def _pool2d_with_index(ins, attrs, rng):
     oh, ow = patches.shape[2], patches.shape[3]
     patches = patches.reshape(b, c, k[0] * k[1], oh, ow)
     out = jnp.max(patches, axis=2)
-    arg = jnp.argmax(patches, axis=2)  # window-local index, like the ref
-    return {"Out": [out], "Mask": [arg.astype(jnp.int32)]}
+    local = jnp.argmax(patches, axis=2)  # [B, C, OH, OW] in-window index
+    # reference Mask is the GLOBAL index h*W_in + w of the original map
+    # (paddle/operators/math/pooling.cc MaxPool2dWithIndex)
+    oi = jax.lax.broadcasted_iota(jnp.int32, (b, c, oh, ow), 2)
+    oj = jax.lax.broadcasted_iota(jnp.int32, (b, c, oh, ow), 3)
+    hi = oi * s[0] + local // k[1] - p[0]
+    wi = oj * s[1] + local % k[1] - p[1]
+    return {"Out": [out], "Mask": [(hi * w + wi).astype(jnp.int32)]}
 
 
 # ---- losses ----

@@ -287,6 +287,7 @@ def img_conv(
     def fwd(ctx, params, states, x):
         x = _to_nhwc(raw(x), c_in, h_in, w_in)
         if trans:
+            enforce(groups == 1, "transposed conv does not support groups")
             # lax.conv_transpose(transpose_kernel=True) wants (kh,kw,co,ci)
             y = nn_ops.conv2d_transpose(
                 x, params[wspec.name].transpose(0, 1, 3, 2), (sh, sw), (ph, pw))

@@ -58,7 +58,11 @@ def conv2d(
 def conv2d_transpose(
     x: jax.Array, w: jax.Array, stride=1, padding=0, groups: int = 1
 ) -> jax.Array:
-    """Transposed conv (≅ ConvTransLayer / conv2d_transpose_op)."""
+    """Transposed conv (≅ ConvTransLayer / conv2d_transpose_op).
+    ``w`` layout (kh, kw, c_out, c_in); grouped transposed conv is not
+    supported (lax.conv_transpose has no feature_group_count)."""
+    if groups != 1:
+        raise NotImplementedError("conv2d_transpose with groups > 1")
     stride = _pair(stride)
     ph, pw = _pair(padding)
     kh, kw = w.shape[0], w.shape[1]
