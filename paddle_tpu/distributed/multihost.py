@@ -178,8 +178,14 @@ def global_batch(local_arrays, mesh, spec=None):
     multi-host data parallelism."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sharding = NamedSharding(mesh, spec if spec is not None
-                             else P(mesh.axis_names[0]))
+    if spec is None:
+        # batch over the DATA-parallel axes, not whatever axis is first
+        batch_axes = tuple(a for a in mesh.axis_names if a in ("dcn", "data"))
+        if not batch_axes:
+            raise ValueError(
+                "mesh has no 'dcn'/'data' axis; pass spec= explicitly")
+        spec = P(batch_axes)
+    sharding = NamedSharding(mesh, spec)
     return jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(sharding, x),
         local_arrays,

@@ -293,3 +293,122 @@ def crf_error(input, label, size=None, param_attr=None, name=None):
 
     return crf_decoding(input=input, size=size, label=label,
                         param_attr=param_attr, name=name)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+
+def img_conv3d(input: LayerOutput, filter_size, num_filters: int,
+               num_channels: int | None = None, img_size=None,
+               stride=1, padding=0, act=None, param_attr=None,
+               bias_attr=None, trans: bool = False,
+               name: str | None = None) -> LayerOutput:
+    """≅ conv3d / deconv3d (Conv3DLayer/DeConv3DLayer): NDHWC volumes.
+    ``img_size`` = (depth, height, width) of the input volume (v1 flat rows
+    carry no 3-D metadata)."""
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+
+    name = name or gen_name("conv3d" if not trans else "deconv3d")
+    kd, kh, kw = _triple(filter_size)
+    sd, sh, sw = _triple(stride)
+    pd, ph, pw = _triple(padding)
+    c_in = num_channels or input.depth or 1
+    img_size = img_size or input.attrs.get("out_vol")
+    enforce(img_size is not None, "img_conv3d needs img_size=(d, h, w)")
+    d_in, h_in, w_in = img_size
+    if trans:
+        d_out = (d_in - 1) * sd + kd - 2 * pd
+        h_out = (h_in - 1) * sh + kh - 2 * ph
+        w_out = (w_in - 1) * sw + kw - 2 * pw
+    else:
+        d_out = (d_in + 2 * pd - kd) // sd + 1
+        h_out = (h_in + 2 * ph - kh) // sh + 1
+        w_out = (w_in + 2 * pw - kw) // sw + 1
+    w = _wspec(param_attr, name, "w0", (kd, kh, kw, c_in, num_filters),
+               I.msra())
+    specs = [w]
+    use_bias = bias_attr is not False
+    if use_bias:
+        b = _wspec(bias_attr if not isinstance(bias_attr, bool) else None,
+                   name, "wbias", (num_filters,), I.constant(0.0))
+        specs.append(b)
+    activation = act_mod.get(act) if act is not None else act_mod.ReluActivation()
+
+    def fwd(ctx, params, states, x):
+        v = raw(x)
+        if v.ndim == 2:
+            v = v.reshape(-1, c_in, d_in, h_in, w_in).transpose(0, 2, 3, 4, 1)
+        if trans:
+            y = _lax.conv_transpose(
+                v, params[w.name].transpose(0, 1, 2, 4, 3),
+                strides=(sd, sh, sw),
+                padding=[(kd - 1 - pd,) * 2, (kh - 1 - ph,) * 2,
+                         (kw - 1 - pw,) * 2],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                transpose_kernel=True)
+        else:
+            y = _lax.conv_general_dilated(
+                v, params[w.name], window_strides=(sd, sh, sw),
+                padding=[(pd, pd), (ph, ph), (pw, pw)],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if use_bias:
+            y = y + params[b.name]
+        return activation(y)
+
+    node = LayerOutput(
+        name=name, layer_type="deconv3d" if trans else "conv3d",
+        size=num_filters * d_out * h_out * w_out, parents=(input,),
+        param_specs=tuple(specs), fn=fwd, depth=num_filters,
+        attrs={"out_vol": [d_out, h_out, w_out]},
+    )
+    return node
+
+
+def img_pool3d(input: LayerOutput, pool_size, img_size=None,
+               num_channels: int | None = None, stride=None, padding=0,
+               pool_type: str = "max", name: str | None = None) -> LayerOutput:
+    """≅ pool3d (Pool3DLayer): max/avg pooling over NDHWC volumes."""
+    import jax.numpy as _jnp
+    from jax import lax as _lax
+
+    name = name or gen_name("pool3d")
+    kd, kh, kw = _triple(pool_size)
+    sd, sh, sw = _triple(stride if stride is not None else pool_size)
+    pd, ph, pw = _triple(padding)
+    c = num_channels or input.depth or 1
+    vol = img_size or input.attrs.get("out_vol")
+    enforce(vol is not None, "img_pool3d needs img_size or a conv3d input")
+    d_in, h_in, w_in = vol
+    # ceil output sizes, like the reference pool layers and 2D img_pool
+    d_out = -(-(d_in + 2 * pd - kd) // sd) + 1
+    h_out = -(-(h_in + 2 * ph - kh) // sh) + 1
+    w_out = -(-(w_in + 2 * pw - kw) // sw) + 1
+    # extra right-padding so reduce_window emits the ceil-mode windows
+    xd = (d_out - 1) * sd + kd - (d_in + 2 * pd)
+    xh = (h_out - 1) * sh + kh - (h_in + 2 * ph)
+    xw = (w_out - 1) * sw + kw - (w_in + 2 * pw)
+    pads = ((0, 0), (pd, pd + xd), (ph, ph + xh), (pw, pw + xw), (0, 0))
+
+    def fwd(ctx, params, states, x):
+        v = raw(x)
+        if v.ndim == 2:
+            v = v.reshape(-1, c, d_in, h_in, w_in).transpose(0, 2, 3, 4, 1)
+        if pool_type == "max":
+            return _lax.reduce_window(
+                v, -_jnp.inf, _lax.max, (1, kd, kh, kw, 1),
+                (1, sd, sh, sw, 1), pads)
+        summed = _lax.reduce_window(
+            v, 0.0, _lax.add, (1, kd, kh, kw, 1), (1, sd, sh, sw, 1), pads)
+        # exclude-padding divisor (the reference's avgPool3DForward)
+        counts = _lax.reduce_window(
+            _jnp.ones_like(v), 0.0, _lax.add, (1, kd, kh, kw, 1),
+            (1, sd, sh, sw, 1), pads)
+        return summed / counts
+
+    return LayerOutput(
+        name=name, layer_type="pool3d",
+        size=c * d_out * h_out * w_out, parents=(input,), fn=fwd,
+        depth=c, attrs={"out_vol": [d_out, h_out, w_out]},
+    )

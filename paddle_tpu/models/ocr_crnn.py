@@ -1,0 +1,98 @@
+"""OCR CRNN — conv feature extractor → columns-as-sequence → bidirectional
+LSTM → CTC, the reference's scene-text recognition recipe
+(models/scene-text CRNN built on ``warp_ctc_layer``; conv machinery from
+``paddle/gserver/layers`` + ``WarpCTCLayer.cpp``).
+
+TPU shape discipline: images are fixed [H, W] (bucket widths upstream);
+the column sequence has static length W' with a per-sample valid length,
+exactly what ops/ctc.ctc_loss consumes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.core import initializer as I
+from paddle_tpu.layers import activation as act
+from paddle_tpu.layers import api as layer
+from paddle_tpu.layers import data_type, extras
+from paddle_tpu.layers.base import LayerOutput, gen_name, raw
+
+
+def _columns_to_sequence(conv: LayerOutput, width: int) -> LayerOutput:
+    """[B, H, W, C] feature map -> width-major sequence [B, W, H*C]."""
+    name = gen_name("cols_to_seq")
+    h, c = conv.height, conv.depth
+
+    def fwd(ctx, params, states, x):
+        v = raw(x)  # NHWC from the conv stack
+        cols = v.transpose(0, 2, 1, 3).reshape(v.shape[0], width, h * c)
+        lengths = jnp.full((v.shape[0],), width, jnp.int32)
+        return SequenceBatch(data=cols, length=lengths)
+
+    return LayerOutput(name=name, layer_type="seq_reshape",
+                       size=h * c, parents=(conv,), fn=fwd)
+
+
+def crnn_ctc_cost(image_height: int = 32, image_width: int = 96,
+                  num_channels: int = 1, num_classes: int = 26,
+                  rnn_size: int = 64):
+    """Returns (cost, log_probs_seq, feed_order).  ``num_classes`` excludes
+    the blank (blank = last index, the reference's ctc_layer convention)."""
+    img = layer.data(
+        name="image",
+        type=data_type.dense_vector(num_channels * image_height * image_width),
+        height=image_height, width=image_width,
+    )
+    conv1 = layer.img_conv(input=img, filter_size=3, num_filters=16,
+                           num_channels=num_channels, padding=1,
+                           act=act.ReluActivation())
+    pool1 = layer.img_pool(input=conv1, pool_size=2, stride=2)
+    conv2 = layer.img_conv(input=pool1, filter_size=3, num_filters=32,
+                           padding=1, act=act.ReluActivation())
+    pool2 = layer.img_pool(input=conv2, pool_size=2, stride=2)
+    seq_w = pool2.width  # pool layers use ceil-mode output sizes
+
+    seq = _columns_to_sequence(pool2, seq_w)
+    fwd = layer.lstmemory(input=layer.fc(input=seq, size=rnn_size * 4,
+                                         act=act.LinearActivation()))
+    bwd = layer.lstmemory(input=layer.fc(input=seq, size=rnn_size * 4,
+                                         act=act.LinearActivation()),
+                          reverse=True)
+    feat = layer.concat(input=[fwd, bwd])
+    probs = layer.fc(input=feat, size=num_classes + 1,
+                     act=act.SoftmaxActivation())
+    label = layer.data(
+        name="label",
+        type=data_type.integer_value_sequence(num_classes),
+    )
+    cost = extras.ctc(input=probs, label=label, size=num_classes + 1)
+    return cost, probs, ["image", "label"]
+
+
+def synthetic_ocr_reader(n_samples: int = 512, image_height: int = 32,
+                         image_width: int = 96, num_classes: int = 26,
+                         max_label_len: int = 6, seed: int = 0):
+    """Bar-code-like synthetic OCR task: each 'character' paints a distinct
+    vertical stripe pattern, so a CRNN genuinely learns alignment."""
+    rng = np.random.default_rng(seed)
+    # glyphs are dataset constants — independent of the sample seed, so
+    # train/test readers share the same alphabet
+    protos = np.random.default_rng(7777).random(
+        (num_classes, image_height, 12)) > 0.5
+
+    def reader():
+        for _ in range(n_samples):
+            n = int(rng.integers(2, max_label_len + 1))
+            labels = rng.integers(0, num_classes, size=n)
+            img = np.zeros((image_height, image_width), np.float32)
+            x = 2
+            for c in labels:
+                img[:, x:x + 12] = protos[c].astype(np.float32)
+                x += 14
+            img += rng.normal(0, 0.1, img.shape).astype(np.float32)
+            yield img.reshape(-1), [int(c) for c in labels]
+
+    return reader

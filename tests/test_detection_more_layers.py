@@ -183,3 +183,23 @@ def test_detection_map_evaluator():
         gts=[[[1, 0.1, 0.1, 0.4, 0.4]]],
     )
     assert ev.finish()["detection_map"] == 0.0
+
+
+def test_conv3d_pool3d_volumes(rng_np):
+    from paddle_tpu.config.topology import Topology
+
+    vol = layer.data(name="vol", type=data_type.dense_vector(2 * 4 * 8 * 8))
+    c3 = more.img_conv3d(vol, filter_size=3, num_filters=5, num_channels=2,
+                         img_size=(4, 8, 8), padding=1)
+    p3 = more.img_pool3d(c3, pool_size=2)
+    d3 = more.img_conv3d(vol, filter_size=2, num_filters=2, num_channels=2,
+                         img_size=(4, 8, 8), stride=2, trans=True)
+    topo = Topology([p3, d3])
+    params = paddle.parameters.create(topo).as_dict()
+    x = rng_np.normal(size=(3, 2 * 4 * 8 * 8)).astype(np.float32)
+    values, _ = topo.forward(params, topo.init_states(), {"vol": x}, False,
+                             jax.random.key(0))
+    assert np.asarray(values[c3.name]).shape == (3, 4, 8, 8, 5)
+    assert np.asarray(values[p3.name]).shape == (3, 2, 4, 4, 5)
+    # transposed: (4-1)*2+2 = 8 -> (3, 8, 16, 16, 2)
+    assert np.asarray(values[d3.name]).shape == (3, 8, 16, 16, 2)
