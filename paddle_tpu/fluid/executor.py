@@ -120,8 +120,24 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list or []
 
+        from paddle_tpu.core.lod import SequenceBatch
+
+        block = program.global_block()
         for name, value in feed.items():
-            scope[name] = jnp.asarray(value)
+            var = block.vars.get(name)
+            lod = getattr(var, "lod_level", 0) if var is not None else 0
+            if isinstance(value, SequenceBatch):
+                scope[name] = value
+            elif lod > 0:
+                # LoD variables feed as (padded_data, lengths)
+                enforce(isinstance(value, tuple) and len(value) == 2,
+                        "lod_level>0 variable %r must be fed a SequenceBatch "
+                        "or a (data, lengths) pair" % name)
+                scope[name] = SequenceBatch(
+                    data=jnp.asarray(value[0]),
+                    length=jnp.asarray(value[1], jnp.int32))
+            else:
+                scope[name] = jnp.asarray(value)
 
         self._run_counter += 1
         rng = jax.random.key(self._run_counter if seed is None else seed)
@@ -146,5 +162,10 @@ class Executor:
             name = f if isinstance(f, str) else f.name
             enforce(name in scope, "fetch target %r not produced" % name)
             v = scope[name]
-            results.append(np.asarray(v) if return_numpy else v)
+            if isinstance(v, SequenceBatch):
+                results.append(SequenceBatch(
+                    data=np.asarray(v.data), length=np.asarray(v.length))
+                    if return_numpy else v)
+            else:
+                results.append(np.asarray(v) if return_numpy else v)
         return results

@@ -81,15 +81,27 @@ def generic_grad_kernel(ins, attrs, rng):
     grad_slots = list(attrs["__grad_slots__"])
 
     fwd_ins = {slot: vals for slot, vals in ins.items() if not slot.startswith("OG:")}
+
+    def _has_float_leaf(v):
+        return any(hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+                   for l in jax.tree.leaves(v))
+
     diff = {}
     for slot in grad_slots:
         vals = fwd_ins[slot]
-        if all(jnp.issubdtype(v.dtype, jnp.floating) for v in vals):
+        if all(_has_float_leaf(v) for v in vals):
             diff[slot] = vals
     frozen = {k: v for k, v in fwd_ins.items() if k not in diff}
 
     def primal(d):
         return fwd_kernel({**frozen, **d}, fwd_attrs, rng)
+
+    def _zero_ct(leaf):
+        # vjp cotangents: zeros for float leaves, float0 for int leaves
+        # (values may be pytrees, e.g. SequenceBatch with int lengths)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return jnp.zeros_like(leaf)
+        return np.zeros(leaf.shape, jax.dtypes.float0)
 
     out, vjp = jax.vjp(primal, diff)
     cts = {}
@@ -97,7 +109,7 @@ def generic_grad_kernel(ins, attrs, rng):
         og = ins.get("OG:" + slot)
         cts[slot] = [
             og[i] if og is not None and i < len(og) and og[i] is not None
-            else jnp.zeros_like(v)
+            else jax.tree.map(_zero_ct, v)
             for i, v in enumerate(vals)
         ]
     (d_in,) = vjp(cts)
@@ -164,12 +176,29 @@ KERNELS["elementwise_min"] = _elementwise(jnp.minimum)
 KERNELS["elementwise_pow"] = _elementwise(jnp.power)
 
 
+def _float_leaf_map(f, *vals):
+    """tree-map over float leaves; int/float0 leaves (e.g. SequenceBatch
+    lengths inside cotangent pytrees) pass through from the first value."""
+    def g(*ls):
+        l0 = ls[0]
+        if (hasattr(l0, "dtype")
+                and (l0.dtype == jax.dtypes.float0
+                     or not jnp.issubdtype(l0.dtype, jnp.inexact))):
+            return l0
+        return f(*ls)
+
+    return jax.tree.map(g, *vals)
+
+
 @register_op("sum")
 def _sum(ins, attrs, rng):
     xs = ins["X"]
     out = xs[0]
     for x in xs[1:]:
-        out = out + x
+        if hasattr(out, "dtype") and hasattr(x, "dtype"):
+            out = out + x
+        else:  # pytree values (SequenceBatch grads): add float leaves
+            out = _float_leaf_map(lambda a, b: a + b, out, x)
     return {"Out": [out]}
 
 
@@ -180,8 +209,11 @@ def _mean(ins, attrs, rng):
 
 @register_op("scale")
 def _scale(ins, attrs, rng):
-    return {"Out": [ins["X"][0] * attrs.get("scale", 1.0)
-                    + attrs.get("bias", 0.0)]}
+    s, b = attrs.get("scale", 1.0), attrs.get("bias", 0.0)
+    x = ins["X"][0]
+    if hasattr(x, "dtype"):
+        return {"Out": [x * s + b]}
+    return {"Out": [_float_leaf_map(lambda l: l * s + b, x)]}
 
 
 @register_op("cast")
@@ -242,7 +274,7 @@ def _fill_constant(ins, attrs, rng):
 
 @register_op("fill_zeros_like")
 def _fill_zeros_like(ins, attrs, rng):
-    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+    return {"Out": [jax.tree.map(jnp.zeros_like, ins["X"][0])]}
 
 
 @register_op("uniform_random")
@@ -451,6 +483,9 @@ def _lrn(ins, attrs, rng):
 @register_op("lookup_table")
 def _lookup_table(ins, attrs, rng):
     w, ids = ins["W"][0], ins["Ids"][0]
+    if not hasattr(ids, "reshape"):  # LoD ids -> LoD embeddings
+        emb = jnp.take(w, ids.data.astype(jnp.int32), axis=0)
+        return {"Out": [type(ids)(data=emb, length=ids.length)]}
     flat = ids.reshape(-1)
     out = jnp.take(w, flat, axis=0)
     return {"Out": [out.reshape(ids.shape[:-1] + (w.shape[-1],))
@@ -911,3 +946,107 @@ def _precision_recall(ins, attrs, rng):
         micro_p, micro_r, micro_f1,
     ])
     return {"BatchMetrics": [metrics]}
+
+
+# --------------------------------------------------------------------------
+# LoD sequence ops: scope values for lod_level>0 variables are SequenceBatch
+# pytrees (data [B, T, ...] + length [B]) — the fluid LoDTensor analog
+# (framework/lod_tensor.h) under static shapes
+# --------------------------------------------------------------------------
+
+from paddle_tpu.core.lod import SequenceBatch  # noqa: E402
+from paddle_tpu.ops import rnn as _rnn  # noqa: E402
+from paddle_tpu.ops import sequence as _seq  # noqa: E402
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ins, attrs, rng):
+    x = ins["X"][0]
+    pool = {
+        "SUM": _seq.seq_pool_sum, "AVERAGE": _seq.seq_pool_avg,
+        "SQRT": _seq.seq_pool_sqrt, "MAX": _seq.seq_pool_max,
+        "LAST": _seq.seq_last, "FIRST": _seq.seq_first,
+    }[attrs.get("pooltype", "AVERAGE").upper()]
+    return {"Out": [pool(x)]}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ins, attrs, rng):
+    x = ins["X"][0]
+    scores = x.data
+    squeeze = scores.ndim == 3 and scores.shape[-1] == 1
+    if squeeze:
+        scores = scores[..., 0]
+    enforce(scores.ndim == 2,
+            "sequence_softmax takes per-step scalar scores [B,T] or [B,T,1]")
+    mask = x.mask()
+    scores = jnp.where(mask > 0, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=1) * mask
+    if squeeze:
+        probs = probs[..., None]
+    return {"Out": [SequenceBatch(data=probs, length=x.length)]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ins, attrs, rng):
+    out = ins["X"][0]
+    for nxt in ins["X"][1:]:
+        out = _seq.seq_concat(out, nxt)
+    return {"Out": [out]}
+
+
+@register_op("seq_expand")
+def _seq_expand(ins, attrs, rng):
+    x, y = ins["X"][0], ins["Y"][0]
+    # sequence inputs expand their per-sequence summary row
+    data = _seq.seq_pool_sum(x) if isinstance(x, SequenceBatch) else x
+    return {"Out": [_seq.expand(data, y)]}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ins, attrs, rng):
+    x = ins["X"][0]
+    w = ins["Filter"][0]  # [ctx_len * D, M]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    ctx = _seq.context_projection(x, ctx_len, ctx_start)
+    b, t, d = ctx.data.shape
+    out = (ctx.data.reshape(b * t, d) @ w).reshape(b, t, -1)
+    out = out * x.mask()[:, :, None]
+    return {"Out": [SequenceBatch(data=out, length=x.length)]}
+
+
+@register_op("lstm")
+def _lstm_op(ins, attrs, rng):
+    x = ins["Input"][0]
+    out, last = _rnn.lstm(
+        x, ins["WeightX"][0], ins["WeightH"][0],
+        ins["Bias"][0] if ins.get("Bias") else None,
+        reverse=attrs.get("is_reverse", False),
+    )
+    return {"Hidden": [out], "LastHidden": [last.h], "LastCell": [last.c]}
+
+
+@register_op("gru")
+def _gru_op(ins, attrs, rng):
+    x = ins["Input"][0]
+    out, last = _rnn.gru(
+        x, ins["WeightX"][0], ins["WeightH"][0], ins["WeightHC"][0],
+        ins["Bias"][0] if ins.get("Bias") else None,
+        reverse=attrs.get("is_reverse", False),
+    )
+    return {"Hidden": [out], "LastHidden": [last]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ins, attrs, rng):
+    state = _rnn.LSTMState(h=ins["HPrev"][0], c=ins["CPrev"][0])
+    new = _rnn.lstm_cell(ins["X"][0], state, ins["WeightH"][0])
+    return {"H": [new.h], "C": [new.c]}
+
+
+@register_op("gru_unit")
+def _gru_unit(ins, attrs, rng):
+    h = _rnn.gru_cell(ins["X"][0], ins["HPrev"][0], ins["WeightH"][0],
+                      ins["WeightHC"][0])
+    return {"H": [h]}

@@ -307,7 +307,6 @@ def img_conv3d(input: LayerOutput, filter_size, num_filters: int,
     """≅ conv3d / deconv3d (Conv3DLayer/DeConv3DLayer): NDHWC volumes.
     ``img_size`` = (depth, height, width) of the input volume (v1 flat rows
     carry no 3-D metadata)."""
-    import jax.numpy as _jnp
     from jax import lax as _lax
 
     name = name or gen_name("conv3d" if not trans else "deconv3d")

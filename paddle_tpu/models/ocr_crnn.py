@@ -9,12 +9,10 @@ exactly what ops/ctc.ctc_loss consumes."""
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.lod import SequenceBatch
-from paddle_tpu.core import initializer as I
 from paddle_tpu.layers import activation as act
 from paddle_tpu.layers import api as layer
 from paddle_tpu.layers import data_type, extras
