@@ -57,6 +57,13 @@ def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
             anc.update(n for n in op.input_names() if n)
     need = ((desc & anc) | {loss.name}) - no_grad
 
+    for op in fwd_ops:
+        if op.type == "while" and any(n in need for n in op.output_names()):
+            raise NotImplementedError(
+                "the 'while' op is not differentiable (lax.while_loop has "
+                "no reverse rule); train recurrences with the scan-based "
+                "lstm/gru ops and keep 'while' for decoding/generation")
+
     # Seed: d loss / d loss = 1.
     loss_grad = grad_var_name(loss.name)
     block.create_var(name=loss_grad, shape=loss.shape or (), dtype=loss.dtype)

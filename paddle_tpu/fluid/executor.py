@@ -37,7 +37,9 @@ class Scope(dict):
 g_scope = Scope()
 
 
-def _run_op(op: framework.Operator, env: dict, rng):
+def _run_op(op: framework.Operator, env: dict, rng, program=None):
+    if op.type == "while":
+        return _run_while(op, env, rng, program)
     kernel = get_kernel(op.type)
     ins = {}
     for slot, names in op.inputs.items():
@@ -58,13 +60,69 @@ def _run_op(op: framework.Operator, env: dict, rng):
                 env[n] = v
 
 
-def _segment_reads_writes(ops: Sequence[framework.Operator]):
+def _run_while(op: framework.Operator, env: dict, rng, program):
+    """Lower the ``while`` op onto ``lax.while_loop``.
+
+    attrs["sub_block"] names a Program block executed while the scalar
+    Condition variable is true.  Loop-carried state = every sub-block
+    write that already exists in env (so shapes are fixed by the
+    pre-loop initializers) + the condition; everything else read by the
+    body is a loop invariant closed over from env.  The body must
+    re-write Condition (e.g. via less_than) or the loop never ends.
+    Reverse-mode autodiff does not cross this op (lax.while_loop is not
+    differentiable); train RNNs with the scan-based lstm/gru ops and use
+    ``while`` for decoders/generation, like the reference's
+    RecurrentGradientMachine generation path.
+    """
+    enforce(program is not None, "while op needs its owning program")
+    sub = program.blocks[op.attrs["sub_block"]]
+    cond_name = op.inputs["Condition"][0]
+    carried = _while_carried(op, sub)
+    for n in carried:
+        enforce(n in env, "while loop state %r must be initialized before "
+                "the loop (feed or fill it)" % n)
+    for n in (n for names in op.outputs.values() for n in names if n):
+        enforce(n in carried,
+                "while output %r is not loop-carried: declare it in the "
+                "op's X inputs and initialize it before the loop" % n)
+
+    def cond_fn(carry):
+        return carry[0][cond_name].reshape(()).astype(bool)
+
+    def body_fn(carry):
+        state, it = carry
+        local = dict(env)
+        local.update(state)
+        it_rng = jax.random.fold_in(rng, it)  # fresh draws per iteration
+        for o in sub.ops:
+            _run_op(o, local, it_rng, program)
+        return {k: local[k] for k in carried}, it + 1
+
+    init = ({k: env[k] for k in carried}, jnp.int32(0))
+    final, _ = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(final)
+
+
+def _while_carried(op: framework.Operator, sub) -> list[str]:
+    """Loop-carried names: sub-block writes that the while op declares as X
+    inputs (they must pre-exist, fixing shapes), plus the condition."""
+    declared = set(op.inputs.get("X", ())) | {op.inputs["Condition"][0]}
+    sub_writes = {n for o in sub.ops for n in o.output_names() if n}
+    return sorted((sub_writes & declared) | {op.inputs["Condition"][0]})
+
+
+def _segment_reads_writes(ops: Sequence[framework.Operator],
+                          program=None):
     reads, writes = [], set()
     for op in ops:
         for n in op.input_names():
             if n and n not in writes and n not in reads:
                 reads.append(n)
         writes.update(n for n in op.output_names() if n)
+        if op.type == "while" and program is not None:
+            # carried state survives the loop even when not declared in Out
+            writes.update(_while_carried(
+                op, program.blocks[op.attrs["sub_block"]]))
     return reads, sorted(writes)
 
 
@@ -88,24 +146,24 @@ class Executor:
         for op in block.ops:
             if op.type in HOST_OPS:
                 if cur:
-                    segs.append(self._make_traced(cur))
+                    segs.append(self._make_traced(cur, program))
                     cur = []
                 segs.append(("host", op))
             else:
                 cur.append(op)
         if cur:
-            segs.append(self._make_traced(cur))
+            segs.append(self._make_traced(cur, program))
         self._programs[fp] = segs
         return segs
 
     @staticmethod
-    def _make_traced(ops: list[framework.Operator]):
-        reads, writes = _segment_reads_writes(ops)
+    def _make_traced(ops: list[framework.Operator], program):
+        reads, writes = _segment_reads_writes(ops, program)
 
         def run_segment(env_in: dict, rng):
             env = dict(env_in)
             for op in ops:
-                _run_op(op, env, rng)
+                _run_op(op, env, rng, program)
             return {k: env[k] for k in writes}
 
         return ("jit", jax.jit(run_segment), reads, writes)
@@ -145,7 +203,7 @@ class Executor:
         for seg in self._segments(program):
             if seg[0] == "host":
                 env = dict(scope)
-                _run_op(seg[1], env, rng)
+                _run_op(seg[1], env, rng, program)
                 scope.update(env)
             else:
                 _, fn, reads, writes = seg

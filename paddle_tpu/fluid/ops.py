@@ -1050,3 +1050,22 @@ def _gru_unit(ins, attrs, rng):
     h = _rnn.gru_cell(ins["X"][0], ins["HPrev"][0], ins["WeightH"][0],
                       ins["WeightHC"][0])
     return {"H": [h]}
+
+
+# --------------------------------------------------------------------------
+# control flow + tensor arrays (reference: while via RNN machinery,
+# tensor_array_read_write_op, increment_op; executor.py lowers the "while"
+# op itself onto lax.while_loop)
+# --------------------------------------------------------------------------
+
+@register_op("write_to_array")
+def _write_to_array(ins, attrs, rng):
+    """Array is a preallocated [MAX_T, ...] buffer; functional update."""
+    x, i, arr = ins["X"][0], ins["I"][0], ins["Array"][0]
+    return {"Out": [arr.at[i.reshape(()).astype(jnp.int32)].set(x)]}
+
+
+@register_op("read_from_array")
+def _read_from_array(ins, attrs, rng):
+    arr, i = ins["Array"][0], ins["I"][0]
+    return {"Out": [arr[i.reshape(()).astype(jnp.int32)]]}

@@ -1,0 +1,66 @@
+"""Fluid control flow: the ``while`` op lowered onto lax.while_loop with
+tensor-array read/write — a dynamic RNN decoder loop (the reference's
+recurrent_op/tensor_array machinery, executor-lowered instead of
+interpreted)."""
+
+import numpy as np
+
+from paddle_tpu import fluid
+from paddle_tpu.fluid import framework, layers
+
+
+def test_while_dynamic_rnn_loop(rng_np):
+    framework.reset_default_programs()
+    T, B, D = 5, 3, 4
+    x_np = rng_np.normal(size=(T, B, D)).astype(np.float32)
+    w_np = (rng_np.normal(size=(D, D)) * 0.4).astype(np.float32)
+
+    prog = framework.default_main_program()
+    main = prog.global_block()
+    for name, shape in (("x", (T, B, D)), ("w", (D, D)), ("i", (1,)),
+                        ("t_lim", (1,)), ("cond", (1,)), ("h", (B, D)),
+                        ("harr", (T, B, D))):
+        main.create_var(name=name, shape=shape)
+
+    sub = prog.create_block()
+    sub.append_op("read_from_array", {"Array": ["x"], "I": ["i"]},
+                  {"Out": ["xt"]}, {})
+    sub.append_op("mul", {"X": ["h"], "Y": ["w"]}, {"Out": ["hw"]}, {})
+    sub.append_op("elementwise_add", {"X": ["hw"], "Y": ["xt"]},
+                  {"Out": ["pre"]}, {})
+    sub.append_op("tanh", {"X": ["pre"]}, {"Out": ["h"]}, {})
+    sub.append_op("write_to_array", {"X": ["h"], "I": ["i"],
+                                     "Array": ["harr"]},
+                  {"Out": ["harr"]}, {})
+    sub.append_op("increment", {"X": ["i"]}, {"Out": ["i"]}, {"step": 1.0})
+    sub.append_op("less_than", {"X": ["i"], "Y": ["t_lim"]},
+                  {"Out": ["cond"]}, {})
+
+    main.append_op(
+        "while",
+        {"Condition": ["cond"], "X": ["x", "w", "i", "t_lim", "h", "harr"]},
+        {"Out": ["harr", "h"]},
+        {"sub_block": sub.idx},
+    )
+
+    exe = fluid.Executor()
+    (harr, h_last, i_final) = exe.run(
+        feed={"x": x_np, "w": w_np,
+              "i": np.zeros((1,), np.float32),
+              "t_lim": np.full((1,), float(T), np.float32),
+              "cond": np.ones((1,), bool),
+              "h": np.zeros((B, D), np.float32),
+              "harr": np.zeros((T, B, D), np.float32)},
+        fetch_list=["harr", "h", "i"],
+    )
+    # carried state survives the loop even though "i" is not a declared Out
+    assert float(i_final[0]) == T
+
+    # numpy reference loop
+    h = np.zeros((B, D), np.float32)
+    ref = np.zeros((T, B, D), np.float32)
+    for t in range(T):
+        h = np.tanh(h @ w_np + x_np[t])
+        ref[t] = h
+    np.testing.assert_allclose(harr, ref, rtol=2e-2, atol=2e-2)  # bf16 mm
+    np.testing.assert_allclose(h_last, ref[-1], rtol=2e-2, atol=2e-2)
