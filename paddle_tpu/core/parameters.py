@@ -40,6 +40,9 @@ class ParamSpec:
     gradient_clipping_threshold: float | None = None
     sparse: bool = False  # embedding-style row-sparse grads
     sharding: tuple[str | None, ...] | None = None  # mesh axes per dim (tensor parallel)
+    # magnitude pruning mask kept at this sparsity each update
+    # (≅ ParameterUpdaterHook 'pruning' / StaticPruningHook)
+    sparsity_ratio: float | None = None
 
     def init(self, key) -> jax.Array:
         return self.initializer(key, self.shape, self.dtype)

@@ -400,11 +400,54 @@ class DetectionMAP(Evaluator):
         return {self.name: float(np.mean(aps)) if aps else 0.0}
 
 
+class RankAUC(Evaluator):
+    """≅ rankauc (RankAucEvaluator): exact AUC from raw ranking scores and
+    binary relevance labels (optionally weighted), computed by sorting —
+    unlike :class:`AUC`, no threshold grid."""
+
+    name = "rankauc"
+
+    def __init__(self):
+        self.start()
+
+    def start(self):
+        self.scores: list = []
+        self.labels: list = []
+        self.weights: list = []
+
+    def eval_batch(self, score=None, label=None, weight=None, **kw):
+        score = np.asarray(score, np.float64).reshape(-1)
+        label = np.asarray(label, np.float64).reshape(-1)
+        weight = (np.ones_like(score) if weight is None
+                  else np.asarray(weight, np.float64).reshape(-1))
+        self.scores.append(score)
+        self.labels.append(label)
+        self.weights.append(weight)
+
+    def finish(self):
+        s = np.concatenate(self.scores)
+        y = np.concatenate(self.labels)
+        w = np.concatenate(self.weights)
+        # weighted AUC = [sum over pos p, neg n of w_p w_n (1[s_p>s_n]
+        # + 0.5*1[s_p=s_n])] / (W_pos W_neg), aggregated per unique score
+        uniq, inv = np.unique(s, return_inverse=True)
+        pos_g = np.zeros(len(uniq))
+        neg_g = np.zeros(len(uniq))
+        np.add.at(pos_g, inv, w * (y > 0))
+        np.add.at(neg_g, inv, w * (y <= 0))
+        n_pos, n_neg = pos_g.sum(), neg_g.sum()
+        if n_pos == 0 or n_neg == 0:
+            return {self.name: 0.0}
+        neg_below = np.concatenate([[0.0], np.cumsum(neg_g)[:-1]])
+        auc = np.sum(pos_g * (neg_below + 0.5 * neg_g)) / (n_pos * n_neg)
+        return {self.name: float(auc)}
+
+
 REGISTRY = {
     c.name: c
     for c in (ClassificationError, SumEvaluator, ColumnSumEvaluator, AUC,
               PrecisionRecall, PnpairEvaluator, ChunkEvaluator, CTCError,
-              DetectionMAP)
+              DetectionMAP, RankAUC)
 }
 
 

@@ -71,6 +71,7 @@ def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
         gradient_clipping_threshold=a.gradient_clipping_threshold,
         sparse=a.sparse_update,
         sharding=a.sharding,
+        sparsity_ratio=a.sparsity_ratio,
     )
     fields.update(kw)  # layer-specific overrides (e.g. embedding sparse=True)
     return ParamSpec(**fields)

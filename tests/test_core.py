@@ -93,3 +93,101 @@ def test_initializers_shapes():
     ]:
         v = init(k, (8, 16), jnp.float32)
         assert v.shape == (8, 16)
+
+
+def test_rankauc_evaluator():
+    from paddle_tpu.evaluator import RankAUC
+
+    ev = RankAUC()
+    ev.eval_batch(score=[0.9, 0.8, 0.3, 0.1], label=[1, 1, 0, 0])
+    assert ev.finish()["rankauc"] == 1.0  # perfect ranking
+    ev.start()
+    ev.eval_batch(score=[0.1, 0.2, 0.8, 0.9], label=[1, 1, 0, 0])
+    assert ev.finish()["rankauc"] == 0.0  # inverted
+    ev.start()
+    ev.eval_batch(score=[0.5, 0.5, 0.5, 0.5], label=[1, 0, 1, 0])
+    assert abs(ev.finish()["rankauc"] - 0.5) < 1e-9  # ties -> 0.5
+
+
+def test_pruning_hook_masks_smallest_weights():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.layers import api as layer, base, data_type
+    from paddle_tpu.layers.attr import ParamAttr
+
+    base.reset_name_counters()
+    x = layer.data(name="px", type=data_type.dense_vector(16))
+    h = layer.fc(input=x, size=8,
+                 param_attr=ParamAttr(name="pruned_w", sparsity_ratio=0.5))
+    label = layer.data(name="plabel", type=data_type.integer_value(8))
+    cost = layer.classification_cost(input=h, label=label)
+    parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=paddle.optimizer.SGD(
+                                     learning_rate=0.1))
+    rng = np.random.default_rng(0)
+
+    def reader():
+        for _ in range(32):
+            v = rng.normal(size=(16,)).astype(np.float32)
+            yield v, int(rng.integers(0, 8))
+
+    trainer.train(reader=paddle.reader.batch(reader, 16), num_passes=1)
+    w = np.asarray(trainer.parameters["pruned_w"])
+    sparsity = float((w == 0).mean())
+    assert 0.45 <= sparsity <= 0.55, sparsity
+
+
+def test_v1_trainer_config_helpers_surface():
+    """A 2017-style v1 config file builds and trains against the shim."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.layers import base
+    import paddle_tpu.trainer_config_helpers as tch
+
+    base.reset_name_counters()
+    tch.settings(batch_size=16, learning_rate=0.1,
+                 learning_method=tch.MomentumOptimizer(momentum=0.9))
+    from paddle_tpu.layers import data_type
+    dat = tch.data_layer(name="v1x", type=data_type.dense_vector(8))
+    hid = tch.fc_layer(input=dat, size=16, act=tch.TanhActivation())
+    out = tch.fc_layer(input=hid, size=4, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name="v1y", type=data_type.integer_value(4))
+    cost = tch.classification_cost_layer(input=out, label=lbl) \
+        if hasattr(tch, "classification_cost_layer") else \
+        tch.classification_cost(input=out, label=lbl)
+
+    parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=tch.optimizers.get_settings_optimizer())
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+
+    def reader():
+        for _ in range(64):
+            v = rng.normal(size=(8,)).astype(np.float32)
+            yield v, int(np.argmax(v @ w))
+
+    costs = []
+    trainer.train(reader=paddle.reader.batch(reader, 16), num_passes=4,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0]
+
+
+def test_rankauc_weighted():
+    from paddle_tpu.evaluator import RankAUC
+
+    ev = RankAUC()
+    # one positive above the negative: weighted AUC must be 1.0
+    ev.eval_batch(score=[2.0, 1.0], label=[1, 0], weight=[2.0, 1.0])
+    assert ev.finish()["rankauc"] == 1.0
+    ev.start()
+    # duplicate an item via weight: same auc as literal duplication
+    ev.eval_batch(score=[0.9, 0.8, 0.7], label=[1, 0, 1],
+                  weight=[1.0, 2.0, 1.0])
+    a_w = ev.finish()["rankauc"]
+    ev.start()
+    ev.eval_batch(score=[0.9, 0.8, 0.8, 0.7], label=[1, 0, 0, 1])
+    assert abs(ev.finish()["rankauc"] - a_w) < 1e-12
