@@ -443,11 +443,38 @@ class RankAUC(Evaluator):
         return {self.name: float(auc)}
 
 
+class ValuePrinter(Evaluator):
+    """≅ value_printer_evaluator (printer evaluators family): logs the
+    values handed to it each batch; passes nothing back."""
+
+    name = "value_printer"
+
+    def __init__(self, prefix: str = "value", max_elems: int = 16):
+        self.prefix = prefix
+        self.max_elems = max_elems
+
+    def start(self):
+        pass
+
+    def eval_batch(self, **kw):
+        from paddle_tpu.core import logger as log
+
+        for name, v in kw.items():
+            arr = np.asarray(v)
+            flat = arr.reshape(-1)[: self.max_elems]
+            log.info("%s[%s] shape=%s %s%s", self.prefix, name, arr.shape,
+                     np.array2string(flat, precision=4),
+                     "..." if arr.size > self.max_elems else "")
+
+    def finish(self):
+        return {}
+
+
 REGISTRY = {
     c.name: c
     for c in (ClassificationError, SumEvaluator, ColumnSumEvaluator, AUC,
               PrecisionRecall, PnpairEvaluator, ChunkEvaluator, CTCError,
-              DetectionMAP, RankAUC)
+              DetectionMAP, RankAUC, ValuePrinter)
 }
 
 

@@ -119,6 +119,10 @@ class SGD:
 
         if event_handler is None:
             event_handler = _default_event_handler
+        if flags.get("debug_nans"):
+            # the documented jax nan-checking traps at the originating op;
+            # the finite-cost check below remains as a cheap backstop
+            jax.config.update("jax_debug_nans", True)
         self._ensure_built()
         feeder = self._default_feeder(feeding)
         params = self.mesh.replicate(self._params_dict())
@@ -172,6 +176,12 @@ class SGD:
                     )
                 event_handler(v2_event.EndForwardBackward(pass_id, batch_id, self))
                 cost_f = float(cost)
+                if not np.isfinite(cost_f) and flags.get("debug_nans"):
+                    # ≅ the reference's feenableexcept FP trapping
+                    # (TrainerMain.cpp:49): stop at the poisoned batch
+                    raise FloatingPointError(
+                        f"non-finite cost {cost_f} at pass {pass_id} "
+                        f"batch {batch_id} (flags.debug_nans)")
                 metrics_f = {k: float(v) for k, v in metrics.items()}
                 batch_costs.append(cost_f)
                 batch_metrics.append(metrics_f)

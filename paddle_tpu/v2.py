@@ -1,0 +1,35 @@
+"""``paddle.v2`` alias — reference user code does ``import paddle.v2 as
+paddle``; here ``import paddle_tpu.v2 as paddle`` (or just ``import
+paddle_tpu as paddle``) exposes the identical surface."""
+
+from paddle_tpu import *  # noqa: F401,F403
+from paddle_tpu import (  # noqa: F401
+    attr,
+    dataset,
+    event,
+    infer,
+    layer,
+    optimizer,
+    parameters,
+    reader,
+    topology,
+    trainer,
+)
+
+try:  # keep the v2 sub-namespaces addressable
+    from paddle_tpu.layers import activation, data_type, pooling  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+
+def init(**kwargs):
+    """≅ paddle.v2.init(use_gpu=..., trainer_count=...): set runtime flags."""
+    from paddle_tpu.core import flags
+
+    mapping = {"use_gpu": "use_tpu"}
+    for k, v in kwargs.items():
+        k = mapping.get(k, k)
+        try:
+            flags.set(k, v)
+        except KeyError:
+            pass  # unknown historical flag: accepted and ignored

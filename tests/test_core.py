@@ -191,3 +191,47 @@ def test_rankauc_weighted():
     ev.start()
     ev.eval_batch(score=[0.9, 0.8, 0.8, 0.7], label=[1, 0, 0, 1])
     assert abs(ev.finish()["rankauc"] - a_w) < 1e-12
+
+
+def test_v2_alias_and_init_flags():
+    import paddle_tpu.v2 as p2
+    from paddle_tpu.core import flags
+
+    assert hasattr(p2, "layer") and hasattr(p2, "trainer")
+    prev = {k: flags.get(k) for k in ("use_tpu", "trainer_count")}
+    try:
+        p2.init(use_gpu=False, trainer_count=2, bogus_flag_from_2017=True)
+        assert flags.get("use_tpu") is False
+        assert flags.get("trainer_count") == 2
+    finally:
+        for k, v in prev.items():
+            flags.set(k, v)
+
+
+def test_debug_nans_traps_poisoned_batch():
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.core import flags
+    from paddle_tpu.layers import api as layer, base, data_type
+
+    base.reset_name_counters()
+    x = layer.data(name="nx", type=data_type.dense_vector(4))
+    h = layer.fc(input=x, size=4)
+    lbl = layer.data(name="ny", type=data_type.integer_value(4))
+    cost = layer.classification_cost(input=h, label=lbl)
+    parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=paddle.optimizer.SGD(
+                                     learning_rate=0.1))
+
+    def reader():
+        for _ in range(8):
+            yield np.full((4,), np.nan, np.float32), 0
+
+    flags.set("debug_nans", True)
+    try:
+        with pytest.raises(FloatingPointError):
+            trainer.train(reader=paddle.reader.batch(reader, 8), num_passes=1)
+    finally:
+        flags.set("debug_nans", False)
