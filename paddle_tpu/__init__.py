@@ -44,7 +44,7 @@ _API_MAP = {
     "activation": "paddle_tpu.layers.activation",
     "pooling": "paddle_tpu.layers.pooling",
     "attr": "paddle_tpu.layers.attr",
-    "init": "paddle_tpu.core.initializer",
+    "initializer": "paddle_tpu.core.initializer",
     "parameters": "paddle_tpu.core.parameters",
     "trainer": "paddle_tpu.trainer",
     "event": "paddle_tpu.trainer.event",
@@ -62,6 +62,17 @@ _API_MAP = {
 
 
 def __getattr__(name):
+    if name == "init":
+        # paddle.v2.init() is a FUNCTION (runtime flag setup), not the
+        # initializer module (that one is paddle.initializer)
+        from paddle_tpu.v2 import init as _init
+
+        globals()["init"] = _init
+        return _init
+    if name == "v2":
+        mod = _importlib.import_module("paddle_tpu.v2")
+        globals()["v2"] = mod
+        return mod
     target = _API_MAP.get(name)
     if target is not None:
         mod = _importlib.import_module(target)

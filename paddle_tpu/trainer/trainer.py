@@ -119,6 +119,7 @@ class SGD:
 
         if event_handler is None:
             event_handler = _default_event_handler
+        prev_debug_nans = jax.config.jax_debug_nans
         if flags.get("debug_nans"):
             # the documented jax nan-checking traps at the originating op;
             # the finite-cost check below remains as a cheap backstop
@@ -134,6 +135,40 @@ class SGD:
             opt_state = self.mesh.replicate(opt_state)
         else:
             opt_state = self._opt_state
+
+        # preemption handling (SURVEY §5/§7.8): on SIGTERM (the TPU-pod
+        # eviction signal) finish the current batch, checkpoint, and exit —
+        # resume picks up from the saved pass
+        preempted = {"flag": False}
+        prev_handler = None
+        if checkpoint_dir:
+            import signal
+
+            def _on_sigterm(signum, frame):
+                preempted["flag"] = True
+                log.info("SIGTERM received: checkpointing at the next "
+                         "batch boundary")
+
+            try:
+                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:  # non-main thread: no handler, no preemption
+                prev_handler = None
+
+        try:
+            self._train_loop(reader, num_passes, event_handler, feeder,
+                             params, states, opt_state, checkpoint_dir,
+                             checkpoint_period, resume, preempted)
+        finally:
+            jax.config.update("jax_debug_nans", prev_debug_nans)
+            if prev_handler is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, prev_handler)
+
+    def _train_loop(self, reader, num_passes, event_handler, feeder,
+                    params, states, opt_state, checkpoint_dir,
+                    checkpoint_period, resume, preempted):
+        from paddle_tpu.trainer import checkpoint as ckpt
 
         start_pass = flags.get("start_pass")
         if checkpoint_dir and resume:
@@ -188,10 +223,27 @@ class SGD:
                 event_handler(
                     v2_event.EndIteration(pass_id, batch_id, cost_f, metrics_f, self)
                 )
+                if preempted["flag"]:
+                    break
             # write back for checkpoint/event access
             self.parameters.update_from(params)
             self.states = dict(states)
             self._opt_state = opt_state
+            if preempted["flag"]:
+                # mid-pass eviction: checkpoint as "last completed pass" so
+                # resume RE-RUNS the interrupted pass; no EndPass for a
+                # partial pass, and the save ignores checkpoint_period
+                if checkpoint_dir:
+                    ckpt.save_checkpoint(
+                        checkpoint_dir, pass_id - 1,
+                        {n: np.asarray(params[n]) for n in params},
+                        opt_state=opt_state, states=dict(states),
+                        meta={"preempted_in_pass": pass_id,
+                              "rng": rng.get_state().tolist()},
+                    )
+                    log.info("preempted in pass %d: checkpoint written; "
+                             "resume re-runs it", pass_id)
+                break
             avg_metrics = _mean_dicts(batch_metrics)
             event_handler(v2_event.EndPass(pass_id, avg_metrics))
             save_dir = flags.get("save_dir")

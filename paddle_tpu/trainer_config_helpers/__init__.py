@@ -49,3 +49,24 @@ def _export_v1_names():
 
 
 _export_v1_names()
+
+
+_CONFIG_ARGS: dict = {}
+
+
+def set_config_args(args: dict) -> None:
+    """Inject CLI key=values for configs to read (≅ --config_args)."""
+    _CONFIG_ARGS.clear()
+    _CONFIG_ARGS.update(args)
+
+
+def get_config_arg(name: str, type_=str, default=None):
+    """≅ get_config_arg (config_parser): read a CLI-provided config knob
+    (see v1_api_demo/mnist/light_mnist.py:17)."""
+    if name not in _CONFIG_ARGS:
+        return default
+    v = _CONFIG_ARGS[name]
+    if type_ is bool and isinstance(v, str):
+        # bool("0") is True; CLI strings need real parsing
+        return v.strip().lower() not in ("", "0", "false", "no", "off")
+    return type_(v)

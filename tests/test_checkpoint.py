@@ -110,3 +110,55 @@ def test_trainer_checkpoint_and_resume(tmp_path):
         tr3.parameters["_out.w0"],
         ckpt.load_checkpoint(ckpt.latest_checkpoint(d)[0])[0]["_out.w0"])
     del w_after
+
+
+def test_sigterm_preemption_checkpoints_and_resumes(tmp_path):
+    """SIGTERM mid-training -> checkpoint at the batch boundary -> a fresh
+    trainer resumes from the saved pass (SURVEY §5 preemption handling)."""
+    import os
+    import signal
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.layers import api as layer, base, data_type
+
+    def build():
+        base.reset_name_counters()
+        x = layer.data(name="sx", type=data_type.dense_vector(4))
+        h = layer.fc(input=x, size=4)
+        lbl = layer.data(name="sy", type=data_type.integer_value(4))
+        cost = layer.classification_cost(input=h, label=lbl)
+        parameters = paddle.parameters.create(paddle.topology.Topology(cost))
+        return paddle.trainer.SGD(
+            cost=cost, parameters=parameters,
+            update_equation=paddle.optimizer.SGD(learning_rate=0.1))
+
+    rng = np.random.default_rng(0)
+
+    def reader():
+        for i in range(16):
+            if i == 4:  # simulate the pod eviction signal mid-pass
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield rng.normal(size=(4,)).astype(np.float32), int(i % 4)
+
+    ckdir = str(tmp_path / "ck")
+    trainer = build()
+    trainer.train(reader=paddle.reader.batch(reader, 8), num_passes=50,
+                  checkpoint_dir=ckdir)
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    found = ckpt.latest_checkpoint(ckdir)
+    assert found is not None
+    saved_pass = found[1]["pass_id"]
+    assert saved_pass < 49  # preempted long before the end
+
+    # resume continues after the saved pass
+    passes = []
+    trainer2 = build()
+    trainer2.train(
+        reader=paddle.reader.batch(
+            lambda: ((rng.normal(size=(4,)).astype(np.float32), 0)
+                     for _ in range(8)), 8),
+        num_passes=saved_pass + 3, checkpoint_dir=ckdir,
+        event_handler=lambda e: passes.append(e.pass_id)
+        if isinstance(e, paddle.event.BeginPass) else None)
+    assert passes and passes[0] == saved_pass + 1
