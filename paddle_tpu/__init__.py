@@ -61,14 +61,21 @@ _API_MAP = {
 }
 
 
-def __getattr__(name):
-    if name == "init":
-        # paddle.v2.init() is a FUNCTION (runtime flag setup), not the
-        # initializer module (that one is paddle.initializer)
-        from paddle_tpu.v2 import init as _init
+def init(**kwargs):
+    """≅ paddle.v2.init(use_gpu=..., trainer_count=...): set runtime flags.
+    Imports only the flag registry — the v2 surface stays lazily loaded."""
+    from paddle_tpu.core import flags
 
-        globals()["init"] = _init
-        return _init
+    mapping = {"use_gpu": "use_tpu"}
+    for k, v in kwargs.items():
+        k = mapping.get(k, k)
+        try:
+            flags.set(k, v)
+        except KeyError:
+            pass  # unknown historical flag: accepted and ignored
+
+
+def __getattr__(name):
     if name == "v2":
         mod = _importlib.import_module("paddle_tpu.v2")
         globals()["v2"] = mod
@@ -82,7 +89,7 @@ def __getattr__(name):
 
 
 def __dir__():
-    return sorted(set(globals()) | set(_API_MAP))
+    return sorted(set(globals()) | set(_API_MAP) | {"init", "v2"})
 
 
 def infer(output_layer, parameters, input, feeding=None, field="value"):

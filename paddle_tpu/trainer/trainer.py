@@ -115,8 +115,6 @@ class SGD:
         optimizer slots + states + pass cursor, uuid/sha manifest — see
         ``trainer/checkpoint.py``); with ``resume`` the newest valid one is
         loaded and training continues from the following pass."""
-        from paddle_tpu.trainer import checkpoint as ckpt
-
         if event_handler is None:
             event_handler = _default_event_handler
         prev_debug_nans = jax.config.jax_debug_nans
@@ -230,19 +228,24 @@ class SGD:
             self.states = dict(states)
             self._opt_state = opt_state
             if preempted["flag"]:
-                # mid-pass eviction: checkpoint as "last completed pass" so
-                # resume RE-RUNS the interrupted pass; no EndPass for a
-                # partial pass, and the save ignores checkpoint_period
+                # mid-pass eviction: checkpoint the partial pass under ITS
+                # OWN pass number (never clobbering the genuine end-of-
+                # previous-pass snapshot); resume continues with the next
+                # pass, keeping the partial progress — no batch is applied
+                # twice.  No EndPass fires for a partial pass, and the save
+                # ignores checkpoint_period.
                 if checkpoint_dir:
                     ckpt.save_checkpoint(
-                        checkpoint_dir, pass_id - 1,
+                        checkpoint_dir, pass_id,
                         {n: np.asarray(params[n]) for n in params},
                         opt_state=opt_state, states=dict(states),
-                        meta={"preempted_in_pass": pass_id,
+                        meta={"preempted": True,
+                              "completed_pass": False,
                               "rng": rng.get_state().tolist()},
                     )
-                    log.info("preempted in pass %d: checkpoint written; "
-                             "resume re-runs it", pass_id)
+                    log.info("preempted in pass %d: partial-pass checkpoint "
+                             "written; resume continues at pass %d",
+                             pass_id, pass_id + 1)
                 break
             avg_metrics = _mean_dicts(batch_metrics)
             event_handler(v2_event.EndPass(pass_id, avg_metrics))

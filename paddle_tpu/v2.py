@@ -22,14 +22,4 @@ except ImportError:  # pragma: no cover
     pass
 
 
-def init(**kwargs):
-    """≅ paddle.v2.init(use_gpu=..., trainer_count=...): set runtime flags."""
-    from paddle_tpu.core import flags
-
-    mapping = {"use_gpu": "use_tpu"}
-    for k, v in kwargs.items():
-        k = mapping.get(k, k)
-        try:
-            flags.set(k, v)
-        except KeyError:
-            pass  # unknown historical flag: accepted and ignored
+from paddle_tpu import init  # noqa: F401  (the flag-setup function)
