@@ -58,11 +58,13 @@ def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
     need = ((desc & anc) | {loss.name}) - no_grad
 
     for op in fwd_ops:
-        if op.type == "while" and any(n in need for n in op.output_names()):
+        if op.type in ("while", "cond") and any(
+                n in need for n in op.output_names()):
             raise NotImplementedError(
-                "the 'while' op is not differentiable (lax.while_loop has "
-                "no reverse rule); train recurrences with the scan-based "
-                "lstm/gru ops and keep 'while' for decoding/generation")
+                "the %r op is not differentiable through the generic vjp "
+                "kernel; train recurrences with the scan-based lstm/gru "
+                "ops and keep control-flow ops for decoding/inference"
+                % op.type)
 
     # Seed: d loss / d loss = 1.
     loss_grad = grad_var_name(loss.name)

@@ -40,6 +40,8 @@ g_scope = Scope()
 def _run_op(op: framework.Operator, env: dict, rng, program=None):
     if op.type == "while":
         return _run_while(op, env, rng, program)
+    if op.type == "cond":
+        return _run_cond(op, env, rng, program)
     kernel = get_kernel(op.type)
     ins = {}
     for slot, names in op.inputs.items():
@@ -111,11 +113,72 @@ def _while_carried(op: framework.Operator, sub) -> list[str]:
     return sorted((sub_writes & declared) | {op.inputs["Condition"][0]})
 
 
+def _run_cond(op: framework.Operator, env: dict, rng, program):
+    """Lower the ``cond`` op onto ``lax.cond`` (reference cond_op.cc ran the
+    true/false sub-nets on gathered row subsets; here both branches are
+    traced whole and selected — the XLA-idiomatic equivalent).
+
+    attrs: true_block / false_block = Program block indices.  Outputs must
+    be written by BOTH branches (same shapes/dtypes); each branch may read
+    anything from the outer scope."""
+    enforce(program is not None, "cond op needs its owning program")
+    tb = program.blocks[op.attrs["true_block"]]
+    fb = program.blocks[op.attrs["false_block"]]
+    cond_name = op.inputs["Cond"][0]
+    enforce(cond_name in env, "cond input %r is not defined" % cond_name)
+    out_names = [n for names in op.outputs.values() for n in names if n]
+
+    def branch(block):
+        def run(_):
+            local = dict(env)
+            for o in block.ops:
+                _run_op(o, local, rng, program)
+            for n in out_names:
+                enforce(n in local,
+                        "cond output %r not written by a branch" % n)
+            return tuple(local[n] for n in out_names)
+
+        return run
+
+    pred = env[cond_name].reshape(()).astype(bool)
+    outs = jax.lax.cond(pred, branch(tb), branch(fb), None)
+    env.update(dict(zip(out_names, outs)))
+
+
+def _sub_blocks(op: framework.Operator, program):
+    if program is None:
+        return []
+    if op.type == "while":
+        return [program.blocks[op.attrs["sub_block"]]]
+    if op.type == "cond":
+        return [program.blocks[op.attrs["true_block"]],
+                program.blocks[op.attrs["false_block"]]]
+    return []
+
+
+def sub_block_external_reads(op: framework.Operator, program):
+    """Outer-scope names read inside a control-flow op's sub-blocks
+    (sub-block reads that no sub-block op wrote first)."""
+    out = []
+    for sub in _sub_blocks(op, program):
+        written: set = set()
+        for o in sub.ops:
+            for n in o.input_names():
+                if n and n not in written:
+                    out.append(n)
+            written.update(n for n in o.output_names() if n)
+    return out
+
+
 def _segment_reads_writes(ops: Sequence[framework.Operator],
                           program=None):
     reads, writes = [], set()
     for op in ops:
         for n in op.input_names():
+            if n and n not in writes and n not in reads:
+                reads.append(n)
+        # control-flow branches may read outer vars not declared on the op
+        for n in sub_block_external_reads(op, program):
             if n and n not in writes and n not in reads:
                 reads.append(n)
         writes.update(n for n in op.output_names() if n)

@@ -264,6 +264,10 @@ class Program:
             if needed & set(op.output_names()):
                 kept.append(op)
                 needed |= set(op.input_names())
+                # control-flow branches read outer vars not on the op itself
+                from paddle_tpu.fluid.executor import sub_block_external_reads
+
+                needed |= set(sub_block_external_reads(op, pruned))
         blk.ops = list(reversed(kept))
         live = needed | target_names
         blk.vars = {n: v for n, v in blk.vars.items() if n in live}

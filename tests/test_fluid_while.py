@@ -64,3 +64,62 @@ def test_while_dynamic_rnn_loop(rng_np):
         ref[t] = h
     np.testing.assert_allclose(harr, ref, rtol=2e-2, atol=2e-2)  # bf16 mm
     np.testing.assert_allclose(h_last, ref[-1], rtol=2e-2, atol=2e-2)
+
+
+def test_cond_op_selects_branch(rng_np):
+    """cond lowered onto lax.cond: both branches traced, scalar-pred select."""
+    framework.reset_default_programs()
+    prog = framework.default_main_program()
+    main = prog.global_block()
+    for name, shape in (("cx", (4, 3)), ("cpred", (1,)), ("cout", (4, 3))):
+        main.create_var(name=name, shape=shape)
+
+    tb = prog.create_block()
+    tb.append_op("scale", {"X": ["cx"]}, {"Out": ["cout"]}, {"scale": 2.0})
+    fb = prog.create_block()
+    fb.append_op("scale", {"X": ["cx"]}, {"Out": ["cout"]},
+                 {"scale": -1.0, "bias": 5.0})
+
+    main.append_op("cond", {"Cond": ["cpred"], "X": ["cx"]},
+                   {"Out": ["cout"]},
+                   {"true_block": tb.idx, "false_block": fb.idx})
+
+    exe = fluid.Executor()
+    x = rng_np.normal(size=(4, 3)).astype(np.float32)
+    (out_t,) = exe.run(feed={"cx": x, "cpred": np.ones((1,), bool)},
+                       fetch_list=["cout"])
+    np.testing.assert_allclose(out_t, 2.0 * x, rtol=1e-6)
+    (out_f,) = exe.run(feed={"cx": x, "cpred": np.zeros((1,), bool)},
+                       fetch_list=["cout"])
+    np.testing.assert_allclose(out_f, -x + 5.0, rtol=1e-6)
+
+
+def test_cond_branch_reads_undeclared_outer_var(rng_np):
+    """Branches may read outer vars NOT declared on the cond op; segment
+    tracing and prune both follow sub-block reads."""
+    framework.reset_default_programs()
+    prog = framework.default_main_program()
+    main = prog.global_block()
+    for name, shape in (("qx", (4, 3)), ("qb", (3,)), ("qpred", (1,)),
+                        ("qout", (4, 3))):
+        main.create_var(name=name, shape=shape)
+
+    tb = prog.create_block()
+    # reads qb, which the cond op does NOT declare in X
+    tb.append_op("elementwise_add", {"X": ["qx"], "Y": ["qb"]},
+                 {"Out": ["qout"]}, {})
+    fb = prog.create_block()
+    fb.append_op("scale", {"X": ["qx"]}, {"Out": ["qout"]}, {"scale": 3.0})
+    main.append_op("cond", {"Cond": ["qpred"], "X": ["qx"]},
+                   {"Out": ["qout"]},
+                   {"true_block": tb.idx, "false_block": fb.idx})
+
+    exe = fluid.Executor()
+    x = rng_np.normal(size=(4, 3)).astype(np.float32)
+    b = rng_np.normal(size=(3,)).astype(np.float32)
+    (out,) = exe.run(feed={"qx": x, "qb": b, "qpred": np.ones((1,), bool)},
+                     fetch_list=["qout"])
+    np.testing.assert_allclose(out, x + b, rtol=1e-6)
+
+    pruned = prog.prune(["qout"])
+    assert "qb" in pruned.global_block().vars  # sub-block read kept
