@@ -10,11 +10,14 @@ layout idea as ``v2/dataset/common.py``)."""
 from paddle_tpu.dataset import (  # noqa: F401
     cifar,
     conll05,
+    flowers,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
     sentiment,
     uci_housing,
+    voc2012,
     wmt14,
 )

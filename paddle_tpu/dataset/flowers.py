@@ -1,0 +1,55 @@
+"""Flowers-102 — schema-compatible with
+``python/paddle/v2/dataset/flowers.py``: train/test/valid yield
+(flattened CHW float32 vector [3*32*32], label int in [0, 102)); a
+``mapper`` is applied per (image, label) sample when given, like the
+reference's train_mapper/test_mapper.
+
+Zero egress: synthetic class-conditional color-texture images (each class
+a distinct hue/stripe pattern) through the same simple_transform pipeline
+real images would use."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+NUM_CLASSES = 102
+TRAIN_SIZE = 2040
+TEST_SIZE = 510
+_SIZE = 32  # synthetic resolution (reference resizes real jpegs anyway)
+
+
+def _image(rng, cls: int) -> np.ndarray:
+    proto_rng = np.random.default_rng(9000 + cls)
+    base = proto_rng.random(3).astype(np.float32)  # class hue
+    freq = 1 + cls % 7
+    yy, xx = np.mgrid[0:_SIZE, 0:_SIZE].astype(np.float32) / _SIZE
+    stripe = 0.5 + 0.5 * np.sin(
+        2 * np.pi * freq * (xx * proto_rng.random() + yy * proto_rng.random()))
+    img = base[:, None, None] * stripe[None]
+    img += rng.normal(0, 0.08, img.shape).astype(np.float32)
+    return np.clip(img, 0, 1).astype(np.float32)
+
+
+def _reader(split: str, count: int, mapper=None):
+    def reader():
+        rng = common.synthetic_rng("flowers", split)
+        for _ in range(count):
+            cls = int(rng.integers(0, NUM_CLASSES))
+            sample = (_image(rng, cls).reshape(-1), cls)
+            yield mapper(sample) if mapper is not None else sample
+
+    return reader
+
+
+def train(mapper=None, buffered_size: int = 1024, use_xmap: bool = True):
+    return _reader("train", TRAIN_SIZE, mapper)
+
+
+def test(mapper=None, buffered_size: int = 1024, use_xmap: bool = True):
+    return _reader("test", TEST_SIZE, mapper)
+
+
+def valid(mapper=None, buffered_size: int = 1024, use_xmap: bool = True):
+    return _reader("valid", TEST_SIZE, mapper)

@@ -1,0 +1,58 @@
+"""MQ2007 learning-to-rank — schema-compatible with
+``python/paddle/v2/dataset/mq2007.py``: per-query docs with 46-dim feature
+vectors and relevance in {0,1,2}, in pointwise / pairwise / listwise
+formats (the formats rank_cost / lambda_cost consume).
+
+Zero egress: synthetic queries whose relevance is a noisy monotone
+function of a fixed linear scorer, so rankers genuinely learn."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+FEATURE_DIM = 46
+TRAIN_QUERIES = 300
+TEST_QUERIES = 60
+_DOCS_PER_QUERY = 12
+
+
+def _queries(split: str, count: int):
+    w = np.random.default_rng(6100).normal(size=(FEATURE_DIM,))
+    rng = common.synthetic_rng("mq2007", split)
+    for qid in range(count):
+        feats = rng.normal(size=(_DOCS_PER_QUERY, FEATURE_DIM)).astype(
+            np.float32)
+        score = feats @ w + rng.normal(0, 0.5, _DOCS_PER_QUERY)
+        rel = np.digitize(score, np.quantile(score, [0.5, 0.85]))
+        yield qid, rel.astype(np.int64), feats
+
+
+def _reader(split: str, count: int, format: str):
+    def pointwise():
+        for _, rel, feats in _queries(split, count):
+            for r, f in zip(rel, feats):
+                yield int(r), f
+
+    def pairwise():
+        for _, rel, feats in _queries(split, count):
+            for i in range(len(rel)):
+                for j in range(len(rel)):
+                    if rel[i] > rel[j]:
+                        yield np.array([1.0], np.float32), feats[i], feats[j]
+
+    def listwise():
+        for _, rel, feats in _queries(split, count):
+            yield rel.astype(np.float32), feats
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train(format: str = "pairwise"):
+    return _reader("train", TRAIN_QUERIES, format)
+
+
+def test(format: str = "pairwise"):
+    return _reader("test", TEST_QUERIES, format)

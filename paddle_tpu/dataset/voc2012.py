@@ -1,0 +1,60 @@
+"""VOC2012 segmentation — schema-compatible with
+``python/paddle/v2/dataset/voc2012.py``: train/test/val yield
+(image CHW float32, label HW int mask with class ids, 255 = void border).
+
+Zero egress: synthetic scenes — one or two rectangular "objects" of a
+class-colored texture on background, mask labeling the object pixels — so
+a segmentation head genuinely learns."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+NUM_CLASSES = 21  # 20 objects + background(0); 255 = void
+TRAIN_SIZE = 600
+TEST_SIZE = 120
+_SIZE = 32
+
+
+def _sample(rng):
+    img = rng.normal(0.4, 0.05, (3, _SIZE, _SIZE)).astype(np.float32)
+    mask = np.zeros((_SIZE, _SIZE), np.int32)
+    for _ in range(int(rng.integers(1, 3))):
+        cls = int(rng.integers(1, NUM_CLASSES))
+        proto = np.random.default_rng(4000 + cls).random(3).astype(np.float32)
+        h, w = int(rng.integers(8, 20)), int(rng.integers(8, 20))
+        y0 = int(rng.integers(0, _SIZE - h))
+        x0 = int(rng.integers(0, _SIZE - w))
+        img[:, y0:y0 + h, x0:x0 + w] = proto[:, None, None]
+        mask[y0:y0 + h, x0:x0 + w] = cls
+        # full void border ring, like VOC's 255 contours
+        mask[y0, x0:x0 + w] = 255
+        mask[y0 + h - 1, x0:x0 + w] = 255
+        mask[y0:y0 + h, x0] = 255
+        mask[y0:y0 + h, x0 + w - 1] = 255
+    img += rng.normal(0, 0.05, img.shape).astype(np.float32)
+    return np.clip(img, 0, 1), mask
+
+
+def _reader(split: str, count: int):
+    def reader():
+        rng = common.synthetic_rng("voc2012", split)
+        for _ in range(count):
+            img, mask = _sample(rng)
+            yield img, mask
+
+    return reader
+
+
+def train():
+    return _reader("train", TRAIN_SIZE)
+
+
+def test():
+    return _reader("test", TEST_SIZE)
+
+
+def val():
+    return _reader("val", TEST_SIZE)
