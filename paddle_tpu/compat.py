@@ -1,0 +1,43 @@
+"""Drop-in ``paddle`` module aliasing.
+
+Reference config files and demos start with ``from
+paddle.trainer_config_helpers import *`` or ``import paddle.v2 as paddle``.
+``install_paddle_alias()`` registers this package under the ``paddle`` name
+in ``sys.modules`` so those files run unmodified against the TPU runtime
+(the compatibility claim of BASELINE.json's "keep the Python v2 API").
+
+The alias is only installed when no real ``paddle`` is importable, and is
+idempotent.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+_ALIASES = {
+    "paddle": "paddle_tpu",
+    "paddle.trainer_config_helpers": "paddle_tpu.trainer_config_helpers",
+    "paddle.trainer_config_helpers.optimizers": "paddle_tpu.trainer_config_helpers.optimizers",
+    "paddle.trainer": "paddle_tpu.trainer",
+    "paddle.trainer.config_parser": "paddle_tpu.trainer.config_parser",
+    "paddle.trainer.PyDataProvider2": "paddle_tpu.reader.py_data_provider2",
+    "paddle.proto": "paddle_tpu.proto",
+    "paddle.v2": "paddle_tpu.v2",
+}
+
+
+def install_paddle_alias(force: bool = False) -> bool:
+    if "paddle" in sys.modules and not force:
+        already_ours = getattr(sys.modules["paddle"], "__name__", "").startswith(
+            "paddle_tpu"
+        )
+        if already_ours:
+            return True
+        return False
+    for alias, target in _ALIASES.items():
+        try:
+            sys.modules[alias] = importlib.import_module(target)
+        except ImportError:
+            pass
+    return True

@@ -1,0 +1,90 @@
+"""Config-parse session state: Inputs()/Outputs()/outputs().
+
+≅ the reference's config_parser globals (``g_config.model_config.
+input_layer_names`` etc., config_parser.py:209-240) plus the
+``outputs()`` DFS input/output inference from ``networks.py:1503``.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.layers.base import LayerOutput
+
+
+class ParseState:
+    def __init__(self):
+        self.input_layer_names: list[str] = []
+        self.output_layer_names: list[str] = []
+        self.data_configs: dict = {}
+
+    def reset(self):
+        self.__init__()
+
+
+STATE = ParseState()
+
+
+def Inputs(*names: str) -> None:
+    """≅ config_parser Inputs() (config_parser.py:209)."""
+    STATE.input_layer_names.extend(names)
+
+
+def Outputs(*names: str) -> None:
+    """≅ config_parser Outputs() (config_parser.py:231)."""
+    STATE.output_layer_names.extend(names)
+
+
+def HasInputsSet() -> bool:
+    return len(STATE.input_layer_names) != 0
+
+
+def outputs(layers, *args) -> None:
+    """≅ networks.outputs (networks.py:1503): declare outputs; if inputs are
+    unset, infer both by DFS — data layers become inputs, v1-cost-typed
+    ancestors become outputs (falling back to the given layers)."""
+    if isinstance(layers, LayerOutput):
+        layers = [layers]
+    layers = list(layers) + list(args)
+    assert layers
+
+    if HasInputsSet():
+        Outputs(*[l.name for l in layers])
+        return
+
+    traveled = set()
+
+    def dfs(layer: LayerOutput, predicate):
+        if id(layer) in traveled:
+            return []
+        traveled.add(id(layer))
+        retv = []
+        for p in layer.attrs.get("dfs_parents", layer.parents):
+            retv.extend(dfs(p, predicate))
+        if predicate(layer):
+            retv.append(layer)
+        return retv
+
+    inputs: list[LayerOutput] = []
+    outs: list[LayerOutput] = []
+    for each in layers:
+        inputs.extend(dfs(each, lambda x: x.layer_type == "data"))
+    traveled.clear()
+    for each in layers:
+        outs.extend(dfs(each, lambda x: x.attrs.get("v1_cost", False)))
+
+    final_inputs, final_outputs = [], []
+    for x in inputs:
+        if x.name not in final_inputs:
+            final_inputs.append(x.name)
+    for x in outs:
+        if x.name not in final_outputs:
+            final_outputs.append(x.name)
+    if not final_outputs:
+        final_outputs = [l.name for l in layers]
+    else:
+        # explicitly-passed non-cost layers stay outputs (matches reference
+        # goldens, e.g. test_cost_layers_with_weight's nce output)
+        for l in layers:
+            if l.name not in final_outputs:
+                final_outputs.append(l.name)
+    Inputs(*final_inputs)
+    Outputs(*final_outputs)

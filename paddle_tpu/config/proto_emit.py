@@ -1,0 +1,879 @@
+"""ModelConfig proto emission from the layer DAG.
+
+The reference builds ``ModelConfig`` *during* helper calls
+(``config_parser.py``: each ``LayerBase.__init__`` appends a ``LayerConfig``,
+``Parameter()`` appends a ``ParameterConfig``).  Here the runtime graph is
+the single source of truth — helper calls build :class:`LayerOutput` nodes
+(compiled to a jitted step by ``Topology``) — and this module *derives* the
+byte-compatible proto from those nodes afterwards.  Per-layer-type emit
+functions reproduce the reference's accreted field semantics (defaults,
+computed conv geometry, parameter dims/init) so protostr output matches the
+reference's goldens (``trainer_config_helpers/tests/configs/protostr``).
+
+Layer ordering follows the creation-order registry
+(:func:`paddle_tpu.layers.base.layer_registry`), matching the reference's
+append-at-call-time order, not the topo-sort used for execution.
+"""
+
+from __future__ import annotations
+
+import math
+
+from paddle_tpu import proto
+from paddle_tpu.config.protostr import to_protostr
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.layers.attr import ParamAttr
+from paddle_tpu.layers.base import LayerOutput
+
+EMITTERS: dict = {}
+
+
+def emits(*types):
+    def deco(fn):
+        for t in types:
+            EMITTERS[t] = fn
+        return fn
+
+    return deco
+
+
+class Emitter:
+    """One ModelConfig under construction (≅ config_parser globals)."""
+
+    def __init__(self, settings: dict | None = None):
+        s = settings or {}
+        self.mc = proto.ModelConfig()
+        self.mc.type = "nn"
+        self.root = self.mc.sub_models.add()
+        self.root.name = "root"
+        self.root.is_recurrent_layer_group = False
+        self.cur_submodel = self.root
+        self._param_names: set[str] = set()
+        self._layer_names: set[str] = set()
+        # g_default_* (config_parser.py:118-121 + settings())
+        self.defaults = {
+            "initial_mean": 0.0,
+            "initial_std": 0.01,
+            "initial_strategy": 0,
+            "initial_smart": False,
+            "momentum": s.get("default_momentum"),
+            "decay_rate": s.get("default_decay_rate"),
+            "num_batches_regularization": s.get("num_batches_regularization"),
+            "gradient_clipping_threshold": None,
+        }
+
+    # -- core helpers (≅ LayerBase / Parameter) ---------------------------
+
+    def layer(self, node: LayerOutput, ltype: str | None = None,
+              active_type: str | None = None, size: int | None = None,
+              inputs: bool = True):
+        """≅ LayerBase.__init__ (config_parser.py:1541): append LayerConfig,
+        one LayerInputConfig per parent, register in current submodel."""
+        lc = self.mc.layers.add()
+        lc.name = node.name
+        lc.type = ltype or node.layer_type
+        if active_type is None:
+            active_type = node.attrs.get("active_type", "")
+        lc.active_type = active_type
+        if size is None:
+            size = node.size
+        if size:
+            lc.size = int(size)
+        if node.attrs.get("drop_rate"):
+            lc.drop_rate = float(node.attrs["drop_rate"])
+        if node.attrs.get("error_clipping_threshold") is not None:
+            lc.error_clipping_threshold = node.attrs["error_clipping_threshold"]
+        if node.attrs.get("coeff_field") is not None:
+            lc.coeff = float(node.attrs["coeff_field"])
+        if inputs:
+            for p in node.parents:
+                lc.inputs.add().input_layer_name = p.name
+        self.cur_submodel.layer_names.append(node.name)
+        self._layer_names.add(node.name)
+        return lc
+
+    def parameter(self, name: str, size: int, dims, attr: ParamAttr | None,
+                  extra: dict | None = None, sparse=None, fmt=None):
+        """≅ Parameter() (config_parser.py:3852): shared params emitted once;
+        smart init recomputes mean/std from dims."""
+        if name in self._param_names:
+            return
+        self._param_names.add(name)
+        pf = dict(attr.proto_fields()) if attr is not None else {}
+        if extra:
+            pf.update(extra)
+        d = self.defaults
+        p = self.mc.parameters.add()
+        p.name = name
+        p.size = int(size)
+        p.dims.extend(int(x) for x in dims)
+        if "learning_rate" in pf:
+            p.learning_rate = float(pf["learning_rate"])
+        mom = pf.get("momentum", d["momentum"])
+        if mom is not None:
+            p.momentum = float(mom)
+        dr = pf.get("decay_rate", d["decay_rate"])
+        if dr is not None:
+            p.decay_rate = float(dr)
+        if "decay_rate_l1" in pf:
+            p.decay_rate_l1 = float(pf["decay_rate_l1"])
+        p.initial_std = float(pf.get("initial_std", d["initial_std"]))
+        p.initial_mean = float(pf.get("initial_mean", d["initial_mean"]))
+        nbr = pf.get("num_batches_regularization", d["num_batches_regularization"])
+        if nbr is not None:
+            p.num_batches_regularization = int(nbr)
+        if "sparse_remote_update" in pf:
+            p.sparse_remote_update = bool(pf["sparse_remote_update"])
+        if "sparse_update" in pf:
+            p.sparse_update = bool(pf["sparse_update"])
+        gct = pf.get(
+            "gradient_clipping_threshold", d["gradient_clipping_threshold"]
+        )
+        if gct is not None:
+            p.gradient_clipping_threshold = float(gct)
+        p.initial_strategy = int(pf.get("initial_strategy", d["initial_strategy"]))
+        p.initial_smart = bool(pf.get("initial_smart", d["initial_smart"]))
+        if p.initial_smart:
+            p.initial_mean = 0.0
+            p.initial_std = 1.0 / math.sqrt(p.dims[0] if p.dims else p.size)
+        if sparse is not None:
+            p.is_sparse = bool(sparse)
+        if fmt is not None:
+            p.format = fmt
+        if "is_static" in pf:
+            p.is_static = bool(pf["is_static"])
+        if "is_shared" in pf:
+            p.is_shared = bool(pf["is_shared"])
+        for hook in pf.get("update_hooks", ()):
+            h = p.update_hooks.add()
+            h.type = hook[0]
+            if hook[1] is not None:
+                h.sparsity_ratio = hook[1]
+        return p
+
+    # -- spec plumbing ----------------------------------------------------
+
+    @staticmethod
+    def split_specs(node: LayerOutput):
+        """(weight_specs, bias_spec) — bias by the ``.wbias`` naming
+        convention used throughout the layer constructors."""
+        ws, b = [], None
+        for s in node.param_specs:
+            if s.name.endswith(".wbias"):
+                b = s
+            else:
+                ws.append(s)
+        return ws, b
+
+    def input_param(self, lc, idx: int, spec, size: int, dims,
+                    default_attr: ParamAttr | None = None, extra=None,
+                    sparse=None, fmt=None):
+        """≅ create_input_parameter (config_parser.py:1687)."""
+        lc.inputs[idx].input_parameter_name = spec.name
+        attr = spec.attr
+        if default_attr is not None and (attr is None or _is_default_attr(attr)):
+            attr = default_attr  # layer-specific default init (e.g. conv MSRA)
+        self.parameter(spec.name, size, dims, attr, extra=extra,
+                       sparse=sparse, fmt=fmt)
+
+    def bias_param(self, lc, node: LayerOutput, size: int, dims=None,
+                   bias_spec=None):
+        """≅ create_bias_parameter (config_parser.py:1634): default bias
+        attr is zero-init gauss (wrap_bias_attr_default,
+        default_decorators.py:144)."""
+        if bias_spec is None:
+            _, bias_spec = self.split_specs(node)
+        if bias_spec is None:
+            return
+        if dims is None:
+            dims = [1, size]
+        attr = bias_spec.attr
+        if attr is None or _is_default_attr(attr):
+            attr = ParamAttr(initial_std=0.0, initial_mean=0.0)
+        lc.bias_parameter_name = bias_spec.name
+        self.parameter(bias_spec.name, size, dims, attr)
+
+    # -- finalization ------------------------------------------------------
+
+    def finalize(self, input_names, output_names):
+        self.mc.input_layer_names.extend(input_names)
+        self.mc.output_layer_names.extend(output_names)
+        self.root.input_layer_names.extend(input_names)
+        self.root.output_layer_names.extend(output_names)
+
+    def evaluator(self, etype: str, name: str, inputs: list[str], **kw):
+        """≅ Evaluator() (config_parser.py:1470)."""
+        ev = self.mc.evaluators.add()
+        ev.type = etype
+        ev.name = name
+        ev.input_layers.extend(inputs)
+        for k, v in kw.items():
+            if v is not None:
+                setattr(ev, k, v)
+        self.cur_submodel.evaluator_names.append(name)
+        return ev
+
+
+def _is_default_attr(a: ParamAttr) -> bool:
+    """True when the user supplied no init/decay info (plain ParamAttr())."""
+    return (
+        a.initial_std is None and a.initial_mean is None
+        and a.initial_max is None and a.initial_min is None
+        and a.learning_rate is None and a.l1_rate is None
+        and a.l2_rate is None and a.momentum is None
+        and not a.is_static and not a.sparse_update
+        and a.gradient_clipping_threshold is None
+        and a.sparsity_ratio is None and a.initializer is None
+        and a.name is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers (≅ config_parser cnn_output_size / get_img_size)
+# ---------------------------------------------------------------------------
+
+
+def cnn_output_size(img_size, filter_size, padding, stride, caffe_mode=True):
+    out = (2 * padding + img_size - filter_size) / float(stride)
+    return 1 + int(math.floor(out) if caffe_mode else math.ceil(out))
+
+
+def cnn_image_size(output_size, filter_size, padding, stride, caffe_mode=True):
+    img = (output_size - 1) * stride + filter_size - 2 * padding
+    return img if caffe_mode else img + 1
+
+
+def get_img_size(parent: LayerOutput, channels: int):
+    pixels = parent.size // channels
+    img_size = parent.width if parent.width > 0 else int(pixels ** 0.5)
+    img_size_y = parent.height if parent.height > 0 else int(pixels // img_size)
+    enforce(
+        img_size * img_size_y == pixels,
+        f"layer {parent.name}: image size {img_size}x{img_size_y} != {pixels} px",
+    )
+    return img_size, img_size_y
+
+
+# ---------------------------------------------------------------------------
+# per-type emitters
+# ---------------------------------------------------------------------------
+
+
+@emits("data")
+def _data(E: Emitter, node: LayerOutput):
+    lc = E.layer(node, active_type="")
+    if node.attrs.get("explicit_hw"):
+        lc.height = node.height
+        lc.width = node.width
+
+
+@emits("fc")
+def _fc(E: Emitter, node: LayerOutput):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    for i, (p, spec) in enumerate(zip(node.parents, ws)):
+        E.input_param(lc, i, spec, p.size * node.size, [p.size, node.size])
+    E.bias_param(lc, node, node.size)
+
+
+@emits("trans")
+def _trans(E, node):
+    E.layer(node, active_type="")
+
+
+@emits("selective_fc")
+def _selective_fc(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    # inputs: [data..., select]; parameters only for the data inputs
+    for i, spec in enumerate(ws):
+        p = node.parents[i]
+        E.input_param(lc, i, spec, p.size * node.size, [p.size, node.size],
+                      sparse=False)
+    E.bias_param(lc, node, node.size)
+    lc.selective_fc_pass_generation = node.attrs.get("pass_generation", False)
+    lc.has_selected_colums = node.attrs.get("has_selected_colums", True)
+    lc.selective_fc_full_mul_ratio = node.attrs.get("full_mul_ratio", 0.02)
+
+
+@emits("exconv", "exconvt")
+def _conv(E: Emitter, node: LayerOutput):
+    a = node.attrs
+    trans = node.layer_type == "exconvt"
+    lc = E.layer(node)
+    lc.ClearField("size")
+    num_filters = a["num_filters"]
+    lc.num_filters = num_filters
+    lc.shared_biases = a.get("shared_biases", True)
+    parent = node.parents[0]
+    groups = a.get("groups", 1)
+    kh, kw = a["filter_size"]
+    sh, sw = a["stride"]
+    ph, pw = a["padding"]
+    channels = a.get("channels") or parent.depth
+    cc = lc.inputs[0].conv_conf
+    cc.filter_size = kw
+    cc.filter_size_y = kh
+    cc.channels = channels
+    cc.padding = pw
+    cc.padding_y = ph
+    cc.stride = sw
+    cc.stride_y = sh
+    cc.groups = groups
+    cc.caffe_mode = a.get("caffe_mode", True)
+    if not trans:
+        cc.filter_channels = channels // groups
+        cc.img_size, cc.img_size_y = get_img_size(parent, channels)
+        cc.output_x = cnn_output_size(cc.img_size, cc.filter_size, cc.padding,
+                                      cc.stride, cc.caffe_mode)
+        cc.output_y = cnn_output_size(cc.img_size_y, cc.filter_size_y,
+                                      cc.padding_y, cc.stride_y, cc.caffe_mode)
+        out_x, out_y = cc.output_x, cc.output_y
+    else:
+        cc.filter_channels = num_filters // groups
+        cc.output_x, cc.output_y = get_img_size(parent, channels)
+        cc.img_size = cnn_image_size(cc.output_x, cc.filter_size, cc.padding,
+                                     cc.stride, cc.caffe_mode)
+        cc.img_size_y = cnn_image_size(cc.output_y, cc.filter_size_y,
+                                       cc.padding_y, cc.stride_y, cc.caffe_mode)
+        out_x, out_y = cc.img_size, cc.img_size_y
+    dil = a.get("dilation", (1, 1))
+    if isinstance(dil, int):
+        dil = (dil, dil)
+    if dil[0] > 1 or dil[1] > 1:
+        cc.dilation = dil[1]
+        cc.dilation_y = dil[0]
+    ws, _ = E.split_specs(node)
+    # ConvLayerBase vs ConvTransLayerBase calc_parameter_size
+    psize = (channels if trans else num_filters) * cc.filter_channels * kh * kw
+    default_attr = ParamAttr(
+        initial_mean=0.0,
+        initial_std=(2.0 / (cc.filter_size ** 2 * channels)) ** 0.5,
+    )
+    E.input_param(lc, 0, ws[0], psize, [], default_attr=default_attr)
+    lc.size = num_filters * out_y * out_x
+    lc.height, lc.width = out_y, out_x
+    if lc.shared_biases:
+        E.bias_param(lc, node, num_filters, dims=[num_filters, 1])
+    else:
+        E.bias_param(lc, node, lc.size, dims=[lc.size, 1])
+
+
+@emits("pool")
+def _pool(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    lc.ClearField("size")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    kh, kw = a["pool_size"]
+    sh, sw = a["stride"]
+    ph, pw = a.get("padding", (0, 0))
+    pc = lc.inputs[0].pool_conf
+    pc.pool_type = {"max": "max-projection", "average": "avg-projection"}.get(
+        a["pool_type"], a["pool_type"])
+    pc.channels = channels
+    pc.size_x = kw
+    pc.stride = sw
+    pc.size_y = kh
+    pc.stride_y = sh
+    pc.img_size, pc.img_size_y = get_img_size(parent, channels)
+    pc.padding = pw
+    pc.padding_y = ph
+    ceil_mode = a.get("ceil_mode", True)
+    pc.output_x = cnn_output_size(pc.img_size, pc.size_x, pc.padding,
+                                  pc.stride, not ceil_mode)
+    pc.output_y = cnn_output_size(pc.img_size_y, pc.size_y, pc.padding_y,
+                                  pc.stride_y, not ceil_mode)
+    lc.size = pc.output_x * pc.output_y * channels
+    lc.height, lc.width = pc.output_y, pc.output_x
+
+
+@emits("norm")
+def _norm(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    lc.ClearField("size")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    nc = lc.inputs[0].norm_conf
+    nc.norm_type = a.get("norm_type", "cmrnorm-projection")
+    nc.channels = channels
+    nc.size = a["size"]
+    nc.scale = a.get("scale", 0.0128)  # img_cmrnorm_layer default alpha
+    nc.pow = a.get("power", 0.75)
+    nc.blocked = a.get("blocked", False)
+    nc.img_size, nc.img_size_y = get_img_size(parent, channels)
+    nc.output_x = nc.img_size
+    nc.output_y = nc.img_size_y
+    if nc.norm_type == "cmrnorm-projection":
+        nc.scale /= nc.size
+    else:
+        nc.scale /= nc.size ** 2
+    lc.size = nc.output_x * nc.output_y * channels
+    lc.height, lc.width = nc.output_y, nc.output_x
+
+
+@emits("batch_norm")
+def _batch_norm(E, node):
+    a = node.attrs
+    lc = E.layer(node)
+    lc.ClearField("size")
+    parent = node.parents[0]
+    # reference adds two extra self-inputs for the moving stats
+    # (config_parser.py:2425-2434)
+    for _ in range(2):
+        lc.inputs.add().input_layer_name = parent.name
+    channels = a.get("channels") or parent.depth
+    ic = lc.inputs[0].image_conf
+    ic.channels = channels
+    img_size_set = parent.width > 0 or parent.height > 0
+    if parent.size % channels == 0 and (parent.size // channels) >= 1:
+        try:
+            ic.img_size, ic.img_size_y = get_img_size(parent, channels)
+        except Exception:
+            ic.img_size = parent.size // channels
+            ic.img_size_y = 1
+    if a.get("use_global_stats") is not None:
+        lc.use_global_stats = a["use_global_stats"]
+    lc.moving_average_fraction = a.get("moving_average_fraction", 0.9)
+    if img_size_set:
+        lc.size = ic.img_size * ic.img_size_y * channels
+        lc.height, lc.width = ic.img_size_y, ic.img_size
+        lc.depth = 1
+    else:
+        lc.size = parent.size
+    psize = channels
+    ws, bias = E.split_specs(node)
+    default_w = ParamAttr(initial_mean=1.0, initial_std=0.0)
+    E.input_param(lc, 0, ws[0], psize, [], default_attr=default_w)
+    stat_attr = ParamAttr(initial_std=0.0, initial_mean=0.0, is_static=True)
+    extra = {"is_shared": True}
+    for i, sname in enumerate(a["stat_param_names"]):
+        lc.inputs[1 + i].input_parameter_name = sname
+        E.parameter(sname, psize, [1, psize], stat_attr, extra=extra)
+    E.bias_param(lc, node, psize, dims=[1, psize], bias_spec=bias)
+
+
+@emits("addto")
+def _addto(E, node):
+    lc = E.layer(node)
+    E.bias_param(lc, node, node.size)
+    lc.height, lc.width = node.height, node.width
+    lc.depth = node.depth
+
+
+@emits("concat")
+def _concat(E, node):
+    lc = E.layer(node)
+    lc.height, lc.width = node.height, node.width
+    lc.depth = node.depth
+
+
+@emits("seqlastins")
+def _seqlastins(E, node):
+    a = node.attrs
+    lc = E.layer(node)
+    if a.get("select_first"):
+        lc.select_first = True
+    lc.trans_type = a.get("trans_type", "non-seq")
+    lc.seq_pool_stride = a.get("stride", -1)
+    E.bias_param(lc, node, node.size)
+
+
+@emits("expand")
+def _expand(E, node):
+    lc = E.layer(node)
+    lc.trans_type = node.attrs.get("trans_type", "non-seq")
+    E.bias_param(lc, node, node.size)
+
+
+@emits("average", "max")
+def _seq_pool(E, node):
+    a = node.attrs
+    lc = E.layer(node)
+    if node.layer_type == "average":
+        lc.average_strategy = a.get("average_strategy", "average")
+    if a.get("output_max_index") is not None:
+        lc.output_max_index = a["output_max_index"]
+    lc.trans_type = a.get("trans_type", "non-seq")
+    lc.seq_pool_stride = a.get("stride", -1)
+    E.bias_param(lc, node, node.size)
+
+
+# -- cost layers -----------------------------------------------------------
+
+_COST_TYPES = (
+    "multi-class-cross-entropy",
+    "mse",
+    "square_error",
+    "rank-cost",
+    "lambda_cost",
+    "multi_class_cross_entropy_with_selfnorm",
+    "sum_cost",
+    "huber_regression",
+    "huber_classification",
+    "multi_binary_label_cross_entropy",
+    "smooth_l1",
+    "soft_binary_class_cross_entropy",
+)
+
+
+@emits(*_COST_TYPES)
+def _cost(E, node):
+    a = node.attrs
+    size = node.size or 1
+    if node.layer_type == "multi_class_cross_entropy_with_selfnorm":
+        size = 0  # reference creates it with size 0 (not printed)
+    lc = E.layer(node, active_type="", size=size)
+    if node.layer_type == "lambda_cost":
+        lc.NDCG_num = a.get("NDCG_num", 5)
+        lc.max_sort_size = a.get("max_sort_size", -1)
+        return  # lambda_cost prints no coeff
+    if node.layer_type == "multi_class_cross_entropy_with_selfnorm":
+        lc.softmax_selfnorm_alpha = a.get("softmax_selfnorm_alpha", 0.1)
+    lc.coeff = float(a.get("coeff", 1.0))
+    if node.layer_type == "huber_regression":
+        lc.delta = a.get("delta", 1.0)
+    if a.get("metric"):
+        ev_type, ev_inputs = a["metric"]
+        if ev_type == "classification_error":
+            E.evaluator(
+                "classification_error",
+                "classification_error_evaluator",
+                list(ev_inputs),
+            )
+
+
+@emits("ctc")
+def _ctc2(E, node):
+    lc = E.layer(node, active_type="")
+    lc.norm_by_times = node.attrs.get("norm_by_times", False)
+
+
+@emits("warp_ctc")
+def _warp_ctc(E, node):
+    lc = E.layer(node, active_type="")
+    lc.norm_by_times = node.attrs.get("norm_by_times", False)
+    lc.blank = node.attrs.get("blank", 0)
+
+
+@emits("recurrent")
+def _recurrent(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    d = node.size
+    E.input_param(lc, 0, ws[0], d * d, [d, d])
+    E.bias_param(lc, node, d)
+    lc.reversed = node.attrs.get("reverse", False)
+
+
+@emits("lstmemory")
+def _lstmemory(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    d = node.size
+    # reference LstmLayer (config_parser.py): w0 size 4*d*d dims [d, d, 4];
+    # bias 7*d (gates + peepholes) dims [1, 7d]
+    E.input_param(lc, 0, ws[0], d * d * 4, [d, d, 4])
+    E.bias_param(lc, node, 7 * d, dims=[1, 7 * d])
+    lc.reversed = node.attrs.get("reverse", False)
+    lc.active_gate_type = node.attrs.get("active_gate_type", "sigmoid")
+    lc.active_state_type = node.attrs.get("active_state_type", "tanh")
+
+
+@emits("gated_recurrent")
+def _gated_recurrent(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    d = node.size
+    E.input_param(lc, 0, ws[0], d * d * 3, [d, 3 * d])
+    E.bias_param(lc, node, 3 * d, dims=[1, 3 * d])
+    lc.reversed = node.attrs.get("reverse", False)
+    lc.active_gate_type = node.attrs.get("active_gate_type", "sigmoid")
+
+
+@emits("hsigmoid")
+def _hsigmoid(E, node):
+    lc = E.layer(node, active_type="", size=1)
+    ws, _ = E.split_specs(node)
+    n = node.attrs["num_classes"]
+    for i, spec in enumerate(ws):
+        p = node.parents[i]
+        E.input_param(lc, i, spec, (n - 1) * p.size, [n - 1, p.size])
+    E.bias_param(lc, node, n - 1, dims=[1, n - 1])
+    lc.num_classes = n
+
+
+@emits("print")
+def _print(E, node):
+    lc = E.layer(node, active_type="", size=0)
+    lc.user_arg = node.attrs["user_arg"]
+
+
+@emits("sampling_id", "resize", "row_l2_norm", "multiplex", "seqconcat",
+       "seqreshape", "conv_shift", "out_prod", "sub_nested_seq", "eos",
+       "trans")
+def _plain(E, node):
+    E.layer(node, active_type=node.attrs.get("active_type", ""))
+
+
+@emits("clip")
+def _clip(E, node):
+    lc = E.layer(node, active_type="")
+    cc = lc.inputs[0].clip_conf
+    cc.min = node.attrs["clip_min"]
+    cc.max = node.attrs["clip_max"]
+
+
+@emits("featmap_expand")
+def _featmap_expand(E, node):
+    lc = E.layer(node)
+    lc.num_filters = node.attrs["num_filters"]
+    if node.attrs.get("user_arg"):
+        lc.user_arg = node.attrs["user_arg"]
+
+
+@emits("seq_slice")
+def _seq_slice(E, node):
+    lc = E.layer(node, active_type="")
+    if "select_first" in node.attrs:
+        lc.select_first = bool(node.attrs["select_first"])
+
+
+@emits("kmax_seq_score")
+def _kmax(E, node):
+    lc = E.layer(node, active_type="", size=0)
+    lc.beam_size = node.attrs["beam_size"]
+
+
+@emits("prelu")
+def _prelu(E, node):
+    lc = E.layer(node, active_type="")
+    ws, _ = E.split_specs(node)
+    partial = node.attrs.get("partial_sum", 1)
+    E.input_param(lc, 0, ws[0], node.size // partial, [])
+    lc.partial_sum = partial
+
+
+@emits("row_conv")
+def _row_conv(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    ctx_len = node.attrs["context_len"]
+    lc.inputs[0].row_conv_conf.context_length = ctx_len
+    E.input_param(lc, 0, ws[0], ctx_len * node.size, [ctx_len, node.size])
+
+
+@emits("scale_shift")
+def _scale_shift(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    E.input_param(lc, 0, ws[0], 1, [1, 1])
+    E.bias_param(lc, node, 1, dims=[1, 1])
+
+
+@emits("maxout")
+def _maxout(E, node):
+    lc = E.layer(node, active_type="")
+    parent = node.parents[0]
+    channels = node.attrs.get("channels") or parent.depth
+    mo = lc.inputs[0].maxout_conf
+    mo.image_conf.channels = channels
+    mo.image_conf.img_size, mo.image_conf.img_size_y = get_img_size(
+        parent, channels
+    )
+    mo.groups = node.attrs["groups"]
+    lc.size = parent.size // mo.groups
+    lc.height, lc.width = mo.image_conf.img_size_y, mo.image_conf.img_size
+
+
+@emits("pad")
+def _pad(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    pc = lc.inputs[0].pad_conf
+    pc.image_conf.channels = channels
+    pc.image_conf.img_size, pc.image_conf.img_size_y = get_img_size(
+        parent, channels
+    )
+    pc.pad_c.extend(a["pad_c"])
+    pc.pad_h.extend(a["pad_h"])
+    pc.pad_w.extend(a["pad_w"])
+    out_ch = channels + sum(a["pad_c"])
+    out_h = pc.image_conf.img_size_y + sum(a["pad_h"])
+    out_w = pc.image_conf.img_size + sum(a["pad_w"])
+    lc.size = out_ch * out_h * out_w
+    lc.height, lc.width = out_h, out_w
+
+
+@emits("spp")
+def _spp(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    sc = lc.inputs[0].spp_conf
+    sc.image_conf.channels = channels
+    sc.image_conf.img_size, sc.image_conf.img_size_y = get_img_size(
+        parent, channels
+    )
+    sc.pool_type = a["pool_type"]
+    sc.pyramid_height = a["pyramid_height"]
+    out_x = (4 ** sc.pyramid_height - 1) // 3
+    lc.size = channels * out_x
+    lc.height, lc.width = 1, out_x
+
+
+@emits("bilinear_interp")
+def _bilinear(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    bc = lc.inputs[0].bilinear_interp_conf
+    bc.image_conf.channels = channels
+    bc.image_conf.img_size, bc.image_conf.img_size_y = get_img_size(
+        parent, channels
+    )
+    bc.out_size_x = a["out_size_x"]
+    bc.out_size_y = a["out_size_y"]
+    lc.size = channels * bc.out_size_x * bc.out_size_y
+    lc.height, lc.width = bc.out_size_y, bc.out_size_x
+
+
+@emits("blockexpand")
+def _blockexpand(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    bc = lc.inputs[0].block_expand_conf
+    bc.channels = channels
+    bc.stride_x = a["stride_x"]
+    bc.stride_y = a["stride_y"]
+    bc.padding_x = a.get("padding_x", 0)
+    bc.padding_y = a.get("padding_y", 0)
+    bc.block_x = a["block_x"]
+    bc.block_y = a["block_y"]
+    # reference parse_block_expand takes img sizes from the helper args
+    # (default 0), not from the input layer
+    bc.img_size_x = a.get("img_size_x", 0)
+    bc.img_size_y = a.get("img_size_y", 0)
+    if bc.img_size_x > 0:
+        bc.output_x = cnn_output_size(
+            bc.img_size_x, bc.block_x, bc.padding_x, bc.stride_x, False
+        )
+        bc.output_y = cnn_output_size(
+            bc.img_size_y, bc.block_y, bc.padding_y, bc.stride_y, False
+        )
+    else:
+        bc.output_x = bc.output_y = 0
+    lc.size = bc.block_x * bc.block_y * bc.channels
+
+
+@emits("tensor")
+def _tensor(E, node):
+    lc = E.layer(node)
+    ws, _ = E.split_specs(node)
+    a, b = node.parents
+    E.input_param(lc, 0, ws[0], node.size * a.size * b.size,
+                  [a.size, b.size])
+    E.bias_param(lc, node, node.size)
+
+
+@emits("linear_comb")
+def _linear_comb(E, node):
+    E.layer(node)
+
+
+@emits("slope_intercept")
+def _slope_intercept(E, node):
+    lc = E.layer(node, active_type="")
+    lc.slope = float(node.attrs.get("slope", 1.0))
+    lc.intercept = float(node.attrs.get("intercept", 0.0))
+
+
+@emits("interpolation", "power", "scaling", "sum_to_one_norm")
+def _weighted_pair(E, node):
+    E.layer(node, active_type="")
+
+
+@emits("cos", "cos_vm")
+def _cos(E, node):
+    lc = E.layer(node, active_type="")
+    lc.cos_scale = float(node.attrs.get("scale", 1.0))
+
+
+@emits("crf")
+def _crf(E, node):
+    n = node.attrs.get("num_classes", node.size)
+    lc = E.layer(node, active_type="", size=n)
+    ws, _ = E.split_specs(node)
+    E.input_param(lc, 0, ws[0], (n + 2) * n, [n + 2, n])
+    lc.coeff = float(node.attrs.get("coeff", 1.0))
+
+
+@emits("crf_decoding")
+def _crf_decoding(E, node):
+    n = node.attrs.get("num_classes")
+    lc = E.layer(node, active_type="", size=n)
+    ws, _ = E.split_specs(node)
+    E.input_param(lc, 0, ws[0], (n + 2) * n, [n + 2, n])
+
+
+@emits("nce")
+def _nce(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="sigmoid", size=1)
+    ws, _ = E.split_specs(node)
+    n = a["num_classes"]
+    for i, spec in enumerate(ws):
+        p = node.parents[i]
+        E.input_param(lc, i, spec, n * p.size, [n, p.size])
+    E.bias_param(lc, node, n, dims=[1, n])
+    lc.num_classes = n
+    lc.num_neg_samples = a.get("num_neg_samples", 10)
+    if a.get("neg_sampling_dist"):
+        lc.neg_sampling_dist.extend(a["neg_sampling_dist"])
+
+
+@emits("maxid")
+def _maxid(E, node):
+    lc = E.layer(node, active_type="")
+    if node.attrs.get("beam_size") is not None:
+        lc.beam_size = node.attrs["beam_size"]
+
+
+@emits("dropout")
+def _dropout(E, node):
+    E.layer(node)
+
+
+# ---------------------------------------------------------------------------
+# top level
+# ---------------------------------------------------------------------------
+
+
+def emit_model_config(registry, input_names, output_names,
+                      settings: dict | None = None):
+    E = Emitter(settings)
+    for node in registry:
+        fn = EMITTERS.get(node.layer_type)
+        enforce(
+            fn is not None,
+            f"no proto emitter for layer type {node.layer_type!r} "
+            f"(layer {node.name!r})",
+        )
+        fn(E, node)
+    E.finalize(input_names, output_names)
+    return E.mc
+
+
+def model_config_protostr(registry, input_names, output_names,
+                          settings=None) -> str:
+    return to_protostr(
+        emit_model_config(registry, input_names, output_names, settings)
+    )

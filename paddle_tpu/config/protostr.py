@@ -1,0 +1,110 @@
+"""Reference-flavored proto text rendering ("protostr").
+
+The reference's golden files (``trainer_config_helpers/tests/configs/
+protostr/*.protostr``) were produced by Python-2-era protobuf text_format,
+whose float rendering is py2 ``str(float)`` — 12 significant digits
+(``'%.12g'``) with a trailing ``.0`` for integral values.  Modern protobuf
+prints shortest-repr floats, so its output would differ byte-wise on any
+computed float (e.g. ``initial_std: 0.0441941738242``).  This tiny printer
+walks descriptors directly (fields in number order, 2-space indents, C-style
+string escaping) and reproduces the py2 spelling, giving byte-exact golden
+comparisons.
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor as _desc
+
+
+def py2_float_repr(v: float) -> str:
+    """Python-2 ``str(float)``: %.12g plus '.0' for integral magnitudes."""
+    s = "%.12g" % float(v)
+    if "." not in s and "e" not in s and "n" not in s and "i" not in s:
+        s += ".0"
+    return s
+
+
+def _escape(s) -> str:
+    if isinstance(s, bytes):
+        data = s
+    else:
+        data = s.encode("utf-8")
+    out = []
+    for b in data:
+        if b == 0x22:
+            out.append('\\"')
+        elif b == 0x5C:
+            out.append("\\\\")
+        elif b == 0x0A:
+            out.append("\\n")
+        elif b == 0x0D:
+            out.append("\\r")
+        elif b == 0x09:
+            out.append("\\t")
+        elif 0x20 <= b < 0x7F:
+            out.append(chr(b))
+        else:
+            out.append("\\%03o" % b)
+    return "".join(out)
+
+
+# double/float fields that config_parser assigns straight from user values
+# (no float() coercion): py2's pure-python protobuf stored the int as-is, so
+# goldens print them without ".0".  Fields the reference float()s always
+# print py2-float style.
+INT_STYLE_FIELDS = {
+    ("ClipConfig", "min"),
+    ("ClipConfig", "max"),
+    ("LayerConfig", "slope"),
+    ("LayerConfig", "intercept"),
+    ("LayerConfig", "cos_scale"),
+    ("OperatorConfig", "dotmul_scale"),
+    ("NormConfig", "pow"),
+}
+
+
+def _scalar(fd, v, msg_name: str = "") -> str:
+    t = fd.type
+    if t in (fd.TYPE_FLOAT, fd.TYPE_DOUBLE):
+        if (msg_name, fd.name) in INT_STYLE_FIELDS and float(v).is_integer():
+            return str(int(v))
+        return py2_float_repr(v)
+    if t == fd.TYPE_BOOL:
+        return "true" if v else "false"
+    if t == fd.TYPE_STRING or t == fd.TYPE_BYTES:
+        return f'"{_escape(v)}"'
+    if t == fd.TYPE_ENUM:
+        return fd.enum_type.values_by_number[v].name
+    return str(v)
+
+
+def _print_msg(msg, indent: int, out: list) -> None:
+    pad = "  " * indent
+    mname = msg.DESCRIPTOR.name
+    for fd in msg.DESCRIPTOR.fields:  # descriptor order == declaration order
+        if fd.label == _desc.FieldDescriptor.LABEL_REPEATED:
+            values = getattr(msg, fd.name)
+            for v in values:
+                if fd.type == fd.TYPE_MESSAGE:
+                    out.append(f"{pad}{fd.name} {{")
+                    _print_msg(v, indent + 1, out)
+                    out.append(f"{pad}}}")
+                else:
+                    out.append(f"{pad}{fd.name}: {_scalar(fd, v, mname)}")
+        else:
+            if not msg.HasField(fd.name):
+                continue
+            if fd.type == fd.TYPE_MESSAGE:
+                out.append(f"{pad}{fd.name} {{")
+                _print_msg(getattr(msg, fd.name), indent + 1, out)
+                out.append(f"{pad}}}")
+            else:
+                out.append(
+                    f"{pad}{fd.name}: {_scalar(fd, getattr(msg, fd.name), mname)}"
+                )
+
+
+def to_protostr(msg) -> str:
+    out: list[str] = []
+    _print_msg(msg, 0, out)
+    return "\n".join(out) + "\n"

@@ -74,7 +74,8 @@ class Topology:
         for n in self.nodes:
             m = n.attrs.get("metric")
             if m:
-                out.append((m[0], m[1], m[2], n.name))
+                names = m[1] if isinstance(m[1], (list, tuple)) else [m[1], m[2]]
+                out.append((m[0], names[0], names[1], n.name))
         return out
 
     # -- execution -------------------------------------------------------------

@@ -43,6 +43,10 @@ class ParamSpec:
     # magnitude pruning mask kept at this sparsity each update
     # (≅ ParameterUpdaterHook 'pruning' / StaticPruningHook)
     sparsity_ratio: float | None = None
+    # originating ParamAttr (None ⇒ all-default): carries the init metadata
+    # (initial_mean/std/strategy/smart) that ParameterConfig proto emission
+    # needs — the runtime uses only the compiled `initializer` above
+    attr: Any = None
 
     def init(self, key) -> jax.Array:
         return self.initializer(key, self.shape, self.dtype)

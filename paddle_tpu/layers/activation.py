@@ -30,7 +30,7 @@ def _mk(name: str) -> Callable[[], BaseActivation]:
     return ctor
 
 
-LinearActivation = _mk("linear")
+LinearActivation = _mk("")  # reference IdentityActivation proto name is ""
 IdentityActivation = LinearActivation
 SigmoidActivation = _mk("sigmoid")
 TanhActivation = _mk("tanh")
@@ -43,17 +43,19 @@ SquareActivation = _mk("square")
 ExpActivation = _mk("exponential")
 LogActivation = _mk("log")
 SoftmaxActivation = _mk("softmax")
-SequenceSoftmaxActivation = _mk("softmax")  # applied over time in layer impl
+SequenceSoftmaxActivation = _mk("sequence_softmax")
 ELUActivation = _mk("elu")
 LeakyReluActivation = _mk("leaky_relu")
 GeluActivation = _mk("gelu")
 SwishActivation = _mk("swish")
+SqrtActivation = _mk("sqrt")
+ReciprocalActivation = _mk("reciprocal")
 
 
 def get(act):
     """Normalize act argument: None -> linear; str -> registry; object -> itself."""
     if act is None:
-        return BaseActivation("linear", _ops.identity)
+        return BaseActivation("", _ops.identity)
     if isinstance(act, str):
         return BaseActivation(act, _ops.get(act))
     return act

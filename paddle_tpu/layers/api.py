@@ -66,8 +66,9 @@ def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
         shape=tuple(shape),
         initializer=a.make_initializer(default_init),
         is_static=a.is_static,
-        learning_rate=a.learning_rate,
+        learning_rate=1.0 if a.learning_rate is None else a.learning_rate,
         decay_rate=a.l2_rate,
+        attr=a,
         gradient_clipping_threshold=a.gradient_clipping_threshold,
         sparse=a.sparse_update,
         sharding=a.sharding,
@@ -78,9 +79,30 @@ def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
 
 
 def _maybe_dropout(node: LayerOutput, layer_attr: ExtraAttr | None) -> LayerOutput:
+    """Fold ExtraAttr.drop_rate into the node itself — the reference stores
+    it as ``LayerConfig.drop_rate`` on the same layer (no extra layer is
+    created), so both runtime graph and protostr keep reference naming."""
     if layer_attr is None or not layer_attr.drop_rate:
         return node
-    return dropout(input=node, dropout_rate=layer_attr.drop_rate)
+    rate = layer_attr.drop_rate
+    inner = node.fn
+
+    def fwd(ctx, params, states, *xs):
+        result = inner(ctx, params, states, *xs)
+        if not ctx.is_train:
+            return result
+        key = ctx.key_for(node.name)
+
+        def drop(v):
+            return map_data(lambda d: nn_ops.dropout(d, rate, key, True), v)
+
+        if isinstance(result, tuple) and len(result) == 2 and isinstance(result[1], dict):
+            return drop(result[0]), result[1]
+        return drop(result)
+
+    node.fn = fwd
+    node.attrs["drop_rate"] = rate
+    return node
 
 
 # ---------------------------------------------------------------------------
@@ -196,7 +218,7 @@ def embedding(
 ) -> LayerOutput:
     """≅ embedding_layer (layers.py:1045) / TableProjection.  Sparse-update
     semantics come from XLA's scatter-add gather gradient (SelectedRows analog)."""
-    name = name or gen_name("embedding_layer")
+    name = name or gen_name("embedding")
     vocab = input.size
     spec = _wspec(
         param_attr, name, "w0", (vocab, size), I.paddle_default(0.0, None), sparse=True
@@ -417,8 +439,10 @@ def batch_norm(
         bias_attr if isinstance(bias_attr, ParamAttr) else None,
         name, "wbias", (c,), I.constant(0.0),
     )
-    mean_s = StateSpec(f"_{name}.mean", (c,), 0.0)
-    var_s = StateSpec(f"_{name}.var", (c,), 1.0)
+    # reference ParameterConfig names for the moving stats (BatchNormLayer
+    # appends two static inputs .w1/.w2, config_parser.py:2425)
+    mean_s = StateSpec(f"_{name}.w1", (c,), 0.0)
+    var_s = StateSpec(f"_{name}.w2", (c,), 1.0)
     # reference batch_norm_layer default act is ReLU (layers.py:2975)
     activation = act_mod.get(act) if act is not None else act_mod.ReluActivation()
 
@@ -447,7 +471,11 @@ def batch_norm(
             height=input.height,
             width=input.width,
             depth=input.depth,
-            attrs={"channels": c, "epsilon": epsilon, "active_type": activation.name},
+            attrs={"channels": c, "epsilon": epsilon,
+                   "active_type": activation.name,
+                   "use_global_stats": use_global_stats,
+                   "moving_average_fraction": moving_average_fraction,
+                   "stat_param_names": (mean_s.name, var_s.name)},
         ),
         layer_attr,
     )
@@ -457,13 +485,13 @@ batch_norm_layer = batch_norm
 
 
 def img_cmrnorm(
-    input: LayerOutput, size: int = 5, scale: float = 0.0001, power: float = 0.75,
+    input: LayerOutput, size: int = 5, scale: float = 0.0128, power: float = 0.75,
     num_channels: int | None = None, name: str | None = None,
 ) -> LayerOutput:
     """≅ img_cmrnorm_layer (LRN across channels, CMRProjectionNormLayer).
     The reference divides alpha by the window size (config_parser.py:1362
     ``norm_conf.scale /= norm.size``)."""
-    name = name or gen_name("norm")
+    name = name or gen_name("crmnorm")
     c = num_channels or input.depth
     eff_scale = scale / size
 
@@ -484,7 +512,7 @@ img_cmrnorm_layer = img_cmrnorm
 def maxout(input: LayerOutput, groups: int, num_channels: int | None = None,
            name: str | None = None) -> LayerOutput:
     """≅ maxout_layer (MaxOutLayer)."""
-    name = name or gen_name("maxout")
+    name = name or gen_name("maxout_layer")
     c = num_channels or input.depth
     c_out = c // groups
 
@@ -496,7 +524,7 @@ def maxout(input: LayerOutput, groups: int, num_channels: int | None = None,
         name=name, layer_type="maxout", size=input.size // groups,
         parents=(input,), fn=fwd,
         height=input.height, width=input.width, depth=c_out,
-        attrs={"groups": groups},
+        attrs={"groups": groups, "channels": c},
     )
 
 
@@ -506,7 +534,7 @@ maxout_layer = maxout
 def bilinear_interp(input: LayerOutput, out_size_x: int, out_size_y: int,
                     name: str | None = None) -> LayerOutput:
     """≅ bilinear_interp_layer."""
-    name = name or gen_name("bilinear_interp")
+    name = name or gen_name("bilinear_interp_layer")
     c = input.depth
 
     def fwd(ctx, params, states, x):
@@ -516,7 +544,8 @@ def bilinear_interp(input: LayerOutput, out_size_x: int, out_size_y: int,
     return LayerOutput(
         name=name, layer_type="bilinear_interp", size=c * out_size_x * out_size_y,
         parents=(input,), fn=fwd, height=out_size_y, width=out_size_x, depth=c,
-        attrs={"out_size_x": out_size_x, "out_size_y": out_size_y},
+        attrs={"out_size_x": out_size_x, "out_size_y": out_size_y,
+               "channels": c},
     )
 
 
@@ -537,7 +566,9 @@ def spp(input: LayerOutput, pyramid_height: int, num_channels: int | None = None
 
     return LayerOutput(
         name=name, layer_type="spp", size=c * bins, parents=(input,), fn=fwd,
-        attrs={"pyramid_height": pyramid_height, "pool_type": ptype},
+        height=1, width=bins, depth=c,
+        attrs={"pyramid_height": pyramid_height, "channels": c,
+               "pool_type": ptype + "-projection"},
     )
 
 
@@ -558,7 +589,8 @@ def pad(input: LayerOutput, pad_c=None, pad_h=None, pad_w=None,
 
     return LayerOutput(
         name=name, layer_type="pad", size=c2 * h2 * w2, parents=(input,), fn=fwd,
-        height=h2, width=w2, depth=c2, attrs={"pad_c": pc, "pad_h": ph, "pad_w": pw},
+        height=h2, width=w2, depth=c2,
+        attrs={"pad_c": pc, "pad_h": ph, "pad_w": pw, "channels": c},
     )
 
 
@@ -567,7 +599,7 @@ pad_layer = pad
 
 def crop(input: LayerOutput, offset, shape, name: str | None = None) -> LayerOutput:
     """≅ crop_layer (paddle/function CropOp)."""
-    name = name or gen_name("crop")
+    name = name or gen_name("crop_layer")
     c, h, w = input.depth, input.height, input.width
     oh, ow = shape
 
@@ -586,7 +618,7 @@ crop_layer = crop
 
 def rotate(input: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ rotate_layer."""
-    name = name or gen_name("rotate")
+    name = name or gen_name("rotate_layer")
     c, h, w = input.depth, input.height, input.width
 
     def fwd(ctx, params, states, x):
@@ -605,7 +637,7 @@ def block_expand(input: LayerOutput, block_x: int, block_y: int,
                  stride_x: int, stride_y: int, padding_x: int = 0, padding_y: int = 0,
                  num_channels: int | None = None, name: str | None = None) -> LayerOutput:
     """≅ block_expand_layer (im2col -> sequence, used by OCR CRNN)."""
-    name = name or gen_name("blockexpand")
+    name = name or gen_name("block_expand_layer")
     c = num_channels or input.depth
     h, w = input.height, input.width
     out_dim = block_x * block_y * c
@@ -622,7 +654,8 @@ def block_expand(input: LayerOutput, block_x: int, block_y: int,
     return LayerOutput(
         name=name, layer_type="blockexpand", size=out_dim, parents=(input,), fn=fwd,
         attrs={"block_x": block_x, "block_y": block_y, "stride_x": stride_x,
-               "stride_y": stride_y},
+               "stride_y": stride_y, "padding_x": padding_x,
+               "padding_y": padding_y, "channels": c},
     )
 
 
@@ -741,23 +774,31 @@ def slice(input: LayerOutput, start: int, end: int, name: str | None = None) -> 
     )
 
 
-def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0,
-            name: str | None = None) -> LayerOutput:
-    """≅ cos_sim (CosSimLayer)."""
-    name = name or gen_name("cos")
+def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0, size: int = 1,
+            name: str | None = None, layer_attr=None) -> LayerOutput:
+    """≅ cos_sim (CosSimLayer); with size>1, b holds `size` vectors and the
+    output is a similarity per vector (CosSimVecMatLayer, type 'cos_vm')."""
+    name = name or gen_name("cos_sim")
 
     def fwd(ctx, params, states, xa, xb):
+        if size > 1:
+            va = raw(xa)
+            vb = raw(xb).reshape(va.shape[0], size, -1)
+            dots = jnp.einsum("bd,bsd->bs", va, vb)
+            na = jnp.linalg.norm(va, axis=-1, keepdims=True)
+            nb = jnp.linalg.norm(vb, axis=-1)
+            return scale * dots / jnp.maximum(na * nb, 1e-12)
         return math_ops.cos_sim(raw(xa), raw(xb), scale)[:, None]
 
     return LayerOutput(
-        name=name, layer_type="cos", size=1, parents=(a, b), fn=fwd,
-        attrs={"scale": scale},
+        name=name, layer_type="cos_vm" if size > 1 else "cos", size=size,
+        parents=(a, b), fn=fwd, attrs={"scale": scale},
     )
 
 
 def trans(input: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ trans_layer (TransLayer): matrix transpose of the feature block."""
-    name = name or gen_name("trans")
+    name = name or gen_name("trans_layer")
 
     def fwd(ctx, params, states, x):
         return jnp.swapaxes(raw(x), -1, -2)
@@ -772,7 +813,7 @@ trans_layer = trans
 def interpolation(input, weight: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ interpolation_layer: w*a + (1-w)*b."""
     a, b = input
-    name = name or gen_name("interpolation")
+    name = name or gen_name("interpolation_layer")
 
     def fwd(ctx, params, states, xa, xb, w):
         return math_ops.interpolation(raw(xa), raw(xb), raw(w))
@@ -786,7 +827,7 @@ interpolation_layer = interpolation
 
 def power(input: LayerOutput, weight: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ power_layer."""
-    name = name or gen_name("power")
+    name = name or gen_name("power_layer")
 
     def fwd(ctx, params, states, x, w):
         return math_ops.power(raw(x), raw(w))
@@ -800,7 +841,7 @@ power_layer = power
 
 def scaling(input: LayerOutput, weight: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ scaling_layer."""
-    name = name or gen_name("scaling")
+    name = name or gen_name("scaling_layer")
 
     def fwd(ctx, params, states, x, w):
         return like(x, math_ops.scaling(raw(x), raw(w)))
@@ -815,7 +856,7 @@ scaling_layer = scaling
 def slope_intercept(input: LayerOutput, slope: float = 1.0, intercept: float = 0.0,
                     name: str | None = None) -> LayerOutput:
     """≅ slope_intercept_layer."""
-    name = name or gen_name("slope_intercept")
+    name = name or gen_name("slope_intercept_layer")
 
     def fwd(ctx, params, states, x):
         return map_data(lambda d: math_ops.slope_intercept(d, slope, intercept), x)
@@ -830,7 +871,7 @@ slope_intercept_layer = slope_intercept
 
 def sum_to_one_norm(input: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ sum_to_one_norm_layer."""
-    name = name or gen_name("sum_to_one_norm")
+    name = name or gen_name("sum_to_one_norm_layer")
 
     def fwd(ctx, params, states, x):
         return map_data(math_ops.sum_to_one_norm, x)
@@ -844,7 +885,7 @@ sum_to_one_norm_layer = sum_to_one_norm
 
 def row_l2_norm(input: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ row_l2_norm_layer."""
-    name = name or gen_name("row_l2_norm")
+    name = name or gen_name("row_l2_norm_layer")
 
     def fwd(ctx, params, states, x):
         return map_data(math_ops.l2_normalize, x)
@@ -862,10 +903,13 @@ row_l2_norm_layer = row_l2_norm
 
 
 def pooling(input: LayerOutput, pooling_type=None, name: str | None = None,
-            layer_attr: ExtraAttr | None = None) -> LayerOutput:
-    """≅ pooling_layer (layers.py:1268, SequencePoolLayer): seq -> vector."""
-    name = name or gen_name("seqpool")
+            agg_level: str = "non-seq", stride: int = -1,
+            bias_attr=None, layer_attr: ExtraAttr | None = None) -> LayerOutput:
+    """≅ pooling_layer (layers.py:1268, SequencePoolLayer): seq -> vector.
+    ``agg_level`` 'seq' pools each inner sequence of a nested batch."""
+    name = name or gen_name("seq_pooling")
     ptype = pool_mod.get(pooling_type) if pooling_type is not None else "max"
+    out_max_index = bool(getattr(pooling_type, "output_max_index", False))
 
     fns = {
         "max": seq_ops.seq_pool_max,
@@ -874,52 +918,111 @@ def pooling(input: LayerOutput, pooling_type=None, name: str | None = None,
         "sqrt": seq_ops.seq_pool_sqrt,
     }
 
+    mode = {"max": "max", "average": "average", "sum": "sum",
+            "sqrt": "sqrt"}[ptype]
+
     def fwd(ctx, params, states, x):
         if isinstance(x, NestedSequenceBatch):
-            x = x.flatten_outer()
+            enforce(not out_max_index and not (stride and stride > 0),
+                    "pooling: output_max_index/stride unsupported on nested "
+                    "sequence input")
+            if agg_level == "seq":
+                # pool each inner sequence -> one step per subsequence
+                return seq_ops.seq_pool_inner(x, mode)
+            return seq_ops.seq_pool_all_nested(x, mode)
+        if out_max_index:
+            enforce(not (stride and stride > 0),
+                    "pooling: stride with output_max_index unsupported")
+            return jnp.argmax(
+                jnp.where(x.mask()[..., None] > 0, x.data, -jnp.inf), axis=1
+            ).astype(jnp.float32)
+        if stride and stride > 0:
+            return seq_ops.seq_pool_windows(x, stride, mode)
         return fns[ptype](x)
 
+    # proto type: max stays 'max'; average/sum/sqrt are 'average' with an
+    # average_strategy (config_parser: 'average'/'sum'/'squarerootn')
+    proto_type = "max" if ptype == "max" else "average"
+    strategy = {"average": "average", "sum": "sum", "sqrt": "squarerootn"}.get(ptype)
+    attrs = {"pool_type": ptype, "trans_type": agg_level, "stride": stride}
+    if proto_type == "average":
+        attrs["average_strategy"] = strategy
+    if out_max_index:
+        attrs["output_max_index"] = True
     return LayerOutput(
-        name=name, layer_type="seqpool", size=input.size, parents=(input,), fn=fwd,
-        attrs={"pool_type": ptype},
+        name=name, layer_type=proto_type, size=input.size, parents=(input,),
+        fn=fwd, attrs=attrs,
     )
 
 
 pooling_layer = pooling
 
 
-def last_seq(input: LayerOutput, name: str | None = None, **kw) -> LayerOutput:
+def last_seq(input: LayerOutput, name: str | None = None,
+             agg_level: str = "non-seq", stride: int = -1, **kw) -> LayerOutput:
     """≅ last_seq (layers.py:1303, SequenceLastInstanceLayer)."""
     name = name or gen_name("last_seq")
 
     def fwd(ctx, params, states, x):
+        if isinstance(x, NestedSequenceBatch):
+            if agg_level == "seq":
+                return seq_ops.seq_pool_inner(x, "last")
+            return seq_ops.seq_pool_all_nested(x, "last")
+        if stride and stride > 0:
+            return seq_ops.seq_pool_windows(x, stride, "last")
         return seq_ops.seq_last(x)
 
     return LayerOutput(name=name, layer_type="seqlastins", size=input.size,
-                       parents=(input,), fn=fwd)
+                       parents=(input,), fn=fwd,
+                       attrs={"trans_type": agg_level, "stride": stride})
 
 
-def first_seq(input: LayerOutput, name: str | None = None, **kw) -> LayerOutput:
-    """≅ first_seq (layers.py:1348)."""
+def first_seq(input: LayerOutput, name: str | None = None,
+              agg_level: str = "non-seq", stride: int = -1, **kw) -> LayerOutput:
+    """≅ first_seq (layers.py:1348); proto type is also 'seqlastins' with
+    select_first (LayerConfig.select_first, ModelConfig.proto:462)."""
     name = name or gen_name("first_seq")
 
     def fwd(ctx, params, states, x):
+        if isinstance(x, NestedSequenceBatch):
+            if agg_level == "seq":
+                return seq_ops.seq_pool_inner(x, "first")
+            return seq_ops.seq_pool_all_nested(x, "first")
+        if stride and stride > 0:
+            return seq_ops.seq_pool_windows(x, stride, "first")
         return seq_ops.seq_first(x)
 
-    return LayerOutput(name=name, layer_type="seqfirstins", size=input.size,
-                       parents=(input,), fn=fwd)
+    return LayerOutput(name=name, layer_type="seqlastins", size=input.size,
+                       parents=(input,), fn=fwd,
+                       attrs={"trans_type": agg_level, "stride": stride,
+                              "select_first": True})
 
 
 def expand(input: LayerOutput, expand_as: LayerOutput, name: str | None = None,
-           **kw) -> LayerOutput:
+           expand_level: str = "non-seq", bias_attr=None, **kw) -> LayerOutput:
     """≅ expand_layer (layers.py:1767, ExpandLayer)."""
-    name = name or gen_name("expand")
+    name = name or gen_name("expand_layer")
 
     def fwd(ctx, params, states, x, ref):
+        if expand_level == "seq":
+            # FROM_SEQUENCE: one vector per subsequence, repeated across
+            # that subsequence's timesteps
+            enforce(is_sequence(x) and isinstance(ref, NestedSequenceBatch),
+                    "expand FROM_SEQUENCE needs sequence input + nested ref")
+            t = ref.data.shape[2]
+            data = jnp.broadcast_to(
+                raw(x)[:, :, None, :],
+                raw(x).shape[:2] + (t,) + raw(x).shape[2:],
+            )
+            return NestedSequenceBatch(data=data, seq_length=ref.seq_length,
+                                       sub_length=ref.sub_length)
+        enforce(not isinstance(ref, NestedSequenceBatch),
+                "expand FROM_NO_SEQUENCE to nested target unsupported")
         return seq_ops.expand(raw(x) if not is_sequence(x) else seq_ops.seq_first(x), ref)
 
     return LayerOutput(name=name, layer_type="expand", size=input.size,
-                       parents=(input, expand_as), fn=fwd)
+                       parents=(input, expand_as), fn=fwd,
+                       attrs={"trans_type": expand_level})
 
 
 expand_layer = expand
@@ -957,8 +1060,11 @@ seq_reshape_layer = seq_reshape
 def seq_slice(input: LayerOutput, starts=None, ends=None, name: str | None = None) -> LayerOutput:
     """≅ seq_slice_layer (SequenceSliceLayer); starts/ends are layers holding
     per-row indices."""
-    name = name or gen_name("seq_slice")
+    name = name or gen_name("seq_slice_layer")
     parents = [input] + [p for p in (starts, ends) if p is not None]
+    attrs = {"dfs_parents": (input,)}
+    if len(parents) == 2:  # config_parser.py:3154 SeqSliceLayer
+        attrs["select_first"] = starts is not None
 
     def fwd(ctx, params, states, x, *se):
         t = x.max_len
@@ -973,7 +1079,7 @@ def seq_slice(input: LayerOutput, starts=None, ends=None, name: str | None = Non
         return seq_ops.seq_slice(x, s, e)
 
     return LayerOutput(name=name, layer_type="seq_slice", size=input.size,
-                       parents=tuple(parents), fn=fwd)
+                       parents=tuple(parents), fn=fwd, attrs=attrs)
 
 
 seq_slice_layer = seq_slice
@@ -1010,7 +1116,7 @@ def context_projection_layer(
 def row_conv(input: LayerOutput, context_len: int, act=None,
              param_attr: ParamAttr | None = None, name: str | None = None) -> LayerOutput:
     """≅ row_conv_layer (RowConvLayer, DeepSpeech2 lookahead)."""
-    name = name or gen_name("row_conv")
+    name = name or gen_name("row_conv_layer")
     wspec = _wspec(param_attr, name, "w0", (context_len, input.size), I.constant(0.0))
     activation = act_mod.get(act)
 
@@ -1020,7 +1126,8 @@ def row_conv(input: LayerOutput, context_len: int, act=None,
 
     return LayerOutput(name=name, layer_type="row_conv", size=input.size,
                        parents=(input,), param_specs=(wspec,), fn=fwd,
-                       attrs={"context_len": context_len})
+                       attrs={"context_len": context_len,
+                              "active_type": activation.name})
 
 
 row_conv_layer = row_conv
@@ -1036,7 +1143,7 @@ def recurrent(input: LayerOutput, act=None, bias_attr=None,
               name: str | None = None) -> LayerOutput:
     """≅ recurrent_layer (layers.py:3732, RecurrentLayer): input is the
     pre-projected sequence; only h_{t-1} @ U runs in the scan."""
-    name = name or gen_name("recurrent")
+    name = name or gen_name("recurrent_layer")
     d = input.size
     wspec = _wspec(param_attr, name, "w0", (d, d), I.paddle_default())
     specs = [wspec]
@@ -1057,7 +1164,8 @@ def recurrent(input: LayerOutput, act=None, bias_attr=None,
 
     return LayerOutput(name=name, layer_type="recurrent", size=d, parents=(input,),
                        param_specs=tuple(specs), fn=fwd,
-                       attrs={"reverse": reverse, "active_type": activation.name})
+                       attrs={"reverse": reverse, "active_type": activation.name,
+                              "reversed_field": True})
 
 
 recurrent_layer = recurrent
@@ -1076,23 +1184,32 @@ def lstmemory(input: LayerOutput, reverse: bool = False, act=None,
     specs = [wspec]
     use_bias = bias_attr is not False
     if use_bias:
+        # reference LstmLayer bias is 7*d (config_parser.py LstmLayer:
+        # gate biases 4d + peephole weights W_ci/W_cf/W_co 3d) — kept as ONE
+        # parameter so names/shapes match checkpoints and protostr
         bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
-                       name, "wbias", (4 * d,), I.constant(0.0))
+                       name, "wbias", (7 * d,), I.constant(0.0))
         specs.append(bspec)
+    oa = act_mod.get(act) if act else act_mod.TanhActivation()
     ga = act_mod.get(gate_act) if gate_act else act_mod.SigmoidActivation()
     sa = act_mod.get(state_act) if state_act else act_mod.TanhActivation()
 
     def fwd(ctx, params, states, x):
         b_, t = x.batch_size, x.max_len
         xw = x.data.reshape(b_, t, 4 * d)
+        peep = None
         if use_bias:
-            xw = xw + params[bspec.name]
+            full = params[bspec.name]
+            xw = xw + full[: 4 * d]
+            peep = full[4 * d:]
         init = rnn_ops.LSTMState(
             h=jnp.zeros((b_, d), jnp.float32), c=jnp.zeros((b_, d), jnp.float32)
         )
 
         def step(state, xt):
-            return rnn_ops.lstm_cell(xt, state, params[wspec.name], ga, sa)
+            return rnn_ops.lstm_cell(
+                xt, state, params[wspec.name], ga, sa, out_act=oa, peephole=peep
+            )
 
         last, ys = rnn_ops._masked_scan(
             step, SequenceBatch(xw, x.length), init, reverse=reverse
@@ -1101,7 +1218,10 @@ def lstmemory(input: LayerOutput, reverse: bool = False, act=None,
 
     return LayerOutput(name=name, layer_type="lstmemory", size=d, parents=(input,),
                        param_specs=tuple(specs), fn=fwd,
-                       attrs={"reverse": reverse})
+                       attrs={"reverse": reverse, "reversed_field": True,
+                              "active_type": oa.name,
+                              "active_gate_type": ga.name,
+                              "active_state_type": sa.name})
 
 
 def grumemory(input: LayerOutput, reverse: bool = False, act=None,
@@ -1110,9 +1230,10 @@ def grumemory(input: LayerOutput, reverse: bool = False, act=None,
     """≅ grumemory (layers.py:1593, GruLayer): input size 3*D pre-projected."""
     name = name or gen_name("gru")
     d = input.size // 3
-    wspec = _wspec(param_attr, name, "w0", (d, 2 * d), I.paddle_default())
-    wcspec = _wspec(None, name, "w1", (d, d), I.paddle_default())
-    specs = [wspec, wcspec]
+    # single fused recurrent weight [d, 3d] like the reference GruLayer
+    # parameter (dims [d, 3d]): [:, :2d] gates, [:, 2d:] candidate
+    wspec = _wspec(param_attr, name, "w0", (d, 3 * d), I.paddle_default())
+    specs = [wspec]
     use_bias = bias_attr is not False
     if use_bias:
         bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
@@ -1127,17 +1248,21 @@ def grumemory(input: LayerOutput, reverse: bool = False, act=None,
         if use_bias:
             xw = xw + params[bspec.name]
         init = jnp.zeros((b_, d), jnp.float32)
+        w = params[wspec.name]
 
         def step(h, xt):
-            return rnn_ops.gru_cell(xt, h, params[wspec.name], params[wcspec.name], ga, sa)
+            return rnn_ops.gru_cell(xt, h, w[:, : 2 * d], w[:, 2 * d:], ga, sa)
 
         last, ys = rnn_ops._masked_scan(
             step, SequenceBatch(xw, x.length), init, reverse=reverse
         )
         return SequenceBatch(data=ys, length=x.length)
 
-    return LayerOutput(name=name, layer_type="gmemory", size=d, parents=(input,),
-                       param_specs=tuple(specs), fn=fwd, attrs={"reverse": reverse})
+    return LayerOutput(name=name, layer_type="gated_recurrent", size=d,
+                       parents=(input,), param_specs=tuple(specs), fn=fwd,
+                       attrs={"reverse": reverse, "reversed_field": True,
+                              "active_type": sa.name,
+                              "active_gate_type": ga.name})
 
 
 # ---------------------------------------------------------------------------
@@ -1147,7 +1272,7 @@ def grumemory(input: LayerOutput, reverse: bool = False, act=None,
 
 def max_id(input: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ maxid_layer (MaxIdLayer)."""
-    name = name or gen_name("maxid")
+    name = name or gen_name("maxid_layer")
 
     def fwd(ctx, params, states, x):
         return map_data(lambda d: jnp.argmax(d, axis=-1).astype(jnp.int32), x)
@@ -1160,14 +1285,14 @@ maxid_layer = max_id
 
 def sampling_id(input: LayerOutput, name: str | None = None) -> LayerOutput:
     """≅ sampling_id_layer (SamplingIdLayer): sample from the row distribution."""
-    name = name or gen_name("sampling_id")
+    name = name or gen_name("sampling_id_layer")
 
     def fwd(ctx, params, states, x):
         key = ctx.key_for(name)
         logits = jnp.log(jnp.maximum(raw(x), 1e-20))
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    return LayerOutput(name=name, layer_type="sampling_id", size=1,
+    return LayerOutput(name=name, layer_type="sampling_id", size=input.size,
                        parents=(input,), fn=fwd)
 
 
@@ -1176,7 +1301,7 @@ sampling_id_layer = sampling_id
 
 def eos(input: LayerOutput, eos_id: int, name: str | None = None) -> LayerOutput:
     """≅ eos_layer (EosIdCheckLayer)."""
-    name = name or gen_name("eos")
+    name = name or gen_name("eos_layer")
 
     def fwd(ctx, params, states, x):
         return (raw(x) == eos_id).astype(jnp.int32)
@@ -1249,14 +1374,18 @@ def classification_cost(input: LayerOutput, label: LayerOutput, weight=None,
 
     node = _cost_node(name, "multi-class-cross-entropy", parents, fwd,
                       {"coeff": coeff})
-    node.attrs["metric"] = ("classification_error", input.name, label.name)
+    ev_inputs = [input.name, label.name]
+    if weight is not None:
+        ev_inputs.append(weight.name)
+    node.attrs["metric"] = ("classification_error", ev_inputs)
+    node.attrs["v1_cost"] = True  # LayerType.COST — outputs() DFS predicate
     return node
 
 
 def cross_entropy_cost(input: LayerOutput, label: LayerOutput,
                        name: str | None = None, coeff: float = 1.0) -> LayerOutput:
     """≅ cross_entropy (CostLayer MultiClassCrossEntropy)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("cross_entropy")
 
     def fwd(ctx, params, states, probs, lbl):
         seq_ce = _seq_aware_ce(probs, lbl, loss_ops.cross_entropy)
@@ -1275,7 +1404,7 @@ cross_entropy = cross_entropy_cost
 def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha: float = 0.1,
                                 name=None) -> LayerOutput:
     """≅ cross_entropy_with_selfnorm (CostLayer)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("cross_entropy_with_selfnorm")
 
     def fwd(ctx, params, states, probs, lbl):
         p = raw(probs)
@@ -1287,15 +1416,21 @@ def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha: float = 0.
                       [input, label], fwd)
 
 
-def square_error_cost(input: LayerOutput, label: LayerOutput,
+def square_error_cost(input: LayerOutput, label: LayerOutput, weight=None,
                       name: str | None = None, coeff: float = 1.0) -> LayerOutput:
     """≅ square_error_cost / regression_cost (SumOfSquaresCostLayer)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("square_error_cost")
+    parents = [input, label] + ([weight] if weight is not None else [])
 
-    def fwd(ctx, params, states, pred, lbl):
-        return coeff * _mean_over_batch(loss_ops.square_error(raw(pred), raw(lbl)))
+    def fwd(ctx, params, states, pred, lbl, *w):
+        c = loss_ops.square_error(raw(pred), raw(lbl))
+        if w:
+            c = c * raw(w[0]).reshape(-1)
+        return coeff * _mean_over_batch(c)
 
-    return _cost_node(name, "square_error", [input, label], fwd)
+    node = _cost_node(name, "square_error", parents, fwd)
+    node.attrs["v1_cost"] = True  # LayerType.COST (layers.py:4335)
+    return node
 
 
 regression_cost = square_error_cost
@@ -1308,7 +1443,7 @@ def mse_cost(input, label, name=None, coeff: float = 1.0):
 def multi_binary_label_cross_entropy(input, label, name=None,
                                      coeff: float = 1.0) -> LayerOutput:
     """≅ multi_binary_label_cross_entropy (MultiBinaryLabelCrossEntropy)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("multi_binary_label_cross_entropy")
 
     def fwd(ctx, params, states, p, lbl):
         return coeff * _mean_over_batch(
@@ -1320,7 +1455,7 @@ def multi_binary_label_cross_entropy(input, label, name=None,
 
 def smooth_l1_cost(input, label, name=None, coeff: float = 1.0) -> LayerOutput:
     """≅ smooth_l1_cost (SmoothL1CostLayer)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("smooth_l1_cost")
 
     def fwd(ctx, params, states, p, lbl):
         return coeff * _mean_over_batch(loss_ops.smooth_l1(raw(p), raw(lbl)))
@@ -1331,7 +1466,7 @@ def smooth_l1_cost(input, label, name=None, coeff: float = 1.0) -> LayerOutput:
 def huber_regression_cost(input, label, delta: float = 1.0, name=None,
                           coeff: float = 1.0) -> LayerOutput:
     """≅ huber_regression_cost."""
-    name = name or gen_name("cost")
+    name = name or gen_name("huber_regression_cost")
 
     def fwd(ctx, params, states, p, lbl):
         return coeff * _mean_over_batch(loss_ops.huber_regression(raw(p), raw(lbl), delta))
@@ -1341,7 +1476,7 @@ def huber_regression_cost(input, label, delta: float = 1.0, name=None,
 
 def huber_classification_cost(input, label, name=None, coeff: float = 1.0) -> LayerOutput:
     """≅ huber_classification_cost (HuberTwoClassification)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("huber_classification_cost")
 
     def fwd(ctx, params, states, p, lbl):
         return coeff * _mean_over_batch(
@@ -1354,7 +1489,7 @@ def huber_classification_cost(input, label, name=None, coeff: float = 1.0) -> La
 def rank_cost(left: LayerOutput, right: LayerOutput, label: LayerOutput,
               weight=None, name=None, coeff: float = 1.0) -> LayerOutput:
     """≅ rank_cost (RankingCost)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("rank_cost")
     parents = [left, right, label] + ([weight] if weight is not None else [])
 
     def fwd(ctx, params, states, l, r, lbl, *w):
@@ -1369,7 +1504,7 @@ def rank_cost(left: LayerOutput, right: LayerOutput, label: LayerOutput,
 def lambda_cost(input: LayerOutput, score: LayerOutput, NDCG_num: int = 5,
                 max_sort_size: int = -1, name=None) -> LayerOutput:
     """≅ lambda_cost (LambdaCost) over a sequence of scores."""
-    name = name or gen_name("cost")
+    name = name or gen_name("lambda_cost")
 
     def fwd(ctx, params, states, x, s):
         return _mean_over_batch(
@@ -1382,7 +1517,7 @@ def lambda_cost(input: LayerOutput, score: LayerOutput, NDCG_num: int = 5,
 
 def sum_cost(input: LayerOutput, name=None) -> LayerOutput:
     """≅ sum_cost (SumCostLayer)."""
-    name = name or gen_name("cost")
+    name = name or gen_name("sum_cost")
 
     def fwd(ctx, params, states, x):
         return jnp.mean(loss_ops.sum_cost(raw(x)))
@@ -1390,37 +1525,74 @@ def sum_cost(input: LayerOutput, name=None) -> LayerOutput:
     return _cost_node(name, "sum_cost", [input], fwd)
 
 
-def nce(input, label, num_classes: int, num_neg_samples: int = 10,
-        param_attr=None, bias_attr=None, name=None) -> LayerOutput:
-    """≅ nce_layer (NCELayer) with uniform noise sampling."""
-    name = name or gen_name("nce")
+def nce(input, label, num_classes: int | None = None, num_neg_samples: int = 10,
+        weight=None, neg_distribution=None, act=None,
+        param_attr=None, bias_attr=None, name=None, layer_attr=None) -> LayerOutput:
+    """≅ nce_layer (NCELayer) with uniform (or given) noise sampling.
+    ``num_classes`` defaults to the label layer's size (layers.py:5489)."""
+    name = name or gen_name("nce_layer")
     inputs = _as_list(input)
-    enforce(len(inputs) == 1, "nce: single hidden input supported")
-    d = inputs[0].size
-    wspec = _wspec(param_attr, name, "w0", (num_classes, d), I.paddle_default())
+    if num_classes is None:
+        num_classes = label.size
+    pattrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    wspecs = [
+        _wspec(pa, name, f"w{i}", (num_classes, inp.size), I.paddle_default())
+        for i, (inp, pa) in enumerate(zip(inputs, pattrs))
+    ]
     bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
                    name, "wbias", (num_classes,), I.constant(0.0))
+    parents = inputs + [label] + ([weight] if weight is not None else [])
+    if neg_distribution is not None:
+        neg_distribution = list(neg_distribution)
+        enforce(len(neg_distribution) == num_classes,
+                "nce: neg_distribution length must equal num_classes")
+        enforce(abs(sum(neg_distribution) - 1.0) < 1e-5,
+                "nce: neg_distribution must sum to 1")
+    nd = None if neg_distribution is None else jnp.asarray(
+        neg_distribution, jnp.float32)
 
-    def fwd(ctx, params, states, x, lbl):
+    def fwd(ctx, params, states, *vals):
+        xs = vals[: len(inputs)]
+        lbl = vals[len(inputs)]
+        wgt = vals[len(inputs) + 1:]
         key = ctx.key_for(name)
-        b = raw(x).shape[0]
-        noise = jax.random.randint(key, (b, num_neg_samples), 0, num_classes)
-        c = loss_ops.nce_loss(raw(x), params[wspec.name], params[bspec.name],
-                              raw(lbl).reshape(-1).astype(jnp.int32), noise, num_classes)
+        x = jnp.concatenate(
+            [raw(v).reshape(raw(v).shape[0], -1) for v in xs], axis=-1
+        )
+        w = jnp.concatenate([params[ws.name] for ws in wspecs], axis=-1)
+        b = x.shape[0]
+        if nd is None:
+            noise = jax.random.randint(key, (b, num_neg_samples), 0, num_classes)
+        else:
+            noise = jax.random.categorical(
+                key, jnp.log(jnp.maximum(nd, 1e-20)), shape=(b, num_neg_samples)
+            )
+        c = loss_ops.nce_loss(x, w, params[bspec.name],
+                              raw(lbl).reshape(-1).astype(jnp.int32), noise,
+                              num_classes, noise_probs=nd)
+        if wgt:
+            c = c * raw(wgt[0]).reshape(-1)
         return _mean_over_batch(c)
 
-    return _cost_node(name, "nce", [inputs[0], label], fwd,
-                      specs=[wspec, bspec])
+    node = _cost_node(name, "nce", parents, fwd, specs=wspecs + [bspec])
+    node.attrs.update(
+        num_classes=num_classes, num_neg_samples=num_neg_samples,
+        neg_sampling_dist=neg_distribution,
+        n_inputs=len(inputs),
+    )
+    return node
 
 
 nce_layer = nce
 
 
-def hsigmoid(input, label, num_classes: int, param_attr=None, bias_attr=None,
-             name=None) -> LayerOutput:
+def hsigmoid(input, label, num_classes: int | None = None, param_attr=None,
+             bias_attr=None, name=None, layer_attr=None) -> LayerOutput:
     """≅ hsigmoid (HierarchicalSigmoidLayer)."""
     name = name or gen_name("hsigmoid")
     inputs = _as_list(input)
+    if num_classes is None:
+        num_classes = label.size  # reference defaults to label layer size
     d = sum(i.size for i in inputs)
     wspec = _wspec(param_attr, name, "w0", (num_classes - 1, d), I.paddle_default())
     bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
@@ -1435,7 +1607,10 @@ def hsigmoid(input, label, num_classes: int, param_attr=None, bias_attr=None,
                                    lbl, num_classes)
         )
 
-    return _cost_node(name, "hsigmoid", inputs + [label], fwd, specs=[wspec, bspec])
+    node = _cost_node(name, "hsigmoid", inputs + [label], fwd,
+                      specs=[wspec, bspec])
+    node.attrs["num_classes"] = num_classes
+    return node
 
 
 hsigmoid_layer = hsigmoid

@@ -18,8 +18,10 @@ class ParamAttr:
     initial_mean: float | None = None
     initial_max: float | None = None  # uniform bounds
     initial_min: float | None = None
-    learning_rate: float = 1.0
+    learning_rate: float | None = None  # None ⇒ global LR (scale 1)
+    l1_rate: float | None = None  # per-param L1 decay (decay_rate_l1)
     l2_rate: float | None = None  # per-param decay override
+    momentum: float | None = None  # per-param momentum (proto surface only)
     sparse_update: bool = False
     # update_hooks ≅ HookAttribute("pruning", sparsity_ratio)
     sparsity_ratio: float | None = None
@@ -29,6 +31,53 @@ class ParamAttr:
     # the pjit mesh; the capability upgrade over the reference's per-layer
     # device placement (ParallelNeuralNetwork.h:34 deviceId pinning)
     sharding: tuple | None = None
+
+    def proto_fields(self) -> dict:
+        """ParameterConfig-bound fields, with reference
+        ``ParameterAttribute.__init__`` semantics (attrs.py:139-210): nothing
+        set ⇒ smart init; std/mean ⇒ gauss (strategy 0); max/min ⇒ uniform
+        (strategy 1) with derived mean/std."""
+        d: dict = {}
+        if self.is_static:
+            d["is_static"] = True
+        if (
+            self.initial_std is None
+            and self.initial_mean is None
+            and self.initial_max is None
+            and self.initial_min is None
+        ):
+            d["initial_smart"] = True
+        elif self.initial_std is not None or self.initial_mean is not None:
+            if self.initial_std is not None:
+                d["initial_std"] = self.initial_std
+            if self.initial_mean is not None:
+                d["initial_mean"] = self.initial_mean
+            d["initial_strategy"] = 0
+        else:
+            # tolerate one-sided bounds like make_initializer does
+            lo = -1.0 if self.initial_min is None else self.initial_min
+            hi = 1.0 if self.initial_max is None else self.initial_max
+            mean = (hi + lo) / 2
+            d["initial_mean"] = mean
+            d["initial_std"] = mean - lo
+            d["initial_strategy"] = 1
+        if not self.is_static:
+            if self.l1_rate is not None:
+                d["decay_rate_l1"] = self.l1_rate
+            if self.l2_rate is not None:
+                d["decay_rate"] = self.l2_rate
+            if self.learning_rate is not None:
+                d["learning_rate"] = self.learning_rate
+            if self.momentum is not None:
+                d["momentum"] = self.momentum
+        if self.sparse_update:
+            d["sparse_update"] = True
+            d["sparse_remote_update"] = True
+        if self.gradient_clipping_threshold is not None:
+            d["gradient_clipping_threshold"] = self.gradient_clipping_threshold
+        if self.sparsity_ratio is not None:
+            d["update_hooks"] = [("pruning", self.sparsity_ratio)]
+        return d
 
     def make_initializer(self, default: Callable) -> Callable:
         from paddle_tpu.core import initializer as I

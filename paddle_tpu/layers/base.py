@@ -53,6 +53,21 @@ class Context:
 
 _name_counters: dict[str, itertools.count] = {}
 
+# every LayerOutput registers here at construction, in creation order — the
+# analog of config_parser's g_layer_map/g_config.model_config.layers, which
+# appends a LayerConfig per helper call.  Proto emission walks this (not the
+# DFS order) so protostr layer ordering matches the reference byte-for-byte.
+# Strong references on purpose: nodes are frequently created inline
+# (``outputs(classification_cost(...))``) with no other owner, and emission
+# must still see them.  Like the reference's ``g_config`` globals, the
+# registry grows until ``reset_name_counters()`` — which every model builder
+# and ``parse_config`` call first (≅ ``init_config_environment``).
+_layer_registry: list["LayerOutput"] = []
+
+
+def layer_registry() -> list["LayerOutput"]:
+    return list(_layer_registry)
+
 
 def gen_name(layer_type: str) -> str:
     c = _name_counters.setdefault(layer_type, itertools.count())
@@ -61,6 +76,7 @@ def gen_name(layer_type: str) -> str:
 
 def reset_name_counters() -> None:
     _name_counters.clear()
+    _layer_registry.clear()
 
 
 @dataclasses.dataclass(eq=False)
@@ -79,6 +95,9 @@ class LayerOutput:
     height: int = 0
     width: int = 0
     depth: int = 1  # channels for image layers
+
+    def __post_init__(self):
+        _layer_registry.append(self)
 
     def config_record(self) -> dict:
         """Serializable config (the ModelConfig-protostr analog for golden tests)."""

@@ -30,7 +30,7 @@ def crf(input: LayerOutput, label: LayerOutput, size: int | None = None,
     transitions with ``crf_decoding``, give both the same param_attr name."""
     name = name or gen_name("crf_layer")
     size = size or input.size
-    w = _wspec(param_attr, name, "w", (size + 2, size), I.constant(0.0))
+    w = _wspec(param_attr, name, "w0", (size + 2, size), I.paddle_default())
     parents = [input, label] + ([weight] if weight is not None else [])
 
     def fwd(ctx, params, states, emis, lbl, *wgt):
@@ -58,7 +58,7 @@ def crf_decoding(input: LayerOutput, size: int | None = None,
     indicator per sequence (1 = path differs), like the reference."""
     name = name or gen_name("crf_decoding_layer")
     size = size or input.size
-    w = _wspec(param_attr, name, "w", (size + 2, size), I.constant(0.0))
+    w = _wspec(param_attr, name, "w0", (size + 2, size), I.paddle_default())
     parents = [input] + ([label] if label is not None else [])
 
     def fwd(ctx, params, states, emis, *lbl):
@@ -86,7 +86,14 @@ def ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
     probabilities with ``size = num_classes + 1`` and blank = size-1 (the
     reference's convention for ctc_layer)."""
     name = name or gen_name("ctc_layer")
-    size = size or input.size
+    size = size or (label.size + 1)  # reference: label classes + blank
+    if input.size != size:
+        from paddle_tpu.core import logger
+
+        logger.warning(
+            "ctc layer %s: input size %d != num_classes+1 (%d); the blank "
+            "index follows `size`, matching the reference's CTCLayer",
+            name, input.size, size)
     blank = size - 1
 
     def fwd(ctx, params, states, probs, lbl):
@@ -99,7 +106,7 @@ def ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
             loss = loss / jnp.maximum(probs.length.astype(loss.dtype), 1.0)
         return jnp.mean(loss)
 
-    return LayerOutput(name=name, layer_type="ctc", size=1,
+    return LayerOutput(name=name, layer_type="ctc", size=size,
                        parents=(input, label), fn=fwd,
                        attrs={"blank": blank, "norm_by_times": norm_by_times})
 
@@ -113,7 +120,13 @@ def warp_ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
     """warp-ctc parity (≅ warp_ctc_layer / WarpCTCLayer): ``input`` is
     pre-softmax activations; softmax happens inside, blank defaults to 0."""
     name = name or gen_name("warp_ctc_layer")
-    size = size or input.size
+    size = size or (label.size + 1)
+    if input.size != size:
+        from paddle_tpu.core import logger
+
+        logger.warning(
+            "warp_ctc layer %s: input size %d != num_classes+1 (%d)",
+            name, input.size, size)
 
     def fwd(ctx, params, states, logits, lbl):
         enforce(is_sequence(logits) and is_sequence(lbl),
@@ -126,9 +139,10 @@ def warp_ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
             loss = loss / jnp.maximum(logits.length.astype(loss.dtype), 1.0)
         return jnp.mean(loss)
 
-    return LayerOutput(name=name, layer_type="warp_ctc", size=1,
+    return LayerOutput(name=name, layer_type="warp_ctc", size=size,
                        parents=(input, label), fn=fwd,
-                       attrs={"blank": blank, "norm_by_times": norm_by_times})
+                       attrs={"blank": blank, "norm_by_times": norm_by_times,
+                              "explicit_blank": True})
 
 
 warp_ctc_layer = warp_ctc
@@ -185,9 +199,12 @@ def repeat(input: LayerOutput, num_repeats: int,
         return map_data(
             lambda d: a(jnp.repeat(d, num_repeats, axis=-1)), x)
 
+    attrs = {"num_filters": num_repeats, "active_type": a.name}
+    if not as_row_vector:
+        attrs["user_arg"] = "as_col_vec"
     return LayerOutput(name=name, layer_type="featmap_expand",
                        size=input.size * num_repeats, parents=(input,),
-                       fn=fwd)
+                       fn=fwd, attrs=attrs)
 
 
 repeat_layer = repeat
@@ -207,7 +224,8 @@ def kmax_seq_score(input: LayerOutput, beam_size: int = 1,
         return idx.astype(jnp.int32)
 
     return LayerOutput(name=name, layer_type="kmax_seq_score", size=beam_size,
-                       parents=(input,), fn=fwd)
+                       parents=(input,), fn=fwd,
+                       attrs={"beam_size": beam_size})
 
 
 kmax_seq_score_layer = kmax_seq_score

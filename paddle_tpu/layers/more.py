@@ -14,6 +14,7 @@ from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.layers import activation as act_mod
 from paddle_tpu.layers.api import _cost_node, _wspec
+from paddle_tpu.layers.attr import ParamAttr
 from paddle_tpu.layers.base import LayerOutput, gen_name, is_sequence, raw
 
 
@@ -21,9 +22,9 @@ def prelu(input: LayerOutput, partial_sum: int = 1, param_attr=None,
           name: str | None = None) -> LayerOutput:
     """≅ prelu (PReluLayer): y = x>0 ? x : a*x with learned slope ``a``;
     ``partial_sum`` groups channels sharing one slope (1 = per-element)."""
-    name = name or gen_name("prelu")
+    name = name or gen_name("prelu_layer")
     n_slopes = input.size // partial_sum
-    w = _wspec(param_attr, name, "w", (n_slopes,), I.constant(0.25))
+    w = _wspec(param_attr, name, "w0", (n_slopes,), I.constant(0.25))
 
     def fwd(ctx, params, states, x):
         v = raw(x)
@@ -45,7 +46,7 @@ def prelu(input: LayerOutput, partial_sum: int = 1, param_attr=None,
 def multiplex(input: list[LayerOutput], name: str | None = None) -> LayerOutput:
     """≅ multiplex (MultiplexLayer): input[0] holds per-row indices k;
     output row i = input[k_i + 1] row i."""
-    name = name or gen_name("multiplex")
+    name = name or gen_name("multiplex_layer")
     enforce(len(input) >= 3, "multiplex needs an index layer + >=2 choices")
     size = input[1].size
 
@@ -64,7 +65,7 @@ def tensor_layer(a: LayerOutput, b: LayerOutput, size: int, act=None,
                  param_attr=None, bias_attr=None,
                  name: str | None = None) -> LayerOutput:
     """≅ tensor (TensorLayer): bilinear form y_i = a W_i b^T for i<size."""
-    name = name or gen_name("tensor")
+    name = name or gen_name("tensor_layer")
     w = _wspec(param_attr, name, "w", (size, a.size, b.size), I.xavier())
     specs = [w]
     use_bias = bias_attr is not False
@@ -90,24 +91,31 @@ def selective_fc(input: LayerOutput, select: LayerOutput, size: int,
     masked to the columns flagged by ``select`` (a [B, size] 0/1 layer);
     unselected outputs are zero.  TPU-style: the full gemm runs on the MXU
     and the mask applies after — dense beats gather here."""
-    name = name or gen_name("selective_fc")
-    w = _wspec(param_attr, name, "w", (input.size, size), I.xavier())
-    specs = [w]
+    name = name or gen_name("selective_fc_layer")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    specs = [
+        _wspec(param_attr, name, f"w{i}", (inp.size, size), I.xavier())
+        for i, inp in enumerate(inputs)
+    ]
     use_bias = bias_attr is not False
     if use_bias:
-        bspec = _wspec(None, name, "wbias", (size,), I.constant(0.0))
+        bspec = _wspec(
+            bias_attr if isinstance(bias_attr, ParamAttr) else None,
+            name, "wbias", (size,), I.constant(0.0))
         specs.append(bspec)
     activation = act_mod.get(act) if act is not None else act_mod.TanhActivation()
 
-    def fwd(ctx, params, states, x, sel):
-        y = raw(x) @ params[w.name]
+    def fwd(ctx, params, states, *vals):
+        xs, sel = vals[:-1], vals[-1]
+        y = sum(raw(x) @ params[s.name] for x, s in zip(xs, specs))
         if use_bias:
             y = y + params[bspec.name]
         return activation(y) * raw(sel)
 
     return LayerOutput(name=name, layer_type="selective_fc", size=size,
-                       parents=(input, select), param_specs=tuple(specs),
-                       fn=fwd)
+                       parents=tuple(inputs) + (select,),
+                       param_specs=tuple(specs), fn=fwd,
+                       attrs={"active_type": activation.name})
 
 
 def data_norm(input: LayerOutput, strategy: str = "z-score",
@@ -149,11 +157,30 @@ def resize(input: LayerOutput, size: int, name: str | None = None) -> LayerOutpu
                        parents=(input,), fn=fwd)
 
 
+def clip(input: LayerOutput, min: float, max: float,
+         name: str | None = None) -> LayerOutput:
+    """≅ clip_layer (ClipLayer, LayerConfig.clip_conf)."""
+    name = name or gen_name("clip")
+    lo, hi = float(min), float(max)
+
+    def fwd(ctx, params, states, x):
+        from paddle_tpu.layers.base import map_data
+
+        return map_data(lambda d: jnp.clip(d, lo, hi), x)
+
+    return LayerOutput(name=name, layer_type="clip", size=input.size,
+                       parents=(input,), fn=fwd,
+                       attrs={"clip_min": lo, "clip_max": hi})
+
+
+clip_layer = clip
+
+
 def conv_shift(a: LayerOutput, b: LayerOutput,
                name: str | None = None) -> LayerOutput:
     """≅ conv_shift (ConvShiftLayer): circular convolution of each row of
     ``a`` with the (odd-length) kernel row of ``b`` — the NTM shift op."""
-    name = name or gen_name("conv_shift")
+    name = name or gen_name("conv_shift_layer")
 
     def fwd(ctx, params, states, xa, xb):
         va, vb = raw(xa), raw(xb)
@@ -170,7 +197,7 @@ def scale_shift(input: LayerOutput, param_attr=None, bias_attr=None,
                 name: str | None = None) -> LayerOutput:
     """≅ scale_shift (ScaleShiftLayer): y = w * x + b with SCALAR w, b."""
     name = name or gen_name("scale_shift")
-    w = _wspec(param_attr, name, "w", (1,), I.constant(1.0))
+    w = _wspec(param_attr, name, "w0", (1,), I.constant(1.0))
     specs = [w]
     use_bias = bias_attr is not False
     if use_bias:
@@ -229,7 +256,7 @@ def sub_nested_seq(input: LayerOutput, selection: LayerOutput,
     """≅ sub_nested_seq (SubNestedSequenceLayer): from each nested sequence,
     keep the sub-sequence whose index the selection row gives, producing an
     ordinary sequence batch."""
-    name = name or gen_name("sub_nested_seq")
+    name = name or gen_name("sub_nested_seq_layer")
 
     def fwd(ctx, params, states, x, sel):
         enforce(isinstance(x, NestedSequenceBatch),
@@ -269,12 +296,14 @@ def print_layer(input: LayerOutput, format: str | None = None,
 
     def fwd(ctx, params, states, x):
         v = raw(x)
-        jax.debug.print((format or (name + ": {}")), v)
+        jax.debug.print((format or (name + ": {}")).replace("%s", "{}"), v)
         return v
 
     return LayerOutput(name=name, layer_type="print", size=input.size,
                        parents=(input,), fn=fwd, height=input.height,
-                       width=input.width, depth=input.depth)
+                       width=input.width, depth=input.depth,
+                       attrs={"user_arg": format or ("layer=" +
+                              input.name + " %s")})
 
 
 # registry aliases: the reference registers these as distinct layer types,

@@ -12,8 +12,9 @@ class BasePoolingType:
 
 
 class MaxPooling(BasePoolingType):
-    def __init__(self):
+    def __init__(self, output_max_index: bool | None = None):
         super().__init__("max")
+        object.__setattr__(self, "output_max_index", output_max_index)
 
 
 class AvgPooling(BasePoolingType):

@@ -108,6 +108,7 @@ def thresholded_relu(x, threshold: float = 1.0):
 REGISTRY = {
     "": identity,
     "linear": identity,
+    "sequence_softmax": softmax,
     "sigmoid": sigmoid,
     "tanh": tanh,
     "relu": relu,

@@ -113,14 +113,25 @@ def nce_loss(
     label: jax.Array,  # [B] int
     noise_ids: jax.Array,  # [B, K] sampled negative classes
     num_classes: int,
+    noise_probs: jax.Array | None = None,  # [V] sampling dist (uniform if None)
 ) -> jax.Array:
-    """Noise-contrastive estimation (≅ NCELayer) with uniform noise dist."""
+    """Noise-contrastive estimation (≅ NCELayer).  The logistic correction
+    term uses log(k·q(w)) with q the ACTUAL noise distribution — uniform by
+    default, or the per-class ``noise_probs`` when a custom
+    ``neg_distribution`` drives the sampler (ParameterServer-free analog of
+    MultinomialSampler in NCELayer.cpp)."""
     k = noise_ids.shape[-1]
-    log_noise = jnp.log(jnp.asarray(k / num_classes, embed.dtype))
+    if noise_probs is None:
+        log_noise_pos = jnp.log(jnp.asarray(k / num_classes, embed.dtype))
+        log_noise_neg = log_noise_pos
+    else:
+        logq = jnp.log(jnp.maximum(noise_probs.astype(embed.dtype), 1e-20))
+        log_noise_pos = jnp.log(float(k)) + logq[label]
+        log_noise_neg = jnp.log(float(k)) + logq[noise_ids]
     pos_logit = jnp.sum(embed * w[label], axis=-1) + b[label]
     neg_logit = jnp.einsum("bd,bkd->bk", embed, w[noise_ids]) + b[noise_ids]
-    pos_loss = jax.nn.softplus(-(pos_logit - log_noise))
-    neg_loss = jax.nn.softplus(neg_logit - log_noise)
+    pos_loss = jax.nn.softplus(-(pos_logit - log_noise_pos))
+    neg_loss = jax.nn.softplus(neg_logit - log_noise_neg)
     return pos_loss + jnp.sum(neg_loss, axis=-1)
 
 

@@ -36,15 +36,25 @@ def lstm_cell(
     w_h: jax.Array,  # [D, 4D]
     gate_act=act.sigmoid,
     state_act=act.tanh,
+    out_act=None,  # activation on c before the output gate (reference act)
+    peephole: jax.Array | None = None,  # [3D]: W_ci, W_cf, W_co diagonals
 ) -> LSTMState:
     d = state.h.shape[-1]
     gates = xw + matmul(state.h, w_h)
-    i = gate_act(gates[:, 0 * d : 1 * d])
-    f = gate_act(gates[:, 1 * d : 2 * d])
-    g = state_act(gates[:, 2 * d : 3 * d])
-    o = gate_act(gates[:, 3 * d : 4 * d])
+    gi, gf, gg, go = (gates[:, k * d : (k + 1) * d] for k in range(4))
+    if peephole is not None:
+        # reference LstmLayer peephole connections (hl_cpu_lstm.h):
+        # i/f see c_{t-1}, o sees c_t
+        gi = gi + peephole[0 * d : 1 * d] * state.c
+        gf = gf + peephole[1 * d : 2 * d] * state.c
+    i = gate_act(gi)
+    f = gate_act(gf)
+    g = state_act(gg)
     c = f * state.c + i * g
-    h = o * state_act(c)
+    if peephole is not None:
+        go = go + peephole[2 * d : 3 * d] * c
+    o = gate_act(go)
+    h = o * (out_act or state_act)(c)
     return LSTMState(h=h, c=c)
 
 

@@ -48,6 +48,71 @@ def seq_last(x: SequenceBatch) -> jax.Array:
     return x.last_step()
 
 
+def _windowed(x: SequenceBatch, k: int):
+    """Pad T to a multiple of k and reshape to windows: returns
+    (data [B, W, k, ...], mask [B, W, k], out_lengths [B]) — the scoped
+    pooling of SequencePoolLayer with seq_pool_stride (LayerConfig:519)."""
+    b, t = x.data.shape[:2]
+    w = -(-t // k)
+    pad = [(0, 0), (0, w * k - t)] + [(0, 0)] * (x.data.ndim - 2)
+    data = jnp.pad(x.data, pad).reshape((b, w, k) + x.data.shape[2:])
+    mask = jnp.pad(x.mask(), [(0, 0), (0, w * k - t)]).reshape(b, w, k)
+    out_len = -(-x.length // k)
+    return data, mask, out_len
+
+
+def _masked_reduce(data, mask, mode: str, axis: int):
+    """Reduce `axis` of data under mask (same shape up to trailing dims)."""
+    mexp = mask.reshape(mask.shape + (1,) * (data.ndim - mask.ndim))
+    if mode == "max":
+        neg = jnp.asarray(-1e30, data.dtype)
+        return jnp.max(jnp.where(mexp > 0, data, neg), axis=axis)
+    if mode in ("first", "last"):
+        if mode == "first":
+            idx = jnp.argmax(mask, axis=axis)
+        else:
+            n = mask.shape[axis]
+            idx = n - 1 - jnp.argmax(jnp.flip(mask, axis=axis), axis=axis)
+        sel_shape = (
+            mask.shape[:axis] + (1,) + mask.shape[axis + 1 :]
+            + (1,) * (data.ndim - mask.ndim)
+        )
+        return jnp.take_along_axis(
+            data, idx.reshape(sel_shape), axis=axis
+        ).squeeze(axis)
+    s = jnp.sum(data * mexp, axis=axis)
+    if mode == "sum":
+        return s
+    n = jnp.maximum(jnp.sum(mask, axis=axis), 1.0)
+    n = n.reshape(n.shape + (1,) * (s.ndim - n.ndim))
+    if mode == "average":
+        return s / n
+    return s / jnp.sqrt(n)  # sqrt
+
+
+def seq_pool_windows(x: SequenceBatch, k: int, mode: str) -> SequenceBatch:
+    """Pool each stride-k window -> shorter sequence (seq_pool_stride)."""
+    data, mask, out_len = _windowed(x, k)
+    return SequenceBatch(data=_masked_reduce(data, mask, mode, 2), length=out_len)
+
+
+def seq_pool_inner(x, mode: str):
+    """Pool each INNER sequence of a NestedSequenceBatch -> SequenceBatch
+    (AggregateLevel.TO_SEQUENCE semantics)."""
+    return SequenceBatch(
+        data=_masked_reduce(x.data, x.inner_mask(), mode, 2),
+        length=x.seq_length,
+    )
+
+
+def seq_pool_all_nested(x, mode: str) -> jax.Array:
+    """Pool every valid timestep of a nested batch -> one vector per row."""
+    b = x.data.shape[0]
+    data = x.data.reshape((b, -1) + x.data.shape[3:])
+    mask = x.inner_mask().reshape(b, -1)
+    return _masked_reduce(data, mask, mode, 1)
+
+
 def seq_first(x: SequenceBatch) -> jax.Array:
     return x.first_step()
 

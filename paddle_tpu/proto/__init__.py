@@ -1,0 +1,38 @@
+"""Paddle-compatible config protos (ModelConfig / TrainerConfig family).
+
+Usage mirrors the reference's generated modules
+(``python/paddle/proto/ModelConfig_pb2.py`` etc.)::
+
+    from paddle_tpu.proto import ModelConfig, LayerConfig, TrainerConfig
+
+The classes are real protobuf messages (text_format + wire compatible with
+``/root/reference/proto/*.proto``), built at import time from
+:mod:`paddle_tpu.proto.schema` — see :mod:`paddle_tpu.proto.build`.
+"""
+
+from paddle_tpu.proto.build import all_message_classes as _all
+
+_classes = _all()
+globals().update(_classes)
+
+__all__ = sorted(_classes)
+
+# enum values (ParameterConfig.proto:22)
+PARAMETER_INIT_NORMAL = 0
+PARAMETER_INIT_UNIFORM = 1
+
+
+def text_format(msg) -> str:
+    """Render a message the way the reference's protostr goldens are stored
+    (``print(parse_config(...).model_config)`` — proto2 text format)."""
+    from google.protobuf import text_format as _tf
+
+    return _tf.MessageToString(msg, float_format=None)
+
+
+def parse_text(text: str, cls):
+    from google.protobuf import text_format as _tf
+
+    msg = cls()
+    _tf.Parse(text, msg)
+    return msg

@@ -1,0 +1,82 @@
+"""Compile the declarative schema into real protobuf message classes.
+
+Equivalent capability to the reference's protoc step (``proto/CMakeLists.txt``
+generating ``*_pb2.py``), done at import time through ``descriptor_pb2`` so
+no ``.proto`` files or codegen are needed.  The resulting classes serialize
+to the same wire bytes and the same text format ("protostr") as the
+reference's generated code — that is the compatibility contract
+(BASELINE.json north star: "ModelConfig/TrainerConfig protos unchanged").
+"""
+
+from __future__ import annotations
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from paddle_tpu.proto import schema
+
+_LABEL = {
+    schema.OPT: descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL,
+    schema.REQ: descriptor_pb2.FieldDescriptorProto.LABEL_REQUIRED,
+    schema.REP: descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED,
+}
+
+
+def _default_str(ftype: int, default) -> str:
+    if ftype == schema.BOOL:
+        return "true" if default else "false"
+    if ftype in (schema.DOUBLE, schema.FLOAT):
+        # descriptor defaults use C-literal-ish spellings; repr round-trips
+        return repr(float(default))
+    return str(default)
+
+
+def build_pool() -> descriptor_pool.DescriptorPool:
+    pool = descriptor_pool.DescriptorPool()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "paddle_tpu/paddle_configs.proto"
+    f.package = schema.PACKAGE
+    f.syntax = "proto2"
+
+    for ename, values in schema.ENUMS.items():
+        e = f.enum_type.add()
+        e.name = ename
+        for vname, vnum in values:
+            v = e.value.add()
+            v.name = vname
+            v.number = vnum
+
+    for mname, fields in schema.MESSAGES.items():
+        m = f.message_type.add()
+        m.name = mname
+        for row in fields:
+            name, number, label, ftype = row[:4]
+            extra = row[4] if len(row) > 4 else None
+            packed = bool(row[5]) if len(row) > 5 else False
+            fd = m.field.add()
+            fd.name = name
+            fd.number = number
+            fd.label = _LABEL[label]
+            fd.type = ftype
+            if ftype == schema.MESSAGE:
+                fd.type_name = f".{schema.PACKAGE}.{extra}"
+            elif ftype == schema.ENUM:
+                fd.type_name = f".{schema.PACKAGE}.{extra}"
+            elif extra is not None and label != schema.REP:
+                fd.default_value = _default_str(ftype, extra)
+            if packed:
+                fd.options.packed = True
+    pool.Add(f)
+    return pool
+
+
+_pool = build_pool()
+
+
+def message_class(name: str):
+    return message_factory.GetMessageClass(
+        _pool.FindMessageTypeByName(f"{schema.PACKAGE}.{name}")
+    )
+
+
+def all_message_classes() -> dict:
+    return {name: message_class(name) for name in schema.MESSAGES}

@@ -1,0 +1,168 @@
+"""``parse_config`` — the v1 config-file compiler.
+
+≅ ``python/paddle/trainer/config_parser.py:4238`` (``parse_config``), which
+exec's a user config file in an environment of trainer_config_helpers
+functions and returns a ``TrainerConfig`` proto.  The reference builds the
+proto *during* the helper calls; here the helpers build the runtime layer
+DAG (paddle_tpu.layers) and the proto is derived afterwards by
+:mod:`paddle_tpu.config.proto_emit` — same wire surface, one source of
+truth.
+
+The returned object carries both the protos (``.model_config``,
+``.opt_config`` …) and the live layer graph (``.output_layers``) so the
+trainer CLI can jit-compile the same topology the proto describes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.config import parse_state
+from paddle_tpu.config.proto_emit import emit_model_config
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.layers import base as layer_base
+
+
+class ParsedConfig:
+    """TrainerConfig-shaped result; `.model_config` etc. are real protos."""
+
+    def __init__(self, trainer_config, model_config, opt_config,
+                 input_layer_names, output_layer_names, registry):
+        self.trainer_config = trainer_config
+        self.model_config = model_config
+        self.opt_config = opt_config
+        self.input_layer_names = list(input_layer_names)
+        self.output_layer_names = list(output_layer_names)
+        # live graph (creation order) for compiling a runtime Topology
+        self.layers = list(registry)
+
+    def output_layers(self):
+        by_name = {n.name: n for n in self.layers}
+        return [by_name[n] for n in self.output_layer_names]
+
+    def protostr(self) -> str:
+        from paddle_tpu.config.protostr import to_protostr
+
+        return to_protostr(self.model_config)
+
+
+def make_config_environment(config_path: str, config_args: dict) -> dict:
+    import paddle_tpu.trainer_config_helpers as tch
+
+    tch.set_config_args(config_args)
+    env: dict = {"__file__": config_path or "<config>"}
+    for name in dir(tch):
+        if not name.startswith("_"):
+            env[name] = getattr(tch, name)
+    env.update(
+        get_config_arg=tch.get_config_arg,
+        Inputs=parse_state.Inputs,
+        Outputs=parse_state.Outputs,
+        HasInputsSet=parse_state.HasInputsSet,
+        outputs=parse_state.outputs,
+    )
+    return env
+
+
+def parse_config(trainer_config, config_arg_str: str = ""):
+    """Run a config file (path or callable) → :class:`ParsedConfig`.
+
+    ``config_arg_str`` is ``var1=val1,var2=val2`` exposed via
+    ``get_config_arg`` (≅ --config_args, config_parser.py:4238-4249).
+    """
+    from paddle_tpu import compat
+
+    compat.install_paddle_alias()
+    config_args = {}
+    if config_arg_str:
+        config_args = dict(f.split("=", 1) for f in config_arg_str.split(","))
+
+    layer_base.reset_name_counters()
+    parse_state.STATE.reset()
+    from paddle_tpu.trainer_config_helpers import optimizers as _opt
+
+    _opt._SETTINGS.clear()
+
+    if callable(trainer_config):
+        env = make_config_environment("", config_args)
+        trainer_config.__globals__.update(env)
+        trainer_config()
+    else:
+        path = os.fspath(trainer_config)
+        env = make_config_environment(path, config_args)
+        with open(path) as f:
+            code = compile(f.read(), path, "exec")
+        exec(code, env)
+
+    return finalize_config()
+
+
+def finalize_config() -> ParsedConfig:
+    settings = dict(_settings())
+    registry = layer_base.layer_registry()
+    input_names = parse_state.STATE.input_layer_names
+    output_names = parse_state.STATE.output_layer_names
+    enforce(registry, "config defined no layers")
+    mc = emit_model_config(registry, input_names, output_names, settings)
+
+    from paddle_tpu import proto
+
+    oc = proto.OptimizationConfig()
+    _fill_opt_config(oc, settings)
+    tc = proto.TrainerConfig()
+    tc.model_config.CopyFrom(mc)
+    tc.opt_config.CopyFrom(oc)
+    return ParsedConfig(tc, mc, oc, input_names, output_names, registry)
+
+
+def parse_config_and_serialize(trainer_config, config_arg_str: str = "") -> bytes:
+    return parse_config(trainer_config, config_arg_str).trainer_config.SerializeToString()
+
+
+def _settings() -> dict:
+    from paddle_tpu.trainer_config_helpers.optimizers import get_settings
+
+    return get_settings()
+
+
+_OPT_FIELDS = (
+    "batch_size",
+    "algorithm",
+    "learning_rate",
+    "learning_rate_decay_a",
+    "learning_rate_decay_b",
+    "learning_rate_schedule",
+    "learning_rate_args",
+    "learning_method",
+    "average_window",
+    "max_average_window",
+    "do_average_in_cpu",
+    "ada_epsilon",
+    "ada_rou",
+    "adam_beta1",
+    "adam_beta2",
+    "adam_epsilon",
+    "delta_add_rate",
+    "gradient_clipping_threshold",
+    "l1weight",
+    "l2weight",
+    "num_batches_per_send_parameter",
+    "num_batches_per_get_parameter",
+)
+
+
+def _fill_opt_config(oc, settings: dict) -> None:
+    oc.algorithm = "sgd"
+    oc.learning_rate = float(settings.get("learning_rate") or 1e-3)
+    for key in _OPT_FIELDS:
+        v = settings.get(key)
+        if v is None:
+            continue
+        try:
+            setattr(oc, key, v)
+        except (TypeError, ValueError):
+            from paddle_tpu.core import logger
+
+            logger.warning(
+                "settings(%s=%r) has wrong type for OptimizationConfig.%s; "
+                "field left at its default", key, v, key)

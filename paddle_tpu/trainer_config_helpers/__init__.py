@@ -10,8 +10,11 @@ against the TPU runtime: ``fc_layer`` == ``layer.fc`` etc.
 from __future__ import annotations
 
 from paddle_tpu.layers import api as _api
+from paddle_tpu.layers import detection as _detection
 from paddle_tpu.layers import extras as _extras
+from paddle_tpu.layers import mixed as _mixed
 from paddle_tpu.layers import more as _more
+from paddle_tpu.layers import recurrent_group as _rg
 from paddle_tpu.layers.activation import *  # noqa: F401,F403 (…Activation)
 from paddle_tpu.layers.attr import (  # noqa: F401
     ExtraAttr,
@@ -35,7 +38,7 @@ from paddle_tpu.trainer_config_helpers.optimizers import (  # noqa: F401
 
 def _export_v1_names():
     g = globals()
-    for mod in (_api, _extras, _more):
+    for mod in (_api, _extras, _more, _mixed, _detection, _rg):
         for name in dir(mod):
             if name.startswith("_"):
                 continue
@@ -50,6 +53,55 @@ def _export_v1_names():
 
 _export_v1_names()
 
+
+class AggregateLevel:
+    """≅ layers.py:280 — pooling aggregation level."""
+
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """≅ layers.py:1768 — expansion source level."""
+
+    FROM_NO_SEQUENCE = "non-seq"
+    FROM_SEQUENCE = "seq"
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+def data_layer(name, size=None, depth=None, height=None, width=None,
+               layer_attr=None, type=None):
+    """v1 data_layer (layers.py:919): size-only declaration; the input TYPE
+    comes from the data provider at runtime, so a dense vector is assumed
+    until a feeder binds richer types.  Accepts the v2 ``type=`` form too
+    (the alias is exported under both APIs)."""
+    from paddle_tpu.layers import data_type as _dt
+
+    if type is not None:
+        return _api.data(name=name, type=type, height=height or 0,
+                         width=width or 0)
+    node = _api.data(
+        name=name,
+        type=_dt.dense_vector(size),
+        height=height or 0,
+        width=width or 0,
+    )
+    if height and width:
+        node.attrs["explicit_hw"] = True
+        node.depth = depth or 1
+        if depth is not None:
+            node.attrs["explicit_depth"] = True
+    return node
+
+
+from paddle_tpu.config.parse_state import (  # noqa: E402,F401
+    HasInputsSet,
+    Inputs,
+    Outputs,
+    outputs,
+)
 
 _CONFIG_ARGS: dict = {}
 

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Golden-protostr compatibility harness.
+
+Runs reference config files (trainer_config_helpers/tests/configs/*.py)
+through paddle_tpu's parse_config and diffs the emitted ModelConfig protostr
+against the reference goldens (protostr/*.protostr).  Development tool; the
+pytest version of the passing set lives in tests/test_protostr_golden.py.
+
+Usage:
+  python tools/protostr_check.py              # summary over all configs
+  python tools/protostr_check.py test_fc      # full diff for one config
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+import sys
+import traceback
+
+REF = "/root/reference/python/paddle/trainer_config_helpers/tests/configs"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(name: str, show: bool = False) -> str:
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    golden_path = os.path.join(REF, "protostr", name + ".protostr")
+    cfg_path = os.path.join(REF, name + ".py")
+    if not os.path.exists(golden_path):
+        return "NO-GOLDEN"
+    if not os.path.exists(cfg_path):
+        return "NO-CONFIG"
+    try:
+        parsed = parse_config(cfg_path)
+        got = parsed.protostr()
+    except Exception as e:
+        if show:
+            traceback.print_exc()
+        return f"ERROR: {type(e).__name__}: {str(e)[:120]}"
+    want = open(golden_path).read()
+    # goldens end "}\n\n" (py2 `print proto` adds a newline on top of the
+    # text-format trailing one); normalize only that artifact
+    if got.rstrip("\n") == want.rstrip("\n"):
+        return "PASS"
+    if show:
+        sys.stdout.writelines(
+            difflib.unified_diff(
+                want.splitlines(True), got.splitlines(True),
+                "golden", "emitted", n=2,
+            )
+        )
+    ndiff = sum(
+        1 for l in difflib.unified_diff(want.splitlines(), got.splitlines())
+        if l[:1] in "+-"
+    )
+    return f"DIFF({ndiff})"
+
+
+def main():
+    if len(sys.argv) > 1:
+        for name in sys.argv[1:]:
+            print(f"== {name}: {run_one(name, show=True)}")
+        return
+    names = sorted(
+        f[:-3] for f in os.listdir(REF)
+        if f.endswith(".py") and not f.startswith("__")
+    )
+    results = {}
+    for name in names:
+        results[name] = run_one(name)
+    npass = sum(1 for v in results.values() if v == "PASS")
+    for name, res in sorted(results.items()):
+        print(f"{res:40s} {name}")
+    print(f"\n{npass}/{len([v for v in results.values() if v != 'NO-GOLDEN'])} byte-exact")
+
+
+if __name__ == "__main__":
+    main()
