@@ -14,10 +14,29 @@ class ParseState:
     def __init__(self):
         self.input_layer_names: list[str] = []
         self.output_layer_names: list[str] = []
-        self.data_configs: dict = {}
+        # (files, module, obj, args) for train and test providers
+        self.data_config: dict | None = None
+        self.test_data_config: dict | None = None
 
     def reset(self):
         self.__init__()
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    """≅ data_sources.define_py_data_sources2: record PyDataProvider2
+    DataConfigs for the trainer (DataConfig.proto fields load_data_*)."""
+
+    def pick(x, idx):
+        return x[idx] if isinstance(x, (list, tuple)) else x
+
+    if train_list is not None:
+        STATE.data_config = dict(
+            files=train_list, module=pick(module, 0), obj=pick(obj, 0),
+            args=pick(args, 0) if args is not None else "")
+    if test_list is not None:
+        STATE.test_data_config = dict(
+            files=test_list, module=pick(module, 1), obj=pick(obj, 1),
+            args=pick(args, 1) if args is not None else "")
 
 
 STATE = ParseState()

@@ -40,9 +40,9 @@ def emits(*types):
 class Emitter:
     """One ModelConfig under construction (≅ config_parser globals)."""
 
-    def __init__(self, settings: dict | None = None):
+    def __init__(self, settings: dict | None = None, target=None):
         s = settings or {}
-        self.mc = proto.ModelConfig()
+        self.mc = target if target is not None else proto.ModelConfig()
         self.mc.type = "nn"
         self.root = self.mc.sub_models.add()
         self.root.name = "root"
@@ -50,6 +50,12 @@ class Emitter:
         self.cur_submodel = self.root
         self._param_names: set[str] = set()
         self._layer_names: set[str] = set()
+        # (id(message), field) pairs assigned from int-typed user values —
+        # printed without ".0" like py2 protobuf did (see protostr._scalar).
+        # _pins keeps those upb wrappers alive: their identity (and hence the
+        # id-keyed lookup) is only stable while a Python reference exists.
+        self.int_style: set = set()
+        self._pins: list = []
         # g_default_* (config_parser.py:118-121 + settings())
         self.defaults = {
             "initial_mean": 0.0,
@@ -117,8 +123,8 @@ class Emitter:
             p.decay_rate = float(dr)
         if "decay_rate_l1" in pf:
             p.decay_rate_l1 = float(pf["decay_rate_l1"])
-        p.initial_std = float(pf.get("initial_std", d["initial_std"]))
-        p.initial_mean = float(pf.get("initial_mean", d["initial_mean"]))
+        self.set_num(p, "initial_std", pf.get("initial_std", d["initial_std"]))
+        self.set_num(p, "initial_mean", pf.get("initial_mean", d["initial_mean"]))
         nbr = pf.get("num_batches_regularization", d["num_batches_regularization"])
         if nbr is not None:
             p.num_batches_regularization = int(nbr)
@@ -151,15 +157,26 @@ class Emitter:
                 h.sparsity_ratio = hook[1]
         return p
 
+    def set_num(self, msg, field: str, v) -> None:
+        """Assign a float/double field, remembering int-typed sources."""
+        setattr(msg, field, v)
+        if isinstance(v, int) and not isinstance(v, bool):
+            self.int_style.add((id(msg), field))
+            self._pins.append(msg)
+
     # -- spec plumbing ----------------------------------------------------
 
     @staticmethod
     def split_specs(node: LayerOutput):
-        """(weight_specs, bias_spec) — bias by the ``.wbias`` naming
-        convention used throughout the layer constructors."""
+        """(weight_specs, bias_spec) — bias by the explicit ``bias_spec``
+        attr when a shared/renamed bias was used, else the ``.wbias``
+        naming convention."""
+        explicit = node.attrs.get("bias_spec")
         ws, b = [], None
         for s in node.param_specs:
-            if s.name.endswith(".wbias"):
+            if (explicit and s.name == explicit) or (
+                not explicit and s.name.endswith(".wbias")
+            ):
                 b = s
             else:
                 ws.append(s)
@@ -182,7 +199,12 @@ class Emitter:
         attr is zero-init gauss (wrap_bias_attr_default,
         default_decorators.py:144)."""
         if bias_spec is None:
-            _, bias_spec = self.split_specs(node)
+            explicit = node.attrs.get("bias_spec")
+            if explicit:
+                bias_spec = next(
+                    (s for s in node.param_specs if s.name == explicit), None)
+            else:
+                _, bias_spec = self.split_specs(node)
         if bias_spec is None:
             return
         if dims is None:
@@ -401,7 +423,7 @@ def _norm(E, node):
     nc.channels = channels
     nc.size = a["size"]
     nc.scale = a.get("scale", 0.0128)  # img_cmrnorm_layer default alpha
-    nc.pow = a.get("power", 0.75)
+    E.set_num(nc, "pow", a.get("power", 0.75))
     nc.blocked = a.get("blocked", False)
     nc.img_size, nc.img_size_y = get_img_size(parent, channels)
     nc.output_x = nc.img_size
@@ -613,7 +635,7 @@ def _print(E, node):
 
 @emits("sampling_id", "resize", "row_l2_norm", "multiplex", "seqconcat",
        "seqreshape", "conv_shift", "out_prod", "sub_nested_seq", "eos",
-       "trans")
+       "trans", "convex_comb", "rotate", "crop")
 def _plain(E, node):
     E.layer(node, active_type=node.attrs.get("active_type", ""))
 
@@ -622,8 +644,8 @@ def _plain(E, node):
 def _clip(E, node):
     lc = E.layer(node, active_type="")
     cc = lc.inputs[0].clip_conf
-    cc.min = node.attrs["clip_min"]
-    cc.max = node.attrs["clip_max"]
+    E.set_num(cc, "min", node.attrs["clip_min"])
+    E.set_num(cc, "max", node.attrs["clip_max"])
 
 
 @emits("featmap_expand")
@@ -780,7 +802,7 @@ def _tensor(E, node):
     ws, _ = E.split_specs(node)
     a, b = node.parents
     E.input_param(lc, 0, ws[0], node.size * a.size * b.size,
-                  [a.size, b.size])
+                  [a.size, b.size, node.size])
     E.bias_param(lc, node, node.size)
 
 
@@ -792,8 +814,8 @@ def _linear_comb(E, node):
 @emits("slope_intercept")
 def _slope_intercept(E, node):
     lc = E.layer(node, active_type="")
-    lc.slope = float(node.attrs.get("slope", 1.0))
-    lc.intercept = float(node.attrs.get("intercept", 0.0))
+    E.set_num(lc, "slope", node.attrs.get("slope", 1.0))
+    E.set_num(lc, "intercept", node.attrs.get("intercept", 0.0))
 
 
 @emits("interpolation", "power", "scaling", "sum_to_one_norm")
@@ -804,7 +826,7 @@ def _weighted_pair(E, node):
 @emits("cos", "cos_vm")
 def _cos(E, node):
     lc = E.layer(node, active_type="")
-    lc.cos_scale = float(node.attrs.get("scale", 1.0))
+    E.set_num(lc, "cos_scale", node.attrs.get("scale", 1.0))
 
 
 @emits("crf")
@@ -840,6 +862,117 @@ def _nce(E, node):
         lc.neg_sampling_dist.extend(a["neg_sampling_dist"])
 
 
+def _fill_conv_conf(cc, g: dict):
+    for k, v in g.items():
+        setattr(cc, k, v)
+
+
+def _emit_mixed_items(E: Emitter, node, lc):
+    """Shared by mixed/concat2: LayerInputConfig proj_confs, operator_confs,
+    and projection parameters (≅ MixedLayer, config_parser.py:3387)."""
+    spec_by_name = {s.name: s for s in node.param_specs}
+    for item in node.attrs["mixed_items"]:
+        if item["kind"] == "proj":
+            ic = lc.inputs[item["slot"]]
+            pc = ic.proj_conf
+            pc.type = item["type"]
+            pc.name = item["pname"]
+            pc.input_size = item["input_size"]
+            pc.output_size = item["output_size"]
+            proto = item["proto"]
+            if item["type"] == "context":
+                pc.context_start = proto["context_start"]
+                pc.context_length = proto["context_length"]
+                pc.trainable_padding = proto["trainable_padding"]
+            if item["type"] == "identity_offset":
+                pc.offset = proto["offset"]
+            if item["type"] == "slice":
+                for s, e in proto["slices"]:
+                    sl = pc.slices.add()
+                    sl.start, sl.end = s, e
+            if "conv" in proto:
+                _fill_conv_conf(pc.conv_conf, proto["conv"])
+                pc.num_filters = proto["num_filters"]
+            spec = spec_by_name.get(item["spec_name"])
+            if spec is not None:
+                ic.input_parameter_name = spec.name
+                attr = spec.attr
+                if attr is None or _is_default_attr(attr):
+                    attr = item.get("default_emit_attr") or attr
+                psize = 1
+                for d in spec.shape:
+                    psize *= d
+                E.parameter(spec.name, psize, item["param_dims"] or [], attr)
+        else:
+            oc = lc.operator_confs.add()
+            oc.type = item["type"]
+            oc.input_indices.extend(item["indices"])
+            oc.input_sizes.extend(item["input_sizes"])
+            oc.output_size = item["output_size"]
+            proto = item["proto"]
+            if "dotmul_scale" in proto:
+                E.set_num(oc, "dotmul_scale", proto["dotmul_scale"])
+            if "conv" in proto:
+                _fill_conv_conf(oc.conv_conf, proto["conv"])
+                oc.num_filters = proto["num_filters"]
+
+
+@emits("mixed")
+def _mixed(E, node):
+    lc = E.layer(node)
+    _emit_mixed_items(E, node, lc)
+    E.bias_param(lc, node, node.size)
+
+
+@emits("concat2")
+def _concat2(E, node):
+    lc = E.layer(node)
+    _emit_mixed_items(E, node, lc)
+
+
+@emits("detection_output")
+def _detection_output(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    dc = lc.inputs[0].detection_output_conf
+    dc.num_classes = a["num_classes"]
+    dc.nms_threshold = a["nms_threshold"]
+    dc.nms_top_k = a["nms_top_k"]
+    dc.background_id = a.get("background_id", 0)
+    dc.input_num = a["input_num"]
+    dc.keep_top_k = a["keep_top_k"]
+    dc.confidence_threshold = a["confidence_threshold"]
+
+
+@emits("multibox_loss")
+def _multibox_loss(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    mc = lc.inputs[0].multibox_loss_conf
+    mc.num_classes = a["num_classes"]
+    mc.overlap_threshold = a["overlap_threshold"]
+    mc.neg_pos_ratio = a["neg_pos_ratio"]
+    mc.neg_overlap = a["neg_overlap"]
+    mc.background_id = a.get("background_id", 0)
+    mc.input_num = a["input_num"]
+
+
+@emits("scale_sub_region")
+def _scale_sub_region(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    parent = node.parents[0]
+    channels = a.get("channels") or parent.depth
+    sc = lc.inputs[0].scale_sub_region_conf
+    sc.image_conf.channels = channels
+    sc.image_conf.img_size, sc.image_conf.img_size_y = get_img_size(
+        parent, channels
+    )
+    E.set_num(sc, "value", a["value"])
+    lc.height = sc.image_conf.img_size_y
+    lc.width = sc.image_conf.img_size
+
+
 @emits("maxid")
 def _maxid(E, node):
     lc = E.layer(node, active_type="")
@@ -858,8 +991,9 @@ def _dropout(E, node):
 
 
 def emit_model_config(registry, input_names, output_names,
-                      settings: dict | None = None):
-    E = Emitter(settings)
+                      settings: dict | None = None, with_emitter: bool = False,
+                      target=None):
+    E = Emitter(settings, target=target)
     for node in registry:
         fn = EMITTERS.get(node.layer_type)
         enforce(
@@ -869,11 +1003,11 @@ def emit_model_config(registry, input_names, output_names,
         )
         fn(E, node)
     E.finalize(input_names, output_names)
-    return E.mc
+    return (E.mc, E) if with_emitter else E.mc
 
 
 def model_config_protostr(registry, input_names, output_names,
                           settings=None) -> str:
-    return to_protostr(
-        emit_model_config(registry, input_names, output_names, settings)
-    )
+    mc, E = emit_model_config(registry, input_names, output_names, settings,
+                              with_emitter=True)
+    return to_protostr(mc, E.int_style)

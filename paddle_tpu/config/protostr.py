@@ -48,25 +48,28 @@ def _escape(s) -> str:
     return "".join(out)
 
 
-# double/float fields that config_parser assigns straight from user values
-# (no float() coercion): py2's pure-python protobuf stored the int as-is, so
-# goldens print them without ".0".  Fields the reference float()s always
-# print py2-float style.
-INT_STYLE_FIELDS = {
-    ("ClipConfig", "min"),
-    ("ClipConfig", "max"),
-    ("LayerConfig", "slope"),
-    ("LayerConfig", "intercept"),
-    ("LayerConfig", "cos_scale"),
-    ("OperatorConfig", "dotmul_scale"),
-    ("NormConfig", "pow"),
-}
+def _float32_shortest(v: float) -> float:
+    """Shortest decimal that round-trips to the same float32 — py2 stored the original python double in FLOAT fields, so goldens show '0.45', not the float32-rounded 0.449999988079."""
+    import struct
+
+    packed = struct.pack("f", v)
+    for digits in range(1, 17):
+        cand = float(f"%.{digits}g" % v)
+        if struct.pack("f", cand) == packed:
+            return cand
+    return v
 
 
-def _scalar(fd, v, msg_name: str = "") -> str:
+def _scalar(fd, v, int_style=None, msg_id=None) -> str:
     t = fd.type
+    if t == fd.TYPE_FLOAT:
+        v = _float32_shortest(v)
     if t in (fd.TYPE_FLOAT, fd.TYPE_DOUBLE):
-        if (msg_name, fd.name) in INT_STYLE_FIELDS and float(v).is_integer():
+        # config_parser assigns some fields straight from user values (no
+        # float() coercion); py2's pure-python protobuf stored the int
+        # as-is, so goldens print those without ".0".  Emitters record the
+        # int-typed assignments per message instance (Emitter.set_num).
+        if int_style and (msg_id, fd.name) in int_style and float(v).is_integer():
             return str(int(v))
         return py2_float_repr(v)
     if t == fd.TYPE_BOOL:
@@ -78,33 +81,34 @@ def _scalar(fd, v, msg_name: str = "") -> str:
     return str(v)
 
 
-def _print_msg(msg, indent: int, out: list) -> None:
+def _print_msg(msg, indent: int, out: list, int_style=None) -> None:
     pad = "  " * indent
-    mname = msg.DESCRIPTOR.name
+    mid = id(msg)
     for fd in msg.DESCRIPTOR.fields:  # descriptor order == declaration order
         if fd.label == _desc.FieldDescriptor.LABEL_REPEATED:
             values = getattr(msg, fd.name)
             for v in values:
                 if fd.type == fd.TYPE_MESSAGE:
                     out.append(f"{pad}{fd.name} {{")
-                    _print_msg(v, indent + 1, out)
+                    _print_msg(v, indent + 1, out, int_style)
                     out.append(f"{pad}}}")
                 else:
-                    out.append(f"{pad}{fd.name}: {_scalar(fd, v, mname)}")
+                    out.append(f"{pad}{fd.name}: {_scalar(fd, v, int_style, mid)}")
         else:
             if not msg.HasField(fd.name):
                 continue
             if fd.type == fd.TYPE_MESSAGE:
                 out.append(f"{pad}{fd.name} {{")
-                _print_msg(getattr(msg, fd.name), indent + 1, out)
+                _print_msg(getattr(msg, fd.name), indent + 1, out, int_style)
                 out.append(f"{pad}}}")
             else:
                 out.append(
-                    f"{pad}{fd.name}: {_scalar(fd, getattr(msg, fd.name), mname)}"
+                    f"{pad}{fd.name}: "
+                    f"{_scalar(fd, getattr(msg, fd.name), int_style, mid)}"
                 )
 
 
-def to_protostr(msg) -> str:
+def to_protostr(msg, int_style=None) -> str:
     out: list[str] = []
-    _print_msg(msg, 0, out)
+    _print_msg(msg, 0, out, int_style)
     return "\n".join(out) + "\n"

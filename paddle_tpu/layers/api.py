@@ -82,6 +82,10 @@ def _maybe_dropout(node: LayerOutput, layer_attr: ExtraAttr | None) -> LayerOutp
     """Fold ExtraAttr.drop_rate into the node itself — the reference stores
     it as ``LayerConfig.drop_rate`` on the same layer (no extra layer is
     created), so both runtime graph and protostr keep reference naming."""
+    if layer_attr is not None and getattr(
+            layer_attr, "error_clipping_threshold", None):
+        node.attrs["error_clipping_threshold"] = (
+            layer_attr.error_clipping_threshold)
     if layer_attr is None or not layer_attr.drop_rate:
         return node
     rate = layer_attr.drop_rate
@@ -188,6 +192,21 @@ def fc(
             for p in parents:
                 d = raw(p)
                 flats.append(d.reshape(b * t, -1))
+            if activation.name == "sequence_softmax":
+                # softmax over the TIMESTEPS of each sequence (reference
+                # SequenceSoftmaxActivation, activations.py:86) — the
+                # attention-weights use case
+                pre = None
+                for i, x in enumerate(flats):
+                    tmp = math_ops.matmul(x, params[specs[i].name])
+                    pre = tmp if pre is None else pre + tmp
+                if use_bias:
+                    pre = pre + params[bspec.name]
+                pre = pre.reshape(b, t, size)
+                mask = ref.mask()[:, :, None]
+                pre = jnp.where(mask > 0, pre, -1e30)
+                y = jax.nn.softmax(pre, axis=1) * mask
+                return SequenceBatch(data=y, length=ref.length)
             y = compute(flats)
             return SequenceBatch(data=y.reshape(b, t, size), length=ref.length)
         return compute([raw(p) for p in parents])
@@ -200,7 +219,8 @@ def fc(
             parents=tuple(inputs),
             param_specs=tuple(specs),
             fn=fwd,
-            attrs={"size": size, "active_type": activation.name},
+            attrs={"size": size, "active_type": activation.name,
+                   "bias_spec": bspec.name if use_bias else None},
         ),
         layer_attr,
     )
@@ -228,14 +248,25 @@ def embedding(
         table = params[spec.name]
         return map_data(lambda d: emb_lookup(table, d, padding_idx), ids)
 
+    # the reference implements embedding_layer as a mixed layer holding one
+    # TableProjection (layers.py:963), so that's the proto shape too
     return LayerOutput(
         name=name,
-        layer_type="embedding",
+        layer_type="mixed",
         size=size,
         parents=(input,),
         param_specs=(spec,),
         fn=fwd,
-        attrs={"size": size, "vocab": vocab},
+        attrs={
+            "size": size, "vocab": vocab, "active_type": "",
+            "mixed_items": [{
+                "kind": "proj", "type": "table", "slot": 0,
+                "pname": spec.name, "spec_name": spec.name,
+                "input_size": vocab, "output_size": size,
+                "param_dims": [vocab, size], "default_emit_attr": None,
+                "proto": {},
+            }],
+        },
     )
 
 
@@ -568,7 +599,9 @@ def spp(input: LayerOutput, pyramid_height: int, num_channels: int | None = None
         name=name, layer_type="spp", size=c * bins, parents=(input,), fn=fwd,
         height=1, width=bins, depth=c,
         attrs={"pyramid_height": pyramid_height, "channels": c,
-               "pool_type": ptype + "-projection"},
+               "pool_type": {"max": "max-projection",
+                             "average": "avg-projection"}.get(
+                   ptype, ptype + "-projection")},
     )
 
 
@@ -705,10 +738,16 @@ addto_layer = addto
 
 
 def concat(input, act=None, name: str | None = None,
-           layer_attr: ExtraAttr | None = None) -> LayerOutput:
-    """≅ concat_layer (ConcatenateLayer): feature-dim concat."""
+           layer_attr: ExtraAttr | None = None, bias_attr=None) -> LayerOutput:
+    """≅ concat_layer (ConcatenateLayer); with Projection inputs it is the
+    reference's ConcatenateLayer2 ('concat2': each projection computed then
+    concatenated, not summed)."""
+    from paddle_tpu.layers import mixed as mixed_mod
+
     inputs = _as_list(input)
     name = name or gen_name("concat")
+    if inputs and isinstance(inputs[0], mixed_mod.Projection):
+        return _concat_projections(inputs, act, name)
     activation = act_mod.get(act)
     total = sum(i.size for i in inputs)
     same_image = all(i.height == inputs[0].height and i.width == inputs[0].width
@@ -739,6 +778,52 @@ def concat(input, act=None, name: str | None = None,
 
 
 concat_layer = concat
+
+
+def _concat_projections(projs, act, name: str) -> LayerOutput:
+    """'concat2' (ConcatenateLayer2): per-projection outputs concatenated."""
+    from paddle_tpu.core.parameters import ParamSpec  # noqa: F401
+    from paddle_tpu.layers import mixed as mixed_mod
+
+    activation = act_mod.get(act)
+    slots, fns, specs, items = [], [], [], []
+    for p in projs:
+        enforce(not p.is_operator, "concat2 takes projections, not operators")
+        enforce(p.size != 0,
+                "concat2 projections need an explicit size (fc/table "
+                "projections cannot elide size outside mixed_layer)")
+        idx = len(slots)
+        pname = f"_{name}.w{idx}"
+        spec, fn = p.bind(pname)
+        slots.append(p.inputs[0])
+        if spec is not None:
+            specs.append(spec)
+        fns.append((fn, idx))
+        items.append({
+            "kind": "proj", "type": p.proj_type, "slot": idx,
+            "pname": pname, "spec_name": spec.name if spec else None,
+            "input_size": p.inputs[0].size, "output_size": p.size,
+            "param_dims": p.param_dims,
+            "default_emit_attr": p.default_emit_attr,
+            "proto": dict(p.proto),
+        })
+    total = sum(p.size for p in projs)
+
+    def fwd(ctx, params, states, *vals):
+        outs = [raw(fn(params, vals[i])) for fn, i in fns]
+        template = next((v for v in vals if is_sequence(v)), None)
+        y = activation(jnp.concatenate(
+            [o.reshape(o.shape[0], -1) if template is None else o for o in outs],
+            axis=-1))
+        if template is not None:
+            return SequenceBatch(data=y, length=template.length)
+        return y
+
+    return LayerOutput(
+        name=name, layer_type="concat2", size=total, parents=tuple(slots),
+        param_specs=tuple(specs), fn=fwd,
+        attrs={"mixed_items": items, "active_type": activation.name},
+    )
 
 
 def dropout(input: LayerOutput, dropout_rate: float, name: str | None = None) -> LayerOutput:
@@ -774,7 +859,7 @@ def slice(input: LayerOutput, start: int, end: int, name: str | None = None) -> 
     )
 
 
-def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0, size: int = 1,
+def cos_sim(a: LayerOutput, b: LayerOutput, scale=1, size: int = 1,
             name: str | None = None, layer_attr=None) -> LayerOutput:
     """≅ cos_sim (CosSimLayer); with size>1, b holds `size` vectors and the
     output is a similarity per vector (CosSimVecMatLayer, type 'cos_vm')."""
@@ -815,11 +900,12 @@ def interpolation(input, weight: LayerOutput, name: str | None = None) -> LayerO
     a, b = input
     name = name or gen_name("interpolation_layer")
 
-    def fwd(ctx, params, states, xa, xb, w):
+    def fwd(ctx, params, states, w, xa, xb):
         return math_ops.interpolation(raw(xa), raw(xb), raw(w))
 
+    # reference InterpolationLayer input order: [weight, a, b]
     return LayerOutput(name=name, layer_type="interpolation", size=a.size,
-                       parents=(a, b, weight), fn=fwd)
+                       parents=(weight, a, b), fn=fwd)
 
 
 interpolation_layer = interpolation
@@ -829,11 +915,12 @@ def power(input: LayerOutput, weight: LayerOutput, name: str | None = None) -> L
     """≅ power_layer."""
     name = name or gen_name("power_layer")
 
-    def fwd(ctx, params, states, x, w):
+    def fwd(ctx, params, states, w, x):
         return math_ops.power(raw(x), raw(w))
 
+    # reference PowerLayer input order: [weight, input]
     return LayerOutput(name=name, layer_type="power", size=input.size,
-                       parents=(input, weight), fn=fwd)
+                       parents=(weight, input), fn=fwd)
 
 
 power_layer = power
@@ -843,11 +930,12 @@ def scaling(input: LayerOutput, weight: LayerOutput, name: str | None = None) ->
     """≅ scaling_layer."""
     name = name or gen_name("scaling_layer")
 
-    def fwd(ctx, params, states, x, w):
+    def fwd(ctx, params, states, w, x):
         return like(x, math_ops.scaling(raw(x), raw(w)))
 
+    # reference ScalingLayer input order: [weight, input]
     return LayerOutput(name=name, layer_type="scaling", size=input.size,
-                       parents=(input, weight), fn=fwd)
+                       parents=(weight, input), fn=fwd)
 
 
 scaling_layer = scaling
@@ -1593,22 +1681,26 @@ def hsigmoid(input, label, num_classes: int | None = None, param_attr=None,
     inputs = _as_list(input)
     if num_classes is None:
         num_classes = label.size  # reference defaults to label layer size
-    d = sum(i.size for i in inputs)
-    wspec = _wspec(param_attr, name, "w0", (num_classes - 1, d), I.paddle_default())
+    pattrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    wspecs = [
+        _wspec(pa, name, f"w{i}", (num_classes - 1, inp.size),
+               I.paddle_default())
+        for i, (inp, pa) in enumerate(zip(inputs, pattrs))
+    ]
     bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
                    name, "wbias", (num_classes - 1,), I.constant(0.0))
 
     def fwd(ctx, params, states, *parents):
         xs = [raw(p) for p in parents[:-1]]
         x = jnp.concatenate([v.reshape(v.shape[0], -1) for v in xs], axis=-1)
+        w = jnp.concatenate([params[ws.name] for ws in wspecs], axis=-1)
         lbl = raw(parents[-1]).reshape(-1).astype(jnp.int32)
         return _mean_over_batch(
-            loss_ops.hsigmoid_loss(x, params[wspec.name], params[bspec.name],
-                                   lbl, num_classes)
+            loss_ops.hsigmoid_loss(x, w, params[bspec.name], lbl, num_classes)
         )
 
     node = _cost_node(name, "hsigmoid", inputs + [label], fwd,
-                      specs=[wspec, bspec])
+                      specs=wspecs + [bspec])
     node.attrs["num_classes"] = num_classes
     return node
 

@@ -89,6 +89,7 @@ def multibox_loss(priors: LayerOutput, label: LayerOutput,
                   loc_layers, conf_layers, num_classes: int,
                   overlap_threshold: float = 0.5,
                   neg_pos_ratio: float = 3.0,
+                  neg_overlap: float = 0.5, background_id: int = 0,
                   name: str | None = None) -> LayerOutput:
     """≅ multibox_loss (MultiBoxLossLayer).  Class 0 is background;
     gt labels are 1-based object classes."""
@@ -140,14 +141,31 @@ def multibox_loss(priors: LayerOutput, label: LayerOutput,
         name=name, layer_type="multibox_loss", size=1,
         parents=tuple([priors, label] + loc_layers + conf_layers), fn=fwd,
         attrs={"num_classes": num_classes,
-               "overlap_threshold": overlap_threshold},
+               "overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio,
+               "neg_overlap": neg_overlap, "background_id": background_id,
+               "input_num": len(loc_layers)},
     )
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
+                        overlap_threshold=0.5, neg_pos_ratio=3.0,
+                        neg_overlap=0.5, background_id=0, name=None):
+    """v1 surface (layers.py:1156)."""
+    locs = input_loc if isinstance(input_loc, (list, tuple)) else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) else [input_conf]
+    return multibox_loss(
+        priors=priorbox, label=label, loc_layers=list(locs),
+        conf_layers=list(confs), num_classes=num_classes,
+        overlap_threshold=overlap_threshold, neg_pos_ratio=neg_pos_ratio,
+        neg_overlap=neg_overlap, background_id=background_id, name=name)
 
 
 def detection_output(priors: LayerOutput, loc_layers, conf_layers,
                      num_classes: int, nms_threshold: float = 0.45,
                      nms_top_k: int = 400, keep_top_k: int = 200,
                      confidence_threshold: float = 0.01,
+                     background_id: int = 0,
                      name: str | None = None) -> LayerOutput:
     """≅ detection_output (DetectionOutputLayer): decode + per-class NMS.
 
@@ -169,7 +187,9 @@ def detection_output(priors: LayerOutput, loc_layers, conf_layers,
             boxes = D.decode_boxes(loc_i, prior_boxes, variance)
             probs = jax.nn.softmax(conf_i, axis=-1)  # [P, C]
             outs = []
-            for c in range(1, num_classes):  # class 0 = background
+            for c in range(num_classes):
+                if c == background_id:
+                    continue
                 idxs, valid = D.nms(
                     boxes, probs[:, c], nms_threshold,
                     max_out=min(nms_top_k, boxes.shape[0]),
@@ -185,9 +205,35 @@ def detection_output(priors: LayerOutput, loc_layers, conf_layers,
             top = jnp.argsort(-allrows[:, 1])[:keep_top_k]
             return allrows[top]
 
-        return jax.vmap(per_image)(loc, conf)
+        rows = jax.vmap(per_image)(loc, conf)  # [B, K, 6]
+        # reference rows are 7-wide: [image_id, label, score, box*4]
+        b = rows.shape[0]
+        img_ids = jnp.broadcast_to(
+            jnp.arange(b, dtype=rows.dtype)[:, None, None],
+            (b, rows.shape[1], 1))
+        return jnp.concatenate([img_ids, rows], axis=-1)
 
     return LayerOutput(
-        name=name, layer_type="detection_output", size=keep_top_k * 6,
+        name=name, layer_type="detection_output", size=keep_top_k * 7,
         parents=tuple([priors] + loc_layers + conf_layers), fn=fwd,
+        attrs={"num_classes": num_classes, "nms_threshold": nms_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "confidence_threshold": confidence_threshold,
+               "background_id": background_id,
+               "input_num": len(loc_layers)},
     )
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                           confidence_threshold=0.01, background_id=0,
+                           name=None):
+    """v1 surface (layers.py:1228): loc/conf given as layers or lists."""
+    locs = input_loc if isinstance(input_loc, (list, tuple)) else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) else [input_conf]
+    return detection_output(
+        priors=priorbox, loc_layers=list(locs), conf_layers=list(confs),
+        num_classes=num_classes, nms_threshold=nms_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        confidence_threshold=confidence_threshold,
+        background_id=background_id, name=name)

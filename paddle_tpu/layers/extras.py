@@ -165,10 +165,13 @@ def out_prod(input1: LayerOutput, input2: LayerOutput,
 out_prod_layer = out_prod
 
 
-def linear_comb(weights: LayerOutput, vectors: LayerOutput, size: int,
+def linear_comb(weights: LayerOutput, vectors: LayerOutput,
+                size: int | None = None,
                 name: str | None = None) -> LayerOutput:
     """out = w (1xM) * V (MxN), per row (≅ linear_comb_layer)."""
     name = name or gen_name("linear_comb_layer")
+    if size is None:
+        size = vectors.size // weights.size
     m = weights.size
 
     def fwd(ctx, params, states, w, v):

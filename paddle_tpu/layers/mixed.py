@@ -4,23 +4,29 @@ Reference: ``MixedLayer`` composes cheap sub-units — Projections (one input,
 may own a parameter: ``paddle/gserver/layers/Projection.h``,
 ``FullMatrixProjection``, ``TableProjection``, ``ContextProjection``,
 ``IdentityProjection``, ``ScalingProjection``, ``DotMulProjection``,
-``TransposedFullMatrixProjection``) and Operators (multi-input, parameter-free:
-``DotMulOperator``, ``ConvOperator``) — summing their outputs
-(``trainer_config_helpers/layers.py:563-998`` helper surface,
-``mixed_layer:739``).  Attention in 2017-Paddle NMT demos is hand-built from
-exactly these pieces, so they are load-bearing for seq2seq parity.
+``TransposedFullMatrixProjection``, ``ConvProjection``) and Operators
+(multi-input, parameter-free: ``DotMulOperator``, ``ConvOperator``) — summing
+their outputs (``trainer_config_helpers/layers.py:563-998`` helper surface,
+``mixed_layer:851``; config side ``config_parser.py:3387`` MixedLayer).
+Attention in 2017-Paddle NMT demos is hand-built from exactly these pieces,
+so they are load-bearing for seq2seq parity.
 
 TPU-native: a projection is a pure function on the input value; the mixed
 node's fn sums projection outputs (XLA fuses the adds into the surrounding
-matmuls).  Both the functional form ``mixed(input=[...])`` and the
-``with mixed(size=..) as m: m += proj`` incremental form are supported."""
+matmuls).  Parameters are named by the OWNING layer at finalize time
+(``_<layer>.w<slot>``, ≅ gen_parameter_name), so protostr/checkpoint names
+match the reference; each slot of the layer's input list is one projection
+or an operator leg (operators' extra inputs appended at the end,
+config_parser.py:3392-3405).  Both the functional form ``mixed(input=[..])``
+and the ``with mixed(size=..) as m: m += proj`` incremental form work."""
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
@@ -36,52 +42,78 @@ from paddle_tpu.ops.math import matmul
 
 @dataclasses.dataclass
 class Projection:
-    """One summand inside a mixed layer (≅ Projection/Operator config)."""
+    """One summand inside a mixed layer (≅ Projection/Operator config).
+
+    The parameter (if any) is unnamed until the owning layer binds it:
+    ``param_shape``/``param_attr``/``default_init`` describe it;
+    ``make_fn(pname)`` builds the runtime closure; ``proto`` carries the
+    reference ProjectionConfig/OperatorConfig extras for emission."""
 
     inputs: tuple[LayerOutput, ...]
-    size: int
+    size: int  # output size (0 = adopt the mixed layer's)
     proj_type: str
-    param_specs: tuple[ParamSpec, ...] = ()
-    # fn(params, *input_values) -> value with same sequence structure
-    fn: Callable = None
+    is_operator: bool = False
+    param_shape: tuple | None = None
+    param_attr: ParamAttr | None = None
+    default_init: Callable | None = None
+    # emission: ParameterConfig dims + default attr when user gave none
+    param_dims: list | None = None
+    default_emit_attr: ParamAttr | None = None
+    make_fn: Callable = None  # (pname | None) -> fn(params, *vals)
+    proto: dict = dataclasses.field(default_factory=dict)
+
+    # set at bind time
+    spec: Any = None
+
+    def bind(self, pname: str) -> tuple[ParamSpec | None, Callable]:
+        from paddle_tpu.layers.api import _wspec
+
+        spec = None
+        if self.param_shape is not None:
+            base, _, suffix = pname.rpartition(".")
+            spec = _wspec(self.param_attr, base[1:], suffix, self.param_shape,
+                          self.default_init or I.paddle_default())
+        self.spec = spec
+        return spec, self.make_fn(spec.name if spec is not None else None)
 
 
-def _wspec(param_attr, name, shape, default_init) -> ParamSpec:
-    """Single source of truth for ParamAttr -> ParamSpec lives in api._wspec;
-    this shim only adapts mixed's full-name convention (`<base>.<suffix>`)."""
-    from paddle_tpu.layers.api import _wspec as api_wspec
-
-    base, _, suffix = name.rpartition(".")
-    return api_wspec(param_attr, base.lstrip("_"), suffix, shape, default_init)
-
-
-def full_matrix_projection(input: LayerOutput, size: int,
+def full_matrix_projection(input: LayerOutput, size: int = 0,
                            param_attr: ParamAttr | None = None) -> Projection:
     """out = in @ W  (≅ FullMatrixProjection, layers.py:563)."""
-    w = _wspec(param_attr, gen_name("fm_proj") + ".w", (input.size, size),
-               I.paddle_default())
 
-    def fn(params, v):
-        return like(v, matmul(raw(v).reshape(-1, input.size),
-                              params[w.name]).reshape(raw(v).shape[:-1] + (size,)))
+    def make_fn(pname):
+        def fn(params, v):
+            return like(v, matmul(raw(v).reshape(-1, input.size),
+                                  params[pname]).reshape(raw(v).shape[:-1] + (-1,)))
 
-    return Projection(inputs=(input,), size=size, proj_type="fc",
-                      param_specs=(w,), fn=fn)
+        return fn
+
+    return Projection(
+        inputs=(input,), size=size, proj_type="fc",
+        param_shape=None if size == 0 else (input.size, size),
+        param_attr=param_attr, make_fn=make_fn,
+        param_dims=[input.size, size],
+    )
 
 
-def trans_full_matrix_projection(input: LayerOutput, size: int,
+def trans_full_matrix_projection(input: LayerOutput, size: int = 0,
                                  param_attr: ParamAttr | None = None) -> Projection:
-    """out = in @ W^T — the parameter is stored transposed [size, in]
+    """out = in @ W^T — parameter stored transposed [size, in]
     (≅ TransposedFullMatrixProjection, layers.py:619)."""
-    w = _wspec(param_attr, gen_name("trans_fm_proj") + ".w", (size, input.size),
-               I.paddle_default())
 
-    def fn(params, v):
-        return like(v, matmul(raw(v).reshape(-1, input.size),
-                              params[w.name].T).reshape(raw(v).shape[:-1] + (size,)))
+    def make_fn(pname):
+        def fn(params, v):
+            return like(v, matmul(raw(v).reshape(-1, input.size),
+                                  params[pname].T).reshape(raw(v).shape[:-1] + (-1,)))
 
-    return Projection(inputs=(input,), size=size, proj_type="trans_fc",
-                      param_specs=(w,), fn=fn)
+        return fn
+
+    return Projection(
+        inputs=(input,), size=size, proj_type="trans_fc",
+        param_shape=None if size == 0 else (size, input.size),
+        param_attr=param_attr, make_fn=make_fn,
+        param_dims=[size, input.size],
+    )
 
 
 def identity_projection(input: LayerOutput, offset: int | None = None,
@@ -89,119 +121,283 @@ def identity_projection(input: LayerOutput, offset: int | None = None,
     """Pass-through, optionally a feature slice [offset, offset+size)
     (≅ IdentityProjection / IdentityOffsetProjection, layers.py:744)."""
     if offset is None:
-        out_size = input.size
+        def make_fn(pname):
+            return lambda params, v: v
 
+        return Projection(inputs=(input,), size=input.size,
+                          proj_type="identity", make_fn=make_fn)
+    out_size = size or (input.size - offset)
+
+    def make_fn(pname):
+        return lambda params, v: like(v, raw(v)[..., offset:offset + out_size])
+
+    return Projection(inputs=(input,), size=out_size,
+                      proj_type="identity_offset", make_fn=make_fn,
+                      proto={"offset": offset})
+
+
+def slice_projection(input: LayerOutput, slices) -> Projection:
+    """Concat of feature slices [start, end) (≅ SliceProjection)."""
+    slices = [tuple(s) for s in slices]
+    out_size = sum(e - s for s, e in slices)
+
+    def make_fn(pname):
         def fn(params, v):
-            return v
-    else:
-        out_size = size or (input.size - offset)
+            parts = [raw(v)[..., s:e] for s, e in slices]
+            return like(v, jnp.concatenate(parts, axis=-1))
 
-        def fn(params, v):
-            return like(v, raw(v)[..., offset:offset + out_size])
+        return fn
 
-    return Projection(inputs=(input,), size=out_size, proj_type="identity", fn=fn)
+    return Projection(inputs=(input,), size=out_size, proj_type="slice",
+                      make_fn=make_fn, proto={"slices": slices})
 
 
 def scaling_projection(input: LayerOutput,
                        param_attr: ParamAttr | None = None) -> Projection:
     """out = w * in with a single learned scalar (≅ ScalingProjection,
     layers.py:802)."""
-    w = _wspec(param_attr, gen_name("scaling_proj") + ".w", (1,), I.constant(1.0))
 
-    def fn(params, v):
-        return like(v, raw(v) * params[w.name][0])
+    def make_fn(pname):
+        return lambda params, v: like(v, raw(v) * params[pname][0])
 
     return Projection(inputs=(input,), size=input.size, proj_type="scaling",
-                      param_specs=(w,), fn=fn)
+                      param_shape=(1,), param_attr=param_attr,
+                      default_init=I.constant(1.0), make_fn=make_fn,
+                      param_dims=[1, 1])
 
 
 def dotmul_projection(input: LayerOutput,
                       param_attr: ParamAttr | None = None) -> Projection:
     """out = in ⊙ w, elementwise with a learned vector (≅ DotMulProjection,
     layers.py:845)."""
-    w = _wspec(param_attr, gen_name("dotmul_proj") + ".w", (input.size,),
-               I.uniform(1.0))
 
-    def fn(params, v):
-        return like(v, raw(v) * params[w.name])
+    def make_fn(pname):
+        return lambda params, v: like(v, raw(v) * params[pname])
 
     return Projection(inputs=(input,), size=input.size, proj_type="dot_mul",
-                      param_specs=(w,), fn=fn)
+                      param_shape=(input.size,), param_attr=param_attr,
+                      default_init=I.uniform(1.0), make_fn=make_fn,
+                      param_dims=[1, input.size])
 
 
-def table_projection(input: LayerOutput, size: int,
+def table_projection(input: LayerOutput, size: int = 0,
                      param_attr: ParamAttr | None = None) -> Projection:
     """Embedding rows summed into the mix: ids -> table[ids]
     (≅ TableProjection, layers.py:667)."""
-    w = _wspec(param_attr, gen_name("table_proj") + ".w", (input.size, size),
-               I.paddle_default())
 
-    def fn(params, v):
-        return like(v, emb_lookup(params[w.name], raw(v)))
+    def make_fn(pname):
+        return lambda params, v: like(v, emb_lookup(params[pname], raw(v)))
 
-    return Projection(inputs=(input,), size=size, proj_type="table",
-                      param_specs=(w,), fn=fn)
+    return Projection(
+        inputs=(input,), size=size, proj_type="table",
+        param_shape=None if size == 0 else (input.size, size),
+        param_attr=param_attr, make_fn=make_fn,
+        param_dims=[input.size, size],
+    )
 
 
 def context_projection(input: LayerOutput, context_len: int,
                        context_start: int | None = None,
-                       padding_attr: ParamAttr | bool | None = False) -> Projection:
+                       padding_attr=None) -> Projection:
     """Sliding-window concat of neighbor steps over a sequence
-    (≅ ContextProjection, layers.py:889).  Trainable padding not supported;
-    zero padding at sequence boundaries."""
-    enforce(padding_attr is False or padding_attr is None,
-            "trainable context padding is only supported via "
-            "layer.context_projection_layer, not the mixed projection")
-    ctx_start = -(context_len // 2) if context_start is None else context_start
+    (≅ ContextProjection, layers.py:889).  With a ParamAttr (or the default),
+    boundary padding rows are trainable (config_parser.py:665
+    ContextProjection: param dims [total_pad, input_size])."""
+    ctx_start = -(context_len - 1) // 2 if context_start is None else context_start
     out_size = input.size * context_len
+    begin_pad = max(0, -ctx_start)
+    end_pad = max(0, ctx_start + context_len - 1)
+    total_pad = begin_pad + end_pad
+    trainable = padding_attr is not False and total_pad > 0
+    attr = padding_attr if isinstance(padding_attr, ParamAttr) else None
 
-    def fn(params, v):
-        enforce(isinstance(v, SequenceBatch),
-                "context_projection needs sequence input")
-        return seq_ops.context_projection(v, context_len, ctx_start)
+    def make_fn(pname):
+        def fn(params, v):
+            enforce(isinstance(v, SequenceBatch),
+                    "context_projection needs sequence input")
+            out = seq_ops.context_projection(v, context_len, ctx_start)
+            if pname is not None:
+                # overwrite the zero-padded boundary windows with the
+                # trainable padding rows (reference ContextProjection)
+                pad = params[pname]  # [total_pad, D]
+                data = out.data.reshape(
+                    out.data.shape[0], out.data.shape[1], context_len, -1)
+                t = data.shape[1]
+                steps = jnp.arange(t)
+                for j in range(context_len):
+                    off = ctx_start + j
+                    src = steps + off
+                    if off < 0:
+                        row = pad[jnp.clip(src, -begin_pad, -1) + begin_pad]
+                        data = data.at[:, :, j].set(
+                            jnp.where((src < 0)[None, :, None], row[None],
+                                      data[:, :, j]))
+                    elif off > 0:
+                        over = src - (out.length[:, None] - 1)
+                        row = pad[jnp.clip(
+                            begin_pad + over - 1, begin_pad,
+                            total_pad - 1 if total_pad else 0)]
+                        data = data.at[:, :, j].set(
+                            jnp.where((over > 0)[..., None], row, data[:, :, j]))
+                return SequenceBatch(
+                    data=data.reshape(out.data.shape), length=out.length)
+            return out
 
-    return Projection(inputs=(input,), size=out_size, proj_type="context",
-                      fn=fn)
+        return fn
+
+    return Projection(
+        inputs=(input,), size=out_size, proj_type="context",
+        param_shape=(total_pad, input.size) if trainable else None,
+        param_attr=attr, default_init=I.constant(0.0), make_fn=make_fn,
+        param_dims=[total_pad, input.size],
+        default_emit_attr=ParamAttr(initial_mean=0.0, initial_std=0.0),
+        proto={"context_start": ctx_start, "context_length": context_len,
+               "trainable_padding": trainable},
+    )
 
 
-def dotmul_operator(a: LayerOutput, b: LayerOutput, scale: float = 1.0) -> Projection:
+def _conv_geometry(img: LayerOutput, filter_size, filter_size_y, stride,
+                   stride_y, padding, padding_y, channels, num_filters,
+                   groups, trans):
+    """ConvConfig numbers the reference computes in parse_conv
+    (config_parser.py:1369)."""
+    from paddle_tpu.config.proto_emit import cnn_image_size, cnn_output_size
+
+    fh = filter_size_y or filter_size
+    fw = filter_size
+    sy = stride_y or stride
+    sx = stride
+    py = padding_y if padding_y is not None else padding
+    px = padding
+    from paddle_tpu.config.proto_emit import get_img_size
+
+    iw, ih = get_img_size(img, channels)
+    g = dict(filter_size=fw, filter_size_y=fh, channels=channels,
+             stride=sx, stride_y=sy, padding=px, padding_y=py,
+             groups=groups, caffe_mode=True)
+    if not trans:
+        g["filter_channels"] = channels // groups
+        g["img_size"], g["img_size_y"] = iw, ih
+        g["output_x"] = cnn_output_size(iw, fw, px, sx, True)
+        g["output_y"] = cnn_output_size(ih, fh, py, sy, True)
+        out_x, out_y = g["output_x"], g["output_y"]
+    else:
+        g["filter_channels"] = num_filters // groups
+        g["output_x"], g["output_y"] = iw, ih
+        g["img_size"] = cnn_image_size(iw, fw, px, sx, True)
+        g["img_size_y"] = cnn_image_size(ih, fh, py, sy, True)
+        out_x, out_y = g["img_size"], g["img_size_y"]
+    return g, num_filters * out_x * out_y, (out_y, out_x)
+
+
+def conv_projection(input: LayerOutput, filter_size: int, num_filters: int,
+                    num_channels: int | None = None, stride: int = 1,
+                    padding: int = 0, filter_size_y: int | None = None,
+                    stride_y: int | None = None, padding_y: int | None = None,
+                    groups: int = 1, param_attr: ParamAttr | None = None,
+                    trans: bool = False) -> Projection:
+    """Convolution with its own learned filter (≅ ConvProjection /
+    ConvTransProjection, layers.py:684)."""
+    c = num_channels or input.depth
+    g, out_size, (oh, ow) = _conv_geometry(
+        input, filter_size, filter_size_y, stride, stride_y, padding,
+        padding_y, c, num_filters, groups, trans)
+    fh, fw = g["filter_size_y"], g["filter_size"]
+
+    def make_fn(pname):
+        def fn(params, v):
+            from paddle_tpu.ops import nn as nn_ops
+
+            hh = input.height or int((input.size // c) ** 0.5)
+            wwid = input.width or (input.size // c) // hh
+            x = raw(v).reshape(-1, c, hh, wwid).transpose(0, 2, 3, 1)
+            k = params[pname].reshape(num_filters, c // groups, fh, fw)
+            k = k.transpose(2, 3, 1, 0)  # HWIO
+            if trans:
+                y = nn_ops.conv2d_transpose(
+                    x, k.transpose(0, 1, 3, 2), (g["stride_y"], g["stride"]),
+                    (g["padding_y"], g["padding"]))
+            else:
+                y = nn_ops.conv2d(x, k, (g["stride_y"], g["stride"]),
+                                  (g["padding_y"], g["padding"]), groups=groups)
+            return like(v, y.transpose(0, 3, 1, 2).reshape(y.shape[0], -1))
+
+        return fn
+
+    # ConvBaseProjection.calc_parameter_size: co*ci*fh*fw/groups (same for
+    # trans — ci is conv_conf.channels, not filter_channels)
+    psize = num_filters * c * fh * fw // groups
+    init_std = (2.0 / (filter_size ** 2 * c)) ** 0.5
+    return Projection(
+        inputs=(input,), size=out_size,
+        proj_type="convt" if trans else "conv",
+        param_shape=(psize,), param_attr=param_attr,
+        default_init=I.paddle_default(0.0, init_std), make_fn=make_fn,
+        param_dims=[],
+        default_emit_attr=ParamAttr(initial_mean=0.0, initial_std=init_std),
+        proto={"conv": g, "num_filters": num_filters},
+    )
+
+
+def dotmul_operator(a: LayerOutput, b: LayerOutput, scale=1) -> Projection:
     """out = scale * (a ⊙ b) (≅ DotMulOperator, layers.py:921)."""
     enforce(a.size == b.size, "dotmul_operator inputs must share size")
 
-    def fn(params, va, vb):
-        return like(va, scale * raw(va) * raw(vb))
+    def make_fn(pname):
+        return lambda params, va, vb: like(va, scale * raw(va) * raw(vb))
 
-    return Projection(inputs=(a, b), size=a.size, proj_type="dot_mul_op", fn=fn)
+    return Projection(inputs=(a, b), size=a.size, proj_type="dot_mul",
+                      is_operator=True, make_fn=make_fn,
+                      proto={"dotmul_scale": scale})
 
 
 def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
                   num_filters: int, num_channels: int | None = None,
                   stride: int = 1, padding: int = 0,
                   filter_size_y: int | None = None, stride_y: int | None = None,
-                  padding_y: int | None = None) -> Projection:
+                  padding_y: int | None = None,
+                  trans: bool = False) -> Projection:
     """Convolution whose filter comes from another layer's output
-    (≅ ConvOperator, layers.py:680).  filter value is reshaped to
-    [num_filters, C, fh, fw]."""
+    (≅ ConvOperator / ConvTransOperator, layers.py:680)."""
     c = num_channels or img.depth
-    fh = filter_size_y or filter_size
-    fw = filter_size
-    sy = stride_y or stride
-    py = padding_y if padding_y is not None else padding
-    h, w = img.height, img.width
-    oh = (h + 2 * py - fh) // sy + 1
-    ow = (w + 2 * padding - fw) // stride + 1
+    g, out_size, (oh, ow) = _conv_geometry(
+        img, filter_size, filter_size_y, stride, stride_y, padding,
+        padding_y, c, num_filters, 1, trans)
+    fh, fw = g["filter_size_y"], g["filter_size"]
 
-    def fn(params, vimg, vfilt):
-        x = raw(vimg).reshape(-1, c, h, w)
-        k = raw(vfilt).reshape(num_filters, c, fh, fw)
-        out = jax.lax.conv_general_dilated(
-            x, k, window_strides=(sy, stride),
-            padding=((py, py), (padding, padding)),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        return like(vimg, out.reshape(out.shape[0], -1))
+    def make_fn(pname):
+        def fn(params, vimg, vfilt):
+            hh = img.height or int((img.size // c) ** 0.5)
+            ww = img.width or (img.size // c) // hh
+            x = raw(vimg).reshape(-1, c, hh, ww)
+            k = raw(vfilt).reshape(num_filters, c, fh, fw)
+            if trans:
+                out = jax.lax.conv_transpose(
+                    x.transpose(0, 2, 3, 1),
+                    k.transpose(2, 3, 0, 1),  # HWOI -> use IO swap below
+                    strides=(g["stride_y"], g["stride"]),
+                    padding=((g["padding_y"], g["padding_y"]),
+                             (g["padding"], g["padding"])),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    transpose_kernel=True,
+                )
+                return like(vimg, out.transpose(0, 3, 1, 2).reshape(out.shape[0], -1))
+            out = jax.lax.conv_general_dilated(
+                x, k, window_strides=(g["stride_y"], g["stride"]),
+                padding=((g["padding_y"], g["padding_y"]),
+                         (g["padding"], g["padding"])),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return like(vimg, out.reshape(out.shape[0], -1))
 
-    return Projection(inputs=(img, filter), size=num_filters * oh * ow,
-                      proj_type="conv_op", fn=fn)
+        return fn
+
+    return Projection(inputs=(img, filter), size=out_size,
+                      proj_type="convt" if trans else "conv",
+                      is_operator=True, make_fn=make_fn,
+                      proto={"conv": g, "num_filters": num_filters})
+
+
+conv_projection_layer = conv_projection
 
 
 class MixedLayerOutput(LayerOutput):
@@ -224,7 +420,7 @@ class MixedLayerOutput(LayerOutput):
 
 def mixed(size: int | None = None, input=None, name: str | None = None,
           act=None, bias_attr=None, layer_attr=None) -> MixedLayerOutput:
-    """≅ mixed_layer (layers.py:739).  Sums its projection/operator inputs,
+    """≅ mixed_layer (layers.py:851).  Sums its projection/operator inputs,
     adds bias, applies activation (default linear)."""
     name = name or gen_name("mixed")
     node = MixedLayerOutput(name=name, layer_type="mixed", size=size or 0)
@@ -232,12 +428,13 @@ def mixed(size: int | None = None, input=None, name: str | None = None,
     node._finalized = False
     node._act = act_mod.get(act) if act else act_mod.LinearActivation()
     node._bias_attr = bias_attr
+    node._layer_attr = layer_attr
     if input is not None:
         projs = input if isinstance(input, (list, tuple)) else [input]
         for p in projs:
             enforce(isinstance(p, Projection),
                     "mixed input must be projections/operators "
-                    "(use fc/identity_projection/... helpers)")
+                    "(use full_matrix_projection/identity_projection/...)")
             node._projections.append(p)
         _finalize_mixed(node)
     return node
@@ -249,33 +446,83 @@ mixed_layer = mixed
 def _finalize_mixed(node: MixedLayerOutput) -> None:
     projs = node._projections
     enforce(len(projs) > 0, f"mixed layer {node.name!r} has no inputs")
-    size = node.size or projs[0].size
+    size = node.size or 0
+    if not size:
+        for p in projs:
+            if p.size:
+                size = p.size
+                break
+    enforce(size, f"mixed layer {node.name!r}: size is not set")
     for p in projs:
+        if p.size == 0:  # fc/table with size elided adopt the layer size
+            p.size = size
+            if p.proj_type in ("fc", "table"):
+                p.param_shape = (
+                    (p.inputs[0].size, size)
+                    if p.proj_type in ("fc", "table")
+                    else p.param_shape
+                )
+                p.param_dims = [p.inputs[0].size, size]
+            elif p.proj_type == "trans_fc":
+                p.param_shape = (size, p.inputs[0].size)
+                p.param_dims = [size, p.inputs[0].size]
         enforce(p.size == size,
                 f"mixed layer {node.name!r}: projection size {p.size} != {size}")
-    parents: list[LayerOutput] = []
+
+    # slot layout (≅ MixedLayer config class): one slot per projection /
+    # operator first leg, then operators' extra legs appended at the end
+    slots: list[LayerOutput] = []
+    fns = []  # (fn, [slot indices])
+    specs: list[ParamSpec] = []
+    items = []  # emission records
+    op_extras = []
     for p in projs:
-        for inp in p.inputs:
-            if inp not in parents:
-                parents.append(inp)
-    specs = tuple(s for p in projs for s in p.param_specs)
-    # reference default: mixed_layer has NO bias (wrap_bias_attr_default(
-    # has_bias=False), layers.py:853) — bias only when explicitly requested
+        idx = len(slots)
+        pname = f"_{node.name}.w{idx}"
+        if p.is_operator:
+            slots.append(p.inputs[0])
+            _, fn = p.bind(pname)
+            rec = {"kind": "op", "type": p.proj_type,
+                   "indices": [idx], "output_size": p.size,
+                   "proto": dict(p.proto)}
+            items.append(rec)
+            op_extras.append((p, rec, fn))
+        else:
+            spec, fn = p.bind(pname)
+            slots.append(p.inputs[0])
+            if spec is not None:
+                specs.append(spec)
+            fns.append((fn, [idx]))
+            items.append({
+                "kind": "proj", "type": p.proj_type, "slot": idx,
+                "pname": pname, "spec_name": spec.name if spec else None,
+                "input_size": p.inputs[0].size, "output_size": p.size,
+                "param_dims": p.param_dims,
+                "default_emit_attr": p.default_emit_attr,
+                "proto": dict(p.proto),
+            })
+    for p, rec, fn in op_extras:
+        for extra in p.inputs[1:]:
+            rec["indices"].append(len(slots))
+            slots.append(extra)
+        rec["input_sizes"] = [slots[i].size for i in rec["indices"]]
+        fns.append((fn, list(rec["indices"])))
+
     use_bias = node._bias_attr is True or isinstance(node._bias_attr, ParamAttr)
     bspec = None
     if use_bias:
-        battr = node._bias_attr if isinstance(node._bias_attr, ParamAttr) else None
-        bspec = _wspec(battr, f"_{node.name}.wbias", (size,), I.constant(0.0))
-        specs = specs + (bspec,)
-    act = node._act
-    idx_of = {id(n): i for i, n in enumerate(parents)}
+        from paddle_tpu.layers.api import _wspec
 
-    def fwd(ctx, params, states, *parent_values):
+        battr = node._bias_attr if isinstance(node._bias_attr, ParamAttr) else None
+        bspec = _wspec(battr, node.name, "wbias", (size,), I.constant(0.0))
+        specs.append(bspec)
+    act = node._act
+
+    def fwd(ctx, params, states, *slot_values):
         total = None
         template = None
-        for p in projs:
-            vals = [parent_values[idx_of[id(inp)]] for inp in p.inputs]
-            out = p.fn(params, *vals)
+        for fn, idxs in fns:
+            out = fn(params, *[slot_values[i] for i in idxs])
             if template is None and isinstance(out, SequenceBatch):
                 template = out
             total = raw(out) if total is None else total + raw(out)
@@ -287,8 +534,16 @@ def _finalize_mixed(node: MixedLayerOutput) -> None:
         return total
 
     node.size = size
-    node.parents = tuple(parents)
-    node.param_specs = specs
+    node.parents = tuple(slots)
+    node.param_specs = tuple(specs)
     node.fn = fwd
-    node.attrs = {"projections": [p.proj_type for p in projs]}
+    node.attrs = {"mixed_items": items, "active_type": act.name}
     node._finalized = True
+    if node._layer_attr is not None:
+        from paddle_tpu.layers.api import _maybe_dropout
+
+        if getattr(node._layer_attr, "error_clipping_threshold", None):
+            node.attrs["error_clipping_threshold"] = (
+                node._layer_attr.error_clipping_threshold
+            )
+        _maybe_dropout(node, node._layer_attr)

@@ -66,7 +66,7 @@ def tensor_layer(a: LayerOutput, b: LayerOutput, size: int, act=None,
                  name: str | None = None) -> LayerOutput:
     """≅ tensor (TensorLayer): bilinear form y_i = a W_i b^T for i<size."""
     name = name or gen_name("tensor_layer")
-    w = _wspec(param_attr, name, "w", (size, a.size, b.size), I.xavier())
+    w = _wspec(param_attr, name, "w0", (size, a.size, b.size), I.xavier())
     specs = [w]
     use_bias = bias_attr is not False
     if use_bias:
@@ -161,12 +161,12 @@ def clip(input: LayerOutput, min: float, max: float,
          name: str | None = None) -> LayerOutput:
     """≅ clip_layer (ClipLayer, LayerConfig.clip_conf)."""
     name = name or gen_name("clip")
-    lo, hi = float(min), float(max)
+    lo, hi = min, max
 
     def fwd(ctx, params, states, x):
         from paddle_tpu.layers.base import map_data
 
-        return map_data(lambda d: jnp.clip(d, lo, hi), x)
+        return map_data(lambda d: jnp.clip(d, float(lo), float(hi)), x)
 
     return LayerOutput(name=name, layer_type="clip", size=input.size,
                        parents=(input,), fn=fwd,
@@ -248,15 +248,19 @@ def scale_sub_region(input: LayerOutput, indices: LayerOutput, value: float,
 
     return LayerOutput(name=name, layer_type="scale_sub_region",
                        size=input.size, parents=(input, indices), fn=fwd,
+                       attrs={"value": value, "channels": c},
                        height=h, width=w_, depth=c)
 
 
-def sub_nested_seq(input: LayerOutput, selection: LayerOutput,
-                   name: str | None = None) -> LayerOutput:
+def sub_nested_seq(input: LayerOutput, selection: LayerOutput = None,
+                   name: str | None = None,
+                   selected_indices: LayerOutput = None) -> LayerOutput:
     """≅ sub_nested_seq (SubNestedSequenceLayer): from each nested sequence,
     keep the sub-sequence whose index the selection row gives, producing an
     ordinary sequence batch."""
     name = name or gen_name("sub_nested_seq_layer")
+    if selection is None:
+        selection = selected_indices
 
     def fwd(ctx, params, states, x, sel):
         enforce(isinstance(x, NestedSequenceBatch),
@@ -268,7 +272,8 @@ def sub_nested_seq(input: LayerOutput, selection: LayerOutput,
         return SequenceBatch(data=rows, length=lens)
 
     return LayerOutput(name=name, layer_type="sub_nested_seq",
-                       size=input.size, parents=(input, selection), fn=fwd)
+                       size=input.size, parents=(input, selection), fn=fwd,
+                       attrs={"dfs_parents": (input,)})
 
 
 def soft_binary_class_cross_entropy(input: LayerOutput, label: LayerOutput,
@@ -286,6 +291,35 @@ def soft_binary_class_cross_entropy(input: LayerOutput, label: LayerOutput,
 
     return _cost_node(name, "soft_binary_class_cross_entropy",
                       (input, label), fwd)
+
+
+def gated_unit(input, size, act=None, name=None, gate_attr=None,
+               gate_param_attr=None, gate_bias_attr=True, inproj_attr=None,
+               inproj_param_attr=None, inproj_bias_attr=True,
+               layer_attr=None):
+    """≅ gated_unit_layer (layers.py:6412): GLU = fc(act) ⊙ sigmoid-fc,
+    composed exactly like the reference (input_proj + gate fc layers, then
+    a mixed layer with a dotmul operator)."""
+    from paddle_tpu.layers.api import fc_layer
+    from paddle_tpu.layers.base import gen_name
+    from paddle_tpu.layers.mixed import dotmul_operator, mixed_layer
+
+    name = name or gen_name("gated_unit_layer")
+    input_proj = fc_layer(
+        input=input, name=f"{name}_input_proj", size=size, act=act,
+        layer_attr=inproj_attr, param_attr=inproj_param_attr,
+        bias_attr=inproj_bias_attr)
+    gate = fc_layer(
+        input=input, name=f"{name}_gate", size=size,
+        act=act_mod.SigmoidActivation(), layer_attr=gate_attr,
+        param_attr=gate_param_attr, bias_attr=gate_bias_attr)
+    return mixed_layer(
+        name=f"{name}_gated_act",
+        input=dotmul_operator(input_proj, gate),
+        layer_attr=layer_attr)
+
+
+gated_unit_layer = gated_unit
 
 
 def print_layer(input: LayerOutput, format: str | None = None,

@@ -105,10 +105,17 @@ def thresholded_relu(x, threshold: float = 1.0):
 
 # registry keyed by the reference's activation type strings
 # (ActivationFunction::create names)
+def _sequence_softmax_needs_context(x):
+    raise RuntimeError(
+        "sequence_softmax normalizes over a sequence's timesteps and needs "
+        "the sequence mask; it is applied inside sequence-aware layers "
+        "(fc/mixed over SequenceBatch), not as an elementwise activation")
+
+
 REGISTRY = {
     "": identity,
     "linear": identity,
-    "sequence_softmax": softmax,
+    "sequence_softmax": _sequence_softmax_needs_context,
     "sigmoid": sigmoid,
     "tanh": tanh,
     "relu": relu,

@@ -43,7 +43,7 @@ class ParsedConfig:
     def protostr(self) -> str:
         from paddle_tpu.config.protostr import to_protostr
 
-        return to_protostr(self.model_config)
+        return to_protostr(self.model_config, getattr(self, "int_style", None))
 
 
 def make_config_environment(config_path: str, config_args: dict) -> dict:
@@ -103,16 +103,29 @@ def finalize_config() -> ParsedConfig:
     input_names = parse_state.STATE.input_layer_names
     output_names = parse_state.STATE.output_layer_names
     enforce(registry, "config defined no layers")
-    mc = emit_model_config(registry, input_names, output_names, settings)
 
     from paddle_tpu import proto
 
-    oc = proto.OptimizationConfig()
-    _fill_opt_config(oc, settings)
     tc = proto.TrainerConfig()
-    tc.model_config.CopyFrom(mc)
-    tc.opt_config.CopyFrom(oc)
-    return ParsedConfig(tc, mc, oc, input_names, output_names, registry)
+    # emit straight into tc.model_config so int_style message ids stay valid
+    # for whole-TrainerConfig protostr rendering
+    mc, emitter = emit_model_config(registry, input_names, output_names,
+                                    settings, with_emitter=True,
+                                    target=tc.model_config)
+    if parse_state.STATE.data_config:
+        _fill_data_config(tc.data_config, parse_state.STATE.data_config)
+    _fill_opt_config(tc.opt_config, emitter)
+    if parse_state.STATE.test_data_config:
+        _fill_data_config(
+            tc.test_data_config, parse_state.STATE.test_data_config,
+            for_test=True)
+    tc.save_dir = "./output/model"  # trainer_settings defaults
+    tc.start_pass = 0
+    pc = ParsedConfig(tc, mc, tc.opt_config, input_names, output_names,
+                      registry)
+    pc.int_style = emitter.int_style
+    pc._emitter = emitter  # keeps int_style's pinned upb wrappers alive
+    return pc
 
 
 def parse_config_and_serialize(trainer_config, config_arg_str: str = "") -> bytes:
@@ -125,41 +138,40 @@ def _settings() -> dict:
     return get_settings()
 
 
-_OPT_FIELDS = (
-    "batch_size",
-    "algorithm",
-    "learning_rate",
-    "learning_rate_decay_a",
-    "learning_rate_decay_b",
-    "learning_rate_schedule",
-    "learning_rate_args",
-    "learning_method",
-    "average_window",
-    "max_average_window",
-    "do_average_in_cpu",
-    "ada_epsilon",
-    "ada_rou",
-    "adam_beta1",
-    "adam_beta2",
-    "adam_epsilon",
-    "delta_add_rate",
-    "gradient_clipping_threshold",
-    "l1weight",
-    "l2weight",
-    "num_batches_per_send_parameter",
-    "num_batches_per_get_parameter",
-)
+def _fill_data_config(dc, rec: dict, for_test: bool = False) -> None:
+    """PyDataProvider2 DataConfig (≅ data_sources.py define_py_data_source)."""
+    dc.type = "py2"
+    dc.files = rec["files"]
+    dc.async_load_data = False
+    dc.for_test = for_test
+    dc.load_data_module = rec["module"]
+    dc.load_data_object = rec["obj"]
+    args = rec.get("args")
+    if args is not None and not isinstance(args, str):
+        import pickle
+
+        # reference data_sources.py:78 pickles non-string args (protocol 0)
+        args = pickle.dumps(args, 0).decode("latin-1")
+    dc.load_data_args = args or ""
+    dc.data_ratio = 1
+    dc.is_main_data = True
+    dc.usage_ratio = 1.0
 
 
-def _fill_opt_config(oc, settings: dict) -> None:
-    oc.algorithm = "sgd"
-    oc.learning_rate = float(settings.get("learning_rate") or 1e-3)
-    for key in _OPT_FIELDS:
-        v = settings.get(key)
-        if v is None:
+def _fill_opt_config(oc, emitter) -> None:
+    """≅ update_g_config (config_parser.py:4196): every non-None entry of
+    the settings dict (DEFAULT_SETTING overlaid with settings() kwargs)
+    becomes an explicitly-set OptimizationConfig field."""
+    from paddle_tpu.trainer_config_helpers.optimizers import proto_settings
+
+    for key, v in proto_settings().items():
+        if v is None or not hasattr(oc, key):
             continue
         try:
-            setattr(oc, key, v)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                emitter.set_num(oc, key, v)
+            else:
+                setattr(oc, key, v)
         except (TypeError, ValueError):
             from paddle_tpu.core import logger
 

@@ -38,7 +38,11 @@ from paddle_tpu.trainer_config_helpers.optimizers import (  # noqa: F401
 
 def _export_v1_names():
     g = globals()
-    for mod in (_api, _extras, _more, _mixed, _detection, _rg):
+    mods = (_api, _extras, _more, _mixed, _detection, _rg)
+    real = set()
+    # pass 1: real names win (a hand-written foo_layer wrapper must not be
+    # shadowed by the automatic alias for foo)
+    for mod in mods:
         for name in dir(mod):
             if name.startswith("_"):
                 continue
@@ -46,9 +50,18 @@ def _export_v1_names():
             if not callable(fn):
                 continue
             g.setdefault(name, fn)
-            # v1 naming: every layer helper also exists as <name>_layer
-            if not name.endswith("_layer"):
-                g.setdefault(name + "_layer", fn)
+            real.add(name)
+    # pass 2: v1 naming — every layer helper also exists as <name>_layer
+    for mod in mods:
+        for name in dir(mod):
+            if name.startswith("_") or name.endswith("_layer"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            alias = name + "_layer"
+            if alias not in real:
+                g.setdefault(alias, fn)
 
 
 _export_v1_names()
@@ -100,8 +113,10 @@ from paddle_tpu.config.parse_state import (  # noqa: E402,F401
     HasInputsSet,
     Inputs,
     Outputs,
+    define_py_data_sources2,
     outputs,
 )
+from paddle_tpu.trainer_config_helpers import layer_math  # noqa: E402,F401
 
 _CONFIG_ARGS: dict = {}
 

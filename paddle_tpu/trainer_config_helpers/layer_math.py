@@ -1,0 +1,99 @@
+"""``layer_math`` — arithmetic sugar over layers.
+
+≅ ``trainer_config_helpers/layer_math.py``: unary math ops are mixed layers
+with an identity projection and the matching activation; +/-/* overloads on
+LayerOutput build slope_intercept / scaling / repeat combinations.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.layers import activation as act
+from paddle_tpu.layers import api as _api
+from paddle_tpu.layers.base import LayerOutput, gen_name
+from paddle_tpu.layers.extras import repeat as repeat_layer
+from paddle_tpu.layers.mixed import identity_projection, mixed_layer
+
+__all__ = []
+
+
+def _register_unary(op_name: str, activation):
+    def op(input, name=None):
+        return mixed_layer(
+            input=[identity_projection(input=input)],
+            name=name or gen_name(op_name),
+            act=activation,
+        )
+
+    op.__name__ = op_name
+    globals()[op_name] = op
+    __all__.append(op_name)
+
+
+_register_unary("exp", act.ExpActivation())
+_register_unary("log", act.LogActivation())
+_register_unary("abs", act.AbsActivation())
+_register_unary("sigmoid", act.SigmoidActivation())
+_register_unary("tanh", act.TanhActivation())
+_register_unary("square", act.SquareActivation())
+_register_unary("relu", act.ReluActivation())
+_register_unary("sqrt", act.SqrtActivation())
+_register_unary("reciprocal", act.ReciprocalActivation())
+
+
+def _is_number(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def add(layeroutput, other):
+    if _is_number(other):
+        return _api.slope_intercept(input=layeroutput, intercept=other)
+    assert isinstance(other, LayerOutput), "can only add LayerOutput or number"
+    if layeroutput.size == other.size:
+        return mixed_layer(input=[
+            identity_projection(input=layeroutput),
+            identity_projection(input=other),
+        ])
+    assert other.size == 1 or layeroutput.size == 1, (
+        "sizes must match or one side must be size 1")
+    if layeroutput.size == 1:
+        layeroutput, other = other, layeroutput
+    other = repeat_layer(other, layeroutput.size)
+    return mixed_layer(input=[
+        identity_projection(input=layeroutput),
+        identity_projection(input=other),
+    ])
+
+
+def sub(layeroutput, other):
+    if _is_number(other):
+        # bug-for-bug with the reference (layer_math.py sub: intercept=other,
+        # NOT negated) — existing configs depend on this exact graph
+        return _api.slope_intercept(input=layeroutput, intercept=other)
+    assert isinstance(other, LayerOutput)
+    neg = _api.slope_intercept(input=other, slope=-1.0)
+    return add(layeroutput, neg)
+
+
+def rsub(layeroutput, other):
+    neg = _api.slope_intercept(input=layeroutput, slope=-1.0)
+    return add(neg, other)
+
+
+def mul(layeroutput, other):
+    if _is_number(other):
+        return _api.slope_intercept(input=layeroutput, slope=other)
+    assert isinstance(other, LayerOutput)
+    if layeroutput.size == 1:
+        return _api.scaling(input=other, weight=layeroutput)
+    if other.size == 1:
+        return _api.scaling(input=layeroutput, weight=other)
+    raise AssertionError(
+        "one operand of '*' must be a number or a size-1 LayerOutput")
+
+
+LayerOutput.__add__ = add
+LayerOutput.__radd__ = add
+LayerOutput.__sub__ = sub
+LayerOutput.__rsub__ = rsub
+LayerOutput.__mul__ = mul
+LayerOutput.__rmul__ = mul

@@ -10,7 +10,8 @@ _SETTINGS: dict = {}
 def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
              regularization=None, gradient_clipping_threshold=None,
              learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
-             learning_rate_schedule="constant", model_average=None, **kw):
+             learning_rate_schedule="poly", learning_rate_args="",
+             model_average=None, is_async=False, **kw):
     _SETTINGS.clear()
     _SETTINGS.update(dict(
         batch_size=batch_size, learning_rate=learning_rate,
@@ -19,7 +20,8 @@ def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
         learning_rate_decay_a=learning_rate_decay_a,
         learning_rate_decay_b=learning_rate_decay_b,
         learning_rate_schedule=learning_rate_schedule,
-        model_average=model_average, **kw))
+        learning_rate_args=learning_rate_args,
+        model_average=model_average, is_async=is_async, **kw))
 
 
 def get_settings() -> dict:
@@ -65,29 +67,184 @@ def get_settings_optimizer():
 
 # v1 method-object names accepted by settings(learning_method=...)
 class _Method:
+    proto_name = "momentum"
+
     def __init__(self, **kw):
         self.kw = kw
 
+    def to_setting_kwargs(self) -> dict:
+        """OptimizationConfig fields (≅ Optimizer.to_setting_kwargs)."""
+        return {"learning_method": self.proto_name}
+
 
 class MomentumOptimizer(_Method):
-    pass
+    proto_name = "momentum"
+
+    def to_setting_kwargs(self):
+        if self.kw.get("sparse"):
+            return {"learning_method": "sparse_momentum"}
+        return {"learning_method": "momentum"}
 
 
 class AdamOptimizer(_Method):
-    pass
+    proto_name = "adam"
+
+    def to_setting_kwargs(self):
+        return {
+            "learning_method": "adam",
+            "adam_beta1": self.kw.get("beta1", 0.9),
+            "adam_beta2": self.kw.get("beta2", 0.999),
+            "adam_epsilon": self.kw.get("epsilon", 1e-8),
+        }
 
 
 class AdamaxOptimizer(_Method):
-    pass
+    proto_name = "adamax"
+
+    def to_setting_kwargs(self):
+        return {
+            "learning_method": "adamax",
+            "adam_beta1": self.kw.get("beta1", 0.9),
+            "adam_beta2": self.kw.get("beta2", 0.999),
+        }
 
 
 class AdaGradOptimizer(_Method):
-    pass
+    proto_name = "adagrad"
+
+
+class DecayedAdaGradOptimizer(_Method):
+    proto_name = "decayed_adagrad"
+
+    def to_setting_kwargs(self):
+        return {
+            "learning_method": "decayed_adagrad",
+            "ada_rou": self.kw.get("rho", 0.95),
+            "ada_epsilon": self.kw.get("epsilon", 1e-6),
+        }
 
 
 class AdaDeltaOptimizer(_Method):
-    pass
+    proto_name = "adadelta"
+
+    def to_setting_kwargs(self):
+        return {
+            "learning_method": "adadelta",
+            "ada_rou": self.kw.get("rho", 0.95),
+            "ada_epsilon": self.kw.get("epsilon", 1e-6),
+        }
 
 
 class RMSPropOptimizer(_Method):
-    pass
+    proto_name = "rmsprop"
+
+    def to_setting_kwargs(self):
+        return {
+            "learning_method": "rmsprop",
+            "ada_rou": self.kw.get("rho", 0.95),
+            "ada_epsilon": self.kw.get("epsilon", 1e-6),
+        }
+
+
+class BaseRegularization:
+    def to_setting_kwargs(self):
+        return {}
+
+
+class L1Regularization(BaseRegularization):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_setting_kwargs(self):
+        return {"l1weight": self.rate}
+
+
+class L2Regularization(BaseRegularization):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_setting_kwargs(self):
+        return {"l2weight": self.rate}
+
+
+class ModelAverage:
+    def __init__(self, average_window, max_average_window=None,
+                 do_average_in_cpu=False):
+        self.kw = {
+            "average_window": average_window,
+            "max_average_window": max_average_window,
+            "do_average_in_cpu": do_average_in_cpu,
+        }
+
+    def to_setting_kwargs(self):
+        return dict(self.kw)
+
+
+# ≅ config_parser DEFAULT_SETTING (config_parser.py:4046): update_g_config
+# copies every non-None entry into OptimizationConfig
+DEFAULT_SETTING = dict(
+    batch_size=None,
+    mini_batch_size=None,
+    algorithm="async_sgd",
+    async_lagged_grad_discard_ratio=1.5,
+    learning_method="momentum",
+    gradient_clipping_threshold=None,
+    num_batches_per_send_parameter=None,
+    num_batches_per_get_parameter=None,
+    center_parameter_update_method=None,
+    learning_rate=1.0,
+    learning_rate_decay_a=0.0,
+    learning_rate_decay_b=0.0,
+    learning_rate_schedule="poly",
+    learning_rate_args="",
+    l1weight=0.1,
+    l2weight=0.0,
+    l2weight_zero_iter=0,
+    c1=0.0001,
+    backoff=0.5,
+    owlqn_steps=10,
+    max_backoff=5,
+    average_window=0,
+    do_average_in_cpu=False,
+    max_average_window=None,
+    ada_epsilon=1e-6,
+    ada_rou=0.95,
+    delta_add_rate=1.0,
+    shrink_parameter_value=0,
+    adam_beta1=0.9,
+    adam_beta2=0.999,
+    adam_epsilon=1e-8,
+)
+
+
+def proto_settings() -> dict:
+    """The OptimizationConfig field dict the reference's settings() +
+    update_g_config produce (optimizers.py:358-441)."""
+    s = dict(DEFAULT_SETTING)
+    cfg = _SETTINGS
+    if not cfg:
+        return s
+    method = cfg.get("learning_method")
+    if method is None or isinstance(method, str):
+        mobj = _Method()
+        mobj.proto_name = method or "momentum"
+        if method in (None, "momentum"):
+            mobj = MomentumOptimizer()
+    else:
+        mobj = method
+    s["algorithm"] = "async_sgd" if cfg.get("is_async") else "sgd"
+    for key in ("batch_size", "learning_rate", "learning_rate_decay_a",
+                "learning_rate_decay_b", "learning_rate_schedule",
+                "learning_rate_args", "gradient_clipping_threshold"):
+        if key in cfg and cfg[key] is not None:
+            s[key] = cfg[key]
+    s.update(mobj.to_setting_kwargs())
+    reg = cfg.get("regularization")
+    regs = reg if isinstance(reg, (list, tuple)) else ([reg] if reg else [])
+    for r in regs:
+        if hasattr(r, "to_setting_kwargs"):
+            s.update(r.to_setting_kwargs())
+    ma = cfg.get("model_average")
+    if ma is not None and hasattr(ma, "to_setting_kwargs"):
+        s.update(ma.to_setting_kwargs())
+    return s

@@ -97,11 +97,12 @@ def test_ssd_pipeline_learns_and_detects():
     dets = paddle.infer(output_layer=out, parameters=trainer.parameters,
                         input=[(feat.reshape(-1), g.reshape(-1))],
                         feeding=feeding)
-    dets = np.asarray(dets).reshape(-1, 6)
-    best = dets[np.argmax(dets[:, 1])]
-    assert best[0] == 1.0  # class 1 detected
+    # reference-shaped rows: [image_id, label, score, xmin, ymin, xmax, ymax]
+    dets = np.asarray(dets).reshape(-1, 7)
+    best = dets[np.argmax(dets[:, 2])]
+    assert best[1] == 1.0  # class 1 detected
     iou = float(D.iou_matrix(
-        jnp.asarray(best[None, 2:6]),
+        jnp.asarray(best[None, 3:7]),
         jnp.asarray([[0.1, 0.1, 0.35, 0.35]]))[0, 0])
     assert iou > 0.3, (best, iou)
 
