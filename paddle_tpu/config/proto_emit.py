@@ -287,6 +287,8 @@ def _data(E: Emitter, node: LayerOutput):
     if node.attrs.get("explicit_hw"):
         lc.height = node.height
         lc.width = node.width
+        if node.attrs.get("explicit_depth"):
+            lc.depth = node.depth
 
 
 @emits("fc")
@@ -450,21 +452,30 @@ def _batch_norm(E, node):
     ic = lc.inputs[0].image_conf
     ic.channels = channels
     img_size_set = parent.width > 0 or parent.height > 0
-    if parent.size % channels == 0 and (parent.size // channels) >= 1:
-        try:
-            ic.img_size, ic.img_size_y = get_img_size(parent, channels)
-        except Exception:
-            ic.img_size = parent.size // channels
-            ic.img_size_y = 1
+    if a.get("img3D"):
+        # parse_image3d: x/y from width/height, z from the layer depth
+        ic.img_size = parent.width
+        ic.img_size_y = parent.height
+        ic.img_size_z = parent.depth
+        lc.size = ic.img_size * ic.img_size_y * ic.img_size_z * channels
+        lc.height, lc.width = ic.img_size_y, ic.img_size
+        lc.depth = ic.img_size_z
+    else:
+        if parent.size % channels == 0 and (parent.size // channels) >= 1:
+            try:
+                ic.img_size, ic.img_size_y = get_img_size(parent, channels)
+            except Exception:
+                ic.img_size = parent.size // channels
+                ic.img_size_y = 1
+        if img_size_set:
+            lc.size = ic.img_size * ic.img_size_y * channels
+            lc.height, lc.width = ic.img_size_y, ic.img_size
+            lc.depth = 1
+        else:
+            lc.size = parent.size
     if a.get("use_global_stats") is not None:
         lc.use_global_stats = a["use_global_stats"]
     lc.moving_average_fraction = a.get("moving_average_fraction", 0.9)
-    if img_size_set:
-        lc.size = ic.img_size * ic.img_size_y * channels
-        lc.height, lc.width = ic.img_size_y, ic.img_size
-        lc.depth = 1
-    else:
-        lc.size = parent.size
     psize = channels
     ws, bias = E.split_specs(node)
     default_w = ParamAttr(initial_mean=1.0, initial_std=0.0)
@@ -625,6 +636,11 @@ def _hsigmoid(E, node):
         E.input_param(lc, i, spec, (n - 1) * p.size, [n - 1, p.size])
     E.bias_param(lc, node, n - 1, dims=[1, n - 1])
     lc.num_classes = n
+
+
+@emits("cross_entropy_over_beam")
+def _ce_over_beam(E, node):
+    E.layer(node, active_type="", size=0)
 
 
 @emits("print")
@@ -870,7 +886,6 @@ def _fill_conv_conf(cc, g: dict):
 def _emit_mixed_items(E: Emitter, node, lc):
     """Shared by mixed/concat2: LayerInputConfig proj_confs, operator_confs,
     and projection parameters (≅ MixedLayer, config_parser.py:3387)."""
-    spec_by_name = {s.name: s for s in node.param_specs}
     for item in node.attrs["mixed_items"]:
         if item["kind"] == "proj":
             ic = lc.inputs[item["slot"]]
@@ -893,7 +908,7 @@ def _emit_mixed_items(E: Emitter, node, lc):
             if "conv" in proto:
                 _fill_conv_conf(pc.conv_conf, proto["conv"])
                 pc.num_filters = proto["num_filters"]
-            spec = spec_by_name.get(item["spec_name"])
+            spec = item.get("spec")
             if spec is not None:
                 ic.input_parameter_name = spec.name
                 attr = spec.attr
@@ -973,6 +988,190 @@ def _scale_sub_region(E, node):
     lc.width = sc.image_conf.img_size
 
 
+@emits("conv3d", "deconv3d")
+def _conv3d(E, node):
+    a = node.attrs
+    trans = a["trans"]
+    lc = E.layer(node)
+    lc.ClearField("size")
+    num_filters = a["num_filters"]
+    lc.num_filters = num_filters
+    lc.shared_biases = a.get("shared_biases", True)
+    channels = a["channels"]
+    kx, ky, kz = a["filter_size"]
+    sx, sy, sz = a["stride"]
+    px, py, pz = a["padding"]
+    d_in, h_in, w_in = a["img_vol"]
+    cc = lc.inputs[0].conv_conf
+    cc.filter_size, cc.filter_size_y, cc.filter_size_z = kx, ky, kz
+    cc.channels = channels
+    cc.stride, cc.stride_y, cc.stride_z = sx, sy, sz
+    cc.padding, cc.padding_y, cc.padding_z = px, py, pz
+    cc.groups = a.get("groups", 1)
+    cc.caffe_mode = True
+    if not trans:
+        cc.filter_channels = channels // cc.groups
+        cc.img_size, cc.img_size_y, cc.img_size_z = w_in, h_in, d_in
+        cc.output_x = cnn_output_size(w_in, kx, px, sx, True)
+        cc.output_y = cnn_output_size(h_in, ky, py, sy, True)
+        cc.output_z = cnn_output_size(d_in, kz, pz, sz, True)
+        out = (cc.output_z, cc.output_y, cc.output_x)
+    else:
+        cc.filter_channels = num_filters // cc.groups
+        cc.output_x, cc.output_y, cc.output_z = w_in, h_in, d_in
+        cc.img_size = cnn_image_size(w_in, kx, px, sx, True)
+        cc.img_size_y = cnn_image_size(h_in, ky, py, sy, True)
+        cc.img_size_z = cnn_image_size(d_in, kz, pz, sz, True)
+        out = (cc.img_size_z, cc.img_size_y, cc.img_size)
+    ws, _ = E.split_specs(node)
+    psize = num_filters * cc.filter_channels * kx * ky * kz
+    default_attr = ParamAttr(
+        initial_mean=0.0,
+        initial_std=(2.0 / (cc.filter_size ** 2 * channels)) ** 0.5,
+    )
+    E.input_param(lc, 0, ws[0], psize, [], default_attr=default_attr)
+    lc.size = num_filters * out[0] * out[1] * out[2]
+    lc.height, lc.width = out[1], out[2]
+    lc.depth = out[0]
+    if lc.shared_biases:
+        E.bias_param(lc, node, num_filters, dims=[num_filters, 1])
+    else:
+        E.bias_param(lc, node, lc.size, dims=[lc.size, 1])
+
+
+@emits("pool3d")
+def _pool3d(E, node):
+    a = node.attrs
+    lc = E.layer(node, active_type="")
+    lc.ClearField("size")
+    channels = a["channels"]
+    kx, ky, kz = a["pool_size"]
+    sx, sy, sz = a["stride"]
+    px, py, pz = a["padding"]
+    d_in, h_in, w_in = a["img_vol"]
+    pc = lc.inputs[0].pool_conf
+    pc.pool_type = ("max-projection" if a["pool_type"] == "max"
+                    else "avg-projection")
+    pc.channels = channels
+    pc.size_x, pc.stride, pc.padding = kx, sx, px
+    pc.img_size = w_in
+    pc.output_x = cnn_output_size(w_in, kx, px, sx, False)
+    pc.size_y, pc.stride_y, pc.padding_y = ky, sy, py
+    pc.img_size_y = h_in
+    pc.output_y = cnn_output_size(h_in, ky, py, sy, False)
+    pc.size_z, pc.stride_z, pc.padding_z = kz, sz, pz
+    pc.img_size_z = d_in
+    pc.output_z = cnn_output_size(d_in, kz, pz, sz, False)
+    lc.size = channels * pc.output_x * pc.output_y * pc.output_z
+    lc.height, lc.width, lc.depth = pc.output_y, pc.output_x, pc.output_z
+
+
+@emits("recurrent_layer_group")
+def _recurrent_group_emit(E, node):
+    """≅ RecurrentLayerGroupBegin/End (config_parser.py): a marker layer +
+    a sub_model with scatter/gather agents, memory agents, and the step
+    layers (all "@group"-suffixed), then gather agents at root."""
+    g = node.attrs["group"]
+    E.mc.type = "recurrent_nn"
+    gname = g["marker"]
+
+    marker = E.mc.layers.add()
+    marker.name = gname
+    marker.type = "recurrent_layer_group"
+    marker.active_type = ""
+    E.root.layer_names.append(gname)
+
+    sub = E.mc.sub_models.add()
+    sub.name = gname
+    sub.is_recurrent_layer_group = True
+    sub.reversed = node.attrs.get("reverse", False)
+
+    prev = E.cur_submodel
+    E.cur_submodel = sub
+    for ph, outer in g["scatter"]:
+        lc = E.mc.layers.add()
+        lc.name = ph.name
+        lc.type = "scatter_agent"
+        lc.size = ph.size
+        lc.active_type = ""
+        sub.layer_names.append(ph.name)
+    for member in g["members"]:
+        if member.layer_type == "__memory__":
+            lc = E.mc.layers.add()
+            lc.name = member.name
+            lc.type = "agent"
+            lc.size = member.size
+            lc.active_type = ""
+            sub.layer_names.append(member.name)
+            continue
+        fn = EMITTERS.get(member.layer_type)
+        enforce(fn is not None,
+                f"no proto emitter for in-group layer type "
+                f"{member.layer_type!r} ({member.name!r})")
+        fn(E, member)
+    E.cur_submodel = prev
+
+    # gather agents at root (one per output)
+    outs = g["outs"]
+    bases = g["out_bases"]
+    gather_names = []
+    for o, base in zip(outs, bases):
+        lc = E.mc.layers.add()
+        lc.name = base
+        lc.type = "gather_agent"
+        lc.size = o.size
+        lc.active_type = ""
+        E.root.layer_names.append(base)
+        gather_names.append(base)
+
+    for mem, tgt in g["memories"]:
+        m = sub.memories.add()
+        m.layer_name = tgt.name
+        m.link_name = mem.name
+    for ph, outer in g["scatter"]:
+        il = sub.in_links.add()
+        il.layer_name = outer.name
+        il.link_name = ph.name
+    for o, base in zip(outs, bases):
+        ol = sub.out_links.add()
+        ol.layer_name = o.name
+        ol.link_name = base
+
+
+@emits("gather_selector")
+def _gather_selector(E, node):
+    # the gather agent was already emitted by the group node
+    pass
+
+
+@emits("get_output")
+def _get_output(E, node):
+    lc = E.layer(node, active_type="", inputs=False)
+    src = node.attrs["arg_of_node"]
+    ic = lc.inputs.add()
+    ic.input_layer_name = src.name
+    ic.input_layer_argument = node.attrs.get("arg_name", "state")
+
+
+@emits("lstm_step")
+def _lstm_step(E, node):
+    lc = E.layer(node)
+    d = node.size
+    E.bias_param(lc, node, 3 * d, dims=[1, 3 * d])
+    lc.active_gate_type = node.attrs.get("active_gate_type", "sigmoid")
+    lc.active_state_type = node.attrs.get("active_state_type", "tanh")
+
+
+@emits("gru_step")
+def _gru_step(E, node):
+    lc = E.layer(node)
+    d = node.size
+    ws, _ = E.split_specs(node)
+    E.input_param(lc, 0, ws[0], d * 3 * d, [d, 3 * d])
+    E.bias_param(lc, node, 3 * d, dims=[1, 3 * d])
+    lc.active_gate_type = node.attrs.get("active_gate_type", "sigmoid")
+
+
 @emits("maxid")
 def _maxid(E, node):
     lc = E.layer(node, active_type="")
@@ -990,11 +1189,16 @@ def _dropout(E, node):
 # ---------------------------------------------------------------------------
 
 
+_SKIP_TYPES = {"__memory__", "__step_input__", "__static_input__"}
+
+
 def emit_model_config(registry, input_names, output_names,
                       settings: dict | None = None, with_emitter: bool = False,
                       target=None):
     E = Emitter(settings, target=target)
     for node in registry:
+        if node.attrs.get("__in_group__") or node.layer_type in _SKIP_TYPES:
+            continue  # emitted by their recurrent_layer_group node
         fn = EMITTERS.get(node.layer_type)
         enforce(
             fn is not None,

@@ -261,7 +261,7 @@ def embedding(
             "size": size, "vocab": vocab, "active_type": "",
             "mixed_items": [{
                 "kind": "proj", "type": "table", "slot": 0,
-                "pname": spec.name, "spec_name": spec.name,
+                "pname": spec.name, "spec": spec,
                 "input_size": vocab, "output_size": size,
                 "param_dims": [vocab, size], "default_emit_attr": None,
                 "proto": {},
@@ -439,7 +439,9 @@ def img_pool(
             height=h_out,
             width=w_out,
             depth=c,
-            attrs={"pool_type": ptype, "pool_size": [kh, kw], "stride": [sh, sw]},
+            attrs={"pool_type": ptype, "pool_size": [kh, kw],
+                   "stride": [sh, sw], "padding": [ph, pw],
+                   "channels": c, "ceil_mode": ceil_mode},
         ),
         layer_attr,
     )
@@ -458,6 +460,9 @@ def batch_norm(
     moving_average_fraction: float = 0.9,
     epsilon: float = 1e-5,
     layer_attr: ExtraAttr | None = None,
+    img3D: bool = False,
+    mean_var_names=None,
+    batch_norm_type: str | None = None,
     name: str | None = None,
 ) -> LayerOutput:
     """≅ batch_norm_layer (layers.py:2841) over BatchNormalizationLayer.
@@ -472,8 +477,10 @@ def batch_norm(
     )
     # reference ParameterConfig names for the moving stats (BatchNormLayer
     # appends two static inputs .w1/.w2, config_parser.py:2425)
-    mean_s = StateSpec(f"_{name}.w1", (c,), 0.0)
-    var_s = StateSpec(f"_{name}.w2", (c,), 1.0)
+    stat_names = tuple(mean_var_names) if mean_var_names else (
+        f"_{name}.w1", f"_{name}.w2")
+    mean_s = StateSpec(stat_names[0], (c,), 0.0)
+    var_s = StateSpec(stat_names[1], (c,), 1.0)
     # reference batch_norm_layer default act is ReLU (layers.py:2975)
     activation = act_mod.get(act) if act is not None else act_mod.ReluActivation()
 
@@ -506,6 +513,7 @@ def batch_norm(
                    "active_type": activation.name,
                    "use_global_stats": use_global_stats,
                    "moving_average_fraction": moving_average_fraction,
+                   "img3D": img3D,
                    "stat_param_names": (mean_s.name, var_s.name)},
         ),
         layer_attr,
@@ -747,6 +755,8 @@ def concat(input, act=None, name: str | None = None,
     inputs = _as_list(input)
     name = name or gen_name("concat")
     if inputs and isinstance(inputs[0], mixed_mod.Projection):
+        enforce(bias_attr is None or bias_attr is False,
+                "concat2 (concat of projections) does not support bias yet")
         return _concat_projections(inputs, act, name)
     activation = act_mod.get(act)
     total = sum(i.size for i in inputs)
@@ -801,7 +811,7 @@ def _concat_projections(projs, act, name: str) -> LayerOutput:
         fns.append((fn, idx))
         items.append({
             "kind": "proj", "type": p.proj_type, "slot": idx,
-            "pname": pname, "spec_name": spec.name if spec else None,
+            "pname": pname, "spec": spec,
             "input_size": p.inputs[0].size, "output_size": p.size,
             "param_dims": p.param_dims,
             "default_emit_attr": p.default_emit_attr,

@@ -457,11 +457,7 @@ def _finalize_mixed(node: MixedLayerOutput) -> None:
         if p.size == 0:  # fc/table with size elided adopt the layer size
             p.size = size
             if p.proj_type in ("fc", "table"):
-                p.param_shape = (
-                    (p.inputs[0].size, size)
-                    if p.proj_type in ("fc", "table")
-                    else p.param_shape
-                )
+                p.param_shape = (p.inputs[0].size, size)
                 p.param_dims = [p.inputs[0].size, size]
             elif p.proj_type == "trans_fc":
                 p.param_shape = (size, p.inputs[0].size)
@@ -495,7 +491,7 @@ def _finalize_mixed(node: MixedLayerOutput) -> None:
             fns.append((fn, [idx]))
             items.append({
                 "kind": "proj", "type": p.proj_type, "slot": idx,
-                "pname": pname, "spec_name": spec.name if spec else None,
+                "pname": pname, "spec": spec,
                 "input_size": p.inputs[0].size, "output_size": p.size,
                 "param_dims": p.param_dims,
                 "default_emit_attr": p.default_emit_attr,

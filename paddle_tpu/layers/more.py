@@ -293,6 +293,51 @@ def soft_binary_class_cross_entropy(input: LayerOutput, label: LayerOutput,
                       (input, label), fwd)
 
 
+class BeamInput:
+    """One beam expansion for cross_entropy_over_beam (layers.py:6026):
+    (candidate_scores, selected_candidates, gold)."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name: str | None = None) -> LayerOutput:
+    """≅ cross_entropy_over_beam (CrossEntropyOverBeam.cpp): cross entropy
+    over the candidates of a sequence of beam expansions — softmax over each
+    expansion's candidate scores with the gold's slot as the target (a
+    beam-search-aware training loss)."""
+    name = name or gen_name("cross_entropy_over_beam")
+    beams = list(input)
+    enforce(all(isinstance(b, BeamInput) for b in beams),
+            "cross_entropy_over_beam takes BeamInput objects")
+    parents = []
+    for b in beams:
+        parents += [b.candidate_scores, b.selected_candidates, b.gold]
+
+    def fwd(ctx, params, states, *vals):
+        total = None
+        for k in range(len(beams)):
+            scores, sel, gold = vals[3 * k: 3 * k + 3]
+            sv = raw(scores)
+            if is_sequence(scores):
+                sv = sv[..., 0] if sv.ndim == 3 else sv  # [B, T]
+            sel_i = raw(sel).astype(jnp.int32)  # [B, K]
+            cand = jnp.take_along_axis(sv, jnp.clip(sel_i, 0), axis=-1)
+            logp = jax.nn.log_softmax(cand, axis=-1)  # [B, K]
+            g = raw(gold).reshape(-1, 1).astype(jnp.int32)
+            hit = (sel_i == g)  # gold's slot among the selected candidates
+            found = jnp.any(hit, axis=-1)
+            ce = -jnp.sum(jnp.where(hit, logp, 0.0), axis=-1)
+            ce = jnp.where(found, ce, -jnp.log(1e-10))
+            total = ce if total is None else total + ce
+        return jnp.mean(total)
+
+    return LayerOutput(name=name, layer_type="cross_entropy_over_beam",
+                       size=0, parents=tuple(parents), fn=fwd)
+
+
 def gated_unit(input, size, act=None, name=None, gate_attr=None,
                gate_param_attr=None, gate_bias_attr=True, inproj_attr=None,
                inproj_param_attr=None, inproj_bias_attr=True,
@@ -365,19 +410,24 @@ def _triple(v):
 def img_conv3d(input: LayerOutput, filter_size, num_filters: int,
                num_channels: int | None = None, img_size=None,
                stride=1, padding=0, act=None, param_attr=None,
-               bias_attr=None, trans: bool = False,
-               name: str | None = None) -> LayerOutput:
-    """≅ conv3d / deconv3d (Conv3DLayer/DeConv3DLayer): NDHWC volumes.
-    ``img_size`` = (depth, height, width) of the input volume (v1 flat rows
-    carry no 3-D metadata)."""
+               bias_attr=None, trans: bool = False, groups: int = 1,
+               shared_biases: bool = True, layer_type: str | None = None,
+               layer_attr=None, name: str | None = None) -> LayerOutput:
+    """≅ img_conv3d_layer (conv3d/deconv3d, Conv3DLayer/DeConv3DLayer):
+    NDHWC volumes.  v1 list args are (x, y, z) order; the volume comes from
+    ``img_size=(d, h, w)``, the input's explicit depth/height/width, or a
+    preceding 3-D layer."""
     from jax import lax as _lax
 
     name = name or gen_name("conv3d" if not trans else "deconv3d")
-    kd, kh, kw = _triple(filter_size)
-    sd, sh, sw = _triple(stride)
-    pd, ph, pw = _triple(padding)
-    c_in = num_channels or input.depth or 1
+    kw, kh, kd = _triple(filter_size)  # v1 order: (x, y, z)
+    sw, sh, sd = _triple(stride)
+    pw, ph, pd = _triple(padding)
+    enforce(groups == 1, "img_conv3d: grouped 3-D conv not supported")
+    c_in = num_channels or input.attrs.get("num_filters") or 1
     img_size = img_size or input.attrs.get("out_vol")
+    if img_size is None and input.attrs.get("explicit_depth"):
+        img_size = (input.depth, input.height, input.width)
     enforce(img_size is not None, "img_conv3d needs img_size=(d, h, w)")
     d_in, h_in, w_in = img_size
     if trans:
@@ -422,25 +472,44 @@ def img_conv3d(input: LayerOutput, filter_size, num_filters: int,
     node = LayerOutput(
         name=name, layer_type="deconv3d" if trans else "conv3d",
         size=num_filters * d_out * h_out * w_out, parents=(input,),
-        param_specs=tuple(specs), fn=fwd, depth=num_filters,
-        attrs={"out_vol": [d_out, h_out, w_out]},
+        param_specs=tuple(specs), fn=fwd,
+        height=h_out, width=w_out, depth=d_out,
+        attrs={"out_vol": [d_out, h_out, w_out],
+               "active_type": activation.name,
+               "channels": c_in, "num_filters": num_filters,
+               "filter_size": (kw, kh, kd), "stride": (sw, sh, sd),
+               "padding": (pw, ph, pd), "groups": groups, "trans": trans,
+               "img_vol": (d_in, h_in, w_in),
+               "shared_biases": shared_biases},
     )
     return node
 
 
+img_conv3d_layer = img_conv3d
+
+
 def img_pool3d(input: LayerOutput, pool_size, img_size=None,
                num_channels: int | None = None, stride=None, padding=0,
-               pool_type: str = "max", name: str | None = None) -> LayerOutput:
-    """≅ pool3d (Pool3DLayer): max/avg pooling over NDHWC volumes."""
+               pool_type="max", layer_attr=None,
+               name: str | None = None) -> LayerOutput:
+    """≅ img_pool3d_layer (pool3d, Pool3DLayer): max/avg pooling over NDHWC
+    volumes.  v1 list args are (x, y, z) order."""
     import jax.numpy as _jnp
     from jax import lax as _lax
+    from paddle_tpu.layers import pooling as pool_mod
 
     name = name or gen_name("pool3d")
-    kd, kh, kw = _triple(pool_size)
-    sd, sh, sw = _triple(stride if stride is not None else pool_size)
-    pd, ph, pw = _triple(padding)
-    c = num_channels or input.depth or 1
+    if not isinstance(pool_type, str):
+        pool_type = pool_mod.get(pool_type)
+    if pool_type not in ("max", "average"):
+        pool_type = "average" if "av" in pool_type else "max"
+    kw, kh, kd = _triple(pool_size)
+    sw, sh, sd = _triple(stride if stride is not None else pool_size)
+    pw, ph, pd = _triple(padding)
+    c = num_channels or input.attrs.get("num_filters") or 1
     vol = img_size or input.attrs.get("out_vol")
+    if vol is None and input.attrs.get("explicit_depth"):
+        vol = (input.depth, input.height, input.width)
     enforce(vol is not None, "img_pool3d needs img_size or a conv3d input")
     d_in, h_in, w_in = vol
     # ceil output sizes, like the reference pool layers and 2D img_pool
@@ -472,5 +541,9 @@ def img_pool3d(input: LayerOutput, pool_size, img_size=None,
     return LayerOutput(
         name=name, layer_type="pool3d",
         size=c * d_out * h_out * w_out, parents=(input,), fn=fwd,
-        depth=c, attrs={"out_vol": [d_out, h_out, w_out]},
+        height=h_out, width=w_out, depth=d_out,
+        attrs={"out_vol": [d_out, h_out, w_out],
+               "pool_type": pool_type, "channels": c,
+               "pool_size": (kw, kh, kd), "stride": (sw, sh, sd),
+               "padding": (pw, ph, pd), "img_vol": (d_in, h_in, w_in)},
     )

@@ -64,14 +64,47 @@ def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
                            name=name)
 
 
-def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
-               gru_param_attr=None, act=None, gate_act=None, **kw):
-    """≅ networks.simple_gru: fc(3*size) -> grumemory."""
-    fc = layer.fc(input=input, size=size * 3, act=act_mod.LinearActivation(),
-                  param_attr=mixed_param_attr,
-                  name=f"{name}_transform" if name else None)
-    return layer.grumemory(input=fc, reverse=reverse, param_attr=gru_param_attr,
-                           act=act, gate_act=gate_act, name=name)
+def simple_gru(input, size, name=None, reverse=False,
+               mixed_param_attr=None, mixed_bias_param_attr=None,
+               mixed_layer_attr=None, gru_param_attr=None,
+               gru_bias_attr=None, act=None, gate_act=None,
+               gru_layer_attr=None, **kw):
+    """≅ networks.simple_gru (networks.py:1047): mixed Wx transform +
+    gru_group (the in-group gru, each step addressable)."""
+    from paddle_tpu.layers.base import gen_name
+    from paddle_tpu.layers.mixed import full_matrix_projection, mixed_layer
+    from paddle_tpu.layers.recurrent_group import gru_group
+
+    name = name or gen_name("simple_gru")
+    with mixed_layer(name=f"{name}_transform", size=size * 3,
+                     bias_attr=mixed_bias_param_attr,
+                     layer_attr=mixed_layer_attr) as m:
+        m += full_matrix_projection(input=input, param_attr=mixed_param_attr)
+    return gru_group(name=name, size=size, input=m, reverse=reverse, act=act,
+                     gate_act=gate_act, gru_bias_attr=gru_bias_attr,
+                     gru_param_attr=gru_param_attr,
+                     gru_layer_attr=gru_layer_attr)
+
+
+def simple_gru2(input, size, name=None, reverse=False,
+                mixed_param_attr=None, mixed_bias_attr=None,
+                mixed_layer_attr=None, gru_param_attr=None,
+                gru_bias_attr=None, act=None, gate_act=None,
+                gru_cell_attr=None, **kw):
+    """≅ networks.simple_gru2 (networks.py:1111): mixed Wx transform +
+    single-layer grumemory (faster than the in-group form)."""
+    from paddle_tpu.layers.base import gen_name
+    from paddle_tpu.layers.mixed import full_matrix_projection, mixed_layer
+
+    name = name or gen_name("simple_gru2")
+    with mixed_layer(name=f"{name}_transform", size=size * 3,
+                     bias_attr=mixed_bias_attr,
+                     layer_attr=mixed_layer_attr) as m:
+        m += full_matrix_projection(input=input, param_attr=mixed_param_attr)
+    return layer.grumemory(input=m, reverse=reverse, name=name,
+                           bias_attr=gru_bias_attr, param_attr=gru_param_attr,
+                           act=act, gate_act=gate_act,
+                           layer_attr=gru_cell_attr)
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False, **kw):
@@ -86,16 +119,28 @@ def bidirectional_lstm(input, size, name=None, return_seq=False, **kw):
     return layer.concat(input=[f_last, b_first])
 
 
-def bidirectional_gru(input, size, name=None, return_seq=False, **kw):
-    """≅ networks.bidirectional_gru."""
-    fwd = simple_gru(input=input, size=size, name=f"{name}_fw" if name else None)
-    bwd = simple_gru(input=input, size=size, reverse=True,
-                     name=f"{name}_bw" if name else None)
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      last_seq_attr=None, first_seq_attr=None,
+                      concat_attr=None, concat_act=None, **kw):
+    """≅ networks.bidirectional_gru (networks.py:1130): fw/bw simple_gru2,
+    concatenated (whole sequences or last/first steps)."""
+    from paddle_tpu.layers.base import gen_name
+
+    name = name or gen_name("bidirectional_gru")
+    fw_args = {k[len("fwd_"):]: v for k, v in kw.items()
+               if k.startswith("fwd_")}
+    bw_args = {k[len("bwd_"):]: v for k, v in kw.items()
+               if k.startswith("bwd_")}
+    fw = simple_gru2(input=input, size=size, name=f"{name}_fw", **fw_args)
+    bw = simple_gru2(input=input, size=size, reverse=True,
+                     name=f"{name}_bw", **bw_args)
     if return_seq:
-        return layer.concat(input=[fwd, bwd])
-    f_last = layer.last_seq(input=fwd)
-    b_first = layer.first_seq(input=bwd)
-    return layer.concat(input=[f_last, b_first])
+        return layer.concat(name=name, input=[fw, bw], act=concat_act,
+                            layer_attr=concat_attr)
+    f_last = layer.last_seq(name=f"{name}_fw_last", input=fw)
+    b_first = layer.first_seq(name=f"{name}_bw_last", input=bw)
+    return layer.concat(name=name, input=[f_last, b_first], act=concat_act,
+                        layer_attr=concat_attr)
 
 
 def sequence_conv_pool(input, context_len, hidden_size, name=None,

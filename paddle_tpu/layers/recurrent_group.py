@@ -45,6 +45,16 @@ class StaticInput:
         self.input = input
 
 
+class SubsequenceInput:
+    """Marks a nested-sequence input scattered one SUBSEQUENCE per step
+    (≅ SubsequenceInput, layers.py:3806)."""
+
+    def __init__(self, input: LayerOutput):
+        enforce(isinstance(input, LayerOutput),
+                "SubsequenceInput wraps a LayerOutput")
+        self.input = input
+
+
 class BaseGeneratedInput:
     pass
 
@@ -80,6 +90,7 @@ def memory(name: str | None, size: int, boot_layer: LayerOutput | None = None,
     )
     node._boot_layer = boot_layer
     node._link_override = None
+    node.set_input = lambda layer: _set_memory_input(node, layer)
     return node
 
 
@@ -154,8 +165,11 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
     """≅ recurrent_group (layers.py:3862).  Scatters sequence inputs into
     timesteps, runs ``step`` under ``lax.scan``, gathers outputs back into a
     sequence."""
+    from paddle_tpu.layers import base as layer_base
+
     name = name or gen_name("recurrent_group")
-    if isinstance(input, (LayerOutput, StaticInput)):
+    reg_start = len(layer_base.layer_registry())
+    if isinstance(input, (LayerOutput, StaticInput, SubsequenceInput)):
         input = [input]
     input = list(input)
     enforce(len(input) > 0, "recurrent_group needs at least one input")
@@ -164,6 +178,10 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
     in_args = []
     seq_inputs: list[LayerOutput] = []  # outer sequence nodes, in order
     static_inputs: list[LayerOutput] = []  # outer static nodes, in order
+    input = [
+        each.input if isinstance(each, SubsequenceInput) else each
+        for each in input
+    ]
     for each in input:
         if isinstance(each, StaticInput):
             ph = LayerOutput(name=gen_name("static_in"),
@@ -187,13 +205,28 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
     single = isinstance(outs, LayerOutput)
     outs = [outs] if single else list(outs)
 
+    # every node built during step() (registry slice), in creation order —
+    # this also catches layers only reachable through memory links (e.g. the
+    # lstm state get_output), ≅ the reference's submodel layer list
+    created = layer_base.layer_registry()[reg_start:]
+
     step_nodes, seq_phs, static_phs, mems = _collect_step_graph(outs)
-    link_targets = _resolve_links(mems, step_nodes, outs)
+    link_targets = _resolve_links(mems, step_nodes + [
+        n for n in created
+        if n.layer_type not in ("__memory__", "__step_input__",
+                                "__static_input__")
+    ], outs)
     # evaluation roots: outputs + every memory's link target
     roots = list(outs)
     for t in link_targets:
         if not any(t is r for r in roots):
             roots.append(t)
+    # re-collect so link-only-reachable layers join the step graph
+    step_nodes, seq_phs, static_phs, mems2 = _collect_step_graph(roots)
+    for m in mems2:
+        if not any(m is x for x in mems):
+            mems.append(m)
+            link_targets.append(_resolve_links([m], step_nodes, outs)[0])
 
     # placeholders found by the walk, matched back to outer nodes
     seq_ph_order = [ph for ph in in_args if ph.layer_type == "__step_input__"]
@@ -292,11 +325,59 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
             return result, states_final
         return result
 
+    # ---- submodel naming + emission metadata (≅ RecurrentLayerGroupBegin/
+    # End, config_parser.py): in-group layers get the "@<group>" suffix, the
+    # memory agents the "+delay1@<group>" names, auto-named parameters follow
+    # their layer, and the gather agent at root takes the step output's name.
+    out_base_names = [o.name for o in outs]
+    members = []  # creation-order in-group nodes (memories + step layers)
+    in_group = {id(n) for n in step_nodes} | {id(m) for m in mems}
+    for n in created:
+        if id(n) in in_group:
+            members.append(n)
+    for ph, outer in zip(seq_ph_order, seq_inputs):
+        ph.name = f"{outer.name}@{name}"
+        ph.attrs["__in_group__"] = name
+    for ph, outer in zip(static_ph_order, static_inputs):
+        ph.name = f"{outer.name}@{name}"
+        ph.attrs["__in_group__"] = name
+    for m in mems:
+        link = m.attrs.get("link")
+        base = f"{link}+delay1" if link else m.name
+        m.name = f"{base}@{name}"
+        m.attrs["__in_group__"] = name
+    for n in step_nodes:
+        old = n.name
+        n.name = f"{old}@{name}"
+        n.attrs["__in_group__"] = name
+        for s in n.param_specs:
+            a = getattr(s, "attr", None)
+            if (a is None or a.name is None) and s.name.startswith(f"_{old}."):
+                # frozen dataclass: rename in place so runtime closures
+                # (which read .name at call time) stay consistent
+                object.__setattr__(
+                    s, "name", f"_{n.name}." + s.name[len(old) + 2:])
+        if (n.attrs.get("bias_spec") or "").startswith(f"_{old}."):
+            n.attrs["bias_spec"] = (
+                f"_{n.name}." + n.attrs["bias_spec"][len(old) + 2:])
+
     group = LayerOutput(
-        name=name, layer_type="recurrent_layer_group",
+        name=out_base_names[0] if single else f"{name}__outputs",
+        layer_type="recurrent_layer_group",
         size=outs[0].size, parents=parents,
         param_specs=tuple(param_specs), state_specs=tuple(state_specs),
-        fn=fwd, attrs={"reverse": reverse, "n_outputs": len(outs)},
+        fn=fwd, attrs={
+            "reverse": reverse, "n_outputs": len(outs),
+            "group": {
+                "marker": name,
+                "scatter": list(zip(seq_ph_order, seq_inputs))
+                + list(zip(static_ph_order, static_inputs)),
+                "members": members,
+                "memories": list(zip(mems, link_targets)),
+                "outs": list(outs),
+                "out_bases": out_base_names,
+            },
+        },
     )
     if single:
         return group
@@ -308,8 +389,9 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                 return v[k]
             return sel
         sels.append(LayerOutput(
-            name=f"{name}@{o.name}", layer_type="get_output", size=o.size,
+            name=out_base_names[k], layer_type="gather_selector", size=o.size,
             parents=(group,), fn=make_sel(k)))
+    group.attrs["group"]["selectors"] = sels
     return sels
 
 
@@ -548,9 +630,10 @@ def gru_step_layer(input: LayerOutput, output_mem: LayerOutput,
 
     size = size or input.size // 3
     name = name or gen_name("gru_step")
-    w_spec = _wspec(param_attr, name, "w0", (size, 2 * size), I.paddle_default())
-    wc_spec = _wspec(None, name, "w1", (size, size), I.paddle_default())
-    specs = [w_spec, wc_spec]
+    # single fused recurrent weight [size, 3*size] like the reference
+    # GruStepLayer parameter (dims [size, 3*size])
+    w_spec = _wspec(param_attr, name, "w0", (size, 3 * size), I.paddle_default())
+    specs = [w_spec]
     use_bias = bias_attr is not False
     bspec = None
     if use_bias:
@@ -565,12 +648,16 @@ def gru_step_layer(input: LayerOutput, output_mem: LayerOutput,
         xw = _raw_boot(x)
         if bspec is not None:
             xw = xw + params[bspec.name]
-        return rnn_ops.gru_cell(xw, _raw_boot(h), params[w_spec.name],
-                                params[wc_spec.name], ga, sa)
+        w = params[w_spec.name]
+        return rnn_ops.gru_cell(xw, _raw_boot(h), w[:, : 2 * size],
+                                w[:, 2 * size:], ga, sa)
 
     return LayerOutput(name=name, layer_type="gru_step", size=size,
                        parents=(input, output_mem),
-                       param_specs=tuple(specs), fn=fwd)
+                       param_specs=tuple(specs), fn=fwd,
+                       attrs={"active_type": sa.name,
+                              "active_gate_type": ga.name,
+                              "bias_spec": bspec.name if bspec else None})
 
 
 def lstm_step_layer(input: LayerOutput, state: LayerOutput,
@@ -593,23 +680,32 @@ def lstm_step_layer(input: LayerOutput, state: LayerOutput,
     if use_bias:
         from paddle_tpu.layers.attr import ParamAttr
         battr = bias_attr if isinstance(bias_attr, ParamAttr) else None
-        bspec = _wspec(battr, name, "wbias", (4 * size,), I.constant(0.0))
+        # reference LstmStepLayer bias is the 3*size PEEPHOLE weights
+        # (W_ci/W_cf/W_co); gate biases live in the input projection
+        bspec = _wspec(battr, name, "wbias", (3 * size,), I.constant(0.0))
         specs.append(bspec)
     ga = act_mod.get(gate_act) if gate_act else act_mod.SigmoidActivation()
+    oa = act_mod.get(act) if act else act_mod.TanhActivation()
     sa = act_mod.get(state_act) if state_act else act_mod.TanhActivation()
 
     def cell(params, x, c_prev):
-        import jax.numpy as jnp
         gates = _raw_boot(x)
-        if bspec is not None:
-            gates = gates + params[bspec.name]
+        cp = _raw_boot(c_prev)
         d = size
-        i = ga(gates[:, 0 * d:1 * d])
-        f = ga(gates[:, 1 * d:2 * d])
-        g = sa(gates[:, 2 * d:3 * d])
-        o = ga(gates[:, 3 * d:4 * d])
-        c = f * _raw_boot(c_prev) + i * g
-        h = o * sa(c)
+        gi = gates[:, 0 * d:1 * d]
+        gf = gates[:, 1 * d:2 * d]
+        gg = gates[:, 2 * d:3 * d]
+        go = gates[:, 3 * d:4 * d]
+        if bspec is not None:
+            peep = params[bspec.name]
+            gi = gi + peep[0 * d:1 * d] * cp
+            gf = gf + peep[1 * d:2 * d] * cp
+        i, f = ga(gi), ga(gf)
+        c = f * cp + i * sa(gg)
+        if bspec is not None:
+            go = go + params[bspec.name][2 * d:3 * d] * c
+        o = ga(go)
+        h = o * oa(c)
         return h, c
 
     def fwd_h(ctx, params, states, x, c_prev):
@@ -620,8 +716,86 @@ def lstm_step_layer(input: LayerOutput, state: LayerOutput,
 
     h_node = LayerOutput(name=name, layer_type="lstm_step", size=size,
                          parents=(input, state),
-                         param_specs=tuple(specs), fn=fwd_h)
-    c_node = LayerOutput(name=name + "@state", layer_type="lstm_step_state",
-                         size=size, parents=(input, state),
-                         param_specs=tuple(specs), fn=fwd_c)
+                         param_specs=tuple(specs), fn=fwd_h,
+                         attrs={"active_type": oa.name,
+                                "active_gate_type": ga.name,
+                                "active_state_type": sa.name,
+                                "bias_spec": bspec.name if bspec else None})
+    c_node = LayerOutput(name=name + "@state", layer_type="get_output",
+                         size=size, parents=(input, state), fn=fwd_c,
+                         attrs={"arg_name": "state", "arg_of": name})
+    h_node._state_node = c_node
+    c_node.attrs["arg_of_node"] = h_node
     return h_node, c_node
+
+
+def get_output_layer(input: LayerOutput, arg_name: str = "state",
+                     name: str | None = None) -> LayerOutput:
+    """≅ get_output_layer (layers.py:3728): expose a layer's secondary
+    output (the lstm_step 'state' cell value)."""
+    enforce(arg_name == "state" and hasattr(input, "_state_node"),
+            "get_output_layer supports the lstm_step 'state' output")
+    node = input._state_node
+    if name:
+        node.name = name
+    return node
+
+
+def lstmemory_group(input: LayerOutput, size: int | None = None,
+                    name: str | None = None, reverse: bool = False,
+                    out_memory=None, act=None, gate_act=None, state_act=None,
+                    input_proj_bias_attr=None, input_proj_layer_attr=None,
+                    mixed_bias_attr=None, lstm_bias_attr=None,
+                    param_attr=None, mixed_layer_attr=None,
+                    lstm_layer_attr=None) -> LayerOutput:
+    """≅ networks.lstmemory_group: lstm built from in-group primitives so each
+    step is addressable (memory/attention use-cases) — input_recurrent mixed
+    (identity + fc-of-output-memory), lstm_step, state get_output."""
+    from paddle_tpu.layers.mixed import (
+        full_matrix_projection,
+        identity_projection,
+        mixed_layer,
+    )
+
+    name = name or gen_name("lstm_group")
+    size = size or input.size // 4
+
+    def step(ipt):
+        out_mem = memory(name=name, size=size)
+        state_mem = memory(name=f"{name}_state", size=size)
+        bias = (input_proj_bias_attr if input_proj_bias_attr is not None
+                else mixed_bias_attr)
+        with mixed_layer(name=f"{name}_input_recurrent", size=size * 4,
+                         bias_attr=bias,
+                         layer_attr=input_proj_layer_attr) as m:
+            m += identity_projection(input=ipt)
+            m += full_matrix_projection(input=out_mem, param_attr=param_attr)
+        h, c = lstm_step_layer(
+            input=m, state=state_mem, name=name, size=size, act=act,
+            gate_act=gate_act, state_act=state_act, bias_attr=lstm_bias_attr)
+        get_output_layer(input=h, arg_name="state", name=f"{name}_state")
+        return h
+
+    return recurrent_group(
+        name=f"{name}_recurrent_group", step=step, input=input,
+        reverse=reverse)
+
+
+def gru_group(input: LayerOutput, size: int | None = None,
+              name: str | None = None, reverse: bool = False,
+              act=None, gate_act=None, gru_bias_attr=None,
+              gru_param_attr=None, gru_layer_attr=None) -> LayerOutput:
+    """≅ networks.gru_group: gru from in-group primitives."""
+    name = name or gen_name("gru_group")
+    size = size or input.size // 3
+
+    def step(ipt):
+        out_mem = memory(name=name, size=size)
+        return gru_step_layer(
+            input=ipt, output_mem=out_mem, name=name, size=size, act=act,
+            gate_act=gate_act, bias_attr=gru_bias_attr,
+            param_attr=gru_param_attr)
+
+    return recurrent_group(
+        name=f"{name}_recurrent_group", step=step, input=input,
+        reverse=reverse)

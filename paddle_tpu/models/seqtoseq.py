@@ -81,11 +81,21 @@ def seqtoseq_net(source_dict_dim: int, target_dict_dim: int,
                    full_matrix_projection(
                        current_word, size=decoder_size * 3,
                        param_attr=ParamAttr(name="_decoder_inputs_word.w"))])
+        # explicit param names: the training topology builds its decoder
+        # inside recurrent_group (params get the "@group" suffix, reference
+        # naming) while generation builds inside beam_search — shared names
+        # must not depend on the group counter
         gru_step = gru_step_layer(
             name="gru_decoder", input=decoder_inputs, output_mem=decoder_mem,
-            size=decoder_size)
+            size=decoder_size,
+            param_attr=ParamAttr(name="_gru_decoder.w"),
+            bias_attr=ParamAttr(name="_gru_decoder.bias",
+                                initial_std=0.0, initial_mean=0.0))
         out = layer.fc(input=gru_step, size=target_dict_dim,
-                       act=act_mod.SoftmaxActivation(), bias_attr=True,
+                       act=act_mod.SoftmaxActivation(),
+                       param_attr=ParamAttr(name="_decoder_prob.w"),
+                       bias_attr=ParamAttr(name="_decoder_prob.bias",
+                                           initial_std=0.0, initial_mean=0.0),
                        name="decoder_prob")
         return out
 
