@@ -56,6 +56,38 @@ def HasInputsSet() -> bool:
     return len(STATE.input_layer_names) != 0
 
 
+def SimpleData(files=None, feat_dim=None, context_len=None,
+               buffer_capacity=None, **kw) -> dict:
+    """≅ SimpleData (config_parser.py:1049): dense rows from text files."""
+    return {"type": "simple", "files": files, "feat_dim": feat_dim,
+            "context_len": context_len, "buffer_capacity": buffer_capacity}
+
+
+def PyData(files=None, type=None, load_data_module=None,
+           load_data_object=None, load_data_args="", **kw) -> dict:
+    """≅ PyData (config_parser.py:1066)."""
+    return {"type": "py", "files": files, "module": load_data_module,
+            "obj": load_data_object, "args": load_data_args}
+
+
+def TrainData(data_config: dict, async_load_data=None) -> None:
+    """≅ TrainData (config_parser.py:1178)."""
+    STATE.data_config = dict(data_config)
+
+
+def TestData(data_config: dict, async_load_data=None) -> None:
+    """≅ TestData (config_parser.py:1190)."""
+    STATE.test_data_config = dict(data_config)
+
+
+def inputs(layers, *args) -> None:
+    """≅ networks.inputs (networks.py:1485): declare input order."""
+    if isinstance(layers, LayerOutput):
+        layers = [layers]
+    layers = list(layers) + list(args)
+    Inputs(*[l.name for l in layers])
+
+
 def outputs(layers, *args) -> None:
     """≅ networks.outputs (networks.py:1503): declare outputs; if inputs are
     unset, infer both by DFS — data layers become inputs, v1-cost-typed

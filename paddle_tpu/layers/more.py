@@ -424,7 +424,8 @@ def img_conv3d(input: LayerOutput, filter_size, num_filters: int,
     sw, sh, sd = _triple(stride)
     pw, ph, pd = _triple(padding)
     enforce(groups == 1, "img_conv3d: grouped 3-D conv not supported")
-    c_in = num_channels or input.attrs.get("num_filters") or 1
+    c_in = (num_channels or input.attrs.get("num_filters")
+            or input.attrs.get("channels") or 1)
     img_size = img_size or input.attrs.get("out_vol")
     if img_size is None and input.attrs.get("explicit_depth"):
         img_size = (input.depth, input.height, input.width)
@@ -506,7 +507,8 @@ def img_pool3d(input: LayerOutput, pool_size, img_size=None,
     kw, kh, kd = _triple(pool_size)
     sw, sh, sd = _triple(stride if stride is not None else pool_size)
     pw, ph, pd = _triple(padding)
-    c = num_channels or input.attrs.get("num_filters") or 1
+    c = (num_channels or input.attrs.get("num_filters")
+         or input.attrs.get("channels") or 1)
     vol = img_size or input.attrs.get("out_vol")
     if vol is None and input.attrs.get("explicit_depth"):
         vol = (input.depth, input.height, input.width)

@@ -150,19 +150,25 @@ def batch_norm(
     (``BatchNormBaseLayer``); here they are explicit state in/out so the
     train step stays pure.
     """
-    x32 = x.astype(jnp.float32)
     if is_train:
+        # single-pass stats (E[x], E[x²]) accumulated in f32 from the native
+        # dtype — the elementwise normalize then runs in the activation dtype
+        # (bf16 under the mixed-precision policy), halving the HBM traffic of
+        # the f32-upcast formulation.  ResNet-class training on TPU is
+        # bandwidth-bound in BN, not FLOP-bound (see BENCHMARKS.md roofline).
         axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x32, axis=axes)
-        var = jnp.var(x32, axis=axes)
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes)
+        var = jnp.maximum(m2 - lax.square(mean), 0.0)
         new_mean = momentum * running_mean + (1 - momentum) * mean
         new_var = momentum * running_var + (1 - momentum) * var
     else:
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var
     inv = lax.rsqrt(var + eps) * scale
-    y = (x32 - mean) * inv + bias
-    return y.astype(x.dtype) if x.dtype != jnp.float32 else y, new_mean, new_var
+    shift = bias - mean * inv
+    y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
+    return y, new_mean, new_var
 
 
 def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5):

@@ -1,0 +1,75 @@
+"""PyDataProvider2 compatibility — the ``@provider`` decorator surface.
+
+Reference: ``python/paddle/trainer/PyDataProvider2.py`` (``@provider``,
+``:367-374``) wraps a user generator so the C++ ``PyDataProvider2.cpp``
+can pull batches through embedded CPython.  Here the direction is inverted
+(the runtime IS Python): the decorated generator simply becomes a
+paddle-style reader over the provider's file list, and the declared
+``input_types`` drive the DataFeeder.
+
+Supported knobs: input_types (dict or list), should_shuffle, cache
+(accepted, pass-level caching handled by the reader buffer), init_hook,
+pool_size/calc_batch_size (accepted and ignored — XLA batches statically).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.layers.data_type import (  # noqa: F401 (re-exported surface)
+    dense_array,
+    dense_vector,
+    dense_vector_sequence,
+    integer_value,
+    integer_value_sequence,
+    integer_value_sub_sequence,
+    sparse_binary_vector,
+    sparse_binary_vector_sequence,
+    sparse_float_vector,
+)
+
+
+class CacheType:
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+class _Settings:
+    """The ``settings`` object handed to providers/init_hooks."""
+
+    def __init__(self, input_types=None, **kwargs):
+        self.input_types = input_types
+        self.__dict__.update(kwargs)
+
+
+def provider(input_types=None, should_shuffle=None, pool_size=-1,
+             min_pool_size=-1, can_over_batch_size=True, calc_batch_size=None,
+             cache=CacheType.NO_CACHE, check=False, check_fail_continue=False,
+             init_hook=None, **outter_kwargs):
+    """≅ @provider (PyDataProvider2.py:367): declare a data provider."""
+
+    def deco(fn):
+        def make_reader(file_list, **kwargs):
+            """paddle-style reader() over the provider's file list."""
+            settings = _Settings(input_types=input_types, **kwargs)
+            if init_hook is not None:
+                init_hook(settings, file_list=file_list, **kwargs)
+
+            def reader():
+                for filename in file_list:
+                    yield from fn(settings, filename)
+
+            return reader
+
+        fn.make_reader = make_reader
+        fn.input_types = input_types
+        fn.is_provider = True
+        fn.should_shuffle = should_shuffle
+        fn.cache = cache
+        return fn
+
+    return deco
+
+
+def read_file_list(list_path: str) -> list[str]:
+    """A ``train.list`` file: one data-file path per line (≅ DataConfig.files)."""
+    with open(list_path) as f:
+        return [ln.strip() for ln in f if ln.strip()]

@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.trainer`` (≅ the paddle_trainer binary)."""
+
+import sys
+
+from paddle_tpu.trainer.cli import main
+
+sys.exit(main())

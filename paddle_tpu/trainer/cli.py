@@ -1,0 +1,325 @@
+"""``python -m paddle_tpu.trainer`` — the classic trainer CLI.
+
+≅ ``paddle/trainer/TrainerMain.cpp:24-61``: ``--config=<file>``,
+``--job=train|test|time|checkgrad``, ``--config_args=k=v,...``,
+``--num_passes``, ``--init_model_path``, ``--save_dir``.  The config file is
+a v1 config (trainer_config_helpers) compiled by
+:mod:`paddle_tpu.trainer.config_parser`; training runs the same jitted step
+the v2 API uses.
+
+Job modes:
+
+- ``train``: pass loop over the config's PyDataProvider2 data source
+  (``define_py_data_sources2``), saving pass checkpoints under --save_dir
+  (≅ Trainer::train, ParamUtil).
+- ``test``: forward over the test source, printing cost + evaluators
+  (≅ Trainer::test / Tester.cpp).
+- ``time``: ``--job=time`` benchmark of the train step
+  (≅ TrainerBenchmark.cpp), ms/batch via the two-point method.
+- ``checkgrad``: finite-difference vs ``jax.grad`` on every parameter
+  (≅ Trainer::checkGradient, Trainer.cpp:332); exits nonzero on mismatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+import numpy as np
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.trainer",
+        description="paddle_tpu trainer (TrainerMain analog)",
+    )
+    p.add_argument("--config", required=True, help="v1 config file")
+    p.add_argument("--job", default="train",
+                   choices=["train", "test", "time", "checkgrad"])
+    p.add_argument("--config_args", default="",
+                   help="var=val,... exposed via get_config_arg")
+    p.add_argument("--num_passes", type=int, default=1)
+    p.add_argument("--save_dir", default=None)
+    p.add_argument("--init_model_path", default=None)
+    p.add_argument("--log_period", type=int, default=100)
+    p.add_argument("--test_period", type=int, default=0,
+                   help="accepted for v1 compat")
+    p.add_argument("--trainer_count", type=int, default=1,
+                   help="data-parallel shards (mesh 'data' axis)")
+    p.add_argument("--use_gpu", default=None, help="accepted for v1 compat")
+    p.add_argument("--dot_period", type=int, default=1,
+                   help="accepted for v1 compat")
+    p.add_argument("--saving_period", type=int, default=1,
+                   help="save a pass checkpoint every N passes")
+    # checkgrad knobs (Trainer.cpp:332 checkgrad_eps analog)
+    p.add_argument("--checkgrad_eps", type=float, default=1e-3)
+    p.add_argument("--checkgrad_samples", type=int, default=6,
+                   help="random entries probed per parameter")
+    return p
+
+
+def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
+                             topo=None):
+    """DataConfig(py2) -> batched paddle reader via the provider module.
+    The provider's declared ``input_types`` override the data layers' dense
+    placeholders (reference: types live in the provider, not the config)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.reader.py_data_provider2 import read_file_list
+
+    mod = importlib.import_module(rec["module"])
+    obj = getattr(mod, rec["obj"])
+    if topo is not None and isinstance(getattr(obj, "input_types", None), dict):
+        for lname, itype in obj.input_types.items():
+            node = topo.data_layers().get(lname)
+            if node is not None:
+                node.attrs.update(data_type=itype.kind,
+                                  seq_type=itype.seq_type, dim=itype.dim)
+    files = read_file_list(rec["files"])
+    reader = obj.make_reader(files)
+    if shuffle and getattr(obj, "should_shuffle", True) is not False:
+        reader = paddle.reader.shuffle(reader, buf_size=4096)
+    return paddle.reader.batch(reader, batch_size=batch_size, drop_last=True)
+
+
+def _build(parsed):
+    """ParsedConfig -> (topology, optimizer, data_types, feeding)."""
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.trainer_config_helpers.optimizers import (
+        get_settings_optimizer,
+    )
+
+    topo = Topology(parsed.output_layers())
+    opt = get_settings_optimizer()
+    from paddle_tpu.layers.data_type import InputType
+
+    data_layers = topo.data_layers()
+    order = [n for n in parsed.input_layer_names if n in data_layers]
+    if not order:
+        order = list(data_layers)
+    types = [
+        (n, InputType(data_layers[n].attrs.get("dim", data_layers[n].size),
+                      data_layers[n].attrs.get("seq_type", 0),
+                      data_layers[n].attrs.get("data_type", "dense")))
+        for n in order
+    ]
+    feeding = {n: i for i, (n, _) in enumerate(types)}
+    return topo, opt, types, feeding
+
+
+def cmd_train(args, parsed) -> int:
+    import paddle_tpu as paddle
+
+    topo, opt, types, feeding = _build(parsed)
+    batch_size = parsed.opt_config.batch_size or 32
+    rec = __import__("paddle_tpu.config.parse_state", fromlist=["STATE"])
+    data_rec = rec.STATE.data_config
+    if data_rec is None:
+        print("config defines no data source (define_py_data_sources2)",
+              file=sys.stderr)
+        return 2
+    sys.path.insert(0, os.path.dirname(os.path.abspath(args.config)))
+    reader = _reader_from_data_config(data_rec, batch_size, shuffle=True,
+                                      topo=topo)
+
+    params = paddle.parameters.create(topo)
+    if args.init_model_path:
+        with open(args.init_model_path, "rb") as f:
+            params = paddle.parameters.Parameters.from_tar(f)
+
+    trainer = paddle.trainer.SGD(
+        cost=topo.outputs, parameters=params, update_equation=opt)
+
+    def on_event(event):
+        if isinstance(event, paddle.event.EndIteration):
+            if event.batch_id % args.log_period == 0:
+                print(f"Pass {event.pass_id}, Batch {event.batch_id}, "
+                      f"Cost {event.cost:.6f}, {event.metrics}")
+        elif isinstance(event, paddle.event.EndPass):
+            due = (event.pass_id % args.saving_period == args.saving_period - 1
+                   or event.pass_id == args.num_passes - 1)
+            if args.save_dir and due:
+                os.makedirs(args.save_dir, exist_ok=True)
+                path = os.path.join(
+                    args.save_dir, f"pass-{event.pass_id:05d}.tar")
+                with open(path, "wb") as f:
+                    trainer.save_parameter_to_tar(f)
+                print(f"saved {path}")
+
+    trainer.train(reader=reader, num_passes=args.num_passes,
+                  event_handler=on_event, feeding=feeding)
+    return 0
+
+
+def cmd_test(args, parsed) -> int:
+    import paddle_tpu as paddle
+
+    topo, opt, types, feeding = _build(parsed)
+    batch_size = parsed.opt_config.batch_size or 32
+    from paddle_tpu.config import parse_state
+
+    rec = parse_state.STATE.test_data_config or parse_state.STATE.data_config
+    if rec is None:
+        print("config defines no test data source", file=sys.stderr)
+        return 2
+    sys.path.insert(0, os.path.dirname(os.path.abspath(args.config)))
+    reader = _reader_from_data_config(rec, batch_size, shuffle=False,
+                                      topo=topo)
+
+    params = paddle.parameters.create(topo)
+    if args.init_model_path:
+        with open(args.init_model_path, "rb") as f:
+            params = paddle.parameters.Parameters.from_tar(f)
+    trainer = paddle.trainer.SGD(
+        cost=topo.outputs, parameters=params, update_equation=opt)
+    result = trainer.test(reader=reader, feeding=feeding)
+    print(f"Test cost {result.cost:.6f}, {result.metrics}")
+    return 0
+
+
+def cmd_time(args, parsed) -> int:
+    """--job=time: benchmark one jitted train step on synthetic data."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.trainer.step import build_train_step
+
+    topo, opt, types, feeding = _build(parsed)
+    batch_size = parsed.opt_config.batch_size or 32
+    specs = {s.name: s for s in topo.param_specs()}
+    params = paddle.parameters.create(topo).as_dict()
+    opt_state = opt.init(params, specs)
+    states = topo.init_states()
+    step = build_train_step(topo, opt)
+    feed = _synthetic_feed(topo, batch_size)
+    key = jax.random.key(0)
+
+    def one(params, opt_state, states):
+        p, o, s, c, _ = step(params, opt_state, states, feed, key)
+        return c
+
+    res = profiler.benchmark(one, (params, opt_state, states),
+                             name=os.path.basename(args.config))
+    print(f"TrainerBenchmark {args.config}: {res.ms_per_step:.3f} ms/batch "
+          f"(batch_size={batch_size})")
+    return 0
+
+
+def _synthetic_feed(topo, batch_size: int):
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.layers.data_type import DataKind, SeqType
+
+    rng = np.random.default_rng(0)
+    feed = {}
+    for name, node in topo.data_layers().items():
+        t = node.attrs
+        kind, seq = t.get("data_type"), t.get("seq_type")
+        dim = t.get("dim", node.size)
+        if kind == DataKind.INTEGER:
+            data = rng.integers(0, dim, size=(batch_size,))
+        else:
+            data = rng.normal(size=(batch_size, dim)).astype(np.float32)
+        if seq and seq != SeqType.NO_SEQUENCE:
+            tdim = 8
+            if kind == DataKind.INTEGER:
+                data = rng.integers(0, dim, size=(batch_size, tdim))
+            else:
+                data = rng.normal(size=(batch_size, tdim, dim)).astype(
+                    np.float32)
+            feed[name] = SequenceBatch(
+                data=data, length=np.full((batch_size,), tdim, np.int32))
+        else:
+            feed[name] = data
+    return feed
+
+
+def cmd_checkgrad(args, parsed) -> int:
+    """Finite differences vs jax.grad on every parameter
+    (≅ Trainer::checkGradient, Trainer.cpp:332)."""
+    import jax
+
+    # finite differences need more mantissa than the training dtype
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_default_matmul_precision", "highest")
+    from paddle_tpu.core import flags as _flags
+
+    _flags.set("bf16", False)  # keep the MXU cast out of the check
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    topo, opt, types, feeding = _build(parsed)
+    batch_size = min(parsed.opt_config.batch_size or 8, 8)
+    params = {
+        k: jnp.asarray(np.asarray(v), jnp.float64)
+        for k, v in paddle.parameters.create(topo).as_dict().items()
+    }
+    states = {k: jnp.asarray(np.asarray(v), jnp.float64)
+              for k, v in topo.init_states().items()}
+    feed = _synthetic_feed(topo, batch_size)
+    key = jax.random.key(0)
+
+    def loss_fn(p):
+        values, _ = topo.forward(p, states, feed, True, key)
+        total = 0.0
+        for out in topo.outputs:
+            v = values[out.name]
+            v = v.data if hasattr(v, "data") else v
+            total = total + jnp.sum(v)
+        return total
+
+    grads = jax.grad(loss_fn)(params)
+    eps = args.checkgrad_eps
+    rng = np.random.default_rng(0)
+    failures = []
+    for name, value in params.items():
+        flat = np.asarray(value, np.float64).reshape(-1)
+        g = np.asarray(grads[name]).reshape(-1)
+        n = flat.size
+        idxs = rng.choice(n, size=min(args.checkgrad_samples, n),
+                          replace=False)
+        for i in idxs:
+            p2 = dict(params)
+            up, down = flat.copy(), flat.copy()
+            up[i] += eps
+            down[i] -= eps
+            shape = np.asarray(value).shape
+            p2[name] = jnp.asarray(up.reshape(shape))
+            hi = float(loss_fn(p2))
+            p2[name] = jnp.asarray(down.reshape(shape))
+            lo = float(loss_fn(p2))
+            fd = (hi - lo) / (2 * eps)  # central difference
+            an = float(g[i])
+            denom = max(abs(fd), abs(an), 1.0)
+            rel = abs(fd - an) / denom
+            if rel >= 1e-4:
+                failures.append((name, int(i), an, fd, rel))
+        print(f"checkgrad {name}: "
+              f"{'FAIL' if any(f[0] == name for f in failures) else 'ok'}")
+    if failures:
+        for name, i, an, fd, rel in failures[:10]:
+            print(f"  MISMATCH {name}[{i}]: analytic={an:.6g} "
+                  f"finite-diff={fd:.6g} rel_err={rel:.3g}", file=sys.stderr)
+        return 1
+    print(f"checkgrad PASSED over {len(params)} parameters")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    parsed = parse_config(args.config, args.config_args)
+    jobs = {
+        "train": cmd_train,
+        "test": cmd_test,
+        "time": cmd_time,
+        "checkgrad": cmd_checkgrad,
+    }
+    return jobs[args.job](args, parsed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

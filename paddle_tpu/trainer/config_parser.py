@@ -139,13 +139,29 @@ def _settings() -> dict:
 
 
 def _fill_data_config(dc, rec: dict, for_test: bool = False) -> None:
-    """PyDataProvider2 DataConfig (≅ data_sources.py define_py_data_source)."""
-    dc.type = "py2"
-    dc.files = rec["files"]
+    """DataConfig emission: PyDataProvider2 ('py2', via
+    define_py_data_sources2) or the classic typed providers
+    (TrainData(SimpleData(...)) etc., config_parser.py:1049-1190)."""
+    kind = rec.get("type", "py2")
+    if kind == "simple":
+        dc.type = "simple"
+        if rec.get("files"):
+            dc.files = rec["files"]
+        if rec.get("feat_dim") is not None:
+            dc.feat_dim = rec["feat_dim"]
+        if rec.get("context_len") is not None:
+            dc.context_len = rec["context_len"]
+        if rec.get("buffer_capacity"):
+            dc.buffer_capacity = rec["buffer_capacity"]
+        dc.for_test = for_test
+        return
+    dc.type = "py2" if kind == "py2" else "py"
+    if rec.get("files"):
+        dc.files = rec["files"]
     dc.async_load_data = False
     dc.for_test = for_test
-    dc.load_data_module = rec["module"]
-    dc.load_data_object = rec["obj"]
+    dc.load_data_module = rec.get("module") or ""
+    dc.load_data_object = rec.get("obj") or ""
     args = rec.get("args")
     if args is not None and not isinstance(args, str):
         import pickle
@@ -153,9 +169,10 @@ def _fill_data_config(dc, rec: dict, for_test: bool = False) -> None:
         # reference data_sources.py:78 pickles non-string args (protocol 0)
         args = pickle.dumps(args, 0).decode("latin-1")
     dc.load_data_args = args or ""
-    dc.data_ratio = 1
-    dc.is_main_data = True
-    dc.usage_ratio = 1.0
+    if kind == "py2":
+        dc.data_ratio = 1
+        dc.is_main_data = True
+        dc.usage_ratio = 1.0
 
 
 def _fill_opt_config(oc, emitter) -> None:

@@ -113,7 +113,12 @@ from paddle_tpu.config.parse_state import (  # noqa: E402,F401
     HasInputsSet,
     Inputs,
     Outputs,
+    PyData,
+    SimpleData,
+    TestData,
+    TrainData,
     define_py_data_sources2,
+    inputs,
     outputs,
 )
 from paddle_tpu.trainer_config_helpers import layer_math  # noqa: E402,F401
