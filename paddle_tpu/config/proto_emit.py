@@ -638,6 +638,35 @@ def _hsigmoid(E, node):
     lc.num_classes = n
 
 
+@emits("subseq")
+def _subseq(E, node):
+    E.layer(node)
+
+
+@emits("switch_order")
+def _switch_order(E, node):
+    lc = E.layer(node)
+    rc = lc.reshape_conf
+    rc.height_axis.extend(node.attrs.get("height_axis", []))
+    rc.width_axis.extend(node.attrs.get("width_axis", []))
+
+
+@emits("mdlstmemory")
+def _mdlstm(E, node):
+    lc = E.layer(node)
+    lc.ClearField("size")
+    lc.size = node.size
+    ws, _ = E.split_specs(node)
+    d = node.depth
+    ndims = len(node.attrs["directions"])
+    E.input_param(lc, 0, ws[0], d * d * (3 + ndims), [d, d * (3 + ndims)])
+    E.bias_param(lc, node, (5 + 2 * ndims) * d, dims=[1, (5 + 2 * ndims) * d])
+    lc.active_gate_type = node.attrs.get("active_gate_type", "sigmoid")
+    lc.active_state_type = node.attrs.get("active_state_type", "tanh")
+    for b in node.attrs["directions"]:
+        lc.directions.append(bool(b))
+
+
 @emits("cross_entropy_over_beam")
 def _ce_over_beam(E, node):
     E.layer(node, active_type="", size=0)

@@ -42,6 +42,8 @@ def _run_op(op: framework.Operator, env: dict, rng, program=None):
         return _run_while(op, env, rng, program)
     if op.type == "cond":
         return _run_cond(op, env, rng, program)
+    if op.type == "recurrent":
+        return _run_recurrent(op, env, rng, program)
     kernel = get_kernel(op.type)
     ins = {}
     for slot, names in op.inputs.items():
@@ -105,6 +107,76 @@ def _run_while(op: framework.Operator, env: dict, rng, program):
     env.update(final)
 
 
+def _run_recurrent(op: framework.Operator, env: dict, rng, program):
+    """Lower the ``recurrent`` op (block-as-stepnet RNN) onto ``lax.scan``.
+
+    ≅ ``paddle/operators/recurrent_op.cc:49-62``: the sub-block is the step
+    net; ``ex_states``/``states`` name the previous/current memory variables
+    inside it; ``inputs`` are time-major sequences split per step; outputs
+    are the per-step values stacked back time-major.  The reference runs a
+    matching backward pass over per-step scopes (``recurrent_op`` grad);
+    here the scan is traced once and ``jax.grad`` differentiates straight
+    through it — the fluid dynamic RNN trains.
+
+    Optional input slot ``sequence_lengths`` ([B] int): rows past their
+    length freeze their state and zero their step outputs (the LoD-aware
+    shrinking-batch semantics of ``lod_tensor_to_array`` +
+    ``shrink_rnn_memory``, done with masks under static shapes).
+    """
+    enforce(program is not None, "recurrent op needs its owning program")
+    sub = program.blocks[op.attrs["sub_block"]]
+    in_names = [n for n in op.inputs.get("inputs", ()) if n]
+    boot_names = [n for n in op.inputs.get("initial_states", ()) if n]
+    step_in = op.attrs["step_inputs"]  # sub-block names, same order
+    ex_states = op.attrs["ex_states"]
+    states = op.attrs["states"]
+    step_out = op.attrs["step_outputs"]
+    out_names = [n for n in op.outputs.get("outputs", ()) if n]
+    reverse = bool(op.attrs.get("reverse", False))
+    len_name = (op.inputs.get("sequence_lengths") or [None])[0]
+
+    xs = [env[n] for n in in_names]  # time-major [T, B, ...]
+    enforce(xs, "recurrent op needs at least one sequence input")
+    t_len = xs[0].shape[0]
+    boots = {s: env[b] for s, b in zip(ex_states, boot_names)}
+    lengths = env[len_name] if len_name else None
+
+    def body(carry, scanned):
+        t_idx = scanned[0]
+        step_xs = scanned[1:]
+        local = dict(env)
+        local.update({n: x for n, x in zip(step_in, step_xs)})
+        local.update(carry)
+        it_rng = jax.random.fold_in(rng, t_idx)
+        for o in sub.ops:
+            _run_op(o, local, it_rng, program)
+        new_state = {}
+        for ex, st in zip(ex_states, states):
+            nv = local[st]
+            if lengths is not None:
+                active = (t_idx < lengths).astype(nv.dtype)
+                mask = active.reshape((-1,) + (1,) * (nv.ndim - 1))
+                nv = mask * nv + (1 - mask) * carry[ex]
+            new_state[ex] = nv
+        outs = []
+        for n in step_out:
+            v = local[n]
+            if lengths is not None:
+                active = (t_idx < lengths).astype(v.dtype)
+                v = v * active.reshape((-1,) + (1,) * (v.ndim - 1))
+            outs.append(v)
+        return new_state, tuple(outs)
+
+    t_ids = jnp.arange(t_len, dtype=jnp.int32)
+    final_state, ys = jax.lax.scan(
+        body, boots, (t_ids,) + tuple(xs), reverse=reverse)
+    for n, y in zip(out_names, ys):
+        env[n] = y
+    for name, ex in zip(op.outputs.get("final_states", ()), ex_states):
+        if name:
+            env[name] = final_state[ex]
+
+
 def _while_carried(op: framework.Operator, sub) -> list[str]:
     """Loop-carried names: sub-block writes that the while op declares as X
     inputs (they must pre-exist, fixing shapes), plus the condition."""
@@ -148,7 +220,7 @@ def _run_cond(op: framework.Operator, env: dict, rng, program):
 def _sub_blocks(op: framework.Operator, program):
     if program is None:
         return []
-    if op.type == "while":
+    if op.type in ("while", "recurrent"):
         return [program.blocks[op.attrs["sub_block"]]]
     if op.type == "cond":
         return [program.blocks[op.attrs["true_block"]],
@@ -160,8 +232,13 @@ def sub_block_external_reads(op: framework.Operator, program):
     """Outer-scope names read inside a control-flow op's sub-blocks
     (sub-block reads that no sub-block op wrote first)."""
     out = []
+    # recurrent step placeholders are bound by the op itself, not the scope
+    bound = set()
+    if op.type == "recurrent":
+        bound = set(op.attrs.get("step_inputs", ())) | set(
+            op.attrs.get("ex_states", ()))
     for sub in _sub_blocks(op, program):
-        written: set = set()
+        written: set = set(bound)
         for o in sub.ops:
             for n in o.input_names():
                 if n and n not in written:

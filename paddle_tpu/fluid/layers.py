@@ -226,6 +226,17 @@ def accuracy(input, label, k=1, **kw):
     return acc
 
 
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, **kw):
+    """matmul op (reference mul_op.cc)."""
+    helper = LayerHelper("mul", input=x, **kw)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op("mul", {"X": [x.name], "Y": [y.name]},
+                     {"Out": [out.name]},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
 def mean(x, **kw):
     helper = LayerHelper("mean", input=x, **kw)
     out = helper.create_tmp_variable(dtype=x.dtype, shape=())
@@ -347,3 +358,208 @@ def _make_binary_layer(op_type):
 for _op in ("elementwise_add", "elementwise_sub", "elementwise_mul",
             "elementwise_div", "elementwise_max", "elementwise_min"):
     globals()[_op] = _make_binary_layer(_op)
+
+
+# --------------------------------------------------------------------------
+# StaticRNN — the block-as-stepnet RNN (≅ v2.framework layers.StaticRNN /
+# paddle/operators/recurrent_op.cc).  The sub-block built inside
+# ``with rnn.step():`` becomes the ``recurrent`` op's step net, lowered by
+# the executor onto a DIFFERENTIABLE lax.scan (reference runs a hand-built
+# backward over per-step scopes; here jax.grad crosses the scan).
+# --------------------------------------------------------------------------
+
+
+class StaticRNNMemoryLink:
+    def __init__(self, init, pre_mem, mem=None):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = mem
+
+
+class StaticRNN:
+    """Usage (reference test_recurrent_op.py API)::
+
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            h_pre = rnn.memory(init=h_boot)     # [B, D]
+            x_t = rnn.step_input(x)             # x is time-major [T, B, D]
+            h = some_layers(x_t, h_pre)
+            rnn.update_memory(h_pre, h)
+            rnn.output(h)
+        out = rnn()                              # [T, B, D]
+
+    ``sequence_lengths`` (a [B] int variable or a lod_rank_table result)
+    enables LoD semantics: rows past their length freeze their memory and
+    zero their outputs — shrink_rnn_memory behavior under static shapes.
+    """
+
+    BEFORE_RNN_BLOCK, IN_RNN_BLOCK, AFTER_RNN_BLOCK = 0, 1, 2
+
+    def __init__(self, name=None, main_program=None, startup_program=None,
+                 sequence_lengths=None, reverse=False):
+        self.helper = LayerHelper("static_rnn", name=name,
+                                  main_program=main_program,
+                                  startup_program=startup_program)
+        self.memories = {}  # pre_mem name -> MemoryLink
+        self.inputs = []  # (outer var, step var)
+        self.outputs = []  # (step var, outer var)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_lengths = sequence_lengths
+        self.reverse = reverse
+        self._sub_block = None
+        self._parent_block = None
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            prog = self.rnn.helper.main_program
+            self.rnn._parent_block = prog.current_block()
+            self.rnn._sub_block = prog.create_block()
+            self.rnn.status = StaticRNN.IN_RNN_BLOCK
+            return self.rnn
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            if exc_type is not None:
+                return False
+            self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+            self.rnn.helper.main_program.rollback()
+            self.rnn._complete_rnn_op()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def _assert_in_rnn_block(self, method):
+        enforce(self.status == StaticRNN.IN_RNN_BLOCK,
+                "StaticRNN.%s() must be called inside `with rnn.step():`"
+                % method)
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0):
+        """Previous-step state variable; ``init`` gives the boot value."""
+        self._assert_in_rnn_block("memory")
+        enforce(init is not None,
+                "StaticRNN.memory needs init= (boot variable); zero boots "
+                "can be built with fill_constant in the outer block")
+        pre = self._sub_block.create_var(
+            name=framework.unique_name(f"{self.helper.name}.mem"),
+            shape=init.shape, dtype=init.dtype)
+        self.memories[pre.name] = StaticRNNMemoryLink(init=init, pre_mem=pre)
+        return pre
+
+    def step_input(self, x):
+        """Register a time-major [T, B, ...] sequence; returns the per-step
+        [B, ...] variable."""
+        self._assert_in_rnn_block("step_input")
+        step = self._sub_block.create_var(
+            name=framework.unique_name(f"{self.helper.name}.in"),
+            shape=list(x.shape[1:]) if x.shape is not None else None,
+            dtype=x.dtype)
+        self.inputs.append((x, step))
+        return step
+
+    def update_memory(self, mem, var):
+        self._assert_in_rnn_block("update_memory")
+        enforce(mem.name in self.memories, "unknown memory %r" % mem.name)
+        self.memories[mem.name].mem = var
+
+    def output(self, *outputs):
+        self._assert_in_rnn_block("output")
+        for o in outputs:
+            shape = [-1] + list(o.shape) if o.shape is not None else None
+            outer = self._parent_block.create_var(
+                name=framework.unique_name(f"{self.helper.name}.out"),
+                shape=shape, dtype=o.dtype)
+            self.outputs.append((o, outer))
+
+    def _complete_rnn_op(self):
+        enforce(self.inputs, "StaticRNN needs at least one step_input")
+        enforce(self.outputs, "StaticRNN needs at least one output")
+        links = list(self.memories.values())
+        for l in links:
+            enforce(l.mem is not None,
+                    "memory %r was never update_memory()-ed" % l.pre_mem.name)
+        ins = {
+            "inputs": [x.name for x, _ in self.inputs],
+            "initial_states": [l.init.name for l in links],
+        }
+        if self.seq_lengths is not None:
+            ins["sequence_lengths"] = [self.seq_lengths.name]
+        self._parent_block.append_op(
+            "recurrent",
+            ins,
+            {"outputs": [outer.name for _, outer in self.outputs]},
+            {
+                "sub_block": self._sub_block.idx,
+                "step_inputs": [s.name for _, s in self.inputs],
+                "ex_states": [l.pre_mem.name for l in links],
+                "states": [l.mem.name for l in links],
+                "step_outputs": [o.name for o, _ in self.outputs],
+                "reverse": self.reverse,
+            },
+        )
+
+    def __call__(self):
+        enforce(self.status == StaticRNN.AFTER_RNN_BLOCK,
+                "StaticRNN not finalized; use `with rnn.step():`")
+        outs = [outer for _, outer in self.outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def lod_rank_table(x, level=0, main_program=None):
+    """≅ layers.lod_rank_table (lod_rank_table_op.cc:19)."""
+    helper = LayerHelper("lod_rank_table", input=x,
+                         main_program=main_program)
+    table = helper.create_tmp_variable(dtype="int32")
+    helper.append_op("lod_rank_table", {"X": [x.name]},
+                     {"Out": [table.name]}, {"level": level})
+    return table
+
+
+def max_sequence_len(rank_table, main_program=None):
+    helper = LayerHelper("max_sequence_len", input=rank_table,
+                         main_program=main_program)
+    out = helper.create_tmp_variable(dtype="int64", shape=[1])
+    helper.append_op("max_sequence_len", {"RankTable": [rank_table.name]},
+                     {"Out": [out.name]}, {})
+    return out
+
+
+def lod_tensor_to_array(x, table, main_program=None):
+    helper = LayerHelper("lod_tensor_to_array", input=x,
+                         main_program=main_program)
+    arr = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op("lod_tensor_to_array",
+                     {"X": [x.name], "RankTable": [table.name]},
+                     {"Out": [arr.name]}, {})
+    return arr
+
+
+def array_to_lod_tensor(x, table, main_program=None):
+    helper = LayerHelper("array_to_lod_tensor", input=x,
+                         main_program=main_program)
+    out = helper.create_tmp_variable(dtype=x.dtype, lod_level=1)
+    helper.append_op("array_to_lod_tensor",
+                     {"X": [x.name], "RankTable": [table.name]},
+                     {"Out": [out.name]}, {})
+    return out
+
+
+def shrink_memory(x, i, table, main_program=None):
+    """≅ layers.shrink_memory (shrink_rnn_memory_op.cc)."""
+    helper = LayerHelper("shrink_memory", input=x, main_program=main_program)
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
+    helper.append_op("shrink_rnn_memory",
+                     {"X": [x.name], "I": [i.name], "RankTable": [table.name]},
+                     {"Out": [out.name]}, {})
+    return out
+
+
+def lod_array_length(x, main_program=None):
+    helper = LayerHelper("lod_array_length", input=x,
+                         main_program=main_program)
+    out = helper.create_tmp_variable(dtype="int64", shape=[1])
+    helper.append_op("lod_array_length", {"X": [x.name]},
+                     {"Out": [out.name]}, {})
+    return out

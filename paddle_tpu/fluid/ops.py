@@ -1069,3 +1069,135 @@ def _write_to_array(ins, attrs, rng):
 def _read_from_array(ins, attrs, rng):
     arr, i = ins["Array"][0], ins["I"][0]
     return {"Out": [arr[i.reshape(()).astype(jnp.int32)]]}
+
+
+# --------------------------------------------------------------------------
+# LoD-array family — the reference's dynamic-RNN data machinery
+# (lod_rank_table_op.cc:19, lod_tensor_to_array_op.cc,
+# array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc, max_sequence_len_op).
+# LoD tensors here are SequenceBatch (padded [B, T, ...] + lengths); the
+# reference's physically-shrinking per-step batches become static-shape
+# masked equivalents (same values on live rows, zeros on dead rows).
+# --------------------------------------------------------------------------
+
+
+@register_op("lod_rank_table")
+def _lod_rank_table(ins, attrs, rng):
+    """Sort sequences by length, descending (stable): the rank table is
+    {index: original row, length: its length} like the reference's
+    LoDRankTable items."""
+    from paddle_tpu.core.lod import SequenceBatch
+
+    x = ins["X"][0]
+    enforce(isinstance(x, SequenceBatch),
+            "lod_rank_table input must be a sequence (LoD) variable")
+    lengths = x.length.astype(jnp.int32)
+    order = jnp.argsort(-lengths, stable=True).astype(jnp.int32)
+    return {"Out": [{"index": order, "length": lengths[order]}]}
+
+
+@register_op("max_sequence_len")
+def _max_sequence_len(ins, attrs, rng):
+    table = ins["RankTable"][0]
+    return {"Out": [jnp.max(table["length"]).reshape(1)]}
+
+
+@register_op("lod_tensor_to_array")
+def _lod_tensor_to_array(ins, attrs, rng):
+    """[B, T, ...] sequence -> time-major [T, B, ...] array in rank-table
+    order; step t's live prefix is the sequences with length > t (desc sort
+    puts them first, like the reference's shrinking batches)."""
+    from paddle_tpu.core.lod import SequenceBatch
+
+    x, table = ins["X"][0], ins["RankTable"][0]
+    enforce(isinstance(x, SequenceBatch),
+            "lod_tensor_to_array input must be a sequence (LoD) variable")
+    data = jnp.swapaxes(x.data[table["index"]], 0, 1)  # [T, B, ...]
+    mask = (jnp.arange(data.shape[0], dtype=jnp.int32)[:, None]
+            < table["length"][None, :]).astype(data.dtype)
+    data = data * mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return {"Out": [data]}
+
+
+@register_op("array_to_lod_tensor")
+def _array_to_lod_tensor(ins, attrs, rng):
+    """Inverse of lod_tensor_to_array: restore batch-major original order
+    and re-attach the lengths."""
+    from paddle_tpu.core.lod import SequenceBatch
+
+    arr, table = ins["X"][0], ins["RankTable"][0]
+    data = jnp.swapaxes(arr, 0, 1)  # [B, T, ...] in table order
+    inv = jnp.argsort(table["index"]).astype(jnp.int32)
+    return {"Out": [SequenceBatch(data=data[inv],
+                                  length=table["length"][inv])]}
+
+
+@register_op("shrink_rnn_memory")
+def _shrink_rnn_memory(ins, attrs, rng):
+    """At step I keep the memory rows of still-live sequences (length > I).
+    The reference slices the first k rows (shrink_rnn_memory_op.cc); under
+    static shapes dead rows are zeroed — their step outputs are discarded by
+    array_to_lod_tensor's mask either way."""
+    x, i, table = ins["X"][0], ins["I"][0], ins["RankTable"][0]
+    step = i.reshape(()).astype(jnp.int32)
+    live = (table["length"] > step).astype(x.dtype)
+    return {"Out": [x * live.reshape((-1,) + (1,) * (x.ndim - 1))]}
+
+
+@register_op("lod_array_length")
+def _lod_array_length(ins, attrs, rng):
+    arr = ins["X"][0]
+    return {"Out": [jnp.full((1,), arr.shape[0], jnp.int64)]}
+
+
+# --------------------------------------------------------------------------
+# CRF kernels (≅ paddle/operators/linear_chain_crf_op.cc, crf_decoding_op.cc)
+# — the v2 layer path's CRF math (ops/crf.py) registered as fluid ops so
+# fluid programs can train/decode linear-chain CRFs too.
+# --------------------------------------------------------------------------
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ins, attrs, rng):
+    """Inputs: Emission (LoD [B,T,C] SequenceBatch), Transition [C+2, C],
+    Label (LoD int [B,T]).  Outputs LogLikelihood [B, 1] (negative NLL like
+    the reference: the op returns log-likelihood; costs negate it)."""
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.ops import crf as _crf
+
+    emission = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    label = ins["Label"][0]
+    enforce(isinstance(emission, SequenceBatch),
+            "linear_chain_crf Emission must be a sequence (LoD) variable")
+    lbl = label if isinstance(label, SequenceBatch) else SequenceBatch(
+        data=label, length=emission.length)
+    lbl_data = lbl.data
+    if lbl_data.ndim == 3:  # [B, T, 1] int columns like the reference
+        lbl_data = lbl_data[..., 0]
+    lbl = SequenceBatch(data=lbl_data.astype(jnp.int32), length=lbl.length)
+    nll = _crf.crf_nll(emission, lbl, trans)  # [B]
+    return {"LogLikelihood": [(-nll)[:, None]]}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ins, attrs, rng):
+    """Viterbi decode; with Label given, outputs per-step 0/1 mismatch like
+    the reference's CRFDecoding (error indicator mode)."""
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.ops import crf as _crf
+
+    emission = ins["Emission"][0]
+    trans = ins["Transition"][0]
+    enforce(isinstance(emission, SequenceBatch),
+            "crf_decoding Emission must be a sequence (LoD) variable")
+    path = _crf.crf_decode(emission, trans)  # SequenceBatch int32 [B, T]
+    label = (ins.get("Label") or [None])[0]
+    if label is None:
+        return {"ViterbiPath": [path]}
+    lbl = label.data if isinstance(label, SequenceBatch) else label
+    if lbl.ndim == 3:
+        lbl = lbl[..., 0]
+    mism = (path.data != lbl.astype(jnp.int32)).astype(jnp.int64)
+    mism = mism * emission.mask().astype(jnp.int64)
+    return {"ViterbiPath": [SequenceBatch(data=mism, length=path.length)]}

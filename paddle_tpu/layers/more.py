@@ -549,3 +549,155 @@ def img_pool3d(input: LayerOutput, pool_size, img_size=None,
                "pool_size": (kw, kh, kd), "stride": (sw, sh, sd),
                "padding": (pw, ph, pd), "img_vol": (d_in, h_in, w_in)},
     )
+
+
+def sub_seq(input: LayerOutput, offsets: LayerOutput, sizes: LayerOutput,
+            act=None, bias_attr=None, name: str | None = None) -> LayerOutput:
+    """≅ sub_seq_layer ('subseq', SubSequenceLayer.cpp:29): from each
+    sequence take the [offset, offset+size) window, producing a shorter
+    sequence per row."""
+    from paddle_tpu.ops import sequence as seq_ops
+
+    name = name or gen_name("sub_seq")
+    activation = act_mod.get(act)
+
+    def fwd(ctx, params, states, x, off, sz):
+        enforce(is_sequence(x), "sub_seq expects a sequence input")
+        s = raw(off).reshape(-1).astype(jnp.int32)
+        e = s + raw(sz).reshape(-1).astype(jnp.int32)
+        y = seq_ops.seq_slice(x, s, e)
+        return SequenceBatch(data=activation(y.data), length=y.length)
+
+    return LayerOutput(name=name, layer_type="subseq", size=input.size,
+                       parents=(input, offsets, sizes), fn=fwd,
+                       attrs={"active_type": activation.name,
+                              "dfs_parents": (input,)})
+
+
+sub_seq_layer = sub_seq
+
+
+def switch_order(input: LayerOutput, reshape_axis: int | None = None,
+                 act=None, name: str | None = None,
+                 layer_attr=None) -> LayerOutput:
+    """≅ switch_order_layer (SwitchOrderLayer): NCHW -> NHWC permute; the
+    reshape_axis splits output dims into (height, width) groups
+    (LayerConfig.reshape_conf)."""
+    name = name or gen_name("switch_order")
+    c, h, w = input.depth, input.height, input.width
+    activation = act_mod.get(act)
+    axis = reshape_axis if reshape_axis is not None else 3
+
+    def fwd(ctx, params, states, x):
+        from paddle_tpu.layers.api import _to_nhwc
+
+        out = _to_nhwc(raw(x), c, h, w)
+        return activation(out.reshape(out.shape[0], -1))
+
+    return LayerOutput(
+        name=name, layer_type="switch_order", size=input.size,
+        parents=(input,), fn=fwd, height=h, width=w, depth=c,
+        attrs={"active_type": activation.name,
+               "reshape_axis": axis,
+               "height_axis": list(range(1, axis)), "width_axis": [axis]},
+    )
+
+
+switch_order_layer = switch_order
+
+
+def mdlstmemory(input: LayerOutput, size: int | None = None,
+                directions=(True, True), act=None, gate_act=None,
+                state_act=None, param_attr=None, bias_attr=None,
+                name: str | None = None) -> LayerOutput:
+    """≅ mdlstmemory (MDLstmLayer.cpp:180): multi-dimensional (2-D) LSTM
+    over an image-shaped grid, one forget gate per dimension, scanned as an
+    anti-diagonal wavefront (cells on a diagonal are independent — the
+    TPU-parallel formulation of the reference's topological cell order).
+
+    Input is pre-projected like lstmemory: channels = (3 + ndims) * size
+    (i, o, candidate + one forget gate per dim).  Parameters follow the
+    reference sizing: recurrent weight [size, size*(3+ndims)] shared by
+    both neighbors, bias [(5 + 2*ndims) * size] = gate biases + peepholes.
+    ``directions[d]`` False flips the scan direction along that axis."""
+    from paddle_tpu.layers.api import _wspec
+
+    enforce(len(directions) == 2, "mdlstmemory supports 2-D grids")
+    ndims = 2
+    gates_n = 3 + ndims  # i, o, g + f_per_dim
+    d = size or (input.depth // gates_n if input.depth > 1 else None)
+    enforce(d, "mdlstmemory needs size= or a pre-projected image input")
+    name = name or gen_name("mdlstmemory")
+    h_dim, w_dim = input.height, input.width
+    enforce(h_dim and w_dim, "mdlstmemory input needs height/width")
+    wspec = _wspec(param_attr, name, "w0", (d, d * gates_n),
+                   I.paddle_default())
+    specs = [wspec]
+    use_bias = bias_attr is not False
+    bspec = None
+    if use_bias:
+        bspec = _wspec(bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                       name, "wbias", ((5 + 2 * ndims) * d,), I.constant(0.0))
+        specs.append(bspec)
+    oa = act_mod.get(act) if act else act_mod.TanhActivation()
+    ga = act_mod.get(gate_act) if gate_act else act_mod.SigmoidActivation()
+    sa = act_mod.get(state_act) if state_act else act_mod.TanhActivation()
+
+    def fwd(ctx, params, states, x):
+        v = raw(x)
+        b = v.shape[0]
+        xg = v.reshape(b, gates_n * d, h_dim, w_dim).transpose(0, 2, 3, 1) \
+            if v.ndim == 2 else v  # [B, H, W, G*D]
+        if not directions[0]:
+            xg = xg[:, ::-1]
+        if not directions[1]:
+            xg = xg[:, :, ::-1]
+        w_r = params[wspec.name]  # [D, G*D]
+        if use_bias:
+            full = params[bspec.name]
+            gate_b = full[: gates_n * d]
+            peep = full[gates_n * d:]  # [(2 + ndims) * D]: i, o, f1, f2
+            xg = xg + gate_b
+        else:
+            peep = jnp.zeros(((2 + ndims) * d,), v.dtype)
+
+        ii = jnp.arange(h_dim)[:, None] + jnp.arange(w_dim)[None, :]  # i+j
+
+        def diag_step(carry, dd):
+            hg, cg = carry  # [B, H, W, D] each
+            up_h = jnp.pad(hg, ((0, 0), (1, 0), (0, 0), (0, 0)))[:, :-1]
+            lf_h = jnp.pad(hg, ((0, 0), (0, 0), (1, 0), (0, 0)))[:, :, :-1]
+            up_c = jnp.pad(cg, ((0, 0), (1, 0), (0, 0), (0, 0)))[:, :-1]
+            lf_c = jnp.pad(cg, ((0, 0), (0, 0), (1, 0), (0, 0)))[:, :, :-1]
+            gates = xg + (up_h + lf_h) @ w_r  # [B, H, W, G*D]
+            gi = gates[..., 0:d] + peep[0:d] * (up_c + lf_c)
+            go_pre = gates[..., d:2 * d]
+            gg = gates[..., 2 * d:3 * d]
+            f1 = ga(gates[..., 3 * d:4 * d] + peep[2 * d:3 * d] * up_c)
+            f2 = ga(gates[..., 4 * d:5 * d] + peep[3 * d:4 * d] * lf_c)
+            c_new = ga(gi) * sa(gg) + f1 * up_c + f2 * lf_c
+            o = ga(go_pre + peep[d:2 * d] * c_new)
+            h_new = o * oa(c_new)
+            on_diag = (ii == dd)[None, :, :, None]
+            return (jnp.where(on_diag, h_new, hg),
+                    jnp.where(on_diag, c_new, cg)), None
+
+        init = (jnp.zeros((b, h_dim, w_dim, d), v.dtype),
+                jnp.zeros((b, h_dim, w_dim, d), v.dtype))
+        (hg, cg), _ = jax.lax.scan(
+            diag_step, init, jnp.arange(h_dim + w_dim - 1))
+        if not directions[0]:
+            hg = hg[:, ::-1]
+        if not directions[1]:
+            hg = hg[:, :, ::-1]
+        return hg
+
+    return LayerOutput(
+        name=name, layer_type="mdlstmemory", size=d * h_dim * w_dim,
+        parents=(input,), param_specs=tuple(specs), fn=fwd,
+        height=h_dim, width=w_dim, depth=d,
+        attrs={"active_type": oa.name, "active_gate_type": ga.name,
+               "active_state_type": sa.name,
+               "directions": list(bool(x) for x in directions),
+               "bias_spec": bspec.name if bspec else None},
+    )

@@ -54,14 +54,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--saving_period", type=int, default=1,
                    help="save a pass checkpoint every N passes")
     # checkgrad knobs (Trainer.cpp:332 checkgrad_eps analog)
-    p.add_argument("--checkgrad_eps", type=float, default=1e-3)
+    p.add_argument("--checkgrad_eps", type=float, default=1e-3,
+                   help="tolerance scale for the gradient check")
     p.add_argument("--checkgrad_samples", type=int, default=6,
                    help="random entries probed per parameter")
     return p
 
 
 def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
-                             topo=None):
+                             topo=None, input_order=None):
     """DataConfig(py2) -> batched paddle reader via the provider module.
     The provider's declared ``input_types`` override the data layers' dense
     placeholders (reference: types live in the provider, not the config)."""
@@ -70,17 +71,56 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
 
     mod = importlib.import_module(rec["module"])
     obj = getattr(mod, rec["obj"])
-    if topo is not None and isinstance(getattr(obj, "input_types", None), dict):
-        for lname, itype in obj.input_types.items():
-            node = topo.data_layers().get(lname)
-            if node is not None:
-                node.attrs.update(data_type=itype.kind,
-                                  seq_type=itype.seq_type, dim=itype.dim)
+    if topo is not None:
+        _apply_provider_types(topo, obj, input_order)
     files = read_file_list(rec["files"])
     reader = obj.make_reader(files)
     if shuffle and getattr(obj, "should_shuffle", True) is not False:
         reader = paddle.reader.shuffle(reader, buf_size=4096)
     return paddle.reader.batch(reader, batch_size=batch_size, drop_last=True)
+
+
+def _add_config_dir_to_path(config_path: str) -> None:
+    d = os.path.dirname(os.path.abspath(config_path))
+    if d not in sys.path:
+        sys.path.insert(0, d)
+
+
+def _apply_provider_types(topo, obj, input_order):
+    """Bind the provider's declared input_types onto the data layers (the
+    reference keeps types in the provider, not the config).  Accepts both
+    the dict form ({layer: type}) and the positional list form (matched to
+    the config's input order)."""
+    types = getattr(obj, "input_types", None)
+    if types is None:
+        return
+    if isinstance(types, dict):
+        items = types.items()
+    else:
+        order = input_order or list(topo.data_layers())
+        items = zip(order, types)
+    for lname, itype in items:
+        node = topo.data_layers().get(lname)
+        if node is not None:
+            node.attrs.update(data_type=itype.kind,
+                              seq_type=itype.seq_type, dim=itype.dim)
+
+
+def _load_provider_types(args, parsed, topo):
+    """For jobs that never build a reader (time/checkgrad): still bind the
+    provider's input_types so synthetic feeds have the right kinds."""
+    from paddle_tpu.config import parse_state
+
+    rec = parse_state.STATE.data_config or parse_state.STATE.test_data_config
+    if not rec or not rec.get("module"):
+        return
+    _add_config_dir_to_path(args.config)
+    try:
+        mod = importlib.import_module(rec["module"])
+        obj = getattr(mod, rec["obj"])
+    except Exception:
+        return  # provider unavailable: dense placeholders stand
+    _apply_provider_types(topo, obj, parsed.input_layer_names)
 
 
 def _build(parsed):
@@ -119,9 +159,10 @@ def cmd_train(args, parsed) -> int:
         print("config defines no data source (define_py_data_sources2)",
               file=sys.stderr)
         return 2
-    sys.path.insert(0, os.path.dirname(os.path.abspath(args.config)))
+    _add_config_dir_to_path(args.config)
     reader = _reader_from_data_config(data_rec, batch_size, shuffle=True,
-                                      topo=topo)
+                                      topo=topo,
+                                      input_order=parsed.input_layer_names)
 
     params = paddle.parameters.create(topo)
     if args.init_model_path:
@@ -163,9 +204,10 @@ def cmd_test(args, parsed) -> int:
     if rec is None:
         print("config defines no test data source", file=sys.stderr)
         return 2
-    sys.path.insert(0, os.path.dirname(os.path.abspath(args.config)))
+    _add_config_dir_to_path(args.config)
     reader = _reader_from_data_config(rec, batch_size, shuffle=False,
-                                      topo=topo)
+                                      topo=topo,
+                                      input_order=parsed.input_layer_names)
 
     params = paddle.parameters.create(topo)
     if args.init_model_path:
@@ -187,6 +229,7 @@ def cmd_time(args, parsed) -> int:
     from paddle_tpu.trainer.step import build_train_step
 
     topo, opt, types, feeding = _build(parsed)
+    _load_provider_types(args, parsed, topo)
     batch_size = parsed.opt_config.batch_size or 32
     specs = {s.name: s for s in topo.param_specs()}
     params = paddle.parameters.create(topo).as_dict()
@@ -202,7 +245,8 @@ def cmd_time(args, parsed) -> int:
 
     res = profiler.benchmark(one, (params, opt_state, states),
                              name=os.path.basename(args.config))
-    print(f"TrainerBenchmark {args.config}: {res.ms_per_step:.3f} ms/batch "
+    ms = res.seconds_per_step * 1000.0
+    print(f"TrainerBenchmark {args.config}: {ms:.3f} ms/batch "
           f"(batch_size={batch_size})")
     return 0
 
@@ -240,17 +284,23 @@ def cmd_checkgrad(args, parsed) -> int:
     (≅ Trainer::checkGradient, Trainer.cpp:332)."""
     import jax
 
-    # finite differences need more mantissa than the training dtype
-    jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_default_matmul_precision", "highest")
+    # finite differences need more mantissa than the training dtype; all
+    # three globals are restored before returning (cli.main may be called
+    # in-process)
     from paddle_tpu.core import flags as _flags
 
+    prev_x64 = jax.config.jax_enable_x64
+    prev_prec = jax.config.jax_default_matmul_precision
+    prev_bf16 = _flags.get("bf16")
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_default_matmul_precision", "highest")
     _flags.set("bf16", False)  # keep the MXU cast out of the check
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
 
     topo, opt, types, feeding = _build(parsed)
+    _load_provider_types(args, parsed, topo)
     batch_size = min(parsed.opt_config.batch_size or 8, 8)
     params = {
         k: jnp.asarray(np.asarray(v), jnp.float64)
@@ -261,6 +311,7 @@ def cmd_checkgrad(args, parsed) -> int:
     feed = _synthetic_feed(topo, batch_size)
     key = jax.random.key(0)
 
+    @jax.jit
     def loss_fn(p):
         values, _ = topo.forward(p, states, feed, True, key)
         total = 0.0
@@ -270,38 +321,30 @@ def cmd_checkgrad(args, parsed) -> int:
             total = total + jnp.sum(v)
         return total
 
-    grads = jax.grad(loss_fn)(params)
-    eps = args.checkgrad_eps
-    rng = np.random.default_rng(0)
+    from jax.test_util import check_grads
+
     failures = []
     for name, value in params.items():
-        flat = np.asarray(value, np.float64).reshape(-1)
-        g = np.asarray(grads[name]).reshape(-1)
-        n = flat.size
-        idxs = rng.choice(n, size=min(args.checkgrad_samples, n),
-                          replace=False)
-        for i in idxs:
-            p2 = dict(params)
-            up, down = flat.copy(), flat.copy()
-            up[i] += eps
-            down[i] -= eps
-            shape = np.asarray(value).shape
-            p2[name] = jnp.asarray(up.reshape(shape))
-            hi = float(loss_fn(p2))
-            p2[name] = jnp.asarray(down.reshape(shape))
-            lo = float(loss_fn(p2))
-            fd = (hi - lo) / (2 * eps)  # central difference
-            an = float(g[i])
-            denom = max(abs(fd), abs(an), 1.0)
-            rel = abs(fd - an) / denom
-            if rel >= 1e-4:
-                failures.append((name, int(i), an, fd, rel))
-        print(f"checkgrad {name}: "
-              f"{'FAIL' if any(f[0] == name for f in failures) else 'ok'}")
+        def one_param(v, name=name):
+            return loss_fn({**params, name: v})
+
+        try:
+            # reverse-mode vs numerical jacobian along random directions
+            # (jax's own methodology; ≅ Trainer::checkGradient's
+            # whole-parameter perturbation, Trainer.cpp:332)
+            check_grads(one_param, (value,), order=1, modes=("rev",),
+                        atol=args.checkgrad_eps * 10,
+                        rtol=args.checkgrad_eps * 10)
+            print(f"checkgrad {name}: ok")
+        except AssertionError as e:
+            failures.append((name, str(e).splitlines()[0][:120]))
+            print(f"checkgrad {name}: FAIL")
+    jax.config.update("jax_enable_x64", prev_x64)
+    jax.config.update("jax_default_matmul_precision", prev_prec)
+    _flags.set("bf16", prev_bf16)
     if failures:
-        for name, i, an, fd, rel in failures[:10]:
-            print(f"  MISMATCH {name}[{i}]: analytic={an:.6g} "
-                  f"finite-diff={fd:.6g} rel_err={rel:.3g}", file=sys.stderr)
+        for name, msg in failures[:10]:
+            print(f"  MISMATCH {name}: {msg}", file=sys.stderr)
         return 1
     print(f"checkgrad PASSED over {len(params)} parameters")
     return 0
