@@ -170,6 +170,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _dqkv_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, *, scale, t_k, causal):
+    """Fused single-tile backward (whole sequence in one block): computes
+    s/p once and does 5 matmuls where the two-kernel tiled path recomputes
+    s/p per kernel and does 7 — used whenever T fits a single block, the
+    common short-context training case."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    valid = _causal_valid(q.shape[0], k.shape[0], 0, 0, t_k, causal)
+    s = jnp.where(valid, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    pb = p.astype(do.dtype)
+    dv_ref[0] = jnp.dot(pb.T, do,
+                        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dq_ref[0] = jnp.dot(ds, k,
+                        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_ref[0] = jnp.dot(ds.T, q,
+                        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
 def _prep(q, k, v, block_q, block_k):
     """[B,T,H,D] → T-padded [BH,Tp,D].  D is kept as-is: a full-size minor
     block dim is always accepted by Mosaic, and zero-padding D to 128 would
@@ -241,9 +268,10 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=512, block_k=1024, interpret=None):
-    # default tiles: measured 8x faster than 128x128 at T=8k on v5e while
-    # keeping the bwd kernels' f32 [bq, bk] intermediates within VMEM
+                    block_q=1024, block_k=1024, interpret=None):
+    # default tiles: 1024x1024 measured fastest on v5e at every T in
+    # {1k, 8k, 32k}, fwd and f+b (tools/bench_attn.py, device-side timing);
+    # the bwd kernels' f32 [bq, bk] intermediates stay within VMEM
     """Flash attention on [B, T, H, D] tensors.
 
     Numerically equal (to fp tolerance) to
@@ -281,6 +309,32 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     # delta_i = sum_d dO_i . O_i  (padded rows have dO == 0 -> delta == 0)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
+
+    if nq == 1 and nk == 1:
+        bspec = lambda blk: pl.BlockSpec((1, blk, dpad), lambda b: (b, 0, 0))
+        rspec = pl.BlockSpec((1, block_q, 1), lambda b: (b, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_single_kernel, scale=scale,
+                              t_k=t_k, causal=causal),
+            grid=(bh,),
+            in_specs=[bspec(block_q), bspec(block_k), bspec(block_k),
+                      bspec(block_q), rspec, rspec],
+            out_specs=[bspec(block_q), bspec(block_k), bspec(block_k)],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, tqp, dpad), qp.dtype),
+                jax.ShapeDtypeStruct((bh, tkp, dpad), kp.dtype),
+                jax.ShapeDtypeStruct((bh, tkp, dpad), vp.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",),
+            ),
+            interpret=interpret,
+        )(qp, kp, vp, do, lse, delta)
+        return (
+            _from_bh(dq, b, h, t_q, d),
+            _from_bh(dk, b, h, t_k, d),
+            _from_bh(dv, b, h, t_k, d),
+        )
 
     qspec = pl.BlockSpec((1, block_q, dpad), lambda b, i, j: (b, i, 0))
     rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))

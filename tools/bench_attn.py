@@ -1,0 +1,98 @@
+"""Attention-kernel shootout at the LM bench shape and long-context shapes.
+
+Compares paddle_tpu's own Pallas flash kernel against the JAX-shipped TPU
+reference kernels (pallas flash / splash) and XLA exact einsum, forward and
+forward+backward, to locate where the LM step's attention time goes.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention as ours
+from paddle_tpu.ops import attention as attn_ops
+
+
+from tools.xprof import device_module_ms as device_ms
+
+
+def mk(b, t, h, d, dtype=jnp.bfloat16):
+    ks = jax.random.split(jax.random.key(0), 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), dtype) for k in ks)
+
+
+def bench_impl(name, fn, q, k, v, fwd_only=False):
+    # fwd
+    f = jax.jit(lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)))
+    try:
+        ms_f = device_ms(lambda: f(q, k, v))
+    except Exception as e:
+        print(f"{name:24s} fwd FAILED: {type(e).__name__}")
+        return
+    if fwd_only:
+        print(f"{name:24s} fwd {ms_f:8.3f} ms")
+        return
+    g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+                         argnums=(0, 1, 2)))
+    try:
+        ms_fb = device_ms(lambda: g(q, k, v)[0])
+    except Exception as e:
+        print(f"{name:24s} fwd {ms_f:8.3f} ms   f+b FAILED: {type(e).__name__}")
+        return
+    print(f"{name:24s} fwd {ms_f:8.3f} ms   f+b {ms_fb:8.3f} ms")
+
+
+def jax_flash(q, k, v, block=512):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jf, BlockSizes)
+    # theirs wants [B, H, T, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    t = q.shape[1]
+    bs = BlockSizes(
+        block_q=min(block, t), block_k_major=min(block, t), block_k=min(block, t),
+        block_b=1,
+        block_q_major_dkv=min(block, t), block_k_major_dkv=min(block, t),
+        block_k_dkv=min(block, t), block_q_dkv=min(block, t),
+        block_k_major_dq=min(block, t), block_k_dq=min(block, t),
+        block_q_dq=min(block, t),
+    )
+    o = jf(qt, kt, vt, causal=True, sm_scale=q.shape[-1] ** -0.5,
+           block_sizes=bs)
+    return o.transpose(0, 2, 1, 3)
+
+
+def exact(q, k, v):
+    t = q.shape[1]
+    return attn_ops.dot_product_attention(
+        q, k, v, mask=attn_ops.causal_mask(t, t))
+
+
+def main():
+    shapes = [(8, 1024, 12, 64), (1, 8192, 8, 64)]
+    if len(sys.argv) > 1:
+        shapes = [tuple(int(x) for x in s.split("x")) for s in sys.argv[1:]]
+    for (b, t, h, d) in shapes:
+        print(f"== B={b} T={t} H={h} D={d} bf16 causal ==")
+        q, k, v = mk(b, t, h, d)
+        for bq, bk in ((256, 256), (512, 512), (512, min(1024, t)),
+                       (min(1024, t), min(1024, t))):
+            bench_impl(f"ours q{bq}k{bk}",
+                       functools.partial(ours, causal=True, block_q=bq,
+                                         block_k=bk),
+                       *(q, k, v))
+        bench_impl("jax pallas flash", jax_flash, q, k, v)
+        bench_impl("jax.nn.dpa", functools.partial(
+            jax.nn.dot_product_attention, is_causal=True), q, k, v)
+        bench_impl("exact einsum", exact, q, k, v)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Profile the current-best 124M LM train step per-op (tools/xprof)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.optimizer import Adam
+from tools.bench_lm import gpt2_cfg
+from tools.xprof import profile_step
+
+import sys
+
+variant = sys.argv[1] if len(sys.argv) > 1 else "mp"
+kw = {}
+if variant == "mp":
+    cfg = gpt2_cfg(remat="dots", dtype=jnp.float32)
+    kw["compute_dtype"] = jnp.bfloat16
+elif variant == "mp_full":
+    cfg = gpt2_cfg(remat=True, dtype=jnp.float32)
+    kw["compute_dtype"] = jnp.bfloat16
+elif variant == "baseline":
+    cfg = gpt2_cfg()
+else:
+    raise SystemExit(f"unknown variant {variant!r} (mp, mp_full, baseline)")
+
+params = T.init_params(cfg, jax.random.key(0))
+opt = Adam(learning_rate=1e-4)
+opt_state = opt.init_tree(params)
+ids = jax.device_put(
+    np.random.default_rng(0).integers(0, cfg.vocab_size, size=(8, 1025)))
+step = T.build_train_step(cfg, opt, **kw)
+state = {"p": params, "o": opt_state}
+
+
+def one():
+    state["p"], state["o"], loss = step(state["p"], state["o"], ids)
+    return loss
+
+
+profile_step(one, steps=3, top=30)
