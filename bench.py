@@ -1,16 +1,21 @@
 """Benchmark grid — JSON lines, one per config; the LAST line is the
 north-star metric (ResNet-50 throughput/MFU).
 
-Configs mirror the reference's published tables (benchmark/README.md:31-58,
-113-119) plus BASELINE.md's targets: AlexNet ms/batch grid vs the K40m
-numbers, ResNet-50 img/s + MFU, seq2seq NMT seq/s, CTR examples/s.
+The grid covers every row BENCHMARKS.md publishes, so the doc tables can be
+regenerated from this script's output (``python bench.py | tee /tmp/bench.jsonl``
+then ``python tools/bench_to_md.py /tmp/bench.jsonl``): AlexNet 4 batch
+sizes, GoogleNet, SmallNet, LSTM h256/512/1280, seq2seq NMT, wide&deep CTR,
+OCR CRNN, ResNet-50 bs64/128/256, and the 124M transformer LM.  Reference
+configs mirror the reference's published tables (benchmark/README.md:31-58,
+113-119, benchmark/paddle/rnn/rnn.py) plus BASELINE.md's targets;
 ``vs_baseline`` is reference_time / our_time where the reference published a
 number (>1 ⇒ faster than the reference hardware), else 0.
 
 MFU counting: FLOPs = 2×MACs (ResNet-50 fwd ≈ 4.09 GFLOP/img at 224²),
-train ≈ 3× fwd, against the v5e bf16 peak 197 TFLOP/s.  The same step's
-bandwidth roofline is discussed in BENCHMARKS.md — ResNet training on one
-v5e chip is HBM-bound in BN/elementwise, not MXU-bound.
+train ≈ 3× fwd, against the v5e bf16 peak 197 TFLOP/s.  The ResNet step is
+*measured* HBM-bandwidth-bound (see BENCHMARKS.md: per-segment achieved
+GB/s from profiler byte counts vs a STREAM-triad calibration), so its MFU
+ceiling on one v5e is ≈20%; the transformer row uses 6ND + attention FLOPs.
 
 Timing: two-point chained-dispatch method with a scalar readback fence (the
 tunneled backend acks block_until_ready without completion; see
@@ -45,7 +50,10 @@ def _two_point(step_fn, warmup=3, n1=5, n2=25):
     return max(t2 - t1, 1e-9) / (n2 - n1) * 1000.0
 
 
-def _image_step(model_fn, batch, img_dim, lr=0.01):
+def _topology_step(cost_fn, feed_fn, optimizer=None, compute_dtype=None,
+                   lr=0.01):
+    """Generic jitted-train-step closure for a v2-layer-API model: builds
+    the Topology, params, optimizer state and a self-chaining step fn."""
     import jax
     import jax.numpy as jnp
 
@@ -56,21 +64,17 @@ def _image_step(model_fn, batch, img_dim, lr=0.01):
     from paddle_tpu.trainer.step import build_train_step
 
     base.reset_name_counters()
-    cost = model_fn()
+    cost = cost_fn()
     topo = Topology(cost)
-    opt = Momentum(momentum=0.9, learning_rate=lr / batch)
+    opt = optimizer or Momentum(momentum=0.9, learning_rate=lr)
     specs = {s.name: s for s in topo.param_specs()}
     params = paddle.parameters.create(topo).as_dict()
     opt_state = opt.init(params, specs)
     states = topo.init_states()
-    step = build_train_step(topo, opt, compute_dtype=jnp.bfloat16)
-    rng = np.random.default_rng(0)
-    feed = {
-        "image": jax.device_put(
-            rng.normal(size=(batch, img_dim)).astype(np.float32)
-        ),
-        "label": jax.device_put(rng.integers(0, 1000, size=(batch,))),
-    }
+    step = build_train_step(
+        topo, opt,
+        compute_dtype=jnp.bfloat16 if compute_dtype is None else compute_dtype)
+    feed = feed_fn()
     key = jax.random.key(0)
     state = {"p": params, "o": opt_state, "s": states}
 
@@ -83,14 +87,35 @@ def _image_step(model_fn, batch, img_dim, lr=0.01):
     return one
 
 
+def _image_feed(batch, img_dim, classes=1000):
+    def feed_fn():
+        import jax
+
+        rng = np.random.default_rng(0)
+        return {
+            "image": jax.device_put(
+                rng.normal(size=(batch, img_dim)).astype(np.float32)),
+            "label": jax.device_put(rng.integers(0, classes, size=(batch,))),
+        }
+    return feed_fn
+
+
+def _image_step(model_fn, batch, img_dim, lr=0.01, classes=1000):
+    from paddle_tpu.optimizer import Momentum
+
+    return _topology_step(
+        model_fn, _image_feed(batch, img_dim, classes),
+        optimizer=Momentum(momentum=0.9, learning_rate=lr / batch))
+
+
 def bench_alexnet(records):
     from paddle_tpu.models import image as M
 
-    # reference: 195/334/602 ms on 1x K40m (benchmark/README.md:31-38)
-    k40 = {64: 195.0, 128: 334.0, 256: 602.0}
-    for bs in (64, 128):
+    # reference: 1x K40m ms/batch (benchmark/README.md:31-38)
+    k40 = {64: 195.0, 128: 334.0, 256: 602.0, 512: 1629.0}
+    for bs in (64, 128, 256, 512):
         step = _image_step(lambda: M.alexnet_cost()[0], bs, 227 * 227 * 3)
-        ms = _two_point(step)
+        ms = _two_point(step, n2=15 if bs >= 256 else 25)
         records.append({
             "metric": f"alexnet_train_ms_per_batch_bs{bs}",
             "value": round(ms, 3), "unit": "ms",
@@ -98,14 +123,230 @@ def bench_alexnet(records):
         })
 
 
+def bench_googlenet(records):
+    from paddle_tpu.models import image as M
+
+    k40 = {64: 613.0, 128: 1149.0}
+    for bs in (64, 128):
+        step = _image_step(lambda: M.googlenet_cost()[0], bs, 224 * 224 * 3)
+        ms = _two_point(step, n2=15)
+        records.append({
+            "metric": f"googlenet_train_ms_per_batch_bs{bs}",
+            "value": round(ms, 3), "unit": "ms",
+            "vs_baseline": round(k40[bs] / ms, 2),
+        })
+
+
+def bench_smallnet(records):
+    from paddle_tpu.models import image as M
+
+    step = _image_step(lambda: M.smallnet_cost()[0], 64, 32 * 32 * 3,
+                       classes=10)
+    ms = _two_point(step)
+    records.append({
+        "metric": "smallnet_cifar_train_ms_per_batch_bs64",
+        "value": round(ms, 3), "unit": "ms",
+        "vs_baseline": round(10.46 / ms, 2),
+    })
+
+
+def _lstm_classify_cost(hidden, vocab=30000, embed=128):
+    """≅ benchmark/paddle/rnn/rnn.py: embedding 128 -> simple_lstm(h) ->
+    last_seq -> fc2 softmax -> classification_cost."""
+    from paddle_tpu.layers import activation as act
+    from paddle_tpu.layers import api as layer
+    from paddle_tpu.layers import data_type
+
+    data = layer.data(name="data",
+                      type=data_type.integer_value_sequence(vocab))
+    net = layer.embedding(input=data, size=embed)
+    net = layer.fc(input=net, size=hidden * 4, act=act.LinearActivation())
+    net = layer.lstmemory(input=net)
+    net = layer.last_seq(input=net)
+    net = layer.fc(input=net, size=2, act=act.SoftmaxActivation())
+    label = layer.data(name="label", type=data_type.integer_value(2))
+    return layer.classification_cost(input=net, label=label)
+
+
+def bench_lstm(records):
+    import jax
+
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.optimizer import Adam
+
+    k40 = {256: 83.0, 512: 184.0, 1280: 641.0}
+    bs, seqlen, vocab = 64, 100, 30000
+    rng = np.random.default_rng(0)
+
+    def feed_fn():
+        return {
+            "data": SequenceBatch(
+                data=rng.integers(0, vocab, size=(bs, seqlen)),
+                length=np.full((bs,), seqlen, np.int32)),
+            "label": jax.device_put(rng.integers(0, 2, size=(bs,))),
+        }
+
+    for h in (256, 512, 1280):
+        step = _topology_step(lambda h=h: _lstm_classify_cost(h), feed_fn,
+                              optimizer=Adam(learning_rate=2e-3))
+        ms = _two_point(step, n2=15)
+        records.append({
+            "metric": f"lstm_text_train_ms_per_batch_h{h}_bs{bs}",
+            "value": round(ms, 3), "unit": "ms",
+            "vs_baseline": round(k40[h] / ms, 2),
+        })
+
+
+def bench_nmt(records):
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.models import seqtoseq as S
+    from paddle_tpu.optimizer import Adam
+
+    bs, tlen, vocab = 64, 32, 30000
+    rng = np.random.default_rng(0)
+
+    def feed_fn():
+        def seq():
+            return SequenceBatch(
+                data=rng.integers(0, vocab, size=(bs, tlen)),
+                length=np.full((bs,), tlen, np.int32))
+        return {
+            "source_language_word": seq(),
+            "target_language_word": seq(),
+            "target_language_next_word": seq(),
+        }
+
+    step = _topology_step(
+        lambda: S.seqtoseq_net(vocab, vocab, word_vector_dim=512,
+                               encoder_size=512, decoder_size=512),
+        feed_fn, optimizer=Adam(learning_rate=5e-4))
+    ms = _two_point(step, n2=15)
+    records.append({
+        "metric": "nmt_attention_train_seq_per_sec",
+        "value": round(bs / ms * 1000.0, 1), "unit": "seq/s",
+        "config": f"vocab {vocab}, dim 512, len {tlen}, bs {bs}, bf16 mixed precision",
+        "vs_baseline": 0,
+    })
+
+
+def bench_ctr(records):
+    from paddle_tpu.models.ctr import wide_and_deep_ctr
+    from paddle_tpu.optimizer import AdaGrad
+    from paddle_tpu.reader.feeder import DataFeeder
+    from paddle_tpu.layers.data_type import integer_value, sparse_binary_vector
+
+    wide_dim, vocabs, bs = 10000, [1000] * 8, 1024
+    rng = np.random.default_rng(0)
+
+    def feed_fn():
+        dtypes = {"wide_input": sparse_binary_vector(wide_dim),
+                  "label": integer_value(2)}
+        for i in range(len(vocabs)):
+            dtypes[f"cat_{i}"] = integer_value(vocabs[i])
+        feeder = DataFeeder(dtypes)
+        batch = []
+        for _ in range(bs):
+            row = [rng.integers(0, wide_dim, size=3).tolist()]
+            row += [int(rng.integers(0, v)) for v in vocabs]
+            row.append(int(rng.integers(0, 2)))
+            batch.append(tuple(row))
+        return feeder.feed(batch)
+
+    step = _topology_step(
+        lambda: wide_and_deep_ctr(
+            wide_dim=wide_dim, categorical_vocab_sizes=vocabs,
+            embedding_size=64, hidden_sizes=(256, 128))[0],
+        feed_fn, optimizer=AdaGrad(learning_rate=1e-2))
+    ms = _two_point(step)
+    records.append({
+        "metric": "ctr_wide_deep_train_examples_per_sec",
+        "value": round(bs / ms * 1000.0, 0), "unit": "ex/s",
+        "config": f"wide {wide_dim}, 8x1k vocab emb64, bs {bs}, bf16 mixed precision",
+        "vs_baseline": 0,
+    })
+
+
+def bench_crnn(records):
+    import jax
+
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.models.ocr_crnn import crnn_ctc_cost
+    from paddle_tpu.optimizer import Adam
+
+    bs, h, w, classes = 64, 32, 96, 26
+    rng = np.random.default_rng(0)
+
+    def feed_fn():
+        lab_len = 5
+        return {
+            "image": jax.device_put(
+                rng.normal(size=(bs, h * w)).astype(np.float32)),
+            "label": SequenceBatch(
+                data=rng.integers(0, classes, size=(bs, lab_len)),
+                length=np.full((bs,), lab_len, np.int32)),
+        }
+
+    step = _topology_step(
+        lambda: crnn_ctc_cost(image_height=h, image_width=w,
+                              num_classes=classes)[0],
+        feed_fn, optimizer=Adam(learning_rate=1e-3))
+    ms = _two_point(step, n2=15)
+    records.append({
+        "metric": "ocr_crnn_ctc_train_samples_per_sec",
+        "value": round(bs / ms * 1000.0, 0), "unit": "samples/s",
+        "config": f"32x96 conv+BiLSTM+CTC, bs {bs}, bf16 mixed precision",
+        "vs_baseline": 0,
+    })
+
+
+def bench_transformer(records):
+    """124M GPT-2-shape LM, bs 8x1024, mixed precision, flash attention,
+    dots-remat — the modern-workload flagship row."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.optimizer import Adam
+
+    cfg = T.TransformerConfig(
+        vocab_size=50257, num_layers=12, num_heads=12, embed_dim=768,
+        mlp_dim=3072, max_seq_len=2048, dtype=jnp.float32, remat="dots",
+        attn_impl="flash", attn_block_size=1024)
+    params = T.init_params(cfg, jax.random.key(0))
+    n = sum(x.size for x in jax.tree.leaves(params))
+    opt = Adam(learning_rate=1e-4)
+    opt_state = opt.init_tree(params)
+    bs, seqlen = 8, 1024
+    ids = jax.device_put(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(bs, seqlen + 1)))
+    step = T.build_train_step(cfg, opt, compute_dtype=jnp.bfloat16)
+    state = {"p": params, "o": opt_state}
+
+    def one():
+        state["p"], state["o"], loss = step(state["p"], state["o"], ids)
+        return loss
+
+    ms = _two_point(one, n2=15)
+    tokens = bs * seqlen
+    attn_fl = 12 * cfg.num_layers * bs * seqlen * seqlen * cfg.embed_dim / 2
+    mfu = (6.0 * n * tokens + attn_fl) / (ms / 1e3) / PEAK_FLOPS
+    records.append({
+        "metric": "transformer_lm_124m_tokens_per_sec",
+        "value": round(tokens / ms * 1000.0, 0), "unit": "tok/s",
+        "mfu_pct": round(mfu * 100, 1),
+        "config": "GPT-2-small shape, bs 8x1024, flash attn, mixed precision",
+        "vs_baseline": 0,
+    })
+
+
 def bench_resnet(records):
     from paddle_tpu.models import image as M
 
     best = None
-    for bs in (64, 128):
+    for bs in (64, 128, 256):
         step = _image_step(lambda: M.resnet_cost(depth=50)[0], bs,
                            224 * 224 * 3, lr=0.1)
-        ms = _two_point(step, n2=15)
+        ms = _two_point(step, n2=15 if bs < 256 else 10)
         img_s = bs / ms * 1000.0
         tf = 3 * RESNET_FWD_GFLOP_PER_IMG * bs / ms  # GFLOP/ms == TF/s
         mfu = tf * 1e12 / PEAK_FLOPS
@@ -126,118 +367,11 @@ def bench_resnet(records):
     return best
 
 
-def bench_nmt(records):
-    import jax
-
-    import paddle_tpu as paddle
-    from paddle_tpu.config.topology import Topology
-    from paddle_tpu.core.lod import SequenceBatch
-    from paddle_tpu.layers import base
-    from paddle_tpu.models import seqtoseq as S
-    from paddle_tpu.optimizer import Adam
-    from paddle_tpu.trainer.step import build_train_step
-
-    base.reset_name_counters()
-    cost = S.seqtoseq_net(30000, 30000, word_vector_dim=512,
-                          encoder_size=512, decoder_size=512)
-    topo = Topology(cost)
-    opt = Adam(learning_rate=5e-4)
-    specs = {s.name: s for s in topo.param_specs()}
-    params = paddle.parameters.create(topo).as_dict()
-    opt_state = opt.init(params, specs)
-    states = topo.init_states()
-    step = build_train_step(topo, opt)
-    rng = np.random.default_rng(0)
-    bs, tlen = 64, 32
-    feed = {
-        "source_language_word": SequenceBatch(
-            data=rng.integers(0, 30000, size=(bs, tlen)),
-            length=np.full((bs,), tlen, np.int32)),
-        "target_language_word": SequenceBatch(
-            data=rng.integers(0, 30000, size=(bs, tlen)),
-            length=np.full((bs,), tlen, np.int32)),
-        "target_language_next_word": SequenceBatch(
-            data=rng.integers(0, 30000, size=(bs, tlen)),
-            length=np.full((bs,), tlen, np.int32)),
-    }
-    key = jax.random.key(0)
-    state = {"p": params, "o": opt_state, "s": states}
-
-    def one():
-        state["p"], state["o"], state["s"], c, _ = step(
-            state["p"], state["o"], state["s"], feed, key)
-        return c
-
-    ms = _two_point(one, n2=15)
-    records.append({
-        "metric": "nmt_attention_train_seq_per_sec",
-        "value": round(bs / ms * 1000.0, 1), "unit": "seq/s",
-        "vs_baseline": 0,
-    })
-
-
-def bench_ctr(records):
-    import jax
-
-    import paddle_tpu as paddle
-    from paddle_tpu.config.topology import Topology
-    from paddle_tpu.layers import base
-    from paddle_tpu.models.ctr import wide_and_deep_ctr
-    from paddle_tpu.optimizer import AdaGrad
-    from paddle_tpu.reader.feeder import DataFeeder
-    from paddle_tpu.trainer.step import build_train_step
-
-    base.reset_name_counters()
-    wide_dim, vocabs = 10000, [1000] * 8
-    cost, predict, _ = wide_and_deep_ctr(
-        wide_dim=wide_dim, categorical_vocab_sizes=vocabs,
-        embedding_size=64, hidden_sizes=(256, 128))
-    topo = Topology(cost)
-    opt = AdaGrad(learning_rate=1e-2)
-    specs = {s.name: s for s in topo.param_specs()}
-    params = paddle.parameters.create(topo).as_dict()
-    opt_state = opt.init(params, specs)
-    states = topo.init_states()
-    step = build_train_step(topo, opt)
-    rng = np.random.default_rng(0)
-    bs = 1024
-    from paddle_tpu.layers.data_type import (
-        integer_value,
-        sparse_binary_vector,
-    )
-
-    dtypes = {"wide_input": sparse_binary_vector(wide_dim),
-              "label": integer_value(2)}
-    for i in range(len(vocabs)):
-        dtypes[f"cat_{i}"] = integer_value(vocabs[i])
-    feeder = DataFeeder(dtypes)
-    batch = []
-    for _ in range(bs):
-        row = [rng.integers(0, wide_dim, size=3).tolist()]
-        row += [int(rng.integers(0, v)) for v in vocabs]
-        row.append(int(rng.integers(0, 2)))
-        batch.append(tuple(row))
-    feed = feeder.feed(batch)
-    key = jax.random.key(0)
-    state = {"p": params, "o": opt_state, "s": states}
-
-    def one():
-        state["p"], state["o"], state["s"], c, _ = step(
-            state["p"], state["o"], state["s"], feed, key)
-        return c
-
-    ms = _two_point(one)
-    records.append({
-        "metric": "ctr_wide_deep_train_examples_per_sec",
-        "value": round(bs / ms * 1000.0, 0), "unit": "ex/s",
-        "vs_baseline": 0,
-    })
-
-
 def main() -> None:
     records: list[dict] = []
     failures = []
-    for fn in (bench_alexnet, bench_nmt, bench_ctr):
+    for fn in (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
+               bench_nmt, bench_ctr, bench_crnn, bench_transformer):
         try:
             fn(records)
         except Exception as e:  # keep the headline alive

@@ -230,7 +230,11 @@ class Emitter:
         ev.name = name
         ev.input_layers.extend(inputs)
         for k, v in kw.items():
-            if v is not None:
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple)):
+                getattr(ev, k).extend(v)
+            else:
                 setattr(ev, k, v)
         self.cur_submodel.evaluator_names.append(name)
         return ev
@@ -1167,6 +1171,47 @@ def _recurrent_group_emit(E, node):
         ol.link_name = base
 
 
+@emits("beam_search")
+def _beam_search_emit(E, node):
+    """Generation-time recurrent group (beam_search, layers.py:4145).
+
+    The reference emits a full recurrent_layer_group whose step layers run
+    host-side beam search (RecurrentGradientMachine::generateSequence);
+    here generation compiles to one lax.scan, so the emission is a marker
+    layer + a sub_model carrying the GeneratorConfig (max_num_frames /
+    beam_size / num_results_per_sample) and the "__beam_search_predict__"
+    out-link.  No reference protostr golden exists for generation configs;
+    runtime behavior is locked by tests/test_generation_golden.py against
+    the reference's r1.test.* files instead."""
+    a = node.attrs
+    E.mc.type = "recurrent_nn"
+    marker = E.mc.layers.add()
+    marker.name = node.name
+    marker.type = "recurrent_layer_group"
+    marker.active_type = ""
+    E.root.layer_names.append(node.name)
+
+    sub = E.mc.sub_models.add()
+    sub.name = node.name
+    sub.is_recurrent_layer_group = True
+    gen = sub.generator
+    gen.max_num_frames = a["max_length"]
+    gen.eos_layer_name = ""
+    gen.beam_size = a["beam_size"]
+    gen.num_results_per_sample = a.get("num_results_per_sample",
+                                       a["beam_size"])
+
+    out = E.mc.layers.add()
+    out.name = "__beam_search_predict__"
+    out.type = "gather_agent"
+    out.size = node.size
+    out.active_type = ""
+    E.root.layer_names.append("__beam_search_predict__")
+    ol = sub.out_links.add()
+    ol.layer_name = node.name
+    ol.link_name = "__beam_search_predict__"
+
+
 @emits("gather_selector")
 def _gather_selector(E, node):
     # the gather agent was already emitted by the group node
@@ -1235,6 +1280,11 @@ def emit_model_config(registry, input_names, output_names,
             f"(layer {node.name!r})",
         )
         fn(E, node)
+    from paddle_tpu.evaluator import declare as _declare
+
+    for spec in _declare.collect():
+        E.evaluator(spec.type, spec.name, list(spec.input_layers),
+                    **spec.fields)
     E.finalize(input_names, output_names)
     return (E.mc, E) if with_emitter else E.mc
 

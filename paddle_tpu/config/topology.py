@@ -86,10 +86,11 @@ class Topology:
         feed: dict,
         is_train: bool,
         key: jax.Array | None = None,
+        taps: dict | None = None,
     ):
         """Evaluate every node; returns ({layer_name: value}, new_states)."""
         ctx = Context(is_train=is_train, key=key)
-        return evaluate(self.nodes, ctx, params, states, feed)
+        return evaluate(self.nodes, ctx, params, states, feed, taps=taps)
 
     # -- serialization (golden-config tests) ----------------------------------
     def serialize(self) -> str:

@@ -27,25 +27,41 @@ class Evaluator:
 
 
 class ClassificationError(Evaluator):
-    """≅ classification_error_evaluator."""
+    """≅ classification_error_evaluator: argmax error, or threshold error on
+    a single-column predictor, or top-k error; optionally sample-weighted
+    (ClassificationErrorEvaluator, Evaluator.cpp:78)."""
 
     name = "classification_error"
 
-    def __init__(self):
+    def __init__(self, threshold: float | None = None,
+                 top_k: int | None = None):
+        self.threshold = threshold
+        self.top_k = top_k
         self.start()
 
     def start(self):
-        self.wrong = 0
-        self.total = 0
+        self.wrong = 0.0
+        self.total = 0.0
 
-    def eval_batch(self, pred=None, label=None, **kw):
-        ids = np.argmax(np.asarray(pred), axis=-1).reshape(-1)
+    def eval_batch(self, pred=None, label=None, weight=None, **kw):
+        p = np.asarray(pred)
+        p = p.reshape(-1, p.shape[-1]) if p.ndim > 1 else p.reshape(-1, 1)
         lbl = np.asarray(label).reshape(-1)
-        self.wrong += int((ids != lbl).sum())
-        self.total += ids.size
+        if p.shape[-1] == 1:
+            thr = 0.5 if self.threshold is None else self.threshold
+            err = (p[:, 0] > thr).astype(np.int64) != lbl
+        elif self.top_k and self.top_k > 1:
+            topk = np.argsort(-p, axis=-1)[:, : self.top_k]
+            err = ~(topk == lbl[:, None]).any(axis=-1)
+        else:
+            err = np.argmax(p, axis=-1) != lbl
+        w = (np.asarray(weight).reshape(-1) if weight is not None
+             else np.ones_like(lbl, np.float64))
+        self.wrong += float((err * w).sum())
+        self.total += float(w.sum())
 
     def finish(self):
-        return {self.name: self.wrong / max(self.total, 1)}
+        return {self.name: self.wrong / max(self.total, 1e-9)}
 
 
 class SumEvaluator(Evaluator):
@@ -60,8 +76,10 @@ class SumEvaluator(Evaluator):
         self.total = 0.0
         self.count = 0
 
-    def eval_batch(self, value=None, **kw):
+    def eval_batch(self, value=None, weight=None, **kw):
         v = np.asarray(value)
+        if weight is not None:
+            v = v * np.asarray(weight).reshape((-1,) + (1,) * (v.ndim - 1))
         self.total += float(v.sum())
         self.count += v.size
 
@@ -103,17 +121,19 @@ class AUC(Evaluator):
         self.tp = np.zeros(self.k + 1)
         self.fp = np.zeros(self.k + 1)
 
-    def eval_batch(self, prob=None, label=None, **kw):
+    def eval_batch(self, prob=None, label=None, weight=None, **kw):
         p = np.asarray(prob)
-        if p.ndim > 1 and p.shape[-1] == 2:
-            p = p[:, 1]
+        if p.ndim > 1 and p.shape[-1] > 1:
+            p = p[..., -1]  # "last-column-auc": last column for any width
         p = p.reshape(-1)
         y = np.asarray(label).reshape(-1)
+        w = (np.asarray(weight).reshape(-1) if weight is not None
+             else np.ones_like(p))
         for t in range(self.k + 1):
             thr = t / self.k
             pred_pos = p >= thr
-            self.tp[t] += int((pred_pos & (y == 1)).sum())
-            self.fp[t] += int((pred_pos & (y == 0)).sum())
+            self.tp[t] += float((w * (pred_pos & (y == 1))).sum())
+            self.fp[t] += float((w * (pred_pos & (y == 0))).sum())
 
     def finish(self):
         pos = max(self.tp[0], 1e-9)
@@ -129,17 +149,31 @@ class PrecisionRecall(Evaluator):
 
     name = "precision_recall"
 
-    def __init__(self, num_classes: int = 2):
+    def __init__(self, num_classes: int | None = 2,
+                 positive_label: int | None = None):
         self.num_classes = num_classes
+        self.positive_label = (None if positive_label in (None, -1)
+                               else positive_label)
         self.start()
 
     def start(self):
-        self.tp = np.zeros(self.num_classes)
-        self.fp = np.zeros(self.num_classes)
-        self.fn = np.zeros(self.num_classes)
+        n = self.num_classes or 0
+        self.tp = np.zeros(n)
+        self.fp = np.zeros(n)
+        self.fn = np.zeros(n)
+
+    def _grow(self, n):
+        if n > self.tp.size:
+            pad = n - self.tp.size
+            self.tp = np.concatenate([self.tp, np.zeros(pad)])
+            self.fp = np.concatenate([self.fp, np.zeros(pad)])
+            self.fn = np.concatenate([self.fn, np.zeros(pad)])
+            self.num_classes = n
 
     def eval_batch(self, pred=None, label=None, **kw):
-        ids = np.argmax(np.asarray(pred), axis=-1).reshape(-1)
+        p = np.asarray(pred)
+        self._grow(p.shape[-1] if p.ndim > 1 else 2)
+        ids = np.argmax(p, axis=-1).reshape(-1)
         lbl = np.asarray(label).reshape(-1)
         for c in range(self.num_classes):
             self.tp[c] += int(((ids == c) & (lbl == c)).sum())
@@ -150,6 +184,10 @@ class PrecisionRecall(Evaluator):
         prec = self.tp / np.maximum(self.tp + self.fp, 1)
         rec = self.tp / np.maximum(self.tp + self.fn, 1)
         f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-9)
+        if self.positive_label is not None:
+            c = self.positive_label
+            return {"precision": float(prec[c]), "recall": float(rec[c]),
+                    "F1-score": float(f1[c])}
         return {
             "precision": float(prec.mean()),
             "recall": float(rec.mean()),
@@ -168,33 +206,38 @@ class PnpairEvaluator(Evaluator):
     def start(self):
         self.records: list[tuple[float, int, int]] = []
 
-    def eval_batch(self, score=None, label=None, query=None, **kw):
+    def eval_batch(self, score=None, label=None, query=None, weight=None,
+                   **kw):
         s = np.asarray(score).reshape(-1)
         y = np.asarray(label).reshape(-1)
         q = (np.asarray(query).reshape(-1) if query is not None
              else np.zeros_like(y))
-        self.records.extend(zip(s.tolist(), y.tolist(), q.tolist()))
+        w = (np.asarray(weight).reshape(-1) if weight is not None
+             else np.ones_like(s))
+        self.records.extend(zip(s.tolist(), y.tolist(), q.tolist(),
+                                w.tolist()))
 
     def finish(self):
         pos, neg, tie = 0.0, 0.0, 0.0
         from collections import defaultdict
 
         by_q = defaultdict(list)
-        for s, y, q in self.records:
-            by_q[q].append((s, y))
+        for s, y, q, w in self.records:
+            by_q[q].append((s, y, w))
         for items in by_q.values():
             for i in range(len(items)):
                 for j in range(i + 1, len(items)):
-                    (si, yi), (sj, yj) = items[i], items[j]
+                    (si, yi, wi), (sj, yj, wj) = items[i], items[j]
                     if yi == yj:
                         continue
+                    pw = (wi + wj) * 0.5
                     hi, lo = (si, sj) if yi > yj else (sj, si)
                     if hi > lo:
-                        pos += 1
+                        pos += pw
                     elif hi < lo:
-                        neg += 1
+                        neg += pw
                     else:
-                        tie += 1
+                        tie += pw
         total = max(pos + neg + tie, 1e-9)
         return {self.name: (pos + 0.5 * tie) / total}
 

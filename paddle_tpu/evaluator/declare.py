@@ -1,0 +1,56 @@
+"""Declared-evaluator specs — the bridge between the v1 config surface
+(``*_evaluator`` calls inside config files,
+``python/paddle/trainer_config_helpers/evaluators.py:161-774``), the
+ModelConfig ``evaluators`` proto emission
+(``EvaluatorConfig``, ModelConfig.proto:536), and runtime execution in the
+train/test loops (``paddle/gserver/evaluators/Evaluator.cpp``).
+
+A declaration is config-scope global state (like the reference's
+``Evaluator()`` config_parser class): ``reset()`` runs at parse start, and
+``collect()`` hands the accumulated specs to ParsedConfig / Topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+# EvaluatorConfig proto field names accepted as kwargs (ModelConfig.proto
+# fields 4-17); anything else is rejected loudly.
+PROTO_FIELDS = (
+    "chunk_scheme", "num_chunk_types", "classification_threshold",
+    "positive_label", "dict_file", "result_file", "num_results",
+    "delimited", "excluded_chunk_types", "top_k", "overlap_threshold",
+    "background_id", "evaluate_difficult", "ap_type",
+)
+
+
+@dataclasses.dataclass
+class EvaluatorSpec:
+    name: str
+    type: str
+    input_layers: list[str]
+    fields: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def field(self, key, default=None):
+        v = self.fields.get(key)
+        return default if v is None else v
+
+
+_declared: list[EvaluatorSpec] = []
+
+
+def declare(spec: EvaluatorSpec) -> EvaluatorSpec:
+    for k in spec.fields:
+        if k not in PROTO_FIELDS:
+            raise ValueError(f"unknown EvaluatorConfig field {k!r}")
+    _declared.append(spec)
+    return spec
+
+
+def reset() -> None:
+    _declared.clear()
+
+
+def collect() -> list[EvaluatorSpec]:
+    return list(_declared)

@@ -1,0 +1,381 @@
+"""Execute declared evaluators (EvaluatorSpecs) over batch layer values —
+the runtime half of the v1 evaluator surface (≅ ``GradientMachine::eval``
+driving ``paddle/gserver/evaluators/Evaluator.cpp``), including the printer
+family (Evaluator.cpp:1018-1357).
+
+The trainer loops call :func:`build` once per topology and then
+``evs.eval_batch(values, feed)`` per batch with the eval-step's layer-value
+dict; ``finish()`` returns the metric dict printed as ``Eval:`` lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from paddle_tpu import evaluator as ev_mod
+from paddle_tpu.core import logger as log
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.evaluator.declare import EvaluatorSpec
+from paddle_tpu.layers.base import is_sequence, raw
+
+
+def _np(v):
+    return np.asarray(raw(v))
+
+
+def _lengths(v):
+    return np.asarray(v.length) if is_sequence(v) else None
+
+
+def _load_dict(path: str | None):
+    if not path:
+        return None
+    with open(path) as f:
+        return [line.rstrip("\n") for line in f]
+
+
+# ---- printer family ---------------------------------------------------------
+
+class MaxIdPrinter(ev_mod.Evaluator):
+    """≅ MaxIdPrinter (Evaluator.cpp:1126): top-k ids per sample."""
+
+    name = "max_id_printer"
+
+    def __init__(self, num_results: int = 1, prefix: str = "max_id"):
+        self.k = max(num_results, 1)
+        self.prefix = prefix
+
+    def start(self):
+        pass
+
+    def eval_batch(self, value=None, **kw):
+        arr = _np(value)
+        arr = arr.reshape(-1, arr.shape[-1])
+        ids = np.argsort(-arr, axis=-1)[:, : self.k]
+        for r, row in enumerate(ids):
+            log.info("%s sample %d: %s", self.prefix, r,
+                     " ".join(str(int(i)) for i in row))
+
+    def finish(self):
+        return {}
+
+
+class MaxFramePrinter(ev_mod.Evaluator):
+    """≅ MaxFramePrinter (Evaluator.cpp:1177): for each sequence, the frame
+    holding the maximum value per position."""
+
+    name = "max_frame_printer"
+
+    def __init__(self, num_results: int = 1, prefix: str = "max_frame"):
+        self.k = max(num_results, 1)
+        self.prefix = prefix
+
+    def start(self):
+        pass
+
+    def eval_batch(self, value=None, **kw):
+        lens = _lengths(value)
+        arr = _np(value)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        score = arr.max(axis=-1)  # [B, T]
+        order = np.argsort(-score, axis=-1)[:, : self.k]
+        for b in range(arr.shape[0]):
+            t = int(lens[b]) if lens is not None else arr.shape[1]
+            frames = [int(f) for f in order[b] if f < t]
+            log.info("%s sample %d: frames %s", self.prefix, b, frames)
+
+    def finish(self):
+        return {}
+
+
+class ClassificationErrorPrinter(ev_mod.Evaluator):
+    """≅ ClassificationErrorPrinter (Evaluator.cpp:1340): per-sample error."""
+
+    name = "classification_error_printer"
+
+    def __init__(self, classification_threshold: float = 0.5,
+                 prefix: str = "classification_error"):
+        self.threshold = classification_threshold
+        self.prefix = prefix
+
+    def start(self):
+        pass
+
+    def eval_batch(self, pred=None, label=None, **kw):
+        p = _np(pred).reshape(-1, _np(pred).shape[-1])
+        y = _np(label).reshape(-1)
+        if p.shape[-1] == 1:
+            err = (p[:, 0] > self.threshold).astype(int) != y
+        else:
+            err = np.argmax(p, axis=-1) != y
+        log.info("%s per-sample: %s", self.prefix,
+                 " ".join(str(int(e)) for e in err))
+
+    def finish(self):
+        return {}
+
+
+class GradientPrinter(ev_mod.Evaluator):
+    """≅ GradientPrinter (Evaluator.cpp:1091): prints d(cost)/d(layer).
+    Gradients arrive via the trainer's tap mechanism (Topology.forward
+    ``taps`` + jax.grad), not a hidden backward hook."""
+
+    name = "gradient_printer"
+
+    def __init__(self, prefix: str = "gradient", max_elems: int = 16):
+        self.prefix = prefix
+        self.max_elems = max_elems
+
+    def start(self):
+        pass
+
+    def eval_batch(self, grad=None, layer_name="", **kw):
+        if grad is None:
+            log.info("%s[%s]: (no gradient in this pass)", self.prefix,
+                     layer_name)
+            return
+        arr = np.asarray(grad)
+        flat = arr.reshape(-1)[: self.max_elems]
+        log.info("%s[%s] shape=%s %s%s", self.prefix, layer_name, arr.shape,
+                 np.array2string(flat, precision=4),
+                 "..." if arr.size > self.max_elems else "")
+
+    def finish(self):
+        return {}
+
+
+class SeqTextPrinter(ev_mod.Evaluator):
+    """≅ SequenceTextPrinter (Evaluator.cpp:1219): writes generated id
+    sequences to ``result_file``, optionally mapping ids through
+    ``dict_file`` (line i = token i) and prefixing a sample id.
+
+    Formats (mirroring the reference's dump files, float-stream-equal):
+    - single result per sample:  ``id\\t tok tok tok``
+    - beam (n results):          ``id`` line, then per result
+      ``rank\\tscore\\t tok tok``, blank line between samples.
+    """
+
+    name = "seq_text_printer"
+
+    def __init__(self, result_file: str, dict_file: str | None = None,
+                 delimited: bool = True):
+        enforce(result_file, "seq_text_printer needs result_file")
+        self.result_file = result_file
+        self.words = _load_dict(dict_file)
+        self.delimited = True if delimited is None else bool(delimited)
+        self._fh = None
+
+    def start(self):
+        self._fh = open(self.result_file, "w")
+
+    def _tok(self, i: int) -> str:
+        if self.words is not None and 0 <= i < len(self.words):
+            return self.words[i]
+        return str(int(i))
+
+    def _join(self, ids) -> str:
+        sep = " " if self.delimited else ""
+        return sep + sep.join(self._tok(int(i)) for i in ids)
+
+    def eval_batch(self, value=None, sample_ids=None, **kw):
+        from paddle_tpu.layers.recurrent_group import GeneratedSequence
+
+        out = self._fh
+        enforce(out is not None, "start() not called")
+        if isinstance(value, GeneratedSequence):
+            ids = np.asarray(value.ids)
+            lens = np.asarray(value.length)
+            scores = np.asarray(value.score)
+            b, n_res, _ = ids.shape
+            for s in range(b):
+                sid = int(np.asarray(sample_ids).reshape(-1)[s]) \
+                    if sample_ids is not None else s
+                if n_res == 1:
+                    out.write(f"{sid}\t{self._join(ids[s, 0, :lens[s, 0]])}\n")
+                else:
+                    out.write(f"{sid}\n")
+                    for r in range(n_res):
+                        sc = float(scores[s, r])
+                        out.write(f"{r}\t{sc:g}\t"
+                                  f"{self._join(ids[s, r, :lens[s, r]])}\n")
+                    out.write("\n")
+        else:
+            lens = _lengths(value)
+            arr = _np(value)
+            if arr.ndim >= 2 and arr.shape[-1] > 1 and not np.issubdtype(
+                    arr.dtype, np.integer):
+                arr = np.argmax(arr, axis=-1)  # maxid convenience
+            arr = arr.reshape(arr.shape[0], -1)
+            for s in range(arr.shape[0]):
+                t = int(lens[s]) if lens is not None else arr.shape[1]
+                sid = int(np.asarray(sample_ids).reshape(-1)[s]) \
+                    if sample_ids is not None else s
+                out.write(f"{sid}\t{self._join(arr[s, :t])}\n")
+
+    def finish(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+        return {}
+
+
+# ---- spec -> instance + batch adapter ---------------------------------------
+
+def _instantiate(spec: EvaluatorSpec) -> ev_mod.Evaluator:
+    t = spec.type
+    if t == "classification_error":
+        return ev_mod.ClassificationError(
+            threshold=spec.field("classification_threshold"),
+            top_k=spec.field("top_k"))
+    if t == "last-column-auc":
+        return ev_mod.AUC()
+    if t == "pnpair":
+        return ev_mod.PnpairEvaluator()
+    if t == "precision_recall":
+        return ev_mod.PrecisionRecall(
+            num_classes=None,
+            positive_label=spec.field("positive_label"))
+    if t == "ctc_edit_distance":
+        return ev_mod.CTCError()
+    if t == "chunk":
+        return ev_mod.ChunkEvaluator(
+            chunk_scheme=spec.field("chunk_scheme", "IOB"),
+            num_chunk_types=spec.field("num_chunk_types", 1))
+    if t == "sum":
+        return ev_mod.SumEvaluator()
+    if t == "last-column-sum":
+        return ev_mod.ColumnSumEvaluator()
+    if t == "detection_map":
+        return ev_mod.DetectionMAP(
+            overlap_threshold=spec.field("overlap_threshold", 0.5),
+            background_id=spec.field("background_id", 0))
+    if t == "value_printer":
+        return ev_mod.ValuePrinter(prefix=spec.name)
+    if t == "gradient_printer":
+        return GradientPrinter(prefix=spec.name)
+    if t == "max_id_printer":
+        return MaxIdPrinter(num_results=spec.field("num_results", 1),
+                            prefix=spec.name)
+    if t == "max_frame_printer":
+        return MaxFramePrinter(num_results=spec.field("num_results", 1),
+                               prefix=spec.name)
+    if t == "seq_text_printer":
+        return SeqTextPrinter(result_file=spec.field("result_file"),
+                              dict_file=spec.field("dict_file"),
+                              delimited=spec.field("delimited"))
+    if t == "classification_error_printer":
+        return ClassificationErrorPrinter(
+            classification_threshold=spec.field("classification_threshold",
+                                                0.5),
+            prefix=spec.name)
+    raise ValueError(f"unknown evaluator type {spec.type!r}")
+
+
+@dataclasses.dataclass
+class _Bound:
+    spec: EvaluatorSpec
+    inst: ev_mod.Evaluator
+
+
+class DeclaredEvaluators:
+    """All declared evaluators of a parsed config, batch-driven."""
+
+    def __init__(self, specs: list[EvaluatorSpec]):
+        self.bound = [_Bound(s, _instantiate(s)) for s in specs]
+
+    def __bool__(self):
+        return bool(self.bound)
+
+    def grad_tap_layers(self) -> list[str]:
+        return [b.spec.input_layers[0] for b in self.bound
+                if b.spec.type == "gradient_printer"]
+
+    def start(self):
+        for b in self.bound:
+            b.inst.start()
+
+    def eval_batch(self, values: dict, grads: dict | None = None,
+                   feed: dict | None = None):
+        """values: layer-name -> batch value (the eval step's output dict);
+        grads: optional layer-name -> d(cost)/d(layer) for printers; feed
+        resolves input layers that are not part of the topology DAG (e.g.
+        an id column consumed only by a printer)."""
+        lookup = dict(feed or {})
+        lookup.update(values)
+        for b in self.bound:
+            ins = [lookup[n] for n in b.spec.input_layers]
+            t = b.spec.type
+            if t in ("classification_error", "precision_recall",
+                     "classification_error_printer"):
+                kw = dict(pred=_np(ins[0]), label=_np(ins[1]))
+                if len(ins) > 2:  # optional declared weight input
+                    kw["weight"] = _np(ins[2])
+                b.inst.eval_batch(**kw)
+            elif t == "last-column-auc":
+                kw = dict(prob=_np(ins[0]), label=_np(ins[1]))
+                if len(ins) > 2:
+                    kw["weight"] = _np(ins[2])
+                b.inst.eval_batch(**kw)
+            elif t == "pnpair":
+                # declared input order: label, query_id, score[, weight]
+                kw = dict(score=_np(ins[2]), label=_np(ins[0]),
+                          query=_np(ins[1]))
+                if len(ins) > 3:
+                    kw["weight"] = _np(ins[3])
+                b.inst.eval_batch(**kw)
+            elif t == "ctc_edit_distance":
+                lg, lb = _np(ins[0]), _np(ins[1])
+                lg_len, lb_len = _lengths(ins[0]), _lengths(ins[1])
+                logits = [lg[i, : (int(lg_len[i]) if lg_len is not None
+                                   else lg.shape[1])]
+                          for i in range(lg.shape[0])]
+                labels = [lb[i, : (int(lb_len[i]) if lb_len is not None
+                                   else lb.shape[1])].reshape(-1)
+                          for i in range(lb.shape[0])]
+                b.inst.eval_batch(logits=logits, label=labels)
+            elif t == "chunk":
+                b.inst.eval_batch(pred=_np(ins[0]), label=_np(ins[1]),
+                                  lengths=_lengths(ins[0]))
+            elif t in ("sum", "last-column-sum"):
+                kw = dict(value=_np(ins[0]))
+                if len(ins) > 1:
+                    kw["weight"] = _np(ins[1])
+                b.inst.eval_batch(**kw)
+            elif t == "value_printer":
+                b.inst.eval_batch(**{n: _np(v) for n, v in
+                                     zip(b.spec.input_layers, ins)})
+            elif t == "gradient_printer":
+                name = b.spec.input_layers[0]
+                g = (grads or {}).get(name)
+                b.inst.eval_batch(grad=g, layer_name=name)
+            elif t in ("max_id_printer", "max_frame_printer"):
+                b.inst.eval_batch(value=ins[0])
+            elif t == "seq_text_printer":
+                if len(ins) == 2:  # [id_input, sequence]
+                    b.inst.eval_batch(value=ins[1], sample_ids=_np(ins[0]))
+                else:
+                    b.inst.eval_batch(value=ins[0])
+            elif t == "detection_map":
+                b.inst.eval_batch(detections=_np(ins[0]), gts=_np(ins[1]))
+            else:  # pragma: no cover
+                raise ValueError(f"unhandled evaluator type {t!r}")
+
+    def finish(self) -> dict:
+        out = {}
+        for b in self.bound:
+            res = b.inst.finish()
+            if isinstance(res, dict):
+                for k, v in res.items():
+                    key = (b.spec.name if k == getattr(b.inst, "name", k)
+                           else f"{b.spec.name}/{k}")
+                    out[key] = v
+            elif res is not None:
+                out[b.spec.name] = res
+        return out
+
+
+def build(specs) -> DeclaredEvaluators:
+    return DeclaredEvaluators(list(specs or []))

@@ -1201,3 +1201,153 @@ def _crf_decoding(ins, attrs, rng):
     mism = (path.data != lbl.astype(jnp.int32)).astype(jnp.int64)
     mism = mism * emission.mask().astype(jnp.int64)
     return {"ViterbiPath": [SequenceBatch(data=mism, length=path.length)]}
+
+
+@register_op("positive_negative_pair")
+def _positive_negative_pair(ins, attrs, rng):
+    """Ranking pair statistics per query (≅ positive_negative_pair_op.cc):
+    over every same-query item pair with differing labels, count pairs whose
+    score order matches the label order (positive), contradicts it
+    (negative), or ties (neutral); optionally weighted by the pair-mean item
+    weight and seeded with accumulator inputs.  Vectorized as an upper-
+    triangular [B, B] pair mask instead of the reference's per-query
+    hash-map loops."""
+    score = ins["Score"][0]
+    label = jnp.reshape(ins["Label"][0], (-1,)).astype(jnp.float32)
+    query = jnp.reshape(ins["QueryID"][0], (-1,))
+    col = int(attrs.get("column", -1))
+    if col < 0:
+        col += score.shape[1]
+    s = score[:, col].astype(jnp.float32)
+    weight = (ins.get("Weight") or [None])[0]
+    w = (jnp.reshape(weight, (-1,)).astype(jnp.float32)
+         if weight is not None else jnp.ones_like(s))
+
+    n = s.shape[0]
+    i = jnp.arange(n)
+    upper = i[:, None] < i[None, :]
+    pair = upper & (query[:, None] == query[None, :]) \
+        & (label[:, None] != label[None, :])
+    pw = (w[:, None] + w[None, :]) * 0.5
+    ds = s[:, None] - s[None, :]
+    dl = label[:, None] - label[None, :]
+    tie = ds == 0.0
+    agree = (ds * dl) > 0.0
+    pos = jnp.sum(jnp.where(pair & ~tie & agree, pw, 0.0))
+    neg = jnp.sum(jnp.where(pair & ~tie & ~agree, pw, 0.0))
+    neu = jnp.sum(jnp.where(pair & tie, pw, 0.0))
+    acc_p = (ins.get("AccumulatePositivePair") or [None])[0]
+    if acc_p is not None:
+        pos = pos + jnp.reshape(acc_p, ())
+        neg = neg + jnp.reshape((ins["AccumulateNegativePair"][0]), ())
+        neu = neu + jnp.reshape((ins["AccumulateNeutralPair"][0]), ())
+    return {"PositivePair": [pos.reshape(1)],
+            "NegativePair": [neg.reshape(1)],
+            "NeutralPair": [neu.reshape(1)]}
+
+
+@register_op("greater_than")
+def _greater_than(ins, attrs, rng):
+    return {"Out": [ins["X"][0] > ins["Y"][0]]}
+
+
+@register_op("less_equal")
+def _less_equal(ins, attrs, rng):
+    return {"Out": [ins["X"][0] <= ins["Y"][0]]}
+
+
+@register_op("reduce_max")
+def _reduce_max(ins, attrs, rng):
+    return {"Out": [jnp.max(ins["X"][0], axis=attrs.get("dim"),
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("reduce_min")
+def _reduce_min(ins, attrs, rng):
+    return {"Out": [jnp.min(ins["X"][0], axis=attrs.get("dim"),
+                            keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ins, attrs, rng):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 0.5)
+    return {"Out": [jnp.where(jnp.abs(x) > t, x, 0.0)]}
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(ins, attrs, rng):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 1.0)
+    return {"Out": [jnp.where(x > t, x, 0.0)]}
+
+
+@register_op("conv3d")
+def _conv3d(ins, attrs, rng):
+    """Reference ``operators/conv_op.cc`` 3-D variant; NCDHW."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    stride = attrs.get("strides", [1, 1, 1])
+    pad = attrs.get("paddings", [0, 0, 0])
+    groups = attrs.get("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    return {"Output": [out]}
+
+
+@register_op("pool3d")
+def _pool3d(ins, attrs, rng):
+    x = ins["X"][0]
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    stride = list(attrs.get("strides", [2, 2, 2]))
+    pad = list(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        stride, pad = ksize, [0, 0, 0]
+    dims = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if attrs.get("pooling_type", "max") == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides,
+                                    pads)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        ones = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                     dims, strides, pads)
+        out = s / ones
+    return {"Out": [out]}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ins, attrs, rng):
+    """Reference ``operators/pool_with_index_op.cc``: max pool + flat
+    argmax indices within each feature map (for unpooling)."""
+    x = ins["X"][0]
+    ksize = list(attrs.get("ksize", [2, 2]))
+    stride = list(attrs.get("strides", [2, 2]))
+    pad = list(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        stride, pad = ksize, [0, 0]
+    n, c, h, w = x.shape
+    dims = (1, 1, ksize[0], ksize[1])
+    strides = (1, 1, stride[0], stride[1])
+    pads = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
+    # flat h*w index per window via a paired (value, index) max reduction
+    idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :]).astype(
+            jnp.float32), x.shape)
+
+    def _sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    _, flat = jax.lax.reduce_window(
+        (x, idx), (-jnp.inf, jnp.float32(-1)), _sel, dims, strides, pads)
+    return {"Out": [out], "Mask": [flat.astype(jnp.int64)]}

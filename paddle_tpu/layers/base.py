@@ -149,8 +149,14 @@ def evaluate(
     params: dict[str, jax.Array],
     states: dict[str, jax.Array],
     feed: dict[str, Value],
+    taps: dict[str, jax.Array] | None = None,
 ) -> tuple[dict[str, Value], dict[str, jax.Array]]:
-    """Evaluate the DAG once; returns ({layer_name: value}, new_states)."""
+    """Evaluate the DAG once; returns ({layer_name: value}, new_states).
+
+    ``taps`` adds a zero-valued array to the named layers' outputs; taking
+    jax.grad of a cost w.r.t. the tap yields d(cost)/d(layer) — the
+    mechanism behind gradient_printer_evaluator (GradientPrinter's backward
+    hook in the reference)."""
     values: dict[str, Value] = {}
     new_states = dict(states)
     for node in topo_sort(nodes):
@@ -174,6 +180,13 @@ def evaluate(
             new_states.update(supd)
         else:
             value = result
+        if taps and node.name in taps:
+            tap = taps[node.name]
+            if isinstance(value, SequenceBatch):
+                value = SequenceBatch(data=value.data + tap,
+                                      length=value.length)
+            else:
+                value = value + tap
         values[node.name] = value
     return values, new_states
 

@@ -612,7 +612,10 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
         parents=parents, param_specs=tuple(param_specs),
         state_specs=tuple(state_specs), fn=fwd,
         attrs={"bos_id": bos_id, "eos_id": eos_id, "beam_size": beam_size,
-               "max_length": max_length},
+               "max_length": max_length,
+               # reference beam_search names its prediction output layer
+               # "__beam_search_predict__" (networks.py); configs reference it
+               "aliases": ("__beam_search_predict__",)},
     )
 
 

@@ -170,7 +170,8 @@ def cmd_train(args, parsed) -> int:
             params = paddle.parameters.Parameters.from_tar(f)
 
     trainer = paddle.trainer.SGD(
-        cost=topo.outputs, parameters=params, update_equation=opt)
+        cost=topo.outputs, parameters=params, update_equation=opt,
+        declared_evaluators=getattr(parsed, "evaluators", None))
 
     def on_event(event):
         if isinstance(event, paddle.event.EndIteration):
@@ -178,6 +179,12 @@ def cmd_train(args, parsed) -> int:
                 print(f"Pass {event.pass_id}, Batch {event.batch_id}, "
                       f"Cost {event.cost:.6f}, {event.metrics}")
         elif isinstance(event, paddle.event.EndPass):
+            if event.metrics:
+                # ≅ the reference's "Eval: name=value" pass summary line
+                evals = " ".join(f"{k}={v:.6g}" if isinstance(v, float)
+                                 else f"{k}={v}"
+                                 for k, v in event.metrics.items())
+                print(f"Pass {event.pass_id} Eval: {evals}")
             due = (event.pass_id % args.saving_period == args.saving_period - 1
                    or event.pass_id == args.num_passes - 1)
             if args.save_dir and due:
@@ -214,7 +221,8 @@ def cmd_test(args, parsed) -> int:
         with open(args.init_model_path, "rb") as f:
             params = paddle.parameters.Parameters.from_tar(f)
     trainer = paddle.trainer.SGD(
-        cost=topo.outputs, parameters=params, update_equation=opt)
+        cost=topo.outputs, parameters=params, update_equation=opt,
+        declared_evaluators=getattr(parsed, "evaluators", None))
     result = trainer.test(reader=reader, feeding=feeding)
     print(f"Test cost {result.cost:.6f}, {result.metrics}")
     return 0

@@ -38,6 +38,9 @@ class ParsedConfig:
 
     def output_layers(self):
         by_name = {n.name: n for n in self.layers}
+        for n in self.layers:  # e.g. "__beam_search_predict__" (beam_search)
+            for a in n.attrs.get("aliases", ()):
+                by_name.setdefault(a, n)
         return [by_name[n] for n in self.output_layer_names]
 
     def protostr(self) -> str:
@@ -79,6 +82,9 @@ def parse_config(trainer_config, config_arg_str: str = ""):
 
     layer_base.reset_name_counters()
     parse_state.STATE.reset()
+    from paddle_tpu.evaluator import declare as _declare
+
+    _declare.reset()
     from paddle_tpu.trainer_config_helpers import optimizers as _opt
 
     _opt._SETTINGS.clear()
@@ -123,6 +129,9 @@ def finalize_config() -> ParsedConfig:
     tc.start_pass = 0
     pc = ParsedConfig(tc, mc, tc.opt_config, input_names, output_names,
                       registry)
+    from paddle_tpu.evaluator import declare as _declare
+
+    pc.evaluators = _declare.collect()
     pc.int_style = emitter.int_style
     pc._emitter = emitter  # keeps int_style's pinned upb wrappers alive
     return pc

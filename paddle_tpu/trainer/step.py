@@ -61,18 +61,23 @@ def _cast_like(tree, ref):
 
 def build_train_step(topology: Topology, optimizer,
                      mesh: MeshContext | None = None,
-                     compute_dtype=None):
+                     compute_dtype=None, fetch_layers=None):
     """Returns jitted fn: (params, opt_state, states, feed, key)
     -> (params, opt_state, states, cost, metrics).
 
     ``compute_dtype=jnp.bfloat16`` enables mixed precision: forward/backward
     run in bf16 on the MXU while master parameters, optimizer state, and
     persistent states stay float32 (grads are upcast before the update).
-    """
+
+    ``fetch_layers`` names layers whose batch values should ride along in
+    the metrics dict (key ``"layer:<name>"``) — the declared-evaluator feed,
+    computed by the SAME forward the update uses (same dropout draw, no
+    extra pass)."""
     specs = {s.name: s for s in topology.param_specs()}
     trainable = {n for n, s in specs.items() if not s.is_static}
     metric_specs = topology.metrics()
     out_names = [o.name for o in topology.outputs]
+    fetch_layers = list(fetch_layers or [])
 
     def step(params, opt_state, states, feed, key):
         train_p = {k: v for k, v in params.items() if k in trainable}
@@ -96,6 +101,9 @@ def build_train_step(topology: Topology, optimizer,
                 [jnp.sum(values[n], dtype=jnp.float32) for n in out_names]
             )
             metrics = _compute_metrics(metric_specs, values)
+            for n in fetch_layers:
+                if n in values:
+                    metrics[f"layer:{n}"] = jax.lax.stop_gradient(values[n])
             return cost, (new_states, metrics)
 
         # grads arrive f32 already (cotangent of the bf16 cast upcasts)
@@ -130,6 +138,29 @@ def build_eval_step(topology: Topology, mesh: MeshContext | None = None):
         return {n: values[n] for n in values}, cost, metrics
 
     return jax.jit(step)
+
+
+def build_tap_grads(topology: Topology, tap_names: list[str]):
+    """Jitted (params, states, feed, key) -> {layer: d(cost)/d(layer)} —
+    the gradient_printer_evaluator's data source (≅ the reference printing
+    ``input.grad`` during backward, Evaluator.cpp:1091) via zero-valued
+    output taps (Topology.forward ``taps``)."""
+    out_names = [o.name for o in topology.outputs]
+
+    def grads(params, states, feed, key):
+        values, _ = topology.forward(params, states, feed, True, key)
+        taps0 = {n: jnp.zeros_like(raw(values[n])) for n in tap_names}
+
+        def cost_of(taps):
+            vals, _ = topology.forward(params, states, feed, True, key,
+                                       taps=taps)
+            return functools.reduce(
+                lambda a, b: a + b,
+                [jnp.sum(vals[n], dtype=jnp.float32) for n in out_names])
+
+        return jax.grad(cost_of)(taps0)
+
+    return jax.jit(grads)
 
 
 def build_forward(topology: Topology, output_names: list[str]):

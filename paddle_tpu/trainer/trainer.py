@@ -54,8 +54,20 @@ class SGD:
     def __init__(self, cost, parameters: Parameters, update_equation,
                  extra_layers=None, is_local: bool = True, pserver_spec=None,
                  use_etcd: bool = False, mesh: MeshContext | None = None,
-                 compute_dtype=None):
+                 compute_dtype=None, declared_evaluators=None):
         self.compute_dtype = compute_dtype  # e.g. jnp.bfloat16 for the MXU
+        # v1 *_evaluator declarations (EvaluatorSpecs or a prebuilt
+        # DeclaredEvaluators) executed host-side per batch, like
+        # GradientMachine::eval driving Evaluator.cpp
+        from paddle_tpu.evaluator import runtime as _ev_runtime
+
+        if declared_evaluators is None:
+            self.declared_evaluators = _ev_runtime.build([])
+        elif isinstance(declared_evaluators, _ev_runtime.DeclaredEvaluators):
+            self.declared_evaluators = declared_evaluators
+        else:
+            self.declared_evaluators = _ev_runtime.build(declared_evaluators)
+        self._tap_grads = None
         if isinstance(cost, LayerOutput):
             cost = [cost]
         self.topology = Topology(cost, extra_layers=extra_layers)
@@ -85,10 +97,24 @@ class SGD:
 
     def _ensure_built(self):
         if self._train_step is None:
+            node_names = {n.name for n in self.topology.nodes}
+            fetch = sorted({
+                name
+                for b in (self.declared_evaluators.bound
+                          if self.declared_evaluators else [])
+                for name in b.spec.input_layers
+                if name in node_names
+            })
             self._train_step = build_train_step(
                 self.topology, self.optimizer, self.mesh,
-                compute_dtype=self.compute_dtype)
+                compute_dtype=self.compute_dtype, fetch_layers=fetch)
             self._eval_step = build_eval_step(self.topology, self.mesh)
+            taps = (self.declared_evaluators.grad_tap_layers()
+                    if self.declared_evaluators else [])
+            if taps:
+                from paddle_tpu.trainer.step import build_tap_grads
+
+                self._tap_grads = build_tap_grads(self.topology, taps)
 
     def _default_feeder(self, feeding):
         dl = self.topology.data_layers()
@@ -193,6 +219,8 @@ class SGD:
         for pass_id in range(start_pass, num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             batch_costs, batch_metrics = [], []
+            if self.declared_evaluators:
+                self.declared_evaluators.start()
             for batch_id, data_batch in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 with stat.timer("feed"):
@@ -203,10 +231,28 @@ class SGD:
                     self._compiled_sigs.add(sig)
                     if len(self._compiled_sigs) > 1:
                         log.info("train step: compiling new feed signature %s", sig)
+                step_key = rng.next_key()
+                if self._tap_grads is not None:
+                    # same key as the step: the printed d(cost)/d(layer)
+                    # corresponds to the exact update being taken
+                    tap_grads = self._tap_grads(params, states, feed, step_key)
+                else:
+                    tap_grads = None
                 with stat.timer("forwardBackward+update"):
                     params, opt_state, states, cost, metrics = self._train_step(
-                        params, opt_state, states, feed, rng.next_key()
+                        params, opt_state, states, feed, step_key
                     )
+                if self.declared_evaluators:
+                    # layer values ride along in the metrics dict from the
+                    # SAME forward the update used (fetch_layers) — no
+                    # second pass
+                    layer_vals = {
+                        k[len("layer:"):]: v for k, v in metrics.items()
+                        if k.startswith("layer:")}
+                    self.declared_evaluators.eval_batch(
+                        layer_vals, grads=tap_grads, feed=feed)
+                metrics = {k: v for k, v in metrics.items()
+                           if not k.startswith("layer:")}
                 event_handler(v2_event.EndForwardBackward(pass_id, batch_id, self))
                 cost_f = float(cost)
                 if not np.isfinite(cost_f) and flags.get("debug_nans"):
@@ -248,6 +294,8 @@ class SGD:
                              pass_id, pass_id + 1)
                 break
             avg_metrics = _mean_dicts(batch_metrics)
+            if self.declared_evaluators:
+                avg_metrics.update(self.declared_evaluators.finish())
             event_handler(v2_event.EndPass(pass_id, avg_metrics))
             save_dir = flags.get("save_dir")
             if save_dir and (pass_id % max(flags.get("saving_period"), 1) == 0):
@@ -271,14 +319,26 @@ class SGD:
         params = self._params_dict()
         states = self.states
         costs, metrics_list, n = [], [], 0
+        if self.declared_evaluators:
+            self.declared_evaluators.start()
         for data_batch in reader():
             feed = self.mesh.shard_batch(feeder(data_batch))
-            _, cost, metrics = self._eval_step(params, states, feed)
+            values, cost, metrics = self._eval_step(params, states, feed)
+            if self.declared_evaluators:
+                grads = None
+                if self._tap_grads is not None:
+                    grads = self._tap_grads(params, states, feed,
+                                            jax.random.key(0))
+                self.declared_evaluators.eval_batch(values, grads=grads,
+                                                    feed=feed)
             costs.append(float(cost))
             metrics_list.append({k: float(v) for k, v in metrics.items()})
             n += 1
         enforce(n > 0, "test reader yielded no batches")
-        return v2_event.TestResult(_mean_dicts(metrics_list), float(np.mean(costs)))
+        metrics = _mean_dicts(metrics_list)
+        if self.declared_evaluators:
+            metrics.update(self.declared_evaluators.finish())
+        return v2_event.TestResult(metrics, float(np.mean(costs)))
 
     # -- checkpointing (ParamUtil / Parameters.to_tar parity) -----------------
     def save_parameter_to_tar(self, f) -> None:

@@ -25,6 +25,7 @@ from paddle_tpu.layers.attr import (  # noqa: F401
 from paddle_tpu.layers.networks import *  # noqa: F401,F403
 from paddle_tpu.layers.pooling import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers import optimizers  # noqa: F401
+from paddle_tpu.trainer_config_helpers.evaluators import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.optimizers import (  # noqa: F401
     AdaDeltaOptimizer,
     AdaGradOptimizer,

@@ -1,0 +1,167 @@
+"""v1 declarative evaluator surface — the 15 ``*_evaluator`` wrappers
+(≅ ``python/paddle/trainer_config_helpers/evaluators.py:161-774``) usable
+inside unmodified reference config files.
+
+Each call records an :class:`EvaluatorSpec` (picked up by proto emission
+into ``ModelConfig.evaluators`` and by the trainer loops for execution)
+and returns it.  Auto-naming follows the reference's ``wrap_name_default``
+pattern (``__maxid_printer_evaluator_0__``).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.evaluator.declare import EvaluatorSpec, declare
+from paddle_tpu.layers import base as layer_base
+from paddle_tpu.layers.base import LayerOutput
+
+__all__ = [
+    "evaluator_base", "classification_error_evaluator", "auc_evaluator",
+    "pnpair_evaluator", "precision_recall_evaluator", "ctc_error_evaluator",
+    "chunk_evaluator", "sum_evaluator", "column_sum_evaluator",
+    "value_printer_evaluator", "gradient_printer_evaluator",
+    "maxid_printer_evaluator", "maxframe_printer_evaluator",
+    "seqtext_printer_evaluator", "classification_error_printer_evaluator",
+    "detection_map_evaluator",
+]
+
+
+def _name(name, default_func):
+    return name or layer_base.gen_name(default_func)
+
+
+def _names(inputs) -> list[str]:
+    out = []
+    for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs]):
+        out.append(i.name if isinstance(i, LayerOutput) else str(i))
+    return out
+
+
+def evaluator_base(input, type, label=None, weight=None, name=None, **fields):
+    """≅ evaluators.py:62 evaluator_base: normalize inputs, record spec."""
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    if label is not None:
+        inputs.append(label)
+    if weight is not None:
+        inputs.append(weight)
+    fields = {k: v for k, v in fields.items() if v is not None}
+    return declare(EvaluatorSpec(
+        name=name, type=type, input_layers=_names(inputs), fields=fields))
+
+
+def classification_error_evaluator(input, label, name=None, weight=None,
+                                   top_k=None, threshold=None):
+    """≅ evaluators.py:211 (ClassificationErrorEvaluator)."""
+    return evaluator_base(
+        input=input, type="classification_error", label=label, weight=weight,
+        name=_name(name, "classification_error_evaluator"),
+        classification_threshold=threshold, top_k=top_k)
+
+
+def auc_evaluator(input, label, name=None, weight=None):
+    """≅ evaluators.py:263 (AucEvaluator)."""
+    return evaluator_base(input=input, type="last-column-auc", label=label,
+                          weight=weight, name=_name(name, "auc_evaluator"))
+
+
+def pnpair_evaluator(input, label, query_id, weight=None, name=None):
+    """≅ evaluators.py:297 (PnpairEvaluator; inputs label, query_id first)."""
+    inputs = [label, query_id, input] + ([weight] if weight is not None else [])
+    return evaluator_base(input=inputs, type="pnpair",
+                          name=_name(name, "pnpair_evaluator"))
+
+
+def precision_recall_evaluator(input, label, positive_label=None, weight=None,
+                               name=None):
+    """≅ evaluators.py:340 (PrecisionRecallEvaluator)."""
+    return evaluator_base(
+        input=input, type="precision_recall", label=label, weight=weight,
+        name=_name(name, "precision_recall_evaluator"),
+        positive_label=positive_label)
+
+
+def ctc_error_evaluator(input, label, name=None):
+    """≅ evaluators.py:385 (CTCErrorEvaluator)."""
+    return evaluator_base(input=input, type="ctc_edit_distance", label=label,
+                          name=_name(name, "ctc_error_evaluator"))
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None):
+    """≅ evaluators.py:412 (ChunkEvaluator)."""
+    return evaluator_base(
+        input=input, type="chunk", label=label,
+        name=_name(name, "chunk_evaluator"), chunk_scheme=chunk_scheme,
+        num_chunk_types=num_chunk_types,
+        excluded_chunk_types=excluded_chunk_types)
+
+
+def sum_evaluator(input, name=None, weight=None):
+    """≅ evaluators.py:519 (SumEvaluator)."""
+    return evaluator_base(input=input, type="sum", weight=weight,
+                          name=_name(name, "sum_evaluator"))
+
+
+def column_sum_evaluator(input, name=None, weight=None):
+    """≅ evaluators.py:545 (ColumnSumEvaluator)."""
+    return evaluator_base(input=input, type="last-column-sum", weight=weight,
+                          name=_name(name, "column_sum_evaluator"))
+
+
+def detection_map_evaluator(input, label, overlap_threshold=0.5,
+                            background_id=0, evaluate_difficult=False,
+                            ap_type="11point", name=None):
+    """≅ evaluators.py:161 (DetectionMAPEvaluator)."""
+    return evaluator_base(
+        input=input, type="detection_map", label=label,
+        name=_name(name, "detection_map_evaluator"),
+        overlap_threshold=overlap_threshold, background_id=background_id,
+        evaluate_difficult=evaluate_difficult, ap_type=ap_type)
+
+
+# ---- printer family (Evaluator.cpp:1018-1357) -------------------------------
+
+def value_printer_evaluator(input, name=None):
+    """≅ evaluators.py:576 (ValuePrinter: print input values per batch)."""
+    return evaluator_base(input=input, type="value_printer",
+                          name=_name(name, "value_printer_evaluator"))
+
+
+def gradient_printer_evaluator(input, name=None):
+    """≅ evaluators.py:599 (GradientPrinter: print d(cost)/d(input))."""
+    return evaluator_base(input=input, type="gradient_printer",
+                          name=_name(name, "gradient_printer_evaluator"))
+
+
+def maxid_printer_evaluator(input, num_results=None, name=None):
+    """≅ evaluators.py:622 (MaxIdPrinter: top-k ids per sample)."""
+    return evaluator_base(input=input, type="max_id_printer",
+                          name=_name(name, "maxid_printer_evaluator"),
+                          num_results=num_results)
+
+
+def maxframe_printer_evaluator(input, num_frames=None, name=None):
+    """≅ evaluators.py:651 (MaxFramePrinter: frames with max value)."""
+    return evaluator_base(input=input, type="max_frame_printer",
+                          name=_name(name, "maxframe_printer_evaluator"),
+                          num_results=num_frames)
+
+
+def seqtext_printer_evaluator(input, result_file, id_input=None,
+                              dict_file=None, delimited=None, name=None):
+    """≅ evaluators.py:684 (SequenceTextPrinter: write generated sequences
+    to ``result_file``, id-prefixed, tokens via ``dict_file``)."""
+    assert isinstance(result_file, str)
+    inputs = [input] if id_input is None else [id_input, input]
+    return evaluator_base(
+        input=inputs, type="seq_text_printer",
+        name=_name(name, "seqtext_printer_evaluator"),
+        dict_file=dict_file, result_file=result_file, delimited=delimited)
+
+
+def classification_error_printer_evaluator(input, label, threshold=0.5,
+                                           name=None):
+    """≅ evaluators.py:774 (ClassificationErrorPrinter)."""
+    return evaluator_base(
+        input=input, type="classification_error_printer", label=label,
+        name=_name(name, "classification_error_printer_evaluator"),
+        classification_threshold=threshold)

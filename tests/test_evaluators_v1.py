@@ -1,0 +1,153 @@
+"""v1 declarative evaluator surface end-to-end:
+
+- ``*_evaluator`` calls inside an (unmodified-style) v1 config file are
+  emitted into ``ModelConfig.evaluators`` (EvaluatorConfig parity) and
+- executed by the trainer CLI: train prints pass "Eval:" metrics, test
+  merges them into the result (≅ Tester.cpp printing GradientMachine eval).
+- printer family members (value/maxid/gradient printers) run host-side,
+  the gradient printer fed by d(cost)/d(layer) taps.
+- chunk evaluator works batch-wise on sequence data (unit-level).
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import numpy as np
+
+
+def _write_binary_config(tmp_path):
+    cfg = tmp_path / "bin.conf"
+    cfg.write_text(textwrap.dedent("""
+        from paddle.trainer_config_helpers import *
+
+        define_py_data_sources2(
+            train_list='{d}/train.list', test_list='{d}/test.list',
+            module='bin_provider', obj='process')
+        settings(batch_size=32, learning_rate=1e-2,
+                 learning_method=AdamOptimizer())
+
+        img = data_layer(name='pixel', size=32)
+        hidden = fc_layer(input=img, size=16, act=ReluActivation())
+        predict = fc_layer(input=hidden, size=2, act=SoftmaxActivation())
+        lbl = data_layer(name='label', size=2)
+
+        classification_error_evaluator(input=predict, label=lbl,
+                                       name='err_rate')
+        auc_evaluator(input=predict, label=lbl, name='train_auc')
+        sum_evaluator(input=predict, name='prob_sum')
+        value_printer_evaluator(input=predict, name='probs_vp')
+        maxid_printer_evaluator(input=predict, name='top1')
+        gradient_printer_evaluator(input=predict, name='dpredict')
+
+        outputs(classification_cost(input=predict, label=lbl))
+    """).format(d=tmp_path))
+    (tmp_path / "bin_provider.py").write_text(textwrap.dedent("""
+        import numpy as np
+        from paddle.trainer.PyDataProvider2 import (
+            provider, dense_vector, integer_value)
+
+        @provider(input_types={'pixel': dense_vector(32),
+                               'label': integer_value(2)})
+        def process(settings, filename):
+            rng = np.random.default_rng(int(filename.split('-')[-1]))
+            for _ in range(128):
+                y = int(rng.integers(0, 2))
+                x = rng.normal(size=(32,)).astype(np.float32) * 0.1
+                x[y * 16:(y + 1) * 16] += 1.0
+                yield x, y
+    """))
+    (tmp_path / "train.list").write_text("seed-0\n")
+    (tmp_path / "test.list").write_text("seed-7\n")
+    return str(cfg)
+
+
+def test_evaluator_declarations_emit_proto(tmp_path):
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    cfg = _write_binary_config(tmp_path)
+    parsed = parse_config(cfg, "")
+    evs = {e.name: e for e in parsed.model_config.evaluators}
+    # auto evaluator from classification_cost + the six declared ones
+    assert "classification_error_evaluator" in evs
+    assert evs["err_rate"].type == "classification_error"
+    assert list(evs["err_rate"].input_layers) == ["__fc_layer_1__", "label"]
+    assert evs["train_auc"].type == "last-column-auc"
+    assert evs["probs_vp"].type == "value_printer"
+    assert evs["top1"].type == "max_id_printer"
+    assert evs["dpredict"].type == "gradient_printer"
+    # declared specs ride on ParsedConfig for the runtime
+    assert {s.name for s in parsed.evaluators} >= {
+        "err_rate", "train_auc", "prob_sum", "probs_vp", "top1", "dpredict"}
+    # protostr renders the evaluator block (EvaluatorConfig parity)
+    assert 'evaluators {' in parsed.protostr()
+    assert 'type: "last-column-auc"' in parsed.protostr()
+
+
+def test_cli_train_and_test_with_declared_evaluators(tmp_path, capsys):
+    from paddle_tpu.trainer import cli
+
+    cfg = _write_binary_config(tmp_path)
+    rc = cli.main(["--config", cfg, "--job", "train", "--num_passes", "2",
+                   "--log_period", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # pass summary carries the declared evaluator metrics
+    assert "Eval:" in out
+    assert "err_rate=" in out
+    assert "train_auc=" in out
+    # the error rate at the final pass should beat chance
+    last_eval = [ln for ln in out.splitlines() if "err_rate=" in ln][-1]
+    err = float(last_eval.split("err_rate=")[1].split()[0])
+    assert err < 0.3, out
+
+    rc = cli.main(["--config", cfg, "--job", "test"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "err_rate=" in out.replace("'err_rate': ", "err_rate=")
+
+
+def test_chunk_evaluator_runtime_sequence():
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.evaluator import declare, runtime
+
+    declare.reset()
+    from paddle_tpu.trainer_config_helpers.evaluators import chunk_evaluator
+
+    chunk_evaluator(input="pred", label="lab", chunk_scheme="IOB",
+                    num_chunk_types=1, name="chunks")
+    evs = runtime.build(declare.collect())
+    evs.start()
+    # B-I-O tag ids for IOB with 1 type: B=0, I=1, O=2
+    pred = SequenceBatch(data=np.asarray([[0, 1, 2, 0, 1]]),
+                         length=np.asarray([5]))
+    lab = SequenceBatch(data=np.asarray([[0, 1, 2, 0, 2]]),
+                        length=np.asarray([5]))
+    evs.eval_batch({"pred": pred, "lab": lab})
+    res = evs.finish()
+    f1 = [v for k, v in res.items() if "F1" in k or "f1" in k]
+    assert res, "chunk evaluator returned no metrics"
+    assert f1 and 0 <= f1[0] <= 1
+
+
+def test_seqtext_printer_plain_sequences(tmp_path):
+    """Non-beam path: integer sequences (or prob matrices via argmax) are
+    printed one line per sample (Evaluator.cpp:1219 basic format)."""
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.evaluator import declare, runtime
+
+    declare.reset()
+    from paddle_tpu.trainer_config_helpers.evaluators import (
+        seqtext_printer_evaluator,
+    )
+
+    out = tmp_path / "seq.txt"
+    seqtext_printer_evaluator(input="ids", result_file=str(out))
+    evs = runtime.build(declare.collect())
+    evs.start()
+    ids = SequenceBatch(data=np.asarray([[3, 1, 2], [2, 2, 0]]),
+                        length=np.asarray([3, 2]))
+    evs.eval_batch({"ids": ids})
+    evs.finish()
+    lines = out.read_text().splitlines()
+    assert lines == ["0\t 3 1 2", "1\t 2 2"]

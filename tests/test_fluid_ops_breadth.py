@@ -146,3 +146,65 @@ def test_generic_grad_covers_new_ops():
         xm = x_np.copy(); xm[i] -= eps
         num[i] = (f(xp) - f(xm)) / (2 * eps)
     np.testing.assert_allclose(got, num, rtol=1e-2, atol=1e-4)
+
+
+def test_round3_straggler_ops(rng_np):
+    """positive_negative_pair + compare/reduce/pool3d/conv3d stragglers
+    (VERDICT r2 task 7)."""
+    # pnpair: q0 ordered pair agrees, q1 tie
+    score = np.asarray([[.1, .9], [.2, .8], [.3, .5], [.4, .5]], np.float32)
+    label = np.asarray([[1.], [0.], [1.], [0.]], np.float32)
+    query = np.asarray([[0], [0], [1], [1]], np.int32)
+    out = run("positive_negative_pair",
+              {"Score": [score], "Label": [label], "QueryID": [query]},
+              {"column": -1})
+    assert float(out["PositivePair"][0][0]) == 1.0
+    assert float(out["NegativePair"][0][0]) == 0.0
+    assert float(out["NeutralPair"][0][0]) == 1.0
+    # accumulators seed the counts
+    out2 = run("positive_negative_pair",
+               {"Score": [score], "Label": [label], "QueryID": [query],
+                "AccumulatePositivePair": [np.asarray([2.0], np.float32)],
+                "AccumulateNegativePair": [np.asarray([1.0], np.float32)],
+                "AccumulateNeutralPair": [np.asarray([0.5], np.float32)]},
+               {"column": -1})
+    assert float(out2["PositivePair"][0][0]) == 3.0
+    assert float(out2["NegativePair"][0][0]) == 1.0
+    assert float(out2["NeutralPair"][0][0]) == 1.5
+
+    x = rng_np.normal(size=(3, 4)).astype(np.float32)
+    y = rng_np.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(run("greater_than", {"X": [x], "Y": [y]})["Out"][0]), x > y)
+    np.testing.assert_array_equal(
+        np.asarray(run("less_equal", {"X": [x], "Y": [y]})["Out"][0]), x <= y)
+    np.testing.assert_allclose(
+        np.asarray(run("reduce_max", {"X": [x]}, {"dim": 1})["Out"][0]),
+        x.max(1), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(run("reduce_min", {"X": [x]}, {"dim": 0})["Out"][0]),
+        x.min(0), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(run("hard_shrink", {"X": [x]}, {"threshold": 0.5})["Out"][0]),
+        np.where(np.abs(x) > 0.5, x, 0.0))
+    np.testing.assert_allclose(
+        np.asarray(run("thresholded_relu", {"X": [x]},
+                       {"threshold": 0.3})["Out"][0]),
+        np.where(x > 0.3, x, 0.0))
+
+    # conv3d / pool3d / max_pool2d_with_index shapes + values
+    v = np.ones((1, 1, 3, 3, 3), np.float32)
+    w = np.ones((2, 1, 2, 2, 2), np.float32)
+    c3 = np.asarray(run("conv3d", {"Input": [v], "Filter": [w]})["Output"][0])
+    assert c3.shape == (1, 2, 2, 2, 2)
+    np.testing.assert_allclose(c3, 8.0)
+    p3 = np.asarray(run("pool3d", {"X": [v * 2]},
+                        {"ksize": [3, 3, 3], "strides": [1, 1, 1],
+                         "pooling_type": "avg"})["Out"][0])
+    assert p3.shape == (1, 1, 1, 1, 1)
+    np.testing.assert_allclose(p3, 2.0)
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = run("max_pool2d_with_index", {"X": [img]},
+             {"ksize": [2, 2], "strides": [2, 2]})
+    np.testing.assert_array_equal(
+        np.asarray(mp["Mask"][0]).reshape(-1), [5, 7, 13, 15])

@@ -92,6 +92,10 @@ def main():
         bench_impl("jax.nn.dpa", functools.partial(
             jax.nn.dot_product_attention, is_causal=True), q, k, v)
         bench_impl("exact einsum", exact, q, k, v)
+        from paddle_tpu.ops.attention import blockwise_attention
+        bench_impl("blockwise scan", functools.partial(
+            blockwise_attention, block_size=min(1024, t), causal=True),
+            q, k, v)
 
 
 if __name__ == "__main__":

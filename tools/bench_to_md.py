@@ -1,0 +1,74 @@
+"""Render BENCHMARKS.md's main tables from a ``python bench.py`` JSONL
+capture, so the doc rows and the driver-recorded rows are the same
+experiment by construction (VERDICT r2 task 6).
+
+Usage: python bench.py | tee /tmp/bench.jsonl
+       python tools/bench_to_md.py /tmp/bench.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+K40 = {  # reference-published 1x K40m ms/batch (benchmark/README.md)
+    "alexnet_train_ms_per_batch_bs64": ("AlexNet", 64, 195),
+    "alexnet_train_ms_per_batch_bs128": ("AlexNet", 128, 334),
+    "alexnet_train_ms_per_batch_bs256": ("AlexNet", 256, 602),
+    "alexnet_train_ms_per_batch_bs512": ("AlexNet", 512, 1629),
+    "googlenet_train_ms_per_batch_bs64": ("GoogleNet", 64, 613),
+    "googlenet_train_ms_per_batch_bs128": ("GoogleNet", 128, 1149),
+    "smallnet_cifar_train_ms_per_batch_bs64": ("SmallNet (cifar)", 64, 10.46),
+    "lstm_text_train_ms_per_batch_h256_bs64":
+        ("LSTM text-classif h256 (seqlen 100)", 64, 83),
+    "lstm_text_train_ms_per_batch_h512_bs64": ("LSTM h512", 64, 184),
+    "lstm_text_train_ms_per_batch_h1280_bs64": ("LSTM h1280", 64, 641),
+}
+
+
+def main(path: str):
+    recs = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                r = json.loads(line)
+                recs[r["metric"]] = r
+
+    print("## Reference benchmark tables, reproduced "
+          "(regenerate: python bench.py | tee x.jsonl; "
+          "python tools/bench_to_md.py x.jsonl)\n")
+    print("| Model (train step) | batch | this build (v5e) | "
+          "reference (K40m) | ratio |")
+    print("|---|---|---|---|---|")
+    for metric, (label, bs, k40) in K40.items():
+        r = recs.get(metric)
+        if not r:
+            continue
+        print(f"| {label} | {bs} | **{r['value']} ms** | {k40} ms | "
+              f"{r['vs_baseline']:.0f}× |")
+
+    print("\n## North-star configs (no published reference numbers — "
+          "established here)\n")
+    print("| Config | metric |")
+    print("|---|---|")
+    rows = [
+        ("resnet50_train_img_per_sec_bs64", "ResNet-50 train bs64"),
+        ("resnet50_train_img_per_sec_bs128", "ResNet-50 train bs128"),
+        ("resnet50_train_img_per_sec_bs256", "ResNet-50 train bs256"),
+        ("transformer_lm_124m_tokens_per_sec", "Transformer LM 124M"),
+        ("nmt_attention_train_seq_per_sec", "seq2seq+attention NMT"),
+        ("ctr_wide_deep_train_examples_per_sec", "Wide&Deep CTR"),
+        ("ocr_crnn_ctc_train_samples_per_sec", "OCR CRNN (conv+BiLSTM+CTC)"),
+    ]
+    for metric, label in rows:
+        r = recs.get(metric)
+        if not r:
+            continue
+        extra = f" ({r['mfu_pct']}% MFU)" if "mfu_pct" in r else ""
+        cfg = f" — {r['config']}" if "config" in r else ""
+        print(f"| {label}{cfg} | **{r['value']:,.0f} {r['unit']}**{extra} |")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench.jsonl")
