@@ -99,8 +99,12 @@ class ColumnSumEvaluator(Evaluator):
         self.total = None
         self.count = 0
 
-    def eval_batch(self, value=None, **kw):
-        v = np.asarray(value).sum(axis=0)
+    def eval_batch(self, value=None, weight=None, **kw):
+        v = np.asarray(value)
+        v = v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v.reshape(-1, 1)
+        if weight is not None:
+            v = v * np.asarray(weight).reshape(-1, 1)
+        v = v.sum(axis=0)
         self.total = v if self.total is None else self.total + v
         self.count += 1
 
@@ -170,15 +174,17 @@ class PrecisionRecall(Evaluator):
             self.fn = np.concatenate([self.fn, np.zeros(pad)])
             self.num_classes = n
 
-    def eval_batch(self, pred=None, label=None, **kw):
+    def eval_batch(self, pred=None, label=None, weight=None, **kw):
         p = np.asarray(pred)
         self._grow(p.shape[-1] if p.ndim > 1 else 2)
         ids = np.argmax(p, axis=-1).reshape(-1)
         lbl = np.asarray(label).reshape(-1)
+        w = (np.asarray(weight).reshape(-1) if weight is not None
+             else np.ones_like(ids, np.float64))
         for c in range(self.num_classes):
-            self.tp[c] += int(((ids == c) & (lbl == c)).sum())
-            self.fp[c] += int(((ids == c) & (lbl != c)).sum())
-            self.fn[c] += int(((ids != c) & (lbl == c)).sum())
+            self.tp[c] += float((w * ((ids == c) & (lbl == c))).sum())
+            self.fp[c] += float((w * ((ids == c) & (lbl != c))).sum())
+            self.fn[c] += float((w * ((ids != c) & (lbl == c))).sum())
 
     def finish(self):
         prec = self.tp / np.maximum(self.tp + self.fp, 1)

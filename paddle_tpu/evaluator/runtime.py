@@ -25,6 +25,32 @@ def _np(v):
     return np.asarray(raw(v))
 
 
+def _valid_frames(pred_v, label_v, weight_v=None):
+    """Flatten (pred, label[, weight]) to per-frame rows, DROPPING padded
+    frames when either side is a SequenceBatch (the reference masks by
+    sequence length; scoring padding would skew every sequence metric)."""
+    lens = _lengths(pred_v)
+    if lens is None:
+        lens = _lengths(label_v)
+    p, y = _np(pred_v), _np(label_v)
+    w = _np(weight_v) if weight_v is not None else None
+    if lens is None:
+        return p, y, w
+    p3 = p.reshape(p.shape[0], -1, p.shape[-1]) if p.ndim > 2 else         p.reshape(p.shape[0], -1, 1)
+    y2 = y.reshape(y.shape[0], -1)
+    ps, ys, ws = [], [], []
+    for i in range(p3.shape[0]):
+        t = int(lens[i])
+        ps.append(p3[i, :t])
+        ys.append(y2[i, :t])
+        if w is not None:
+            wi = w.reshape(w.shape[0], -1)[i]
+            ws.append(np.broadcast_to(wi[:1] if wi.size == 1 else wi[:t],
+                                      (t,)))
+    return (np.concatenate(ps), np.concatenate(ys),
+            np.concatenate(ws) if w is not None else None)
+
+
 def _lengths(v):
     return np.asarray(v.length) if is_sequence(v) else None
 
@@ -310,14 +336,18 @@ class DeclaredEvaluators:
             t = b.spec.type
             if t in ("classification_error", "precision_recall",
                      "classification_error_printer"):
-                kw = dict(pred=_np(ins[0]), label=_np(ins[1]))
-                if len(ins) > 2:  # optional declared weight input
-                    kw["weight"] = _np(ins[2])
+                p, y, w = _valid_frames(ins[0], ins[1],
+                                        ins[2] if len(ins) > 2 else None)
+                kw = dict(pred=p, label=y)
+                if w is not None:
+                    kw["weight"] = w
                 b.inst.eval_batch(**kw)
             elif t == "last-column-auc":
-                kw = dict(prob=_np(ins[0]), label=_np(ins[1]))
-                if len(ins) > 2:
-                    kw["weight"] = _np(ins[2])
+                p, y, w = _valid_frames(ins[0], ins[1],
+                                        ins[2] if len(ins) > 2 else None)
+                kw = dict(prob=p, label=y)
+                if w is not None:
+                    kw["weight"] = w
                 b.inst.eval_batch(**kw)
             elif t == "pnpair":
                 # declared input order: label, query_id, score[, weight]

@@ -126,3 +126,70 @@ def benchmark(fn, args: tuple, iters: int = 50, warmup: int = 3,
     dt = max(t2 - t1, 1e-9) / (n2 - n1)
     global_stat.add(name, dt)
     return BenchmarkResult(dt, flops, device_peak_flops())
+
+
+# ---- trace-based device timing (tunnel-noise-immune) ------------------------
+
+def read_device_trace(logdir: str):
+    """Parse a jax.profiler chrome trace: returns (op_events, module_ms)
+    where op_events are the per-HLO-op events of the device's "XLA Ops"
+    thread (dur_us, model_flops, raw_bytes_accessed, tf_op, source) and
+    module_ms sums the "XLA Modules" thread — the device-side wall time.
+    Single implementation shared by device_step_ms and tools/xprof.py."""
+    import glob
+    import gzip
+    import json
+    import os
+
+    files = sorted(glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
+                             recursive=True))
+    if not files:
+        raise RuntimeError(f"no trace under {logdir}")
+    tr = json.load(gzip.open(files[-1]))
+    pids, tids = {}, {}
+    for e in tr["traceEvents"]:
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                pids[e["pid"]] = e["args"].get("name")
+            elif e.get("name") == "thread_name":
+                tids[(e["pid"], e["tid"])] = e["args"].get("name")
+    events = []
+    module_us = 0.0
+    for e in tr["traceEvents"]:
+        if e.get("ph") != "X" or "TPU" not in (pids.get(e["pid"]) or ""):
+            continue
+        tname = tids.get((e["pid"], e["tid"]))
+        if tname == "XLA Modules":
+            module_us += e.get("dur", 0.0)
+        elif tname == "XLA Ops":
+            a = e.get("args", {})
+            events.append({
+                "name": e["name"],
+                "dur_us": e.get("dur", 0.0),
+                "flops": float(a.get("model_flops", 0) or 0),
+                "bytes": float(a.get("raw_bytes_accessed", 0) or 0),
+                "tf_op": a.get("tf_op", ""),
+                "source": a.get("source", ""),
+            })
+    return events, module_us / 1000.0
+
+
+def device_step_ms(step_fn, steps: int = 10, warmup: int = 3) -> float:
+    """ms/step measured on the DEVICE via a jax.profiler trace — immune to
+    the tunnel's host-dispatch noise, which makes two-point wall-clock
+    timing unstable below ~10 ms/step.  ``step_fn`` must keep its own state
+    and return a readback-able array (the readback fences the trace)."""
+    import tempfile
+
+    import numpy as np
+
+    for _ in range(warmup):
+        out = step_fn()
+    float(np.asarray(out).reshape(-1)[0])
+    logdir = tempfile.mkdtemp(prefix="bench_trace_")
+    jax.profiler.start_trace(logdir)
+    for _ in range(steps):
+        out = step_fn()
+    float(np.asarray(out).reshape(-1)[0])
+    jax.profiler.stop_trace()
+    return read_device_trace(logdir)[1] / steps

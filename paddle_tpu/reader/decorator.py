@@ -12,6 +12,8 @@ import random as _random
 import threading
 from typing import Callable, Iterable
 
+import numpy as np
+
 
 def map_readers(func: Callable, *readers):
     """Apply func to the items of several readers zipped together."""
@@ -160,5 +162,83 @@ def batch(reader, batch_size: int, drop_last: bool = False):
                 b = []
         if b and not drop_last:
             yield b
+
+    return batch_reader
+
+
+def bucket_batch(reader, batch_size, calc_batch_size=None, sample_length=None,
+                 buckets=(16, 32, 64, 128, 256, 512, 1024),
+                 drop_last: bool = False, size_multiple: int = 1):
+    """Length-bucketed, cost-balanced batching — the XLA-native answer to
+    PyDataProvider2's ``pool_size``/``calc_batch_size``
+    (``python/paddle/trainer/PyDataProvider2.py:367-374``, served by
+    ``PyDataProvider2.cpp``'s pooled dispatch).
+
+    Samples are grouped by their bucketed sequence length (the same bucket
+    table ``pad_sequences`` pads to, so every batch of a bucket compiles to
+    ONE static shape), and a bucket flushes when its accumulated cost —
+    ``sum(calc_batch_size(sample))``, default 1 per sample — reaches
+    ``batch_size``.  calc_batch_size thereby balances variable-length
+    batches exactly as the reference's pooled provider does: e.g.
+    ``calc_batch_size=lambda s: len(s[0])`` makes long-sequence batches
+    smaller at equal token budget.
+
+    ``size_multiple`` trims each emitted batch to a multiple of the mesh
+    replica count (sharding divisibility); trimmed samples stay pooled
+    until the end-of-stream flush, which drops an under-multiple tail
+    (logged).
+
+    Shape discipline: the FIRST flush of a bucket pins that bucket's batch
+    size; later flushes reuse it, so the jit sees at most one
+    (batch, time-bucket) signature per bucket instead of a fresh batch dim
+    every flush.
+    """
+    from paddle_tpu.core.lod import bucket_length
+
+    def default_len(sample):
+        best = 1
+        for field in (sample if isinstance(sample, (list, tuple)) else [sample]):
+            if isinstance(field, (list, tuple, np.ndarray)) and not np.isscalar(field):
+                try:
+                    best = max(best, len(field))
+                except TypeError:
+                    pass
+        return best
+
+    length_of = sample_length or default_len
+    cost_of = calc_batch_size or (lambda s: 1)
+
+    m = max(int(size_multiple), 1)
+
+    def batch_reader():
+        pools: dict[int, tuple[list, float]] = {}
+        pinned: dict[int, int] = {}  # bucket -> fixed batch size
+        for sample in reader():
+            b = bucket_length(length_of(sample), buckets)
+            items, cost = pools.get(b, ([], 0.0))
+            items.append(sample)
+            cost += float(cost_of(sample))
+            n = pinned.get(b)
+            if n is None and cost >= batch_size and len(items) >= m:
+                n = pinned[b] = (len(items) // m) * m
+            if n is not None and len(items) >= n:
+                yield items[:n]
+                rest = items[n:]
+                pools[b] = (rest, sum(float(cost_of(s)) for s in rest))
+            else:
+                pools[b] = (items, cost)
+        if not drop_last:
+            dropped = 0
+            for b in sorted(pools):
+                items, _ = pools[b]
+                n = (len(items) // m) * m
+                if n:
+                    yield items[:n]
+                dropped += len(items) - n
+            if dropped:
+                from paddle_tpu.core import logger as log
+
+                log.info("bucket_batch: dropped %d tail samples not "
+                         "divisible by the %d-replica mesh", dropped, m)
 
     return batch_reader

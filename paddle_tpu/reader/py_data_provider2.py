@@ -9,7 +9,9 @@ paddle-style reader over the provider's file list, and the declared
 
 Supported knobs: input_types (dict or list), should_shuffle, cache
 (accepted, pass-level caching handled by the reader buffer), init_hook,
-pool_size/calc_batch_size (accepted and ignored — XLA batches statically).
+calc_batch_size (HONORED via length-bucketed cost-balanced batching —
+``reader.decorator.bucket_batch`` — giving each bucket one static XLA
+shape), pool_size (subsumed by the per-bucket pools).
 """
 
 from __future__ import annotations
@@ -64,6 +66,8 @@ def provider(input_types=None, should_shuffle=None, pool_size=-1,
         fn.is_provider = True
         fn.should_shuffle = should_shuffle
         fn.cache = cache
+        fn.calc_batch_size = calc_batch_size
+        fn.pool_size = pool_size
         return fn
 
     return deco

@@ -77,6 +77,16 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
     reader = obj.make_reader(files)
     if shuffle and getattr(obj, "should_shuffle", True) is not False:
         reader = paddle.reader.shuffle(reader, buf_size=4096)
+    calc = getattr(obj, "calc_batch_size", None)
+    if calc is not None:
+        # PyDataProvider2 dynamic-batch semantics: cost-balanced batches
+        # per length bucket (one static shape each), trimmed to the mesh
+        # replica count for sharding divisibility
+        from paddle_tpu.parallel.mesh import get_mesh
+        from paddle_tpu.reader.decorator import bucket_batch
+
+        return bucket_batch(reader, batch_size, calc_batch_size=calc,
+                            size_multiple=get_mesh().num_replicas)
     return paddle.reader.batch(reader, batch_size=batch_size, drop_last=True)
 
 

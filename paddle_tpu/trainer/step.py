@@ -140,19 +140,22 @@ def build_eval_step(topology: Topology, mesh: MeshContext | None = None):
     return jax.jit(step)
 
 
-def build_tap_grads(topology: Topology, tap_names: list[str]):
+def build_tap_grads(topology: Topology, tap_names: list[str],
+                    is_train: bool = True):
     """Jitted (params, states, feed, key) -> {layer: d(cost)/d(layer)} —
     the gradient_printer_evaluator's data source (≅ the reference printing
     ``input.grad`` during backward, Evaluator.cpp:1091) via zero-valued
-    output taps (Topology.forward ``taps``)."""
+    output taps (Topology.forward ``taps``).  ``is_train`` selects the
+    train or eval forward (dropout on/off) to match the pass being
+    printed."""
     out_names = [o.name for o in topology.outputs]
 
     def grads(params, states, feed, key):
-        values, _ = topology.forward(params, states, feed, True, key)
+        values, _ = topology.forward(params, states, feed, is_train, key)
         taps0 = {n: jnp.zeros_like(raw(values[n])) for n in tap_names}
 
         def cost_of(taps):
-            vals, _ = topology.forward(params, states, feed, True, key,
+            vals, _ = topology.forward(params, states, feed, is_train, key,
                                        taps=taps)
             return functools.reduce(
                 lambda a, b: a + b,

@@ -321,14 +321,23 @@ class SGD:
         costs, metrics_list, n = [], [], 0
         if self.declared_evaluators:
             self.declared_evaluators.start()
+        tap_grads_eval = None
+        taps = (self.declared_evaluators.grad_tap_layers()
+                if self.declared_evaluators else [])
+        if taps:
+            from paddle_tpu.trainer.step import build_tap_grads
+
+            # eval-mode forward (dropout off), matching _eval_step's pass
+            tap_grads_eval = build_tap_grads(self.topology, taps,
+                                             is_train=False)
         for data_batch in reader():
             feed = self.mesh.shard_batch(feeder(data_batch))
             values, cost, metrics = self._eval_step(params, states, feed)
             if self.declared_evaluators:
                 grads = None
-                if self._tap_grads is not None:
-                    grads = self._tap_grads(params, states, feed,
-                                            jax.random.key(0))
+                if tap_grads_eval is not None:
+                    grads = tap_grads_eval(params, states, feed,
+                                           jax.random.key(0))
                 self.declared_evaluators.eval_batch(values, grads=grads,
                                                     feed=feed)
             costs.append(float(cost))

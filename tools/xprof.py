@@ -28,59 +28,24 @@ import jax
 
 
 def _read_trace(logdir: str):
-    """Returns (per-op events on the 'XLA Ops' device thread,
-    total device-module ms summed over the trace)."""
-    files = sorted(glob.glob(os.path.join(logdir, "**", "*.trace.json.gz"),
-                             recursive=True))
-    if not files:
-        raise RuntimeError(
-            f"no *.trace.json.gz under {logdir} — the profiler produced no "
-            "device trace (unsupported backend?)")
-    tr = json.load(gzip.open(files[-1]))
-    events = tr["traceEvents"]
-    pids, tids = {}, {}
-    for e in events:
-        if e.get("ph") == "M":
-            if e.get("name") == "process_name":
-                pids[e["pid"]] = e["args"].get("name")
-            elif e.get("name") == "thread_name":
-                tids[(e["pid"], e["tid"])] = e["args"].get("name")
-    dev_pids = {p for p, n in pids.items() if n and "TPU" in n}
-    out = []
-    module_us = 0.0
-    for e in events:
-        if e.get("ph") != "X" or e["pid"] not in dev_pids:
-            continue
-        tname = tids.get((e["pid"], e["tid"]))
-        if tname == "XLA Modules":
-            module_us += e.get("dur", 0.0)
-        elif tname == "XLA Ops":
-            a = e.get("args", {})
-            out.append({
-                "name": e["name"],
-                "dur_us": e.get("dur", 0.0),
-                "flops": float(a.get("model_flops", 0) or 0),
-                "bytes": float(a.get("raw_bytes_accessed", 0) or 0),
-                "tf_op": a.get("tf_op", ""),
-                "source": a.get("source", ""),
-            })
-    return out, module_us
+    """(per-op events, module_ms) — thin wrapper over the library parser
+    (paddle_tpu.profiler.read_device_trace, the single implementation)."""
+    from paddle_tpu.profiler import read_device_trace
+
+    events, module_ms = read_device_trace(logdir)
+    return events, module_ms * 1000.0
 
 
 def device_module_ms(run_once, steps: int = 10, logdir: str | None = None):
-    """Device-side ms per call of ``run_once`` from XLA-module events —
-    immune to host/tunnel dispatch noise (wall-clock two-point timing is
-    only trustworthy above ~10 ms through the axon tunnel)."""
-    logdir = logdir or tempfile.mkdtemp(prefix="xprof_")
-    run_once()  # compile outside the trace
-    jax.profiler.start_trace(logdir)
-    out = None
-    for _ in range(steps):
+    """Device-side ms per call — delegates to
+    paddle_tpu.profiler.device_step_ms (single implementation)."""
+    from paddle_tpu.profiler import device_step_ms
+
+    def scalarable():
         out = run_once()
-    float(np.asarray(jax.tree.leaves(out)[0]).reshape(-1)[0])
-    jax.profiler.stop_trace()
-    _, module_us = _read_trace(logdir)
-    return module_us / 1000.0 / steps
+        return jax.tree.leaves(out)[0]
+
+    return device_step_ms(scalarable, steps=steps, warmup=1)
 
 
 def profile_step(run_once, steps: int = 3, logdir: str | None = None,
