@@ -370,10 +370,19 @@ class DeclaredEvaluators:
                 b.inst.eval_batch(pred=_np(ins[0]), label=_np(ins[1]),
                                   lengths=_lengths(ins[0]))
             elif t in ("sum", "last-column-sum"):
-                kw = dict(value=_np(ins[0]))
                 if len(ins) > 1:
-                    kw["weight"] = _np(ins[1])
-                b.inst.eval_batch(**kw)
+                    v, w2, _ = _valid_frames(ins[0], ins[1])
+                    # _valid_frames pairs (pred,label); here the "label" is
+                    # the weight column, flattened per valid frame
+                    b.inst.eval_batch(value=v, weight=w2)
+                else:
+                    v = ins[0]
+                    lens = _lengths(v)
+                    if lens is not None:
+                        v, _, _ = _valid_frames(v, v)
+                        b.inst.eval_batch(value=v)
+                    else:
+                        b.inst.eval_batch(value=_np(v))
             elif t == "value_printer":
                 b.inst.eval_batch(**{n: _np(v) for n, v in
                                      zip(b.spec.input_layers, ins)})

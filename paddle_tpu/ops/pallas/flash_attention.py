@@ -197,6 +197,26 @@ def _dqkv_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
+def _fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       *, scale, t_k, causal):
+    """Single-tile forward (whole sequence in one block): plain softmax —
+    no online-rescale machinery (m/l carry, acc correction), which is pure
+    VPU overhead when nk == 1."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    valid = _causal_valid(q.shape[0], k.shape[0], 0, 0, t_k, causal)
+    s = jnp.where(valid, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe_l = jnp.maximum(l, 1e-30)
+    o = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    o_ref[0] = (o / safe_l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(safe_l)).astype(lse_ref.dtype)
+
+
 def _prep(q, k, v, block_q, block_k):
     """[B,T,H,D] → T-padded [BH,Tp,D].  D is kept as-is: a full-size minor
     block dim is always accepted by Mosaic, and zero-padding D to 128 would
@@ -232,6 +252,26 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     bh, tqp, dpad = qp.shape
     tkp = kp.shape[1]
     nq, nk = tqp // block_q, tkp // block_k
+
+    if nq == 1 and nk == 1:
+        bspec = lambda blk: pl.BlockSpec((1, blk, dpad), lambda b: (b, 0, 0))
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_single_kernel, scale=scale, t_k=t_k,
+                              causal=causal),
+            grid=(bh,),
+            in_specs=[bspec(block_q), bspec(block_k), bspec(block_k)],
+            out_specs=[bspec(block_q),
+                       pl.BlockSpec((1, block_q, 1), lambda b: (b, 0, 0))],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, tqp, dpad), q.dtype),
+                jax.ShapeDtypeStruct((bh, tqp, 1), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",),
+            ),
+            interpret=interpret,
+        )(qp, kp, vp)
+        return o, lse, (qp, kp, vp)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, bq=block_q, bk=block_k, t_k=t_k,

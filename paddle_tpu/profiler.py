@@ -183,13 +183,18 @@ def device_step_ms(step_fn, steps: int = 10, warmup: int = 3) -> float:
 
     import numpy as np
 
+    import shutil
+
     for _ in range(warmup):
         out = step_fn()
     float(np.asarray(out).reshape(-1)[0])
     logdir = tempfile.mkdtemp(prefix="bench_trace_")
-    jax.profiler.start_trace(logdir)
-    for _ in range(steps):
-        out = step_fn()
-    float(np.asarray(out).reshape(-1)[0])
-    jax.profiler.stop_trace()
-    return read_device_trace(logdir)[1] / steps
+    try:
+        jax.profiler.start_trace(logdir)
+        for _ in range(steps):
+            out = step_fn()
+        float(np.asarray(out).reshape(-1)[0])
+        jax.profiler.stop_trace()
+        return read_device_trace(logdir)[1] / steps
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)

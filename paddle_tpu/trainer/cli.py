@@ -85,8 +85,11 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
         from paddle_tpu.parallel.mesh import get_mesh
         from paddle_tpu.reader.decorator import bucket_batch
 
+        # drop_last: tail flushes would emit non-pinned batch sizes and
+        # recompile every pass (shuffle reorders the tail each time)
         return bucket_batch(reader, batch_size, calc_batch_size=calc,
-                            size_multiple=get_mesh().num_replicas)
+                            size_multiple=get_mesh().num_replicas,
+                            drop_last=True)
     return paddle.reader.batch(reader, batch_size=batch_size, drop_last=True)
 
 

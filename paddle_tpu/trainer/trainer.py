@@ -68,6 +68,7 @@ class SGD:
         else:
             self.declared_evaluators = _ev_runtime.build(declared_evaluators)
         self._tap_grads = None
+        self._tap_grads_eval = None
         if isinstance(cost, LayerOutput):
             cost = [cost]
         self.topology = Topology(cost, extra_layers=extra_layers)
@@ -321,15 +322,16 @@ class SGD:
         costs, metrics_list, n = [], [], 0
         if self.declared_evaluators:
             self.declared_evaluators.start()
-        tap_grads_eval = None
         taps = (self.declared_evaluators.grad_tap_layers()
                 if self.declared_evaluators else [])
-        if taps:
+        if taps and self._tap_grads_eval is None:
             from paddle_tpu.trainer.step import build_tap_grads
 
-            # eval-mode forward (dropout off), matching _eval_step's pass
-            tap_grads_eval = build_tap_grads(self.topology, taps,
-                                             is_train=False)
+            # eval-mode forward (dropout off), matching _eval_step's pass;
+            # cached: build_tap_grads jits, one compile per topology
+            self._tap_grads_eval = build_tap_grads(self.topology, taps,
+                                                   is_train=False)
+        tap_grads_eval = self._tap_grads_eval
         for data_batch in reader():
             feed = self.mesh.shard_batch(feeder(data_batch))
             values, cost, metrics = self._eval_step(params, states, feed)
