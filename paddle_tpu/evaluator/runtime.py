@@ -207,10 +207,42 @@ class SeqTextPrinter(ev_mod.Evaluator):
         return sep + sep.join(self._tok(int(i)) for i in ids)
 
     def eval_batch(self, value=None, sample_ids=None, **kw):
-        from paddle_tpu.layers.recurrent_group import GeneratedSequence
+        from paddle_tpu.layers.recurrent_group import (
+            GeneratedSequence,
+            NestedGeneratedSequence,
+        )
 
         out = self._fh
         enforce(out is not None, "start() not called")
+        if isinstance(value, NestedGeneratedSequence):
+            # nested format (Evaluator.cpp sub-sequence mode): id on the
+            # first line, one tab-prefixed line per subsequence, blank line
+            # between outer samples
+            ids = np.asarray(value.inner.ids)
+            lens = np.asarray(value.inner.length)
+            scores = np.asarray(value.inner.score)
+            n_res = ids.shape[1]
+            n_sub = value.n_sub
+            seq_len = np.asarray(value.seq_length)
+            b_outer = seq_len.shape[0]
+            for s in range(b_outer):
+                sid = int(np.asarray(sample_ids).reshape(-1)[s]) \
+                    if sample_ids is not None else s
+                for j in range(int(seq_len[s])):
+                    r = s * n_sub + j
+                    prefix = f"{sid}\t" if j == 0 else "\t"
+                    if n_res == 1:
+                        out.write(prefix
+                                  + f"{self._join(ids[r, 0, :lens[r, 0]])}\n")
+                    else:  # beam block per subsequence (rank, score, seq)
+                        out.write(prefix.rstrip("\t") + "\n" if j == 0
+                                  else "")
+                        for k in range(n_res):
+                            out.write(f"{k}\t{float(scores[r, k]):g}\t"
+                                      f"{self._join(ids[r, k, :lens[r, k]])}"
+                                      "\n")
+                out.write("\n")
+            return
         if isinstance(value, GeneratedSequence):
             ids = np.asarray(value.ids)
             lens = np.asarray(value.length)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
-from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.core.lod import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.core.parameters import ParamSpec
 from paddle_tpu.layers.base import Context, LayerOutput, evaluate, gen_name
 
@@ -160,6 +160,18 @@ def _boot_value(mem, boot_val, batch, dtype=jnp.float32):
     return jnp.zeros((batch, mem.size), dtype)
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NestedGeneratedSequence:
+    """Generation results for an outer sequence of subsequences (the nested
+    configs of test_recurrent_machine_generation.cpp): one GeneratedSequence
+    per (outer sample x subsequence), plus the outer LoD."""
+
+    inner: "GeneratedSequence"  # [B*S, R, L]
+    seq_length: jax.Array  # [B] valid subsequences per outer sample
+    n_sub: int = dataclasses.field(metadata=dict(static=True))
+
+
 def recurrent_group(step: Callable, input, reverse: bool = False,
                     name: str | None = None, targetInlink=None):
     """≅ recurrent_group (layers.py:3862).  Scatters sequence inputs into
@@ -204,6 +216,9 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
     outs = step(*in_args)
     single = isinstance(outs, LayerOutput)
     outs = [outs] if single else list(outs)
+
+    if single and outs[0].layer_type == "beam_search":
+        return _nested_beam_group(name, outs[0], seq_inputs)
 
     # every node built during step() (registry slice), in creation order —
     # this also catches layers only reachable through memory links (e.g. the
@@ -425,6 +440,50 @@ class GeneratedSequence:
                             [int(i) for i in ids[b, r, :int(lens[b, r])]]))
             out.append(row)
         return out
+
+
+def _nested_beam_group(name, beam_node, seq_inputs):
+    """recurrent_group over subsequences whose step IS a beam_search (the
+    sample_trainer_nest_rnn_gen.conf shape): each subsequence generates
+    independently (the reference notes the outer memory is read-only and
+    unused), so execution flattens [B, S, ...] subsequences into a
+    [B*S]-row generation batch and re-attaches the outer LoD.  Generalizing
+    to inner steps that consume a live outer memory would need the outer
+    scan to carry GeneratedSequence state and is intentionally rejected
+    until a use case exists."""
+    enforce(len(seq_inputs) == 1,
+            "nested beam generation supports exactly one subsequence input")
+    enforce(
+        len(beam_node.parents) == 1,
+        "nested beam generation requires the inner beam_search to take "
+        "exactly one (read-only) outer input; extra StaticInputs or live "
+        "outer memories are not supported — restructure so the inner step "
+        "depends only on the subsequence input",
+    )
+    outer = seq_inputs[0]
+    # the wrapper supersedes the inner node as "__beam_search_predict__"
+    inner_aliases = beam_node.attrs.get("aliases", ())
+    beam_node.attrs["aliases"] = ()
+    beam_node.attrs["__in_group__"] = True
+
+    def fwd(ctx, params, states, outer_val):
+        enforce(isinstance(outer_val, NestedSequenceBatch),
+                "nested beam generation needs a NestedSequenceBatch feed "
+                "(sequence of subsequences)")
+        flat = outer_val.flatten_outer()
+        res = beam_node.fn(ctx, params, states, flat)
+        return NestedGeneratedSequence(
+            inner=res, seq_length=outer_val.seq_length,
+            n_sub=outer_val.data.shape[1])
+
+    return LayerOutput(
+        name=name, layer_type="beam_search", size=beam_node.size,
+        parents=(outer,), param_specs=beam_node.param_specs,
+        state_specs=beam_node.state_specs, fn=fwd,
+        attrs={**{k: v for k, v in beam_node.attrs.items()
+                  if k != "__in_group__"},
+               "aliases": inner_aliases or ("__beam_search_predict__",)},
+    )
 
 
 def beam_search(step: Callable, input, bos_id: int, eos_id: int,

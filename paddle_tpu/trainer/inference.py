@@ -64,6 +64,14 @@ class Inference:
                 if isinstance(r, SequenceBatch):
                     outs[i].extend(to_ragged(r))
                     ragged[i] = True
+                elif hasattr(r, "inner"):  # NestedGeneratedSequence
+                    inner = r.inner.to_list()
+                    seq_len = np.asarray(r.seq_length)
+                    for s_i in range(seq_len.shape[0]):
+                        outs[i].append(
+                            inner[s_i * r.n_sub:
+                                  s_i * r.n_sub + int(seq_len[s_i])])
+                    ragged[i] = True
                 elif hasattr(r, "to_list"):  # GeneratedSequence (beam search)
                     outs[i].extend(r.to_list())
                     ragged[i] = True

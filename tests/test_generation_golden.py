@@ -94,3 +94,60 @@ def test_rnn_generation_matches_reference_golden(tmp_path, beam):
     assert got == want, (
         f"generation output diverged from the reference golden {golden}:\n"
         f"got  {got[:30]}...\nwant {want[:30]}...")
+
+
+@pytest.mark.parametrize("beam", [False, True])
+def test_nested_rnn_generation_matches_reference_golden(tmp_path, beam):
+    """The hierarchical variant (test_recurrent_machine_generation.cpp:
+    NEST_CONFIG_FILE): beam_search inside an outer recurrent_group over
+    subsequences; both beam settings produce the same r1.test.nest output
+    (the conf sets num_results_per_sample=1)."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.core.lod import NestedSequenceBatch
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    parsed = parse_config(
+        os.path.join(REF_TESTS, "sample_trainer_nest_rnn_gen.conf"),
+        f"beam_search={1 if beam else 0}")
+    topo = Topology(parsed.output_layers())
+    params = {}
+    for spec in topo.param_specs():
+        arr = load_reference_param(os.path.join(MODEL_DIR, "t1", spec.name))
+        params[spec.name] = arr.reshape(spec.shape)
+
+    # one outer sequence with 15 single-word subsequences (the reference
+    # test's prepareInArgs hasSubseq branch); one sample id
+    n_sub = 15
+    rng = np.random.default_rng(0)
+    feed = {
+        "sent_id": np.zeros((1, 1), np.float32),
+        "dummy_data_input": NestedSequenceBatch(
+            data=np.asarray(
+                rng.uniform(size=(1, n_sub, 1, 2)).astype(np.float32)),
+            seq_length=np.asarray([n_sub], np.int32),
+            sub_length=np.ones((1, n_sub), np.int32)),
+    }
+    prev = flags.get("bf16")
+    flags.set("bf16", False)
+    try:
+        values, _ = topo.forward(params, topo.init_states(), feed, False,
+                                 jax.random.key(0))
+    finally:
+        flags.set("bf16", prev)
+
+    specs = parsed.evaluators
+    assert len(specs) == 1 and specs[0].type == "seq_text_printer"
+    result_file = tmp_path / "dump_text.nest"
+    specs[0].fields["result_file"] = str(result_file)
+    specs[0].fields["dict_file"] = os.path.join(REF_TESTS,
+                                                "test_gen_dict.txt")
+    evs = ev_runtime.build(specs)
+    evs.start()
+    evs.eval_batch(values, feed=feed)
+    evs.finish()
+
+    got = float_stream(result_file.read_text())
+    want = float_stream(
+        open(os.path.join(MODEL_DIR, "r1.test.nest")).read())
+    assert got == want, (
+        f"nested generation diverged:\ngot  {got[:30]}\nwant {want[:30]}")
