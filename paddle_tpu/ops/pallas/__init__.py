@@ -19,6 +19,13 @@ def default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+NEG_INF = -1e30  # shared masking sentinel for the softmax-family kernels
+
+
+def round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
 from paddle_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
 
-__all__ = ["flash_attention", "default_interpret"]
+__all__ = ["flash_attention", "default_interpret", "NEG_INF", "round_up"]

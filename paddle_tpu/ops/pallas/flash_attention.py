@@ -28,11 +28,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-
-
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+from paddle_tpu.ops.pallas import NEG_INF, round_up as _round_up
 
 
 def _causal_valid(bq, bk, qi0, ki0, t_k, causal):

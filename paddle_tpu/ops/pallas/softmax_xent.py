@@ -25,11 +25,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-
-
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+from paddle_tpu.ops.pallas import NEG_INF, round_up as _round_up
 
 
 def _lse_kernel(l_ref, lse_ref, m_ref, s_ref, *, v, bv):
@@ -68,9 +64,11 @@ def _dlogits_kernel(l_ref, lse_ref, tgt_ref, g_ref, dl_ref, *, v, bv):
 
 
 def _lse(logits, block_rows, block_v, interpret):
+    """Grid over ceil-divided blocks of the UNPADDED array: Pallas serves
+    partial edge blocks zero-padded, and the kernels mask by the true
+    row/col bounds — no materialized pad copy of the logits."""
     n, v = logits.shape
     np_, vp = _round_up(n, block_rows), _round_up(v, block_v)
-    lp = jnp.pad(logits, ((0, np_ - n), (0, vp - v)))
     lse = pl.pallas_call(
         functools.partial(_lse_kernel, v=v, bv=block_v),
         grid=(np_ // block_rows, vp // block_v),
@@ -83,8 +81,8 @@ def _lse(logits, block_rows, block_v, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(lp)
-    return lse[:n, 0], lp, np_, vp
+    )(logits)
+    return lse[:n, 0], np_, vp
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
@@ -103,10 +101,10 @@ def _fwd(logits, targets, block_rows, block_v, interpret):
 
     if interpret is None:
         interpret = default_interpret()
-    lse, lp, np_, vp = _lse(logits, block_rows, block_v, interpret)
+    lse, np_, vp = _lse(logits, block_rows, block_v, interpret)
     tgt = jnp.take_along_axis(logits, targets[:, None].astype(jnp.int32),
                               axis=-1)[:, 0].astype(jnp.float32)
-    return lse - tgt, (lp, lse, targets, (logits.shape, np_, vp))
+    return lse - tgt, (logits, lse, targets, (logits.shape, np_, vp))
 
 
 def _bwd(block_rows, block_v, interpret, res, g):
@@ -114,12 +112,9 @@ def _bwd(block_rows, block_v, interpret, res, g):
 
     if interpret is None:
         interpret = default_interpret()
-    lp, lse, targets, ((n, v), np_, vp) = res
-    lse_p = jnp.pad(lse[:, None], ((0, np_ - n), (0, 0)))
-    # padded rows: g is zero there, so their dlogits are zero
-    g_p = jnp.pad(g.astype(jnp.float32)[:, None], ((0, np_ - n), (0, 0)))
-    tgt_p = jnp.pad(targets.astype(jnp.int32)[:, None],
-                    ((0, np_ - n), (0, 0)), constant_values=-1)
+    logits, lse, targets, ((n, v), np_, vp) = res
+    # per-row side inputs are tiny; pallas zero-pads their edge blocks too.
+    # padded rows produce garbage p but write into dl rows >= n, sliced off
     rspec = pl.BlockSpec((block_rows, 1), lambda i, j: (i, 0))
     dl = pl.pallas_call(
         functools.partial(_dlogits_kernel, v=v, bv=block_v),
@@ -127,11 +122,12 @@ def _bwd(block_rows, block_v, interpret, res, g):
         in_specs=[pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
                   rspec, rspec, rspec],
         out_specs=pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((np_, vp), lp.dtype),
+        out_shape=jax.ShapeDtypeStruct((np_, vp), logits.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(lp, lse_p, tgt_p, g_p)
+    )(logits, lse[:, None], targets.astype(jnp.int32)[:, None],
+      g.astype(jnp.float32)[:, None])
     return dl[:n, :v], None
 
 
