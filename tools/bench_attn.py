@@ -82,7 +82,7 @@ def main():
     for (b, t, h, d) in shapes:
         print(f"== B={b} T={t} H={h} D={d} bf16 causal ==")
         q, k, v = mk(b, t, h, d)
-        for bq, bk in ((256, 256), (512, 512), (512, min(1024, t)),
+        for bq, bk in ((512, 512), (512, min(1024, t)),
                        (min(1024, t), min(1024, t))):
             bench_impl(f"ours q{bq}k{bk}",
                        functools.partial(ours, causal=True, block_q=bq,
