@@ -33,6 +33,7 @@ class Topology:
             outputs = [outputs]
         self.outputs: list[LayerOutput] = list(outputs)
         extra = list(extra_layers) if extra_layers else []
+        self.extra_layers: list[LayerOutput] = extra
         self.nodes: list[LayerOutput] = topo_sort(self.outputs + extra)
         names = [n.name for n in self.nodes]
         enforce(len(names) == len(set(names)), "duplicate layer names in topology")

@@ -88,28 +88,41 @@ class SumEvaluator(Evaluator):
 
 
 class ColumnSumEvaluator(Evaluator):
-    """≅ column_sum_evaluator."""
+    """≅ column_sum_evaluator (ref Evaluator.cpp:276 ColumnSumEvaluator).
+
+    Reports ``sum[col_idx] / numSamples`` like the reference's
+    ``printStats`` (Evaluator.cpp:351-363); ``numSamples`` is the weight
+    sum when a weight input exists, else the sample count
+    (Evaluator.cpp:288-294).
+    """
 
     name = "column_sum"
 
-    def __init__(self):
+    def __init__(self, col_idx: int = -1):
+        self.col_idx = col_idx
         self.start()
 
     def start(self):
         self.total = None
-        self.count = 0
+        self.count = 0.0
 
     def eval_batch(self, value=None, weight=None, **kw):
         v = np.asarray(value)
         v = v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v.reshape(-1, 1)
         if weight is not None:
-            v = v * np.asarray(weight).reshape(-1, 1)
+            w = np.asarray(weight).reshape(-1, 1)
+            self.count += float(w.sum())
+            v = v * w
+        else:
+            self.count += v.shape[0]
         v = v.sum(axis=0)
         self.total = v if self.total is None else self.total + v
-        self.count += 1
 
     def finish(self):
-        return {self.name: self.total}
+        if self.total is None:
+            return {self.name: 0.0}
+        return {self.name: float(self.total[self.col_idx] / self.count)
+                if self.count else 0.0}
 
 
 class AUC(Evaluator):
@@ -192,6 +205,10 @@ class PrecisionRecall(Evaluator):
         f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-9)
         if self.positive_label is not None:
             c = self.positive_label
+            if not 0 <= c < prec.size:
+                raise ValueError(
+                    f"positive_label={c} out of range for "
+                    f"{prec.size}-class precision_recall evaluator")
             return {"precision": float(prec[c]), "recall": float(rec[c]),
                     "F1-score": float(f1[c])}
         return {
@@ -214,7 +231,10 @@ class PnpairEvaluator(Evaluator):
 
     def eval_batch(self, score=None, label=None, query=None, weight=None,
                    **kw):
-        s = np.asarray(score).reshape(-1)
+        s = np.asarray(score)
+        if s.ndim > 1 and s.shape[-1] > 1:
+            s = s[..., -1]  # ref Evaluator.cpp:925: score is the last column
+        s = s.reshape(-1)
         y = np.asarray(label).reshape(-1)
         q = (np.asarray(query).reshape(-1) if query is not None
              else np.zeros_like(y))
@@ -248,16 +268,38 @@ class PnpairEvaluator(Evaluator):
         return {self.name: (pos + 0.5 * tie) / total}
 
 
+#: per-scheme tag ids: (num_tag_types, begin, inside, end, single)
+#: ≅ ChunkEvaluator.cpp:82-108 (init)
+_CHUNK_SCHEMES = {
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
 class ChunkEvaluator(Evaluator):
-    """≅ ChunkEvaluator.cpp: chunk-level F1 for sequence tagging (IOB/IOE/IOBES).
+    """≅ ChunkEvaluator.cpp:53: chunk-level F1 for sequence tagging.
+
     Labels encode (chunk_type, tag_type) as in the reference:
-    tag = chunk_type * num_tag_types + tag_id."""
+    ``tag = label % num_tag_types; type = label / num_tag_types``, with
+    ``type == num_chunk_types`` meaning "other/O".  Supports the four
+    reference schemes (plain/IOB/IOE/IOBES) and ``excluded_chunk_types``
+    (excluded segments never count, ChunkEvaluator.cpp:160-184).
+    """
 
     name = "chunk"
 
-    def __init__(self, chunk_scheme: str = "IOB", num_chunk_types: int = 1):
+    def __init__(self, chunk_scheme: str = "IOB", num_chunk_types: int = 1,
+                 excluded_chunk_types=None):
+        if chunk_scheme not in _CHUNK_SCHEMES:
+            raise ValueError(f"Unknown chunk scheme: {chunk_scheme}")
         self.scheme = chunk_scheme
+        (self.num_tag_types, self.tag_begin, self.tag_inside,
+         self.tag_end, self.tag_single) = _CHUNK_SCHEMES[chunk_scheme]
         self.num_chunk_types = num_chunk_types
+        self.other_type = num_chunk_types
+        self.excluded = frozenset(excluded_chunk_types or ())
         self.start()
 
     def start(self):
@@ -265,26 +307,53 @@ class ChunkEvaluator(Evaluator):
         self.infer_total = 0
         self.label_total = 0
 
-    def _extract(self, tags: list[int]):
-        """Decode chunks as (start, end, type) from an IOB sequence."""
-        chunks = []
-        start, ctype = None, None
-        n_tag = 2 if self.scheme == "IOB" else 2
-        for i, t in enumerate(tags):
-            if t < 0 or t >= self.num_chunk_types * n_tag:
-                inside = False  # O tag
+    def _is_chunk_end(self, prev_tag, prev_type, tag, type_):
+        """≅ ChunkEvaluator.cpp:224 isChunkEnd."""
+        if prev_type == self.other_type:
+            return False
+        if type_ == self.other_type or type_ != prev_type:
+            return True
+        if prev_tag in (self.tag_begin, self.tag_inside):
+            return tag in (self.tag_begin, self.tag_single)
+        return prev_tag in (self.tag_end, self.tag_single)
+
+    def _is_chunk_begin(self, prev_tag, prev_type, tag, type_):
+        """≅ ChunkEvaluator.cpp:236 isChunkBegin."""
+        if prev_type == self.other_type:
+            return type_ != self.other_type
+        if type_ == self.other_type:
+            return False
+        if type_ != prev_type or tag in (self.tag_begin, self.tag_single):
+            return True
+        if tag in (self.tag_inside, self.tag_end):
+            return prev_tag in (self.tag_end, self.tag_single)
+        return False
+
+    def _extract(self, labels: list[int]):
+        """≅ ChunkEvaluator.cpp:186 getSegments: (begin, end, type) list."""
+        segments = []
+        chunk_start, in_chunk = 0, False
+        tag, type_ = -1, self.other_type
+        hi = self.num_chunk_types * self.num_tag_types
+        for i, lab in enumerate(labels):
+            prev_tag, prev_type = tag, type_
+            if 0 <= lab < hi:
+                tag = lab % self.num_tag_types
+                type_ = lab // self.num_tag_types
             else:
-                c, tag = divmod(t, n_tag)
-                inside = True
-            if start is not None:
-                if (not inside) or tag == 0 or c != ctype:
-                    chunks.append((start, i - 1, ctype))
-                    start, ctype = None, None
-            if inside and (tag == 0 or start is None):
-                start, ctype = i, c
-        if start is not None:
-            chunks.append((start, len(tags) - 1, ctype))
-        return set(chunks)
+                # out-of-range / negative (padding) labels count as O —
+                # the reference CHECKs the range (ChunkEvaluator.cpp:196);
+                # we degrade gracefully for padded batches
+                tag, type_ = -1, self.other_type
+            if in_chunk and self._is_chunk_end(prev_tag, prev_type,
+                                               tag, type_):
+                segments.append((chunk_start, i - 1, prev_type))
+                in_chunk = False
+            if self._is_chunk_begin(prev_tag, prev_type, tag, type_):
+                chunk_start, in_chunk = i, True
+        if in_chunk:
+            segments.append((chunk_start, len(labels) - 1, type_))
+        return segments
 
     def eval_batch(self, pred=None, label=None, lengths=None, **kw):
         p = np.asarray(pred)
@@ -296,9 +365,11 @@ class ChunkEvaluator(Evaluator):
         for i in range(p.shape[0]):
             pi = self._extract(p[i, : lens[i]].tolist())
             yi = self._extract(y[i, : lens[i]].tolist())
-            self.correct += len(pi & yi)
-            self.infer_total += len(pi)
-            self.label_total += len(yi)
+            keep = lambda seg: seg[2] not in self.excluded  # noqa: E731
+            self.correct += len(set(filter(keep, pi)) &
+                                set(filter(keep, yi)))
+            self.infer_total += sum(1 for s in pi if keep(s))
+            self.label_total += sum(1 for s in yi if keep(s))
 
     def finish(self):
         prec = self.correct / max(self.infer_total, 1)

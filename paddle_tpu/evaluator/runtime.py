@@ -301,7 +301,8 @@ def _instantiate(spec: EvaluatorSpec) -> ev_mod.Evaluator:
     if t == "chunk":
         return ev_mod.ChunkEvaluator(
             chunk_scheme=spec.field("chunk_scheme", "IOB"),
-            num_chunk_types=spec.field("num_chunk_types", 1))
+            num_chunk_types=spec.field("num_chunk_types", 1),
+            excluded_chunk_types=spec.field("excluded_chunk_types"))
     if t == "sum":
         return ev_mod.SumEvaluator()
     if t == "last-column-sum":
@@ -309,7 +310,7 @@ def _instantiate(spec: EvaluatorSpec) -> ev_mod.Evaluator:
     if t == "detection_map":
         return ev_mod.DetectionMAP(
             overlap_threshold=spec.field("overlap_threshold", 0.5),
-            background_id=spec.field("background_id", 0))
+            ap_version=spec.field("ap_type", "11point"))
     if t == "value_printer":
         return ev_mod.ValuePrinter(prefix=spec.name)
     if t == "gradient_printer":
@@ -382,9 +383,10 @@ class DeclaredEvaluators:
                     kw["weight"] = w
                 b.inst.eval_batch(**kw)
             elif t == "pnpair":
-                # declared input order: label, query_id, score[, weight]
-                kw = dict(score=_np(ins[2]), label=_np(ins[0]),
-                          query=_np(ins[1]))
+                # declared input order (ref Evaluator.cpp:880-887):
+                # score, label, info[, weight]
+                kw = dict(score=_np(ins[0]), label=_np(ins[1]),
+                          query=_np(ins[2]))
                 if len(ins) > 3:
                     kw["weight"] = _np(ins[3])
                 b.inst.eval_batch(**kw)

@@ -62,7 +62,8 @@ def build_argparser() -> argparse.ArgumentParser:
 
 
 def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
-                             topo=None, input_order=None):
+                             topo=None, input_order=None,
+                             drop_last: bool | None = None):
     """DataConfig(py2) -> batched paddle reader via the provider module.
     The provider's declared ``input_types`` override the data layers' dense
     placeholders (reference: types live in the provider, not the config)."""
@@ -77,6 +78,12 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
     reader = obj.make_reader(files)
     if shuffle and getattr(obj, "should_shuffle", True) is not False:
         reader = paddle.reader.shuffle(reader, buf_size=4096)
+    if drop_last is None:
+        # train (shuffle=True): tail flushes would emit non-pinned batch
+        # sizes and recompile every pass (shuffle reorders the tail).
+        # test: metrics must cover every sample, so flush tails — the tail
+        # shapes are deterministic so at most one extra compile per shape.
+        drop_last = shuffle
     calc = getattr(obj, "calc_batch_size", None)
     if calc is not None:
         # PyDataProvider2 dynamic-batch semantics: cost-balanced batches
@@ -85,12 +92,31 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
         from paddle_tpu.parallel.mesh import get_mesh
         from paddle_tpu.reader.decorator import bucket_batch
 
-        # drop_last: tail flushes would emit non-pinned batch sizes and
-        # recompile every pass (shuffle reorders the tail each time)
         return bucket_batch(reader, batch_size, calc_batch_size=calc,
                             size_multiple=get_mesh().num_replicas,
-                            drop_last=True)
-    return paddle.reader.batch(reader, batch_size=batch_size, drop_last=True)
+                            drop_last=drop_last)
+    batched = paddle.reader.batch(reader, batch_size=batch_size,
+                                  drop_last=drop_last)
+    if drop_last:
+        return batched
+    # tail batches must still divide the mesh data axis (shard_batch
+    # enforces batch % replicas == 0); trim like bucket_batch does
+    from paddle_tpu.parallel.mesh import get_mesh
+
+    m = get_mesh().num_replicas
+
+    def trimmed():
+        for b in batched():
+            if len(b) == batch_size:
+                # full batches pass through: a batch_size that doesn't
+                # divide the mesh is a config error shard_batch reports
+                yield b
+                continue
+            n = (len(b) // m) * m
+            if n:
+                yield b[:n]
+
+    return trimmed if m > 1 else batched
 
 
 def _add_config_dir_to_path(config_path: str) -> None:
@@ -143,7 +169,15 @@ def _build(parsed):
         get_settings_optimizer,
     )
 
-    topo = Topology(parsed.output_layers())
+    # evaluator inputs may name layers off the cost path (the reference's
+    # GradientMachine computes every configured layer, so evaluators can
+    # tap any of them) — keep those alive as extra topology roots
+    from paddle_tpu.layers.base import layer_registry
+
+    ev_names = {n for s in (getattr(parsed, "evaluators", None) or [])
+                for n in s.input_layers}
+    extra = [lo for lo in layer_registry() if lo.name in ev_names]
+    topo = Topology(parsed.output_layers(), extra_layers=extra)
     opt = get_settings_optimizer()
     from paddle_tpu.layers.data_type import InputType
 
@@ -151,6 +185,9 @@ def _build(parsed):
     order = [n for n in parsed.input_layer_names if n in data_layers]
     if not order:
         order = list(data_layers)
+    # data layers reached only via evaluator extra roots still need a feed
+    # slot (the provider yields fields for every configured data layer)
+    order += [n for n in data_layers if n not in order]
     types = [
         (n, InputType(data_layers[n].attrs.get("dim", data_layers[n].size),
                       data_layers[n].attrs.get("seq_type", 0),
@@ -184,6 +221,7 @@ def cmd_train(args, parsed) -> int:
 
     trainer = paddle.trainer.SGD(
         cost=topo.outputs, parameters=params, update_equation=opt,
+        extra_layers=topo.extra_layers,
         declared_evaluators=getattr(parsed, "evaluators", None))
 
     def on_event(event):
@@ -235,6 +273,7 @@ def cmd_test(args, parsed) -> int:
             params = paddle.parameters.Parameters.from_tar(f)
     trainer = paddle.trainer.SGD(
         cost=topo.outputs, parameters=params, update_equation=opt,
+        extra_layers=topo.extra_layers,
         declared_evaluators=getattr(parsed, "evaluators", None))
     result = trainer.test(reader=reader, feeding=feeding)
     print(f"Test cost {result.cost:.6f}, {result.metrics}")

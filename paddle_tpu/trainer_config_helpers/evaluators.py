@@ -63,10 +63,26 @@ def auc_evaluator(input, label, name=None, weight=None):
                           weight=weight, name=_name(name, "auc_evaluator"))
 
 
-def pnpair_evaluator(input, label, query_id, weight=None, name=None):
-    """≅ evaluators.py:297 (PnpairEvaluator; inputs label, query_id first)."""
-    inputs = [label, query_id, input] + ([weight] if weight is not None else [])
-    return evaluator_base(input=inputs, type="pnpair",
+def pnpair_evaluator(input, label, info=None, weight=None, name=None,
+                     query_id=None):
+    """≅ evaluators.py:295 (PnpairEvaluator).
+
+    Input order matches the reference's ``evalImp``
+    (Evaluator.cpp:880-887): [score, label, info, weight?].  ``query_id``
+    is accepted as an alias for ``info``.
+    """
+    if info is None:
+        info = query_id
+    if info is None:
+        raise TypeError("pnpair_evaluator requires an info (query id) layer")
+    if isinstance(input, (list, tuple)):
+        if len(input) != 1:
+            # the runtime (and the reference's evalImp, which reads
+            # arguments[0..3] positionally) require exactly one score input
+            raise ValueError("pnpair_evaluator takes a single score input")
+        input = input[0]
+    inputs = [input, label, info]
+    return evaluator_base(input=inputs, type="pnpair", weight=weight,
                           name=_name(name, "pnpair_evaluator"))
 
 

@@ -151,3 +151,173 @@ def test_seqtext_printer_plain_sequences(tmp_path):
     evs.finish()
     lines = out.read_text().splitlines()
     assert lines == ["0\t 3 1 2", "1\t 2 2"]
+
+def test_pnpair_evaluator_reference_order_and_result():
+    """pnpair inputs are declared [score, label, info, weight] like the
+    reference (evaluators.py:295 appends label then info;
+    Evaluator.cpp:880-887 reads output/label/info/weight in that order),
+    ``info`` is the reference parameter name, and the runtime maps the
+    indices accordingly."""
+    import numpy as np
+
+    from paddle_tpu.evaluator import declare, runtime
+    from paddle_tpu.trainer_config_helpers.evaluators import pnpair_evaluator
+
+    declare.reset()
+    spec = pnpair_evaluator(input="score", label="lab", info="qid",
+                            name="pn")
+    assert spec.input_layers == ["score", "lab", "qid"]
+    # query_id= kept as an alias for old callers
+    declare.reset()
+    spec2 = pnpair_evaluator(input="score", label="lab", query_id="qid")
+    assert spec2.input_layers == ["score", "lab", "qid"]
+
+    declare.reset()
+    pnpair_evaluator(input="score", label="lab", info="qid", name="pn")
+    evs = runtime.build(declare.collect())
+    evs.start()
+    # one query, one (pos, neg) pair ranked correctly -> pnpair accuracy 1
+    evs.eval_batch({
+        "score": np.asarray([[0.9], [0.1]], np.float32),
+        "lab": np.asarray([1, 0]),
+        "qid": np.asarray([7, 7]),
+    })
+    res = evs.finish()
+    vals = [v for v in res.values() if isinstance(v, (int, float))]
+    assert vals and any(abs(v - 1.0) < 1e-6 for v in vals), res
+
+
+def test_chunk_evaluator_excluded_types_and_iobes():
+    from paddle_tpu.evaluator import ChunkEvaluator
+
+    # IOB, 2 chunk types; exclude type 1 -> only type-0 chunks count
+    ev = ChunkEvaluator(chunk_scheme="IOB", num_chunk_types=2,
+                        excluded_chunk_types=[1])
+    # labels: tag = lab % 2, type = lab // 2, O = 4
+    # pred: [B0 I0 B1 I1] -> (0,1,0) and (2,3,1); label identical
+    ev.eval_batch(pred=[[0, 1, 2, 3]], label=[[0, 1, 2, 3]])
+    res = ev.finish()
+    assert res["F1-score"] == 1.0
+    assert ev.correct == 1 and ev.infer_total == 1 and ev.label_total == 1
+
+    # IOBES single-token chunk via S tag (tag ids B=0 I=1 E=2 S=3)
+    ev = ChunkEvaluator(chunk_scheme="IOBES", num_chunk_types=1)
+    # S0, O, B0 I0 E0  (O = 1 * 4 = 4)
+    ev.eval_batch(pred=[[3, 4, 0, 1, 2]], label=[[3, 4, 0, 1, 2]])
+    res = ev.finish()
+    assert res["F1-score"] == 1.0 and ev.correct == 2
+
+
+def test_column_sum_evaluator_last_column_mean():
+    """ColumnSumEvaluator reports sum[-1]/numSamples like the reference's
+    printStats (Evaluator.cpp:351-363)."""
+    import numpy as np
+
+    from paddle_tpu.evaluator import ColumnSumEvaluator
+
+    ev = ColumnSumEvaluator()
+    ev.eval_batch(value=np.asarray([[1.0, 2.0], [3.0, 4.0]]))
+    ev.eval_batch(value=np.asarray([[5.0, 6.0]]))
+    res = ev.finish()
+    (val,) = res.values()
+    assert abs(val - (2.0 + 4.0 + 6.0) / 3) < 1e-9
+
+    # weighted: numSamples is the weight sum (Evaluator.cpp:288-294)
+    ev = ColumnSumEvaluator()
+    ev.eval_batch(value=np.asarray([[2.0], [4.0]]),
+                  weight=np.asarray([1.0, 3.0]))
+    (val,) = ev.finish().values()
+    assert abs(val - (2.0 * 1 + 4.0 * 3) / 4.0) < 1e-9
+
+
+def test_precision_recall_positive_label_out_of_range():
+    import numpy as np
+    import pytest
+
+    from paddle_tpu.evaluator import PrecisionRecall
+
+    ev = PrecisionRecall(num_classes=None, positive_label=3)
+    ev.eval_batch(pred=np.asarray([[0.4, 0.6]]), label=np.asarray([1]))
+    with pytest.raises(ValueError, match="positive_label"):
+        ev.finish()
+
+
+def test_test_job_reader_keeps_tail_batches(tmp_path):
+    """The test job must evaluate every sample: _reader_from_data_config
+    flushes tail batches when shuffle=False (train still drops them to
+    keep batch shapes pinned)."""
+    import sys
+    import textwrap
+
+    from paddle_tpu.trainer.cli import _reader_from_data_config
+
+    (tmp_path / "tail_provider.py").write_text(textwrap.dedent("""
+        import numpy as np
+        from paddle.trainer.PyDataProvider2 import (
+            provider, dense_vector, integer_value)
+
+        @provider(input_types={'x': dense_vector(4),
+                               'y': integer_value(2)})
+        def process(settings, filename):
+            for i in range(10):
+                yield np.full((4,), float(i), np.float32), i % 2
+    """))
+    (tmp_path / "files.list").write_text("f0\n")
+    sys.path.insert(0, str(tmp_path))
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    prev = mesh_mod.get_mesh()
+    try:
+        rec = {"module": "tail_provider", "obj": "process",
+               "files": str(tmp_path / "files.list")}
+        # single-replica mesh: the test job covers every sample
+        mesh_mod.get_mesh({"data": 1})
+        test_batches = list(_reader_from_data_config(
+            rec, batch_size=4, shuffle=False)())
+        assert sum(len(b) for b in test_batches) == 10
+        train_batches = list(_reader_from_data_config(
+            rec, batch_size=4, shuffle=True)())
+        assert all(len(b) == 4 for b in train_batches)
+        # multi-replica mesh: tails are trimmed to the replica multiple so
+        # shard_batch's divisibility enforce can't fire (full batches of a
+        # user-chosen size pass through untouched)
+        mesh_mod.get_mesh({"data": 4})
+        test_batches = list(_reader_from_data_config(
+            rec, batch_size=8, shuffle=False)())
+        assert [len(b) for b in test_batches] == [8]
+    finally:
+        mesh_mod.set_mesh(prev)
+        sys.path.remove(str(tmp_path))
+
+
+def test_chunk_evaluator_padding_labels_are_O():
+    from paddle_tpu.evaluator import ChunkEvaluator
+
+    ev = ChunkEvaluator(chunk_scheme="IOB", num_chunk_types=1)
+    ev.eval_batch(pred=[[0, 1, 2, 2]], label=[[0, 1, -1, -1]])
+    res = ev.finish()
+    assert res["recall"] == 1.0 and ev.label_total == 1
+
+
+def test_pnpair_rejects_multi_input():
+    import pytest
+
+    from paddle_tpu.evaluator import declare
+    from paddle_tpu.trainer_config_helpers.evaluators import pnpair_evaluator
+
+    declare.reset()
+    with pytest.raises(ValueError, match="single score input"):
+        pnpair_evaluator(input=["a", "b"], label="l", info="q")
+
+
+def test_detection_map_instantiates_from_spec():
+    from paddle_tpu.evaluator import declare, runtime
+
+    declare.reset()
+    from paddle_tpu.trainer_config_helpers.evaluators import (
+        detection_map_evaluator,
+    )
+
+    detection_map_evaluator(input="det", label="gt", name="mAP")
+    evs = runtime.build(declare.collect())
+    assert evs.bound, "detection_map evaluator failed to instantiate"
