@@ -33,6 +33,26 @@ def param_dtype():
     return jnp.float32
 
 
+def dot_precision(*arrays):
+    """Per-call MXU precision for dots/convs/einsums on the compat surface.
+
+    With the ``bf16`` flag OFF (the default) and float32 operands, return
+    ``Precision.HIGHEST`` so the MXU computes true f32 passes — matching the
+    reference's f32 numerics (``paddle/math/Matrix.h:79``).  TPU's default
+    precision would silently round f32 operands through bf16.  With bf16
+    operands (the mixed-precision fast path) or the flag ON, return None
+    (single native MXU pass; HIGHEST on bf16 inputs can even break Mosaic
+    lowering inside pallas kernels).
+    """
+    import jax.lax
+
+    if flags.get("bf16"):
+        return None
+    if all(a.dtype == jnp.float32 for a in arrays if hasattr(a, "dtype")):
+        return jax.lax.Precision.HIGHEST
+    return None
+
+
 def cast_for_matmul(*arrays):
     """Cast operands to the compute dtype for the MXU.
 

@@ -112,4 +112,9 @@ define("loadsave_parameters_in_pserver", False, "kept for API compat; no-op on T
 define("rdma_tcp", "tcp", "kept for API compat; ICI/DCN is used on TPU")
 define("with_timer", False, "enable Stat timers (was: WITH_TIMER build flag)")
 define("debug_nans", False, "enable jax nan-checking (was: feenableexcept)")
-define("bf16", True, "compute in bfloat16 on the MXU where safe")
+# OFF by default: the reference computes f32 end to end
+# (paddle/math/Matrix.h:79 `real`), so unmodified configs must reproduce
+# its numerics.  Opt in via --bf16 / PADDLE_TPU_BF16=1 / flags.set, or —
+# preferred — an explicit mixed-precision policy (build_train_step's
+# compute_dtype / SGD(compute_dtype=bfloat16)), which bench.py uses.
+define("bf16", False, "force bfloat16 MXU compute for float32 operands")

@@ -436,14 +436,22 @@ class DetectionMAP(Evaluator):
     name = "detection_map"
 
     def __init__(self, overlap_threshold: float = 0.5,
-                 ap_version: str = "11point"):
+                 ap_version: str = "11point",
+                 evaluate_difficult: bool = False,
+                 background_id: int = 0):
         self.thr = overlap_threshold
         self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        # kept for config parity: the reference reads background_id into
+        # the evaluator but never consults it in evalImp
+        # (DetectionMAPEvaluator.cpp:44,293) — post-NMS detection output
+        # carries no background rows
+        self.background_id = background_id
         self.start()
 
     def start(self):
         self.dets: list = []   # (class, score, image_id, box)
-        self.gts: dict = {}    # (image_id, class) -> [boxes]
+        self.gts: dict = {}    # (image_id, class) -> [(box, difficult)]
         self.n_img = 0
 
     @staticmethod
@@ -468,8 +476,11 @@ class DetectionMAP(Evaluator):
             for row in gt_rows:
                 if row[0] < 0:
                     continue
+                # 6th column, when present, is the VOC difficult flag
+                # (getBBoxFromLabelData reads 6 fields per row)
+                difficult = bool(row[5]) if len(row) > 5 else False
                 self.gts.setdefault((img, int(row[0])), []).append(
-                    np.asarray(row[1:5], np.float64))
+                    (np.asarray(row[1:5], np.float64), difficult))
 
     def _ap(self, recalls, precisions):
         if self.ap_version == "11point":
@@ -490,7 +501,12 @@ class DetectionMAP(Evaluator):
                          {c for _, c in self.gts})
         aps = []
         for c in classes:
-            n_gt = sum(len(v) for (img, cc), v in self.gts.items() if cc == c)
+            # positives exclude difficult gts unless evaluate_difficult
+            # (DetectionMAPEvaluator.cpp:106-116)
+            n_gt = sum(
+                sum(1 for _, diff in v
+                    if self.evaluate_difficult or not diff)
+                for (img, cc), v in self.gts.items() if cc == c)
             dets = sorted([d for d in self.dets if d[0] == c],
                           key=lambda d: -d[1])
             if n_gt == 0:
@@ -498,22 +514,29 @@ class DetectionMAP(Evaluator):
             used: dict = {}
             tp = np.zeros(len(dets))
             fp = np.zeros(len(dets))
+            keep = np.ones(len(dets), bool)
             for i, (_, score, img, box) in enumerate(dets):
                 cand = self.gts.get((img, c), [])
                 # VOC rule: only the single max-overlap gt counts; if it is
                 # already claimed by a higher-scoring detection, this is FP
                 best, best_iou = -1, 0.0
-                for j, g in enumerate(cand):
+                for j, (g, _diff) in enumerate(cand):
                     iou = self._iou(box, g)
                     if iou > best_iou:
                         best, best_iou = j, iou
-                if best >= 0 and best_iou > self.thr and (
-                        img, c, best) not in used:
-                    tp[i] = 1
-                    used[(img, c, best)] = True
+                if best >= 0 and best_iou > self.thr:
+                    if not self.evaluate_difficult and cand[best][1]:
+                        # matched a difficult gt: neither TP nor FP
+                        # (DetectionMAPEvaluator.cpp:184-185)
+                        keep[i] = False
+                    elif (img, c, best) not in used:
+                        tp[i] = 1
+                        used[(img, c, best)] = True
+                    else:
+                        fp[i] = 1
                 else:
                     fp[i] = 1
-            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            ctp, cfp = np.cumsum(tp[keep]), np.cumsum(fp[keep])
             recalls = ctp / n_gt
             precisions = ctp / np.maximum(ctp + cfp, 1e-10)
             aps.append(self._ap(recalls, precisions))

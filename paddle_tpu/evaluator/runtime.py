@@ -310,7 +310,9 @@ def _instantiate(spec: EvaluatorSpec) -> ev_mod.Evaluator:
     if t == "detection_map":
         return ev_mod.DetectionMAP(
             overlap_threshold=spec.field("overlap_threshold", 0.5),
-            ap_version=spec.field("ap_type", "11point"))
+            ap_version=spec.field("ap_type", "11point"),
+            evaluate_difficult=bool(spec.field("evaluate_difficult", False)),
+            background_id=spec.field("background_id", 0))
     if t == "value_printer":
         return ev_mod.ValuePrinter(prefix=spec.name)
     if t == "gradient_printer":

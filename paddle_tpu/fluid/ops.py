@@ -28,6 +28,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dt
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
@@ -147,7 +149,7 @@ def _matmul(ins, attrs, rng):
         x = jnp.swapaxes(x, -1, -2)
     if attrs.get("transpose_Y"):
         y = jnp.swapaxes(y, -1, -2)
-    return {"Out": [jnp.matmul(x, y)]}
+    return {"Out": [jnp.matmul(x, y, precision=dt.dot_precision(x, y))]}
 
 
 def _bcast_y(x, y, axis):
@@ -412,7 +414,8 @@ def _conv2d(ins, attrs, rng):
         padding=[(pad[0], pad[0]), (pad[1], pad[1])],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32,
+        precision=dt.dot_precision(x, w))
     return {"Output": [out]}
 
 
@@ -688,7 +691,8 @@ def _conv_shift_op(ins, attrs, rng):
     m = y.shape[-1] // 2
     idx = (jnp.arange(x.shape[-1])[:, None]
            + jnp.arange(-m, m + 1)[None, :]) % x.shape[-1]
-    return {"Out": [jnp.einsum("bnk,bk->bn", x[:, idx], y)]}
+    return {"Out": [jnp.einsum("bnk,bk->bn", x[:, idx], y,
+                               precision=dt.dot_precision(x, y))]}
 
 
 @register_op("fill_constant_batch_size_like")
@@ -1294,7 +1298,8 @@ def _conv3d(ins, attrs, rng):
         padding=[(p, p) for p in pad],
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32,
+        precision=dt.dot_precision(x, w))
     return {"Output": [out]}
 
 

@@ -21,6 +21,8 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dt
+
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import NestedSequenceBatch, SequenceBatch
@@ -879,7 +881,8 @@ def cos_sim(a: LayerOutput, b: LayerOutput, scale=1, size: int = 1,
         if size > 1:
             va = raw(xa)
             vb = raw(xb).reshape(va.shape[0], size, -1)
-            dots = jnp.einsum("bd,bsd->bs", va, vb)
+            dots = jnp.einsum("bd,bsd->bs", va, vb,
+                              precision=dt.dot_precision(va, vb))
             na = jnp.linalg.norm(va, axis=-1, keepdims=True)
             nb = jnp.linalg.norm(vb, axis=-1)
             return scale * dots / jnp.maximum(na * nb, 1e-12)

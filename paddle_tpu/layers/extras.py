@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dt
+
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import SequenceBatch
@@ -155,7 +157,8 @@ def out_prod(input1: LayerOutput, input2: LayerOutput,
 
     def fwd(ctx, params, states, a, b):
         av, bv = raw(a), raw(b)
-        return jnp.einsum("bi,bj->bij", av, bv).reshape(av.shape[0], -1)
+        return jnp.einsum("bi,bj->bij", av, bv,
+                          precision=dt.dot_precision(av, bv)).reshape(av.shape[0], -1)
 
     return LayerOutput(name=name, layer_type="out_prod",
                        size=input1.size * input2.size,
@@ -176,7 +179,8 @@ def linear_comb(weights: LayerOutput, vectors: LayerOutput,
 
     def fwd(ctx, params, states, w, v):
         wv, vv = raw(w), raw(v)
-        return jnp.einsum("bm,bmn->bn", wv, vv.reshape(-1, m, size))
+        return jnp.einsum("bm,bmn->bn", wv, vv.reshape(-1, m, size),
+                          precision=dt.dot_precision(wv, vv))
 
     return LayerOutput(name=name, layer_type="convex_comb", size=size,
                        parents=(weights, vectors), fn=fwd)

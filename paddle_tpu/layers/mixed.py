@@ -28,6 +28,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dt
+
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import SequenceBatch
@@ -380,13 +382,15 @@ def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
                              (g["padding"], g["padding"])),
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
                     transpose_kernel=True,
+                    precision=dt.dot_precision(x, k),
                 )
                 return like(vimg, out.transpose(0, 3, 1, 2).reshape(out.shape[0], -1))
             out = jax.lax.conv_general_dilated(
                 x, k, window_strides=(g["stride_y"], g["stride"]),
                 padding=((g["padding_y"], g["padding_y"]),
                          (g["padding"], g["padding"])),
-                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                precision=dt.dot_precision(x, k))
             return like(vimg, out.reshape(out.shape[0], -1))
 
         return fn

@@ -9,6 +9,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dt
+
 from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import NestedSequenceBatch, SequenceBatch
@@ -75,7 +77,8 @@ def tensor_layer(a: LayerOutput, b: LayerOutput, size: int, act=None,
     activation = act_mod.get(act) if act is not None else act_mod.LinearActivation()
 
     def fwd(ctx, params, states, xa, xb):
-        y = jnp.einsum("bm,imn,bn->bi", raw(xa), params[w.name], raw(xb))
+        y = jnp.einsum("bm,imn,bn->bi", raw(xa), params[w.name], raw(xb),
+                       precision=dt.dot_precision(raw(xa), params[w.name]))
         if use_bias:
             y = y + params[bspec.name]
         return activation(y)
@@ -187,7 +190,8 @@ def conv_shift(a: LayerOutput, b: LayerOutput,
         m = vb.shape[-1] // 2
         idx = (jnp.arange(va.shape[-1])[:, None]
                + jnp.arange(-m, m + 1)[None, :]) % va.shape[-1]
-        return jnp.einsum("bnk,bk->bn", va[:, idx], vb)
+        return jnp.einsum("bnk,bk->bn", va[:, idx], vb,
+                          precision=dt.dot_precision(va, vb))
 
     return LayerOutput(name=name, layer_type="conv_shift", size=a.size,
                        parents=(a, b), fn=fwd)
@@ -460,12 +464,14 @@ def img_conv3d(input: LayerOutput, filter_size, num_filters: int,
                 padding=[(kd - 1 - pd,) * 2, (kh - 1 - ph,) * 2,
                          (kw - 1 - pw,) * 2],
                 dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-                transpose_kernel=True)
+                transpose_kernel=True,
+                precision=dt.dot_precision(v, params[w.name]))
         else:
             y = _lax.conv_general_dilated(
                 v, params[w.name], window_strides=(sd, sh, sw),
                 padding=[(pd, pd), (ph, ph), (pw, pw)],
-                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                precision=dt.dot_precision(v, params[w.name]))
         if use_bias:
             y = y + params[b.name]
         return activation(y)

@@ -196,6 +196,7 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
     ``decoder_state`` is a memory."""
     import jax.numpy as jnp
 
+    from paddle_tpu.core import dtype as dt
     from paddle_tpu.core import initializer as I
     from paddle_tpu.layers.api import _wspec
     from paddle_tpu.layers.base import LayerOutput, gen_name
@@ -218,7 +219,8 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
         scores = jnp.where(mask > 0, scores, -1e9)
         attn = jnp.exp(scores - scores.max(axis=1, keepdims=True)) * mask
         attn = attn / jnp.clip(attn.sum(axis=1, keepdims=True), 1e-9)
-        return jnp.einsum("bt,btd->bd", attn, enc_seq.data)
+        return jnp.einsum("bt,btd->bd", attn, enc_seq.data,
+                          precision=dt.dot_precision(attn, enc_seq.data))
 
     return LayerOutput(
         name=name, layer_type="simple_attention", size=encoded_sequence.size,

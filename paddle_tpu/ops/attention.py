@@ -19,6 +19,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dt
 from jax import lax
 
 NEG_INF = -1e30
@@ -40,10 +42,12 @@ def dot_product_attention(
     """Exact attention — the reference small-T path; XLA fuses QK^T+softmax+PV."""
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        precision=dt.dot_precision(q, k)) * scale
     scores = _apply_mask(scores, mask)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                      precision=dt.dot_precision(probs, v))
 
 
 def causal_mask(t_q: int, t_k: int, q_offset=0, k_offset=0) -> jax.Array:
@@ -56,13 +60,15 @@ def causal_mask(t_q: int, t_k: int, q_offset=0, k_offset=0) -> jax.Array:
 def _block_update(carry, k_blk, v_blk, q, scale, mask_blk):
     """One online-softmax accumulation step (the flash-attention recurrence)."""
     acc, m, l = carry  # [B,H,Tq,D], [B,H,Tq], [B,H,Tq]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # [B,H,Tq,Tk_blk]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   precision=dt.dot_precision(q, k_blk)) * scale  # [B,H,Tq,Tk_blk]
     s = _apply_mask(s, mask_blk)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     p = jnp.exp(s - m_new[..., None])
     correction = jnp.exp(m - m_new)
     l_new = l * correction + jnp.sum(p, axis=-1)
-    acc_new = acc * correction[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk, precision=dt.dot_precision(p, v_blk))
     return acc_new, m_new, l_new
 
 

@@ -8,6 +8,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dt
+
 
 def cross_entropy(probs: jax.Array, label: jax.Array, eps: float = 1e-10) -> jax.Array:
     """-log p[label] with integer labels (≅ MultiClassCrossEntropy).
@@ -129,7 +131,8 @@ def nce_loss(
         log_noise_pos = jnp.log(float(k)) + logq[label]
         log_noise_neg = jnp.log(float(k)) + logq[noise_ids]
     pos_logit = jnp.sum(embed * w[label], axis=-1) + b[label]
-    neg_logit = jnp.einsum("bd,bkd->bk", embed, w[noise_ids]) + b[noise_ids]
+    neg_logit = jnp.einsum("bd,bkd->bk", embed, w[noise_ids],
+                           precision=dt.dot_precision(embed, w)) + b[noise_ids]
     pos_loss = jax.nn.softplus(-(pos_logit - log_noise_pos))
     neg_loss = jax.nn.softplus(neg_logit - log_noise_neg)
     return pos_loss + jnp.sum(neg_loss, axis=-1)

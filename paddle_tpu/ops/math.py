@@ -18,7 +18,8 @@ def matmul(a: jax.Array, b: jax.Array, transpose_a=False, transpose_b=False) -> 
         b = jnp.swapaxes(b, -1, -2)
     out_dtype = jnp.promote_types(a.dtype, b.dtype)
     a, b = dt.cast_for_matmul(a, b)
-    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32,
+                      precision=dt.dot_precision(a, b)).astype(out_dtype)
 
 
 def linear(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:

@@ -51,6 +51,7 @@ def conv2d(
         rhs_dilation=dilation,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
+        precision=dt.dot_precision(x, w),
     )
     return y.astype(out_dtype)
 
@@ -77,6 +78,7 @@ def conv2d_transpose(
         padding=[(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         transpose_kernel=True,
+        precision=dt.dot_precision(x, w),
     )
     return y.astype(out_dtype)
 

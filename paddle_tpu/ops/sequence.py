@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dt
+
 from paddle_tpu.core.lod import SequenceBatch
 
 
@@ -142,7 +144,10 @@ def seq_concat(a: SequenceBatch, b: SequenceBatch) -> SequenceBatch:
         a.data.dtype
     ) * bm[:, :, None]
     bdata = b.data.reshape(b.batch_size, tb, -1)
-    scattered = jnp.einsum("bto,btd->bod", onehot, bdata).reshape((a.batch_size, t_out) + d)
+    scattered = jnp.einsum(
+        "bto,btd->bod", onehot, bdata,
+        precision=dt.dot_precision(onehot, bdata),
+    ).reshape((a.batch_size, t_out) + d)
     return SequenceBatch(data=out + scattered, length=a.length + b.length)
 
 
@@ -155,7 +160,10 @@ def seq_slice(x: SequenceBatch, starts: jax.Array, ends: jax.Array) -> SequenceB
         x.data.dtype
     )
     flat = x.data.reshape(x.batch_size, t, -1)
-    gathered = jnp.einsum("bto,bod->btd", onehot, flat).reshape(x.data.shape)
+    gathered = jnp.einsum(
+        "bto,bod->btd", onehot, flat,
+        precision=dt.dot_precision(onehot, flat),
+    ).reshape(x.data.shape)
     new_len = jnp.clip(ends - starts, 0, t)
     return SequenceBatch(data=gathered, length=new_len)
 

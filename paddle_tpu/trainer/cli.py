@@ -106,6 +106,7 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
     m = get_mesh().num_replicas
 
     def trimmed():
+        dropped = 0
         for b in batched():
             if len(b) == batch_size:
                 # full batches pass through: a batch_size that doesn't
@@ -113,8 +114,14 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
                 yield b
                 continue
             n = (len(b) // m) * m
+            dropped += len(b) - n
             if n:
                 yield b[:n]
+        if dropped:
+            from paddle_tpu.core import logger as log
+
+            log.info("test reader: dropped %d tail samples not divisible "
+                     "by the %d-replica mesh", dropped, m)
 
     return trimmed if m > 1 else batched
 
@@ -344,9 +351,11 @@ def cmd_checkgrad(args, parsed) -> int:
     (≅ Trainer::checkGradient, Trainer.cpp:332)."""
     import jax
 
-    # finite differences need more mantissa than the training dtype; all
-    # three globals are restored before returning (cli.main may be called
-    # in-process)
+    # finite differences need more mantissa than the training dtype; the
+    # globals are restored before returning (cli.main may be called
+    # in-process).  A user-set --bf16 is also suspended: central
+    # differences with eps=1e-3 on a bf16-rounded function would fail
+    # every parameter spuriously.
     from paddle_tpu.core import flags as _flags
 
     prev_x64 = jax.config.jax_enable_x64
@@ -354,7 +363,7 @@ def cmd_checkgrad(args, parsed) -> int:
     prev_bf16 = _flags.get("bf16")
     jax.config.update("jax_enable_x64", True)
     jax.config.update("jax_default_matmul_precision", "highest")
-    _flags.set("bf16", False)  # keep the MXU cast out of the check
+    _flags.set("bf16", False)
     import jax.numpy as jnp
 
     import paddle_tpu as paddle

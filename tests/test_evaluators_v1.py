@@ -321,3 +321,28 @@ def test_detection_map_instantiates_from_spec():
     detection_map_evaluator(input="det", label="gt", name="mAP")
     evs = runtime.build(declare.collect())
     assert evs.bound, "detection_map evaluator failed to instantiate"
+
+
+def test_detection_map_difficult_gts():
+    """evaluate_difficult=False: difficult gts neither count as positives
+    nor turn their matched detections into FPs
+    (DetectionMAPEvaluator.cpp:106-116,184-185)."""
+    from paddle_tpu.evaluator import DetectionMAP
+
+    dets = [[[0, 0.9, 0, 0, 10, 10], [0, 0.8, 20, 20, 30, 30]]]
+    gts = [[[0, 0, 0, 10, 10, 1],      # difficult, matched by det 1
+            [0, 20, 20, 30, 30, 0]]]   # normal, matched by det 2
+    ev = DetectionMAP(evaluate_difficult=False)
+    ev.eval_batch(detections=dets, gts=gts)
+    assert ev.finish()["detection_map"] == 1.0  # 1 positive, 1 TP
+
+    ev = DetectionMAP(evaluate_difficult=True)
+    ev.eval_batch(detections=dets, gts=gts)
+    assert ev.finish()["detection_map"] == 1.0  # 2 positives, 2 TPs
+
+    # unmatched difficult gt must not hurt recall
+    ev = DetectionMAP(evaluate_difficult=False)
+    ev.eval_batch(
+        detections=[[[0, 0.9, 0, 0, 10, 10]]],
+        gts=[[[0, 0, 0, 10, 10, 0], [0, 50, 50, 60, 60, 1]]])
+    assert ev.finish()["detection_map"] == 1.0

@@ -63,13 +63,7 @@ def test_seq_expand_and_concat(rng_np):
 
 
 def test_lstm_gru_ops_match_cells(rng_np):
-    from paddle_tpu.core import flags
-
-    flags.set("bf16", False)  # exact f32 comparisons below
-    try:
-        _lstm_gru_case(rng_np)
-    finally:
-        flags.set("bf16", True)
+    _lstm_gru_case(rng_np)  # exact f32 comparisons (f32 is the default)
 
 
 def _lstm_gru_case(rng_np):

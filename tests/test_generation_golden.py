@@ -63,16 +63,10 @@ def test_rnn_generation_matches_reference_golden(tmp_path, beam):
         "sent_id": np.arange(batch, dtype=np.float32).reshape(batch, 1),
         "dummy_data_input": rng.uniform(size=(batch, 2)).astype(np.float32),
     }
-    # the reference computes in f32; the default bf16 MXU policy rounds the
-    # -0.2 transition score to -0.200195 (the beam file prints scores)
-    from paddle_tpu.core import flags
-    prev = flags.get("bf16")
-    flags.set("bf16", False)
-    try:
-        values, _ = topo.forward(params, topo.init_states(), feed, False,
-                                 jax.random.key(0))
-    finally:
-        flags.set("bf16", prev)
+    # the reference computes in f32, which is now the default policy (the
+    # bf16 MXU cast would round the -0.2 transition score to -0.200195)
+    values, _ = topo.forward(params, topo.init_states(), feed, False,
+                             jax.random.key(0))
 
     # the declared seqtext printer, redirected to tmp and the absolute
     # dict path (the conf assumes cwd == reference/paddle)
@@ -127,13 +121,10 @@ def test_nested_rnn_generation_matches_reference_golden(tmp_path, beam):
             seq_length=np.asarray([n_sub], np.int32),
             sub_length=np.ones((1, n_sub), np.int32)),
     }
-    prev = flags.get("bf16")
-    flags.set("bf16", False)
-    try:
-        values, _ = topo.forward(params, topo.init_states(), feed, False,
-                                 jax.random.key(0))
-    finally:
-        flags.set("bf16", prev)
+    # the reference computes in f32, which is now the default policy (the
+    # bf16 MXU cast would round the -0.2 transition score to -0.200195)
+    values, _ = topo.forward(params, topo.init_states(), feed, False,
+                             jax.random.key(0))
 
     specs = parsed.evaluators
     assert len(specs) == 1 and specs[0].type == "seq_text_printer"

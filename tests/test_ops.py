@@ -8,21 +8,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from paddle_tpu.core import flags
 from paddle_tpu.core.lod import SequenceBatch, from_ragged
 from paddle_tpu.ops import loss as L
 from paddle_tpu.ops import math as M
 from paddle_tpu.ops import nn as N
 from paddle_tpu.ops import rnn as R
 from paddle_tpu.ops import sequence as S
-
-
-@pytest.fixture(autouse=True)
-def f32_compute():
-    """Numeric comparisons want f32 matmuls."""
-    flags.set("bf16", False)
-    yield
-    flags.set("bf16", True)
 
 
 def numeric_grad(f, x, eps=1e-3):
