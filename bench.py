@@ -65,6 +65,23 @@ def _two_point(step_fn, warmup=3, n1=5, n2=25):
         return _wall_two_point(step_fn, warmup=warmup, n1=n1, n2=n2)
 
 
+def _utilization(step_fn):
+    """Ceiling-relative utilization for a bench row: MFU vs bf16 peak and
+    op-level byte throughput vs the STREAM-calibrated HBM ceiling of this
+    chip (661-673 GB/s, BENCHMARKS.md).  hbm_pct > 100 means the op-level
+    byte count exceeds physical HBM bandwidth — operands are being re-read
+    from VMEM/fused buffers, i.e. the workload is latency-bound, not
+    HBM-bound."""
+    try:
+        from tools.xprof import measure_utilization
+
+        u = measure_utilization(step_fn)
+        return {"mfu_pct": u["mfu_pct"], "achieved_gbps": u["gbps"],
+                "hbm_pct": u["hbm_pct"]}
+    except Exception as e:  # keep the row alive without utilization
+        return {"util_error": f"{type(e).__name__}: {e}"[:100]}
+
+
 def _topology_step(cost_fn, feed_fn, optimizer=None, compute_dtype=None,
                    lr=0.01):
     """Generic jitted-train-step closure for a v2-layer-API model: builds
@@ -209,6 +226,7 @@ def bench_lstm(records):
             "metric": f"lstm_text_train_ms_per_batch_h{h}_bs{bs}",
             "value": round(ms, 3), "unit": "ms",
             "vs_baseline": round(k40[h] / ms, 2),
+            **_utilization(step),
         })
 
 
@@ -241,6 +259,7 @@ def bench_nmt(records):
         "value": round(bs / ms * 1000.0, 1), "unit": "seq/s",
         "config": f"vocab {vocab}, dim 512, len {tlen}, bs {bs}, bf16 mixed precision",
         "vs_baseline": 0,
+        **_utilization(step),
     })
 
 
@@ -278,6 +297,7 @@ def bench_ctr(records):
         "value": round(bs / ms * 1000.0, 0), "unit": "ex/s",
         "config": f"wide {wide_dim}, 8x1k vocab emb64, bs {bs}, bf16 mixed precision",
         "vs_baseline": 0,
+        **_utilization(step),
     })
 
 
@@ -311,6 +331,7 @@ def bench_crnn(records):
         "value": round(bs / ms * 1000.0, 0), "unit": "samples/s",
         "config": f"32x96 conv+BiLSTM+CTC, bs {bs}, bf16 mixed precision",
         "vs_baseline": 0,
+        **_utilization(step),
     })
 
 

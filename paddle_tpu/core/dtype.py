@@ -62,10 +62,22 @@ def cast_for_matmul(*arrays):
     dt = compute_dtype()
     if dt == jnp.float32:
         # respect the caller's dtype, but still unify mixed operands
-        # (lax.conv requires matching dtypes)
-        common = arrays[0].dtype
-        for a in arrays[1:]:
-            common = jnp.promote_types(common, a.dtype)
+        # (lax.conv requires matching dtypes).  Mixed f32/bf16 pairs only
+        # occur under an explicit mixed-precision policy (f32 boot states
+        # or BN stats meeting policy-cast bf16 weights), so resolve to the
+        # NARROWEST float — promoting to f32 would silently demote the
+        # policy to 6-pass HIGHEST matmuls (measured 2x on the NMT scan).
+        dtypes = [a.dtype for a in arrays]
+        narrow = {d for d in (jnp.float16, jnp.bfloat16) if d in dtypes}
+        if len(narrow) == 1:
+            common = next(iter(narrow))
+        else:
+            # no narrow dtype -> plain promotion; BOTH f16 and bf16 ->
+            # promotion too (f32): neither contains the other, and casting
+            # bf16's f32-like exponent range into f16 overflows
+            common = dtypes[0]
+            for d in dtypes[1:]:
+                common = jnp.promote_types(common, d)
         out = tuple(a.astype(common) if a.dtype != common else a
                     for a in arrays)
         return out if len(out) > 1 else out[0]

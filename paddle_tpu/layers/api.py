@@ -1307,6 +1307,14 @@ def lstmemory(input: LayerOutput, reverse: bool = False, act=None,
             h=jnp.zeros((b_, d), jnp.float32), c=jnp.zeros((b_, d), jnp.float32)
         )
 
+        # standard activations -> fused Pallas sequence kernel (peepholes
+        # included); exotic activations keep the lax.scan cell
+        if ga.name == "sigmoid" and sa.name == "tanh" and oa.name == "tanh":
+            out, _ = rnn_ops.lstm_fused(
+                SequenceBatch(xw, x.length), params[wspec.name], init,
+                peephole=peep, reverse=reverse)
+            return out
+
         def step(state, xt):
             return rnn_ops.lstm_cell(
                 xt, state, params[wspec.name], ga, sa, out_act=oa, peephole=peep
@@ -1350,6 +1358,13 @@ def grumemory(input: LayerOutput, reverse: bool = False, act=None,
             xw = xw + params[bspec.name]
         init = jnp.zeros((b_, d), jnp.float32)
         w = params[wspec.name]
+
+        # standard activations -> fused Pallas sequence kernel
+        if ga.name == "sigmoid" and sa.name == "tanh":
+            out, _ = rnn_ops.gru_fused(
+                SequenceBatch(xw, x.length), w[:, : 2 * d], w[:, 2 * d:],
+                init, reverse=reverse)
+            return out
 
         def step(h, xt):
             return rnn_ops.gru_cell(xt, h, w[:, : 2 * d], w[:, 2 * d:], ga, sa)

@@ -49,21 +49,21 @@ def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array,
     prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
     can_skip = (ext != blank) & (ext != prev2)  # [B, S]
 
-    # emission log-prob of each extended label at each time
-    # gather per-time: do it inside the scan to save memory
-    alpha0 = jnp.full((bsz, s), NEG_INF)
-    lp0 = log_probs[:, 0, :]
-    a00 = jnp.take_along_axis(lp0, ext[:, 0:1], axis=1)[:, 0]
-    a01 = jnp.where(
-        label_lengths > 0,
-        jnp.take_along_axis(lp0, ext[:, 1:2], axis=1)[:, 0],
-        NEG_INF)
-    alpha0 = alpha0.at[:, 0].set(a00).at[:, 1].set(a01)
+    # emission log-probs for EVERY (t, s) in one vectorized gather OUTSIDE
+    # the scan, so the loop body is elementwise only.  A per-step
+    # take_along_axis puts a serialized [B, V] scatter-add in the backward
+    # — measured ~45 µs/scan-step on a v5e, 70% of the whole CRNN train
+    # step; hoisted, the backward is one big scatter over [B, T, V].
+    emit_all = jnp.take_along_axis(
+        log_probs, jnp.broadcast_to(ext[:, None, :], (bsz, t_max, s)),
+        axis=2)  # [B, T, S]
 
-    def step(alpha, t):
-        lpt = jax.lax.dynamic_index_in_dim(log_probs, t, axis=1,
-                                           keepdims=False)  # [B, V]
-        emit = jnp.take_along_axis(lpt, ext, axis=1)  # [B, S]
+    alpha0 = jnp.full((bsz, s), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit_all[:, 0, 0]).at[:, 1].set(
+        jnp.where(label_lengths > 0, emit_all[:, 0, 1], NEG_INF))
+
+    def step(alpha, inputs):
+        emit, t = inputs  # [B, S], scalar time index
         stay = alpha
         from1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
                         constant_values=NEG_INF)
@@ -76,8 +76,10 @@ def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array,
         active = (t < input_lengths)[:, None]
         return jnp.where(active, new, alpha), None
 
-    alpha, _ = jax.lax.scan(step, alpha0,
-                            jnp.arange(1, t_max, dtype=jnp.int32))
+    alpha, _ = jax.lax.scan(
+        step, alpha0,
+        (jnp.swapaxes(emit_all[:, 1:], 0, 1),
+         jnp.arange(1, t_max, dtype=jnp.int32)))
 
     # final prob: last blank + last label of the extended sequence
     idx_last = 2 * label_lengths  # [B] position of final blank

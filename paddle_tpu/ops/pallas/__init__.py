@@ -29,3 +29,20 @@ def round_up(x: int, m: int) -> int:
 from paddle_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
 
 __all__ = ["flash_attention", "default_interpret", "NEG_INF", "round_up"]
+
+
+def mxu_precision(ref):
+    """Precision for a kernel-internal dot: true-f32 MXU passes for f32
+    refs (the compat surface), native single pass for bf16."""
+    import jax.lax
+    import jax.numpy as jnp
+
+    return (jax.lax.Precision.HIGHEST
+            if ref.dtype == jnp.float32 else None)
+
+
+def time_major_mask(mask):
+    """[B, T] -> [T, B, 1] f32, the kernels' freeze-mask layout."""
+    import jax.numpy as jnp
+
+    return jnp.swapaxes(mask, 0, 1)[:, :, None].astype(jnp.float32)

@@ -1,0 +1,217 @@
+"""Fused GRU sequence kernel (Pallas TPU) — sibling of
+``ops/pallas/lstm.py`` for the reference's GRU hand-kernel class
+(``paddle/cuda/include/hl_gpu_gru.cuh:28`` ``KeGruForwardUnit``).
+
+Same design as the LSTM kernel: grid=(T,) iterates sequentially with the
+recurrent weights (w_h [D, 2D] gates + w_hc [D, D] candidate — 3D² total,
+smaller than LSTM's 4D²) resident in VMEM and h carried in scratch; the
+dW_h / dW_hc contractions run OUTSIDE as single large MXU matmuls.
+
+Cell (reference hl_gpu_gru frameOutput semantics, = ``ops/rnn.gru_cell``):
+    u, r = sigmoid(xw[:, :2D] + h @ w_h)
+    c    = tanh(xw[:, 2D:] + (r * h) @ w_hc)
+    h'   = u * h + (1 - u) * c
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas import (mxu_precision as _prec,
+                                   time_major_mask as _mask3)
+
+
+def _fwd_kernel(xw_ref, mask_ref, wh_ref, whc_ref, h0_ref,
+                hs_ref, urc_ref, hT_ref, h_scr, *, d):
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = h0_ref[...].astype(h_scr.dtype)
+
+    h = h_scr[...]
+    hf = h.astype(jnp.float32)
+    ur = xw_ref[0][:, :2 * d] + jnp.dot(
+        h, wh_ref[...], preferred_element_type=jnp.float32,
+        precision=_prec(wh_ref))
+    u = jax.nn.sigmoid(ur[:, :d])
+    r = jax.nn.sigmoid(ur[:, d:])
+    rh = (r * hf).astype(whc_ref.dtype)
+    c = jnp.tanh(xw_ref[0][:, 2 * d:] + jnp.dot(
+        rh, whc_ref[...], preferred_element_type=jnp.float32,
+        precision=_prec(whc_ref)))
+    h_new = u * hf + (1.0 - u) * c
+    m = mask_ref[0]  # [B, 1]
+    h_new = m * h_new + (1.0 - m) * hf
+
+    h_scr[...] = h_new.astype(h_scr.dtype)
+    hs_ref[0] = h_new.astype(hs_ref.dtype)
+    urc_ref[0] = jnp.concatenate([u, r, c], axis=-1).astype(urc_ref.dtype)
+
+    @pl.when(t == nt - 1)
+    def _final():
+        hT_ref[...] = h_new.astype(hT_ref.dtype)
+
+
+def _bwd_kernel(mask_ref, wh_ref, whc_ref, urc_ref, hs_prev_ref,
+                dhs_ref, dhT_ref,
+                dxw_ref, dh0_ref, dh_scr, *, d):
+    """Reverse-time (index maps run t = T-1 .. 0)."""
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        dh_scr[...] = dhT_ref[...]
+
+    m = mask_ref[0]
+    dh = dh_scr[...] + dhs_ref[0].astype(jnp.float32)
+
+    urc = urc_ref[0].astype(jnp.float32)
+    u = urc[:, 0 * d:1 * d]
+    r = urc[:, 1 * d:2 * d]
+    c = urc[:, 2 * d:3 * d]
+    h_prev = hs_prev_ref[0].astype(jnp.float32)
+
+    # h' = u*h + (1-u)*c, all grads masked (frozen rows pass dh through)
+    du = dh * (h_prev - c) * u * (1.0 - u) * m        # = dpre_u
+    dcand = dh * (1.0 - u) * m
+    dpre_c = dcand * (1.0 - c * c)
+    # (r*h) branch through w_hc
+    drh = jnp.dot(dpre_c.astype(whc_ref.dtype), whc_ref[...].T,
+                  preferred_element_type=jnp.float32,
+                  precision=_prec(whc_ref))
+    dr = drh * h_prev * r * (1.0 - r)                 # = dpre_r
+    dur = jnp.concatenate([du, dr], axis=-1)
+    dh_prev = (dh * u * m
+               + drh * r
+               + jnp.dot(dur.astype(wh_ref.dtype), wh_ref[...].T,
+                         preferred_element_type=jnp.float32,
+                         precision=_prec(wh_ref)))
+    dxw_ref[0] = jnp.concatenate([dur, dpre_c], axis=-1).astype(
+        dxw_ref.dtype)
+    dh_scr[...] = dh_prev + (1.0 - m) * dh
+
+    @pl.when(t == nt - 1)
+    def _final():
+        dh0_ref[...] = dh_scr[...]
+
+
+def _fwd_call(xw, mask, w_h, w_hc, h0, *, interpret):
+    t, b, dd3 = xw.shape  # time-major [T, B, 3D]
+    d = dd3 // 3
+    io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_fwd_kernel, d=d)
+    hs, urc, hT = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, dd3), lambda i: (i, 0, 0)),     # xw
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),       # mask
+            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
+            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
+            pl.BlockSpec((b, d), lambda i: (0, 0)),             # h0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, d), lambda i: (i, 0, 0)),       # hs
+            pl.BlockSpec((1, b, dd3), lambda i: (i, 0, 0)),     # u,r,c
+            pl.BlockSpec((b, d), lambda i: (0, 0)),             # h_T
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, d), io_dtype),
+            jax.ShapeDtypeStruct((t, b, dd3), io_dtype),
+            jax.ShapeDtypeStruct((b, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, d), w_h.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(xw, mask, w_h, w_hc, h0)
+    return hs, urc, hT
+
+
+def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, interpret):
+    t, b, dd3 = urc.shape
+    d = dd3 // 3
+    kernel = functools.partial(_bwd_kernel, d=d)
+    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    dxw, dh0 = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, 1), rev),                       # mask
+            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
+            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
+            pl.BlockSpec((1, b, dd3), rev),                     # u,r,c
+            pl.BlockSpec((1, b, d), rev),                       # h_{t-1}
+            pl.BlockSpec((1, b, d), rev),                       # dh_t
+            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh_T
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, dd3), rev),                     # dxw
+            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, dd3), jnp.float32),
+            jax.ShapeDtypeStruct((b, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(mask, w_h, w_hc, urc, hs_prev, dhs, dhT)
+    return dxw, dh0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def gru_seq(xw, mask, w_h, w_hc, h0, interpret=False):
+    """Fused GRU over a whole sequence.
+
+    xw: [B, T, 3D] precomputed x @ W_x (+ bias), layout [update, reset,
+    candidate]; mask: [B, T]; w_h: [D, 2D]; w_hc: [D, D]; h0: [B, D].
+    Returns (hs [B, T, D], h_T).
+    """
+    hs, _, hT = _fwd_call(jnp.swapaxes(xw, 0, 1), _mask3(mask),
+                          w_h, w_hc, h0, interpret=interpret)
+    return jnp.swapaxes(hs, 0, 1), hT
+
+
+def _gru_seq_fwd(xw, mask, w_h, w_hc, h0, interpret):
+    hs, urc, hT = _fwd_call(jnp.swapaxes(xw, 0, 1), _mask3(mask),
+                            w_h, w_hc, h0, interpret=interpret)
+    return (jnp.swapaxes(hs, 0, 1), hT), (mask, w_h, w_hc, h0, hs, urc)
+
+
+def _gru_seq_bwd(interpret, res, cts):
+    mask, w_h, w_hc, h0, hs, urc = res
+    d_hs, d_hT = cts
+    d = w_hc.shape[0]
+    hs_prev = jnp.concatenate([h0.astype(hs.dtype)[None], hs[:-1]], axis=0)
+    dxw, dh0 = _bwd_call(
+        _mask3(mask), w_h, w_hc, urc, hs_prev,
+        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+        d_hT.astype(jnp.float32), interpret=interpret)
+    # weight grads as single large contractions
+    prec = (jax.lax.Precision.HIGHEST
+            if w_h.dtype == jnp.float32 else None)
+    hp = hs_prev.astype(w_h.dtype)
+    dwh = jnp.einsum("tbd,tbe->de", hp, dxw[:, :, :2 * d].astype(w_h.dtype),
+                     preferred_element_type=jnp.float32, precision=prec)
+    rh = (urc[:, :, d:2 * d].astype(jnp.float32)
+          * hs_prev.astype(jnp.float32)).astype(w_hc.dtype)
+    dwhc = jnp.einsum("tbd,tbe->de", rh, dxw[:, :, 2 * d:].astype(w_hc.dtype),
+                      preferred_element_type=jnp.float32, precision=prec)
+    dxw_b = jnp.swapaxes(dxw, 0, 1).astype(hs.dtype)
+    return (dxw_b, None, dwh.astype(w_h.dtype), dwhc.astype(w_hc.dtype),
+            dh0.astype(h0.dtype))
+
+
+gru_seq.defvjp(_gru_seq_fwd, _gru_seq_bwd)

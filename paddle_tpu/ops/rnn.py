@@ -124,11 +124,82 @@ def lstm(
             h=jnp.zeros((b_, d), jnp.float32), c=jnp.zeros((b_, d), jnp.float32)
         )
 
+    # standard cell (sigmoid gates, tanh state) -> the fused Pallas
+    # sequence kernel: one program iterates time with w_h VMEM-resident,
+    # replacing the lax.scan whose per-step residual stacking dominates
+    # (ops/pallas/lstm.py; ≅ hl_lstm_parallel_forward's role)
+    if gate_act is act.sigmoid and state_act is act.tanh:
+        return lstm_fused(SequenceBatch(xw, x.length), w_h, init,
+                          reverse=reverse)
+
     def step(state, xt):
         return lstm_cell(xt, state, w_h, gate_act, state_act)
 
     last, ys = _masked_scan(step, SequenceBatch(xw, x.length), init, reverse=reverse)
     return SequenceBatch(data=ys.h, length=x.length), last
+
+
+def lstm_fused(xw: SequenceBatch, w_h: jax.Array,
+               init: LSTMState, peephole: jax.Array | None = None,
+               reverse: bool = False):
+    """Standard-activation LSTM over precomputed gate inputs via the fused
+    Pallas sequence kernel (ops/pallas/lstm.py); the shared fast path of
+    ``lstm`` and the ``lstmemory`` layer.
+
+    xw: SequenceBatch of [B, T, 4D] pre-projected gate inputs;
+    peephole: optional [3D] flat [W_ci, W_cf, W_co] diagonals.
+    Returns (SequenceBatch of h, last LSTMState).
+    """
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.ops.pallas import default_interpret
+    from paddle_tpu.ops.pallas.lstm import lstm_seq
+
+    d = w_h.shape[0]
+    mask = xw.mask().astype(jnp.float32)
+    # honor the dtype policy exactly like matmul() would: the bf16 flag
+    # (or a mixed policy pair) resolves both kernel operands to bf16,
+    # the pure-f32 compat surface keeps true-f32 kernel matmuls
+    data, w_h = dt.cast_for_matmul(xw.data, w_h)
+    if reverse:
+        data, mask_k = jnp.flip(data, 1), jnp.flip(mask, 1)
+    else:
+        mask_k = mask
+    peep = (jnp.zeros((3, d), w_h.dtype) if peephole is None
+            else peephole.reshape(3, d).astype(w_h.dtype))
+    hs, (hT, cT) = lstm_seq(
+        data, mask_k, w_h, peep,
+        init.h.astype(w_h.dtype), init.c, default_interpret())
+    if reverse:
+        hs = jnp.flip(hs, 1)
+    # outputs keep the CALLER's dtype, like matmul() does under the flag
+    out_dtype = xw.data.dtype
+    hs = hs.astype(out_dtype)
+    return (SequenceBatch(data=hs, length=xw.length),
+            LSTMState(h=hT.astype(out_dtype), c=cT.astype(out_dtype)))
+
+
+def gru_fused(xw: SequenceBatch, w_h: jax.Array, w_hc: jax.Array,
+              init: jax.Array, reverse: bool = False):
+    """Standard-activation GRU over precomputed gate inputs via the fused
+    Pallas sequence kernel (ops/pallas/gru.py); shared fast path of
+    ``gru`` and the ``grumemory`` layer.  Returns (SequenceBatch, last h).
+    """
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.ops.pallas import default_interpret
+    from paddle_tpu.ops.pallas.gru import gru_seq
+
+    mask = xw.mask().astype(jnp.float32)
+    # same dtype-policy rule as matmul() (see lstm_fused)
+    data, w_h, w_hc = dt.cast_for_matmul(xw.data, w_h, w_hc)
+    if reverse:
+        data, mask = jnp.flip(data, 1), jnp.flip(mask, 1)
+    hs, hT = gru_seq(data, mask, w_h, w_hc,
+                     init.astype(w_h.dtype), default_interpret())
+    if reverse:
+        hs = jnp.flip(hs, 1)
+    hs = hs.astype(xw.data.dtype)
+    return (SequenceBatch(data=hs, length=xw.length),
+            hT.astype(xw.data.dtype))
 
 
 def gru(
@@ -151,6 +222,10 @@ def gru(
     xw = xw.reshape(b_, t, 3 * d)
     if init is None:
         init = jnp.zeros((b_, d), jnp.float32)
+
+    if gate_act is act.sigmoid and state_act is act.tanh:
+        return gru_fused(SequenceBatch(xw, x.length), w_h, w_hc, init,
+                         reverse=reverse)
 
     def step(h, xt):
         return gru_cell(xt, h, w_h, w_hc, gate_act, state_act)

@@ -1,0 +1,88 @@
+"""Multi-PROCESS ``jax.distributed`` integration test — the mesh-era
+version of the reference's in-process cluster tests
+(``paddle/trainer/tests/test_CompareSparse.cpp:65-73``, which spawn real
+pservers inside the test binary and compare sparse vs dense training).
+
+Two local processes with 4 virtual CPU devices each rendezvous through
+``multihost.initialize`` (real coordinator, real ``jax.distributed``
+handshake), build the 8-device dp mesh, feed per-process slices of a
+deterministic global batch through ``multihost.global_batch``, run 4 dp
+train steps, and must end bit-comparable to the same model trained in
+THIS process on its own 8-device mesh."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+import jax
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+    port, nproc = _free_port(), 2
+    out = tmp_path / "params_mp.pkl"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(nproc), str(port),
+             str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(nproc)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout.decode(errors="replace"))
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker rc={p.returncode}:\n{log[-3000:]}"
+    assert out.exists(), logs[0][-2000:]
+    with open(out, "rb") as f:
+        mp_params = pickle.load(f)
+
+    # single-process reference on this process's own 8-device mesh
+    sys.path.insert(0, os.path.dirname(__file__))
+    try:
+        import _multihost_worker as W
+    finally:
+        sys.path.pop(0)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("data"))
+    params, opt_state, states, step = W.build_model()
+
+    def place(feed_np):
+        return {k: jax.device_put(v, shard) for k, v in feed_np.items()}
+
+    params = jax.tree.map(lambda x: jax.device_put(x, repl), params)
+    sp_params = W.run_steps(params, opt_state, states, step, place)
+
+    assert set(sp_params) == set(mp_params)
+    for k in sp_params:
+        np.testing.assert_allclose(
+            sp_params[k], mp_params[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"parameter {k} diverged between 1-process and "
+                    f"2-process dp training")

@@ -1,0 +1,141 @@
+"""Fused Pallas LSTM/GRU sequence kernels vs the lax.scan cells.
+
+The kernels (ops/pallas/{lstm,gru}.py) are the hand-kernel-class analog of
+the reference's ``hl_lstm_parallel_forward`` (hl_cuda_lstm.cu:334) and
+``KeGruForwardUnit`` (hl_gpu_gru.cuh:28).  On CPU they run in interpret
+mode; these tests pin forward and gradient equality against the scan
+implementations for ragged batches, peepholes, and both directions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.lod import SequenceBatch
+from paddle_tpu.ops import rnn
+
+
+@pytest.fixture
+def ragged(rng_np):
+    B, T, D = 4, 7, 8
+    lens = np.asarray([7, 5, 3, 1], np.int32)
+    return B, T, D, jnp.asarray(lens)
+
+
+def test_lstm_fused_matches_scan_with_peephole(rng_np, ragged):
+    B, T, D, lens = ragged
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 4 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 4 * D)).astype(np.float32) * .3)
+    peep = jnp.asarray(rng_np.normal(size=(3 * D,)).astype(np.float32) * .2)
+    sb = SequenceBatch(data=xw, length=lens)
+    init = rnn.LSTMState(h=jnp.zeros((B, D)), c=jnp.zeros((B, D)))
+
+    def scan_loss(wh, peep, reverse):
+        def step(state, xt):
+            return rnn.lstm_cell(xt, state, wh, peephole=peep)
+        last, ys = rnn._masked_scan(step, sb, init, reverse=reverse)
+        return (jnp.sum(ys.h * sb.mask()[:, :, None]) + jnp.sum(last.h)
+                + 0.5 * jnp.sum(last.c))
+
+    def fused_loss(wh, peep, reverse):
+        ys, last = rnn.lstm_fused(sb, wh, init, peephole=peep,
+                                  reverse=reverse)
+        return (jnp.sum(ys.data * sb.mask()[:, :, None]) + jnp.sum(last.h)
+                + 0.5 * jnp.sum(last.c))
+
+    for reverse in (False, True):
+        r = scan_loss(wh, peep, reverse)
+        k = fused_loss(wh, peep, reverse)
+        assert abs(float(r - k)) < 1e-5, (reverse, float(r), float(k))
+        gr = jax.grad(scan_loss, argnums=(0, 1))(wh, peep, reverse)
+        gk = jax.grad(fused_loss, argnums=(0, 1))(wh, peep, reverse)
+        for a, b in zip(gr, gk):
+            np.testing.assert_allclose(np.asarray(a),
+                                       np.asarray(b).reshape(a.shape),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_fused_dxw_and_state_grads(rng_np, ragged):
+    B, T, D, lens = ragged
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 4 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 4 * D)).astype(np.float32) * .3)
+    init = rnn.LSTMState(h=jnp.asarray(
+        rng_np.normal(size=(B, D)).astype(np.float32) * .2),
+        c=jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2))
+    mask = (np.arange(T)[None] < np.asarray(lens)[:, None])
+
+    def scan_loss(xw_, h0, c0):
+        sb = SequenceBatch(data=xw_, length=lens)
+
+        def step(state, xt):
+            return rnn.lstm_cell(xt, state, wh)
+        last, ys = rnn._masked_scan(
+            step, sb, rnn.LSTMState(h=h0, c=c0))
+        return jnp.sum(ys.h * jnp.asarray(mask)[:, :, None]) + jnp.sum(last.c)
+
+    def fused_loss(xw_, h0, c0):
+        sb = SequenceBatch(data=xw_, length=lens)
+        ys, last = rnn.lstm_fused(sb, wh, rnn.LSTMState(h=h0, c=c0))
+        return jnp.sum(ys.data * jnp.asarray(mask)[:, :, None]) + jnp.sum(last.c)
+
+    gr = jax.grad(scan_loss, argnums=(0, 1, 2))(xw, init.h, init.c)
+    gk = jax.grad(fused_loss, argnums=(0, 1, 2))(xw, init.h, init.c)
+    for a, b in zip(gr, gk):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_gru_fused_matches_scan(rng_np, ragged):
+    B, T, D, lens = ragged
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 3 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 2 * D)).astype(np.float32) * .3)
+    whc = jnp.asarray(rng_np.normal(size=(D, D)).astype(np.float32) * .3)
+    sb = SequenceBatch(data=xw, length=lens)
+    init = jnp.zeros((B, D))
+
+    def scan_loss(wh, whc, xw_, reverse):
+        sbx = SequenceBatch(data=xw_, length=lens)
+
+        def step(h, xt):
+            return rnn.gru_cell(xt, h, wh, whc)
+        last, ys = rnn._masked_scan(step, sbx, init, reverse=reverse)
+        return jnp.sum(ys * sbx.mask()[:, :, None]) + jnp.sum(last)
+
+    def fused_loss(wh, whc, xw_, reverse):
+        sbx = SequenceBatch(data=xw_, length=lens)
+        ys, last = rnn.gru_fused(sbx, wh, whc, init, reverse=reverse)
+        return jnp.sum(ys.data * sbx.mask()[:, :, None]) + jnp.sum(last)
+
+    for reverse in (False, True):
+        r = scan_loss(wh, whc, xw, reverse)
+        k = fused_loss(wh, whc, xw, reverse)
+        assert abs(float(r - k)) < 1e-5
+        gr = jax.grad(scan_loss, argnums=(0, 1, 2))(wh, whc, xw, reverse)
+        gk = jax.grad(fused_loss, argnums=(0, 1, 2))(wh, whc, xw, reverse)
+        for a, b in zip(gr, gk):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_cast_for_matmul_mixed_pair_stays_narrow():
+    """Under the f32 default, a mixed f32/bf16 operand pair (only possible
+    under an explicit mixed-precision policy) must resolve to bf16 —
+    promoting to f32+HIGHEST silently doubled the NMT step (measured
+    11.8 -> 23.4 ms on a v5e)."""
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.core import flags
+
+    assert flags.get("bf16") is False  # the default under test
+    a = jnp.ones((4, 4), jnp.float32)
+    b = jnp.ones((4, 4), jnp.bfloat16)
+    ca, cb = dt.cast_for_matmul(a, b)
+    assert ca.dtype == jnp.bfloat16 and cb.dtype == jnp.bfloat16
+    # pure f32 stays f32 (reference numerics)
+    ca, cb = dt.cast_for_matmul(a, jnp.ones((4, 4), jnp.float32))
+    assert ca.dtype == jnp.float32 and cb.dtype == jnp.float32
+    # and f32 pairs request true-f32 MXU passes
+    assert dt.dot_precision(ca, cb) == jax.lax.Precision.HIGHEST
+    assert dt.dot_precision(a, b) is None
