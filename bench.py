@@ -346,13 +346,17 @@ def bench_transformer(records):
 
     cfg = T.TransformerConfig(
         vocab_size=50257, num_layers=12, num_heads=12, embed_dim=768,
-        mlp_dim=3072, max_seq_len=2048, dtype=jnp.float32, remat="dots",
+        # remat=False: all activations fit this chip's 16 GB at bs16, and
+        # skipping the dots-policy recompute + taking the larger batch is
+        # worth +8% tok/s (round-4 sweep: bs8/dots 130.0k, bs8/False
+        # 134.0k, bs16/False 140.9k, bs24/False 140.0k tok/s)
+        mlp_dim=3072, max_seq_len=2048, dtype=jnp.float32, remat=False,
         attn_impl="flash", attn_block_size=1024)
     params = T.init_params(cfg, jax.random.key(0))
     n = sum(x.size for x in jax.tree.leaves(params))
     opt = Adam(learning_rate=1e-4)
     opt_state = opt.init_tree(params)
-    bs, seqlen = 8, 1024
+    bs, seqlen = 16, 1024
     ids = jax.device_put(np.random.default_rng(0).integers(
         0, cfg.vocab_size, size=(bs, seqlen + 1)))
     step = T.build_train_step(cfg, opt, compute_dtype=jnp.bfloat16)
@@ -370,7 +374,7 @@ def bench_transformer(records):
         "metric": "transformer_lm_124m_tokens_per_sec",
         "value": round(tokens / ms * 1000.0, 0), "unit": "tok/s",
         "mfu_pct": round(mfu * 100, 1),
-        "config": "GPT-2-small shape, bs 8x1024, flash attn, mixed precision",
+        "config": "GPT-2-small shape, bs 16x1024, flash attn, mixed precision",
         "vs_baseline": 0,
     })
 

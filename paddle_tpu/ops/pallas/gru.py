@@ -103,24 +103,26 @@ def _bwd_kernel(mask_ref, wh_ref, whc_ref, urc_ref, hs_prev_ref,
         dh0_ref[...] = dh_scr[...]
 
 
-def _fwd_call(xw, mask, w_h, w_hc, h0, *, interpret):
+def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret):
     t, b, dd3 = xw.shape  # time-major [T, B, 3D]
     d = dd3 // 3
     io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
     kernel = functools.partial(_fwd_kernel, d=d)
+    # reversed index maps instead of flipped HBM copies (see lstm.py)
+    step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
     hs, urc, hT = pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
-            pl.BlockSpec((1, b, dd3), lambda i: (i, 0, 0)),     # xw
-            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),       # mask
+            pl.BlockSpec((1, b, dd3), step),                    # xw
+            pl.BlockSpec((1, b, 1), step),                      # mask
             pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
             pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
             pl.BlockSpec((b, d), lambda i: (0, 0)),             # h0
         ],
         out_specs=[
-            pl.BlockSpec((1, b, d), lambda i: (i, 0, 0)),       # hs
-            pl.BlockSpec((1, b, dd3), lambda i: (i, 0, 0)),     # u,r,c
+            pl.BlockSpec((1, b, d), step),                      # hs
+            pl.BlockSpec((1, b, dd3), step),                    # u,r,c
             pl.BlockSpec((b, d), lambda i: (0, 0)),             # h_T
         ],
         out_shape=[
@@ -137,11 +139,13 @@ def _fwd_call(xw, mask, w_h, w_hc, h0, *, interpret):
     return hs, urc, hT
 
 
-def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, interpret):
+def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, reverse,
+              interpret):
     t, b, dd3 = urc.shape
     d = dd3 // 3
     kernel = functools.partial(_bwd_kernel, d=d)
-    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    rev = ((lambda i: (i, 0, 0)) if reverse
+           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
     dxw, dh0 = pl.pallas_call(
         kernel,
         grid=(t,),
@@ -171,37 +175,42 @@ def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, interpret):
     return dxw, dh0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def gru_seq(xw, mask, w_h, w_hc, h0, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def gru_seq(xw, mask, w_h, w_hc, h0, reverse=False, interpret=False):
     """Fused GRU over a whole sequence.
 
     xw: [B, T, 3D] precomputed x @ W_x (+ bias), layout [update, reset,
-    candidate]; mask: [B, T]; w_h: [D, 2D]; w_hc: [D, D]; h0: [B, D].
+    candidate]; mask: [B, T]; w_h: [D, 2D]; w_hc: [D, D]; h0: [B, D];
+    reverse iterates time T-1..0 via index maps (no data flips).
     Returns (hs [B, T, D], h_T).
     """
     hs, _, hT = _fwd_call(jnp.swapaxes(xw, 0, 1), _mask3(mask),
-                          w_h, w_hc, h0, interpret=interpret)
+                          w_h, w_hc, h0, reverse=reverse,
+                          interpret=interpret)
     return jnp.swapaxes(hs, 0, 1), hT
 
 
-def _gru_seq_fwd(xw, mask, w_h, w_hc, h0, interpret):
+def _gru_seq_fwd(xw, mask, w_h, w_hc, h0, reverse, interpret):
     hs, urc, hT = _fwd_call(jnp.swapaxes(xw, 0, 1), _mask3(mask),
-                            w_h, w_hc, h0, interpret=interpret)
+                            w_h, w_hc, h0, reverse=reverse,
+                            interpret=interpret)
     return (jnp.swapaxes(hs, 0, 1), hT), (mask, w_h, w_hc, h0, hs, urc)
 
 
-def _gru_seq_bwd(interpret, res, cts):
+def _gru_seq_bwd(reverse, interpret, res, cts):
+    from paddle_tpu.ops.pallas import mxu_precision
+    from paddle_tpu.ops.pallas.lstm import _shift_prev
+
     mask, w_h, w_hc, h0, hs, urc = res
     d_hs, d_hT = cts
     d = w_hc.shape[0]
-    hs_prev = jnp.concatenate([h0.astype(hs.dtype)[None], hs[:-1]], axis=0)
+    hs_prev = _shift_prev(hs, h0, reverse)
     dxw, dh0 = _bwd_call(
         _mask3(mask), w_h, w_hc, urc, hs_prev,
         jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
-        d_hT.astype(jnp.float32), interpret=interpret)
+        d_hT.astype(jnp.float32), reverse=reverse, interpret=interpret)
     # weight grads as single large contractions
-    prec = (jax.lax.Precision.HIGHEST
-            if w_h.dtype == jnp.float32 else None)
+    prec = mxu_precision(w_h)
     hp = hs_prev.astype(w_h.dtype)
     dwh = jnp.einsum("tbd,tbe->de", hp, dxw[:, :, :2 * d].astype(w_h.dtype),
                      preferred_element_type=jnp.float32, precision=prec)

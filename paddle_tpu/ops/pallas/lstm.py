@@ -154,26 +154,29 @@ def _bwd_kernel(mask_ref, wh_ref, peep_ref, gates_ref, cs_prev_ref, cs_ref,
         dpeep_ref[...] = dpeep_scr[...]
 
 
-def _fwd_call(xw, mask, w_h, peep, h0, c0, *, interpret):
+def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret):
     t, b, dd4 = xw.shape  # time-major [T, B, 4D]
     d = dd4 // 4
     io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
     kernel = functools.partial(_fwd_kernel, d=d)
+    # reverse runs the SAME carry recurrence over array indices T-1..0 via
+    # reversed index maps — no flipped HBM copies of the sequence
+    step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
     hs, cs, gates, hT, cT = pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
-            pl.BlockSpec((1, b, dd4), lambda i: (i, 0, 0)),      # xw [T,B,4D]
-            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),        # mask [T,B,1]
+            pl.BlockSpec((1, b, dd4), step),                     # xw [T,B,4D]
+            pl.BlockSpec((1, b, 1), step),                       # mask [T,B,1]
             pl.BlockSpec((d, dd4), lambda i: (0, 0)),            # w_h resident
             pl.BlockSpec((3, d), lambda i: (0, 0)),              # peephole
             pl.BlockSpec((b, d), lambda i: (0, 0)),              # h0
             pl.BlockSpec((b, d), lambda i: (0, 0)),              # c0
         ],
         out_specs=[
-            pl.BlockSpec((1, b, d), lambda i: (i, 0, 0)),        # hs
-            pl.BlockSpec((1, b, d), lambda i: (i, 0, 0)),        # cs
-            pl.BlockSpec((1, b, dd4), lambda i: (i, 0, 0)),      # gates
+            pl.BlockSpec((1, b, d), step),                       # hs
+            pl.BlockSpec((1, b, d), step),                       # cs
+            pl.BlockSpec((1, b, dd4), step),                     # gates
             pl.BlockSpec((b, d), lambda i: (0, 0)),              # h_T
             pl.BlockSpec((b, d), lambda i: (0, 0)),              # c_T
         ],
@@ -199,11 +202,14 @@ def _fwd_call(xw, mask, w_h, peep, h0, c0, *, interpret):
 
 
 def _bwd_call(mask, w_h, peep, gates, cs_prev, cs, dhs, dhT, dcT,
-              *, interpret):
+              *, reverse, interpret):
     t, b, dd4 = gates.shape
     d = dd4 // 4
     kernel = functools.partial(_bwd_kernel, d=d)
-    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731 — reverse time
+    # iterate computation-reverse: array order T-1..0 for a forward run,
+    # 0..T-1 for a reverse run
+    rev = ((lambda i: (i, 0, 0)) if reverse
+           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
     dgates, dh0, dc0, dpeep = pl.pallas_call(
         kernel,
         grid=(t,),
@@ -245,8 +251,9 @@ def _bwd_call(mask, w_h, peep, gates, cs_prev, cs, dhs, dhT, dcT,
     return dgates, dh0, dc0, dpeep
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def lstm_seq(xw, mask, w_h, peephole, h0, c0, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def lstm_seq(xw, mask, w_h, peephole, h0, c0, reverse=False,
+             interpret=False):
     """Fused LSTM over a whole sequence.
 
     xw:   [B, T, 4D] precomputed x @ W_x (+ bias), gate order [i, f, g, o]
@@ -255,41 +262,51 @@ def lstm_seq(xw, mask, w_h, peephole, h0, c0, interpret=False):
     peephole: [3, D] diagonal peephole weights [W_ci, W_cf, W_co]
               (pass zeros for a plain LSTM)
     h0, c0: [B, D] initial state
+    reverse: iterate time T-1..0 (reversed index maps, no data flips)
     Returns (hs [B, T, D], (h_T, c_T)).
     """
     hs, _, _, hT, cT = _fwd_call(
         jnp.swapaxes(xw, 0, 1), _mask3(mask), w_h, peephole,
-        h0, c0.astype(jnp.float32), interpret=interpret)
+        h0, c0.astype(jnp.float32), reverse=reverse, interpret=interpret)
     return jnp.swapaxes(hs, 0, 1), (hT, cT)
 
 
-def _lstm_seq_fwd(xw, mask, w_h, peephole, h0, c0, interpret):
+def _shift_prev(stack, boot, reverse):
+    """Per-array-index previous-state stack: the state the cell saw when
+    computing index t — boot-padded at the first COMPUTED index (t=0
+    forward, t=T-1 reverse)."""
+    boot = boot.astype(stack.dtype)[None]
+    if reverse:
+        return jnp.concatenate([stack[1:], boot], axis=0)
+    return jnp.concatenate([boot, stack[:-1]], axis=0)
+
+
+def _lstm_seq_fwd(xw, mask, w_h, peephole, h0, c0, reverse, interpret):
     xw_t = jnp.swapaxes(xw, 0, 1)
     hs, cs, gates, hT, cT = _fwd_call(
         xw_t, _mask3(mask), w_h, peephole, h0, c0.astype(jnp.float32),
-        interpret=interpret)
+        reverse=reverse, interpret=interpret)
     out = (jnp.swapaxes(hs, 0, 1), (hT, cT))
     return out, (mask, w_h, peephole, h0, c0, hs, cs, gates)
 
 
-def _lstm_seq_bwd(interpret, res, cts):
+def _lstm_seq_bwd(reverse, interpret, res, cts):
     mask, w_h, peephole, h0, c0, hs, cs, gates = res
     d_hs, (d_hT, d_cT) = cts
-    cs_prev = jnp.concatenate(
-        [c0.astype(cs.dtype)[None], cs[:-1]], axis=0)
+    cs_prev = _shift_prev(cs, c0, reverse)
     dgates, dh0, dc0, dpeep = _bwd_call(
         _mask3(mask), w_h, peephole, gates, cs_prev, cs,
         jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
         d_hT.astype(jnp.float32), d_cT.astype(jnp.float32),
-        interpret=interpret)
+        reverse=reverse, interpret=interpret)
     # weight grad as ONE large MXU contraction: [D, T*B] @ [T*B, 4D]
-    hs_prev = jnp.concatenate(
-        [h0.astype(hs.dtype)[None], hs[:-1]], axis=0)
+    from paddle_tpu.ops.pallas import mxu_precision
+
+    hs_prev = _shift_prev(hs, h0, reverse)
     dg_c = dgates.astype(w_h.dtype)
     dwh = jnp.einsum("tbd,tbe->de", hs_prev.astype(w_h.dtype), dg_c,
                      preferred_element_type=jnp.float32,
-                     precision=(jax.lax.Precision.HIGHEST
-                                if w_h.dtype == jnp.float32 else None))
+                     precision=mxu_precision(w_h))
     # dgates IS dxw; cotangent dtype must match the primal xw (== gates io)
     dxw = jnp.swapaxes(dgates, 0, 1).astype(gates.dtype)
     return (dxw, None, dwh.astype(w_h.dtype),

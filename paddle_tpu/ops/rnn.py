@@ -139,12 +139,25 @@ def lstm(
     return SequenceBatch(data=ys.h, length=x.length), last
 
 
+def _fused_fits(b: int, d: int, gates: int, *weights) -> bool:
+    """VMEM budget check for the fused sequence kernels: resident weights
+    plus ~8 double-buffered [B, gates*D] slabs must fit the 64 MB scoped
+    limit (ops/pallas/lstm.py compiler_params) with headroom.  Float16 is
+    rejected too (the kernels' io/cotangent plumbing is f32/bf16 only)."""
+    if any(w.dtype == jnp.float16 for w in weights):
+        return False
+    resident = sum(w.nbytes for w in weights)
+    slabs = 8 * b * gates * d * weights[0].dtype.itemsize
+    return resident + slabs < 48 * 1024 * 1024
+
+
 def lstm_fused(xw: SequenceBatch, w_h: jax.Array,
                init: LSTMState, peephole: jax.Array | None = None,
                reverse: bool = False):
     """Standard-activation LSTM over precomputed gate inputs via the fused
     Pallas sequence kernel (ops/pallas/lstm.py); the shared fast path of
-    ``lstm`` and the ``lstmemory`` layer.
+    ``lstm`` and the ``lstmemory`` layer.  Falls back to the lax.scan
+    cell when the weights exceed the kernel's VMEM budget.
 
     xw: SequenceBatch of [B, T, 4D] pre-projected gate inputs;
     peephole: optional [3D] flat [W_ci, W_cf, W_co] diagonals.
@@ -159,18 +172,18 @@ def lstm_fused(xw: SequenceBatch, w_h: jax.Array,
     # honor the dtype policy exactly like matmul() would: the bf16 flag
     # (or a mixed policy pair) resolves both kernel operands to bf16,
     # the pure-f32 compat surface keeps true-f32 kernel matmuls
-    data, w_h = dt.cast_for_matmul(xw.data, w_h)
-    if reverse:
-        data, mask_k = jnp.flip(data, 1), jnp.flip(mask, 1)
-    else:
-        mask_k = mask
-    peep = (jnp.zeros((3, d), w_h.dtype) if peephole is None
-            else peephole.reshape(3, d).astype(w_h.dtype))
+    data, w_h_c = dt.cast_for_matmul(xw.data, w_h)
+    if not _fused_fits(xw.batch_size, d, 4, w_h_c):
+        def step(state, xt):
+            return lstm_cell(xt, state, w_h, peephole=peephole)
+        last, ys = _masked_scan(
+            step, SequenceBatch(xw.data, xw.length), init, reverse=reverse)
+        return SequenceBatch(data=ys.h, length=xw.length), last
+    peep = (jnp.zeros((3, d), w_h_c.dtype) if peephole is None
+            else peephole.reshape(3, d).astype(w_h_c.dtype))
     hs, (hT, cT) = lstm_seq(
-        data, mask_k, w_h, peep,
-        init.h.astype(w_h.dtype), init.c, default_interpret())
-    if reverse:
-        hs = jnp.flip(hs, 1)
+        data, mask, w_h_c, peep,
+        init.h.astype(w_h_c.dtype), init.c, reverse, default_interpret())
     # outputs keep the CALLER's dtype, like matmul() does under the flag
     out_dtype = xw.data.dtype
     hs = hs.astype(out_dtype)
@@ -190,13 +203,15 @@ def gru_fused(xw: SequenceBatch, w_h: jax.Array, w_hc: jax.Array,
 
     mask = xw.mask().astype(jnp.float32)
     # same dtype-policy rule as matmul() (see lstm_fused)
-    data, w_h, w_hc = dt.cast_for_matmul(xw.data, w_h, w_hc)
-    if reverse:
-        data, mask = jnp.flip(data, 1), jnp.flip(mask, 1)
-    hs, hT = gru_seq(data, mask, w_h, w_hc,
-                     init.astype(w_h.dtype), default_interpret())
-    if reverse:
-        hs = jnp.flip(hs, 1)
+    data, w_h_c, w_hc_c = dt.cast_for_matmul(xw.data, w_h, w_hc)
+    if not _fused_fits(xw.batch_size, w_hc.shape[0], 3, w_h_c, w_hc_c):
+        def step(h, xt):
+            return gru_cell(xt, h, w_h, w_hc)
+        last, ys = _masked_scan(
+            step, SequenceBatch(xw.data, xw.length), init, reverse=reverse)
+        return SequenceBatch(data=ys, length=xw.length), last
+    hs, hT = gru_seq(data, mask, w_h_c, w_hc_c,
+                     init.astype(w_h_c.dtype), reverse, default_interpret())
     hs = hs.astype(xw.data.dtype)
     return (SequenceBatch(data=hs, length=xw.length),
             hT.astype(xw.data.dtype))
