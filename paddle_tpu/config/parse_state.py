@@ -70,6 +70,19 @@ def PyData(files=None, type=None, load_data_module=None,
             "obj": load_data_object, "args": load_data_args}
 
 
+def ProtoData(files=None, usage_ratio=None, **kw) -> dict:
+    """≅ ProtoData (config_parser.py:1036): binary DataFormat.proto files
+    (the ProtoDataProvider source; reader in
+    :mod:`paddle_tpu.reader.proto_data`)."""
+    return {"type": "proto", "files": files, "usage_ratio": usage_ratio}
+
+
+def MultiData(data_configs=(), **kw) -> dict:
+    """≅ MultiData: several sub-providers feeding one network
+    (MultiDataProvider.h:24)."""
+    return {"type": "multi", "sub": list(data_configs)}
+
+
 def TrainData(data_config: dict, async_load_data=None) -> None:
     """≅ TrainData (config_parser.py:1178)."""
     STATE.data_config = dict(data_config)

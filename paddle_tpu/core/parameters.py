@@ -15,6 +15,8 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
+import os
+import struct
 import tarfile
 from typing import Any, Callable, Iterator
 
@@ -50,6 +52,28 @@ class ParamSpec:
 
     def init(self, key) -> jax.Array:
         return self.initializer(key, self.shape, self.dtype)
+
+
+def load_reference_param(path: str) -> np.ndarray:
+    """Read one parameter in the reference ``Parameter::save`` binary
+    format: int32 version(0), uint32 valueSize(4), uint64 count, then
+    count float32 values (``paddle/parameter/Parameter.cpp``)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    version, value_size, count = struct.unpack("<iIQ", raw[:16])
+    enforce(version == 0 and value_size == 4,
+            f"unsupported reference parameter header in {path}: "
+            f"version={version} valueSize={value_size}")
+    return np.frombuffer(raw[16:], np.float32, count=count).copy()
+
+
+def save_reference_param(path: str, arr: np.ndarray) -> None:
+    """Write one parameter in the reference binary format (see
+    :func:`load_reference_param`)."""
+    flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<iIQ", 0, 4, flat.size))
+        f.write(flat.tobytes())
 
 
 class Parameters:
@@ -194,6 +218,33 @@ class Parameters:
         for name in other.names():
             if name in self._specs:
                 self[name] = other[name]
+
+    def init_from_reference_dir(self, dirname: str) -> None:
+        """Warm-start from a reference pretrained-model directory — one
+        binary file per parameter in ``Parameter::save`` format (the
+        model_zoo distribution layout, e.g.
+        ``v1_api_demo/model_zoo/resnet/classify.py`` loading
+        ``resnet_50/`` dumps).  Names match our specs because the layer
+        helpers reproduce the reference naming (``_layer.w0`` etc.)."""
+        for name, spec in self._specs.items():
+            path = os.path.join(dirname, name)
+            if not os.path.exists(path):
+                continue
+            arr = load_reference_param(path)
+            enforce(
+                arr.size == int(np.prod(spec.shape)),
+                f"reference parameter {name!r} has {arr.size} values, "
+                f"spec shape {spec.shape} wants {int(np.prod(spec.shape))}")
+            self[name] = arr.reshape(spec.shape)
+
+    def to_reference_dir(self, dirname: str) -> None:
+        """Write every parameter in the reference ``Parameter::save``
+        binary format (one file per parameter) — produces a directory the
+        reference framework itself could load."""
+        os.makedirs(dirname, exist_ok=True)
+        for name in self._specs:
+            save_reference_param(os.path.join(dirname, name),
+                                 np.asarray(self._values[name]))
 
 
 def create(topology_or_specs) -> Parameters:

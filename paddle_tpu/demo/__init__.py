@@ -1,0 +1,23 @@
+"""Runnable ports of the reference ``v1_api_demo`` applications.
+
+Each sub-package drives a REFERENCE config (byte-identical; taken from
+``$PADDLE_REFERENCE_ROOT``, default ``/root/reference``) through the
+paddle_tpu trainer with synthetic stand-in data, since the original demo
+datasets require downloads:
+
+- ``quick_start``          — text classification, ``trainer_config.lr.py``
+                             run unmodified (dict via --config_args).
+- ``traffic_prediction``   — multi-task traffic forecasting; the config is
+                             used verbatim, the data provider is a py3 port
+                             (the reference's is python-2-only: ``f.next``,
+                             list-``map``, ``sys.maxint``).
+- ``model_zoo``            — pretrained-model feature extraction: save /
+                             load parameters in the reference
+                             ``Parameter::save`` binary-dir layout and pull
+                             hidden-layer features via ``paddle.infer``
+                             (≅ ``model_zoo/resnet/classify.py``).
+"""
+
+import os
+
+REFERENCE_ROOT = os.environ.get("PADDLE_REFERENCE_ROOT", "/root/reference")

@@ -1,0 +1,80 @@
+"""quick_start text classification — the reference config
+(``v1_api_demo/quick_start/trainer_config.lr.py`` + ``dataprovider_bow``)
+executed UNMODIFIED by the paddle_tpu trainer CLI.
+
+The original demo downloads Amazon review data; here a synthetic
+sentiment corpus with the same file formats (``dict.txt``, tab-separated
+``label\\ttext`` lines, ``train.list``/``test.list``) stands in.
+
+Run: python -m paddle_tpu.demo.quick_start.run [--passes N] [--workdir D]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+
+from paddle_tpu.demo import REFERENCE_ROOT
+
+POS = "good great fine excellent loved wonderful best happy".split()
+NEG = "bad awful terrible hate worst boring poor sad".split()
+FILLER = "the a movie film it was i this and of to very really".split()
+
+
+def make_data(workdir: str, n_train: int = 1280, n_test: int = 256) -> None:
+    data = os.path.join(workdir, "data")
+    os.makedirs(data, exist_ok=True)
+    rnd = random.Random(0)
+    with open(os.path.join(data, "dict.txt"), "w") as f:
+        for w in sorted(set(POS + NEG + FILLER)):
+            f.write(w + "\t0\n")
+
+    def gen(path, n):
+        with open(path, "w") as f:
+            for _ in range(n):
+                y = rnd.randint(0, 1)
+                words = rnd.choices(POS if y else NEG, k=6) + \
+                    rnd.choices(FILLER, k=6)
+                rnd.shuffle(words)
+                f.write(f"{y}\t{' '.join(words)}\n")
+
+    gen(os.path.join(data, "train.txt"), n_train)
+    gen(os.path.join(data, "test.txt"), n_test)
+    with open(os.path.join(data, "train.list"), "w") as f:
+        f.write("data/train.txt\n")
+    with open(os.path.join(data, "test.list"), "w") as f:
+        f.write("data/test.txt\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=8)
+    ap.add_argument("--workdir", default="./quick_start_work")
+    ap.add_argument("--config", default=os.path.join(
+        REFERENCE_ROOT, "v1_api_demo/quick_start/trainer_config.lr.py"))
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    make_data(args.workdir)
+    cwd = os.getcwd()
+    os.chdir(args.workdir)  # the config refs ./data/* relative paths
+    try:
+        from paddle_tpu.trainer import cli
+
+        rc = cli.main(["--config", args.config, "--job", "train",
+                       "--num_passes", str(args.passes),
+                       "--config_args", "dict_file=data/dict.txt",
+                       "--save_dir", "out"])
+        if rc:
+            return rc
+        last = sorted(os.listdir("out"))[-1]
+        return cli.main(["--config", args.config, "--job", "test",
+                         "--init_model_path", os.path.join("out", last),
+                         "--config_args", "dict_file=data/dict.txt"])
+    finally:
+        os.chdir(cwd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,64 @@
+"""Python-3 port of ``v1_api_demo/traffic_prediction/dataprovider.py``.
+
+The reference provider is python-2-only (``f.next()``, list-returning
+``map``, ``sys.maxint``); the semantics here are identical: each CSV row
+is ``link_id,spd,spd,...``; a sliding window of TERM_NUM speeds is the
+dense input and the following FORECASTING_NUM speeds (minus 1, classes
+0..3; windows containing missing readings are dropped) are the
+multi-task labels.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from paddle.trainer.PyDataProvider2 import (
+    CacheType,
+    dense_vector,
+    integer_value,
+    provider,
+)
+
+TERM_NUM = 24
+FORECASTING_NUM = 24
+LABEL_VALUE_NUM = 4
+
+
+def initHook(settings, file_list, **kwargs):
+    del kwargs
+    settings.pool_size = sys.maxsize
+    settings.input_types = [dense_vector(TERM_NUM)] + [
+        integer_value(LABEL_VALUE_NUM) for _ in range(FORECASTING_NUM)
+    ]
+
+
+@provider(
+    init_hook=initHook, cache=CacheType.CACHE_PASS_IN_MEM,
+    should_shuffle=True)
+def process(settings, file_name):
+    with open(file_name) as f:
+        next(f)  # header row
+        for line in f:
+            speeds = [int(t) for t in line.rstrip("\r\n").split(",")[1:]]
+            end_time = len(speeds)
+            for i in range(TERM_NUM, end_time - FORECASTING_NUM):
+                pre_spd = [float(s) for s in speeds[i - TERM_NUM:i]]
+                fol_spd = [j - 1 for j in speeds[i:i + FORECASTING_NUM]]
+                if -1 in fol_spd:
+                    continue
+                yield [pre_spd] + fol_spd
+
+
+def predict_initHook(settings, file_list, **kwargs):
+    settings.pool_size = sys.maxsize
+    settings.input_types = [dense_vector(TERM_NUM)]
+
+
+@provider(init_hook=predict_initHook, should_shuffle=False)
+def process_predict(settings, file_name):
+    with open(file_name) as f:
+        next(f)
+        for line in f:
+            speeds = [int(t) for t in line.rstrip("\r\n").split(",")]
+            end_time = len(speeds)
+            yield [float(s) for s in speeds[end_time - TERM_NUM:end_time]]

@@ -1,0 +1,74 @@
+"""traffic_prediction — the reference multi-task config
+(``v1_api_demo/traffic_prediction/trainer_config.py``) executed verbatim
+(copied byte-identical into the workdir so the py3 dataprovider port in
+this package shadows the python-2-only original), on synthetic traffic
+CSVs.
+
+Run: python -m paddle_tpu.demo.traffic_prediction.run [--passes N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import shutil
+
+from paddle_tpu.demo import REFERENCE_ROOT
+
+TERM_NUM, FORECASTING_NUM = 24, 24
+
+
+def make_data(workdir: str, links: int = 40, t: int = 120) -> None:
+    data = os.path.join(workdir, "data")
+    os.makedirs(data, exist_ok=True)
+    rnd = random.Random(0)
+
+    def gen(path, n_links):
+        with open(path, "w") as f:
+            f.write("link," + ",".join(f"t{i}" for i in range(t)) + "\n")
+            for li in range(n_links):
+                # speeds 1..4 with slow daily drift (class 0 = missing)
+                base = rnd.randint(1, 4)
+                speeds = []
+                for i in range(t):
+                    base = min(4, max(1, base + rnd.choice((-1, 0, 0, 1))))
+                    speeds.append(str(base))
+                f.write(f"link_{li}," + ",".join(speeds) + "\n")
+
+    gen(os.path.join(data, "train.csv"), links)
+    gen(os.path.join(data, "test.csv"), max(links // 4, 2))
+    with open(os.path.join(data, "train.list"), "w") as f:
+        f.write("data/train.csv\n")
+    with open(os.path.join(data, "test.list"), "w") as f:
+        f.write("data/test.csv\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=3)
+    ap.add_argument("--workdir", default="./traffic_work")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    make_data(args.workdir)
+    ref_cfg = os.path.join(
+        REFERENCE_ROOT, "v1_api_demo/traffic_prediction/trainer_config.py")
+    cfg = os.path.join(args.workdir, "trainer_config.py")
+    shutil.copyfile(ref_cfg, cfg)  # byte-identical
+    shutil.copyfile(
+        os.path.join(os.path.dirname(__file__), "dataprovider.py"),
+        os.path.join(args.workdir, "dataprovider.py"))
+    cwd = os.getcwd()
+    os.chdir(args.workdir)
+    try:
+        from paddle_tpu.trainer import cli
+
+        return cli.main(["--config", "trainer_config.py", "--job", "train",
+                         "--num_passes", str(args.passes)])
+    finally:
+        os.chdir(cwd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

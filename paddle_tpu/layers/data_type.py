@@ -62,6 +62,10 @@ def sparse_binary_vector_sequence(dim: int) -> InputType:
     return InputType(dim, SeqType.SEQUENCE, DataKind.SPARSE_BINARY)
 
 
+def sparse_float_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SeqType.SEQUENCE, DataKind.SPARSE_FLOAT)
+
+
 def integer_value_sub_sequence(value_range: int) -> InputType:
     return InputType(value_range, SeqType.SUB_SEQUENCE, DataKind.INTEGER)
 

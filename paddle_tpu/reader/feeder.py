@@ -44,7 +44,11 @@ class DataFeeder:
                 f"(feeding keys: {sorted(self.feeding)})",
             )
             idx = self.feeding[name]
-            col = [sample[idx] for sample in batch]
+            # providers may yield dict samples keyed by layer name
+            # (PyDataProvider2.py supports both; dataprovider_bow yields
+            # {'word': ..., 'label': ...})
+            col = [sample[name] if isinstance(sample, Mapping)
+                   else sample[idx] for sample in batch]
             out[name] = self._convert(col, itype, name)
         return out
 

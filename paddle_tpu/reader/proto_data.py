@@ -1,0 +1,214 @@
+"""ProtoDataProvider / MultiDataProvider — readers for the reference's
+binary proto data format (``proto/DataFormat.proto``, served by
+``paddle/gserver/dataproviders/ProtoDataProvider.{h,cpp}`` and
+``MultiDataProvider.h``), rebuilt as paddle readers.
+
+Wire format (``ProtoReader.h:96``): a stream of varint32-length-prefixed
+messages — one ``DataHeader`` then ``DataSample``s until EOF; ``.gz``
+files are gzip streams of the same.  Each DataSample is one TIMESTEP;
+``is_beginning`` marks sequence starts (``ProtoDataProvider.cpp:226``),
+so samples are regrouped here into per-sequence rows, which is what the
+trainer's feeder consumes.
+
+Slot -> feed conversion mirrors ``ProtoDataProvider::fillSlots``:
+VECTOR_DENSE -> float list, VECTOR_SPARSE_NON_VALUE -> id list,
+VECTOR_SPARSE_VALUE -> (ids, values), INDEX -> int.  Sequence datasets
+yield, per slot, the list of per-timestep values (length-1 sequences
+included); non-sequence datasets yield each timestep's value directly.
+"""
+
+from __future__ import annotations
+
+import gzip
+
+from paddle_tpu.proto.build import message_class
+
+_DataHeader = message_class("DataHeader")
+_DataSample = message_class("DataSample")
+
+# SlotDef.SlotType values (DataFormat.proto:49)
+VECTOR_DENSE = 0
+VECTOR_SPARSE_NON_VALUE = 1
+VECTOR_SPARSE_VALUE = 2
+INDEX = 3
+STRING = 6
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def iter_proto_stream(path: str):
+    """Yield the DataHeader, then each DataSample, lazily."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        buf = f.read()
+    pos = 0
+    size, pos = _read_varint(buf, pos)
+    yield _DataHeader.FromString(buf[pos:pos + size])
+    pos += size
+    n = len(buf)
+    while pos < n:
+        size, pos = _read_varint(buf, pos)
+        yield _DataSample.FromString(buf[pos:pos + size])
+        pos += size
+
+
+def read_proto_stream(path: str):
+    """Returns (header, list_of_samples)."""
+    it = iter_proto_stream(path)
+    return next(it), list(it)
+
+
+def write_proto_stream(path: str, header, samples) -> None:
+    """Writer for the same format (tests, data conversion tools)."""
+    from google.protobuf.internal.encoder import _VarintBytes
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        for msg in [header, *samples]:
+            payload = msg.SerializeToString()
+            f.write(_VarintBytes(len(payload)))
+            f.write(payload)
+
+
+_VECTOR_TYPES = (VECTOR_DENSE, VECTOR_SPARSE_NON_VALUE,
+                 VECTOR_SPARSE_VALUE)
+
+
+def _slot_table(header):
+    """Per-slot (type, per-kind index), computed once per header.
+
+    The wire stores VECTOR slots in ``vector_slots`` and INDEX slots in
+    ``id_slots``, each in declaration order of their kind (the header
+    comment "INDEX slot should be always after VECTOR slots",
+    DataFormat.proto:64, is a convention — counting per kind is correct
+    for any order and never aliases another slot)."""
+    table = []
+    n_vec = n_idx = 0
+    for sdef in header.slot_defs:
+        if sdef.type in _VECTOR_TYPES:
+            table.append((sdef.type, n_vec))
+            n_vec += 1
+        elif sdef.type == INDEX:
+            table.append((sdef.type, n_idx))
+            n_idx += 1
+        else:
+            raise NotImplementedError(
+                f"proto data slot type {sdef.type} not supported")
+    return table
+
+
+def _slot_value(sample, table, slot_idx: int):
+    """One timestep's value for one slot (fillSlots semantics)."""
+    stype, kidx = table[slot_idx]
+    if stype == VECTOR_DENSE:
+        return list(sample.vector_slots[kidx].values)
+    if stype == VECTOR_SPARSE_NON_VALUE:
+        return list(sample.vector_slots[kidx].ids)
+    if stype == VECTOR_SPARSE_VALUE:
+        vs = sample.vector_slots[kidx]
+        return (list(vs.ids), list(vs.values))
+    return int(sample.id_slots[kidx])
+
+
+def proto_reader(file_list, sequential: bool | None = None):
+    """paddle reader over proto data files: one tuple per SEQUENCE, one
+    entry per slot.
+
+    ``sequential`` decides the row shape DATASET-wide (matching the
+    types ``input_types_from_header`` reports): sequences yield the
+    per-timestep list for every slot — including length-1 sequences —
+    while non-sequence data yields each timestep's value directly.
+    ``None`` auto-detects per file (any ``is_beginning=False`` sample).
+    """
+
+    def reader():
+        for path in file_list:
+            header, samples = read_proto_stream(path)
+            table = _slot_table(header)
+            n_slots = len(header.slot_defs)
+            has_seq = (any(not s.is_beginning for s in samples)
+                       if sequential is None else sequential)
+            seq: list = []
+
+            def emit(seq):
+                cols = []
+                for i in range(n_slots):
+                    vals = [_slot_value(s, table, i) for s in seq]
+                    cols.append(vals if has_seq else vals[0])
+                return tuple(cols)
+
+            for s in samples:
+                if s.is_beginning and seq:
+                    yield emit(seq)
+                    seq = []
+                seq.append(s)
+            if seq:
+                yield emit(seq)
+
+    return reader
+
+
+def input_types_from_header(path: str):
+    """Provider-style input_types list derived from a file's DataHeader —
+    the trainer binds these to the config's data layers in input order
+    (ProtoDataProvider keeps types in the data file, not the config)."""
+    from paddle_tpu.layers import data_type as dt
+
+    it = iter_proto_stream(path)
+    header = next(it)
+    # sequence-ness is decidable from the first continuation sample —
+    # scan a bounded prefix instead of parsing the whole (possibly huge
+    # .gz) file twice
+    has_seq = False
+    for i, s in enumerate(it):
+        if not s.is_beginning:
+            has_seq = True
+            break
+        if i >= 512:
+            break
+    kinds = []
+    for sdef in header.slot_defs:
+        if sdef.type == VECTOR_DENSE:
+            mk = (dt.dense_vector_sequence if has_seq else dt.dense_vector)
+        elif sdef.type == VECTOR_SPARSE_NON_VALUE:
+            mk = (dt.sparse_binary_vector_sequence if has_seq
+                  else dt.sparse_binary_vector)
+        elif sdef.type == VECTOR_SPARSE_VALUE:
+            mk = (dt.sparse_float_vector_sequence if has_seq
+                  else dt.sparse_float_vector)
+        elif sdef.type == INDEX:
+            mk = (dt.integer_value_sequence if has_seq
+                  else dt.integer_value)
+        else:
+            raise NotImplementedError(f"slot type {sdef.type}")
+        kinds.append(mk(int(sdef.dim)))
+    return kinds
+
+
+def multi_reader(sub_readers, ratios=None):
+    """MultiDataProvider (MultiDataProvider.h:24): one sample per
+    sub-provider per step, yielded as one concatenated tuple — the
+    reference feeds multiple data sources into one network."""
+
+    def reader():
+        its = [r() for r in sub_readers]
+        while True:
+            row = []
+            try:
+                for it in its:
+                    part = next(it)
+                    row.extend(part if isinstance(part, tuple) else (part,))
+            except StopIteration:
+                return
+            yield tuple(row)
+
+    return reader

@@ -54,6 +54,11 @@ def provider(input_types=None, should_shuffle=None, pool_size=-1,
             settings = _Settings(input_types=input_types, **kwargs)
             if init_hook is not None:
                 init_hook(settings, file_list=file_list, **kwargs)
+                # init_hook providers declare types on settings
+                # (dataprovider_bow.initializer pattern); expose them for
+                # the trainer's layer-type binding
+                if settings.input_types is not None:
+                    fn.input_types = settings.input_types
 
             def reader():
                 for filename in file_list:

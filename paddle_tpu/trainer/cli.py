@@ -61,6 +61,83 @@ def build_argparser() -> argparse.ArgumentParser:
     return p
 
 
+def _raw_reader_from_data_config(rec: dict, topo, input_order):
+    """DataConfig -> (unbatched reader, provider-ish object).
+
+    Dispatches on the config's data source type: PyDataProvider2 modules
+    ("py"/define_py_data_sources2), binary DataFormat.proto files
+    ("proto", ProtoDataProvider), or several sub-sources zipped into one
+    sample stream ("multi", MultiDataProvider.h:24)."""
+    from paddle_tpu.reader.py_data_provider2 import read_file_list
+
+    kind = rec.get("type")
+    if kind == "proto":
+        from paddle_tpu.reader import proto_data
+
+        files = read_file_list(rec["files"])
+        types = proto_data.input_types_from_header(files[0])
+        # row shape must match the header-derived types dataset-wide
+        sequential = any(t.seq_type != 0 for t in types)
+        reader = proto_data.proto_reader(files, sequential=sequential)
+
+        class _ProtoObj:  # reader metadata the batching code consults
+            should_shuffle = True
+            calc_batch_size = None
+            input_types = types
+
+        if topo is not None:
+            _apply_provider_types(topo, _ProtoObj, input_order)
+        return reader, _ProtoObj
+    if kind == "multi":
+        from paddle_tpu.reader import proto_data
+
+        subs = [_raw_reader_from_data_config(sub, None, None)
+                for sub in rec["sub"]]
+        reader = proto_data.multi_reader([r for r, _ in subs])
+        # merge type declarations preserving names where present: a dict
+        # binds by layer name, so mixing forms positionally would scramble
+        # layers — flatten dicts ONLY when every sub uses the list form
+        if any(isinstance(getattr(o, "input_types", None), dict)
+               for _, o in subs):
+            types = {}
+            for _, o in subs:
+                sub_types = getattr(o, "input_types", None) or {}
+                enforce_dict = isinstance(sub_types, dict)
+                if not enforce_dict:
+                    raise ValueError(
+                        "MultiData: mixing dict-typed and list-typed "
+                        "sub-providers is ambiguous; declare all "
+                        "input_types as {layer: type} dicts")
+                types.update(sub_types)
+        else:
+            types = []
+            for _, o in subs:
+                types.extend(getattr(o, "input_types", None) or [])
+
+        class _MultiObj:
+            should_shuffle = True
+            calc_batch_size = None
+            input_types = types
+
+        if topo is not None and types:
+            _apply_provider_types(topo, _MultiObj, input_order)
+        return reader, _MultiObj
+
+    mod = importlib.import_module(rec["module"])
+    obj = getattr(mod, rec["obj"])
+    files = read_file_list(rec["files"])
+    # config-supplied provider kwargs (define_py_data_sources2 args=...)
+    # reach the init_hook; types may be declared there rather than in the
+    # decorator, so bind them AFTER make_reader ran the hook
+    args = rec.get("args") or {}
+    if isinstance(args, str):
+        args = dict(f.split("=", 1) for f in args.split(",") if f)
+    reader = obj.make_reader(files, **args)
+    if topo is not None:
+        _apply_provider_types(topo, obj, input_order)
+    return reader, obj
+
+
 def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
                              topo=None, input_order=None,
                              drop_last: bool | None = None):
@@ -68,14 +145,8 @@ def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
     The provider's declared ``input_types`` override the data layers' dense
     placeholders (reference: types live in the provider, not the config)."""
     import paddle_tpu as paddle
-    from paddle_tpu.reader.py_data_provider2 import read_file_list
 
-    mod = importlib.import_module(rec["module"])
-    obj = getattr(mod, rec["obj"])
-    if topo is not None:
-        _apply_provider_types(topo, obj, input_order)
-    files = read_file_list(rec["files"])
-    reader = obj.make_reader(files)
+    reader, obj = _raw_reader_from_data_config(rec, topo, input_order)
     if shuffle and getattr(obj, "should_shuffle", True) is not False:
         reader = paddle.reader.shuffle(reader, buf_size=4096)
     if drop_last is None:
@@ -158,7 +229,16 @@ def _load_provider_types(args, parsed, topo):
     from paddle_tpu.config import parse_state
 
     rec = parse_state.STATE.data_config or parse_state.STATE.test_data_config
-    if not rec or not rec.get("module"):
+    if not rec:
+        return
+    if rec.get("type") in ("proto", "multi"):
+        # header-derived types (no provider module to import)
+        try:
+            _raw_reader_from_data_config(rec, topo, parsed.input_layer_names)
+        except Exception:
+            pass  # data files unavailable: dense placeholders stand
+        return
+    if not rec.get("module"):
         return
     _add_config_dir_to_path(args.config)
     try:

@@ -63,6 +63,11 @@ def make_config_environment(config_path: str, config_args: dict) -> dict:
         Outputs=parse_state.Outputs,
         HasInputsSet=parse_state.HasInputsSet,
         outputs=parse_state.outputs,
+        # the reference's 2017-era configs are python 2
+        # (v1_api_demo/traffic_prediction/trainer_config.py uses xrange)
+        xrange=range,
+        long=int,
+        unicode=str,
     )
     return env
 
@@ -163,6 +168,22 @@ def _fill_data_config(dc, rec: dict, for_test: bool = False) -> None:
         if rec.get("buffer_capacity"):
             dc.buffer_capacity = rec["buffer_capacity"]
         dc.for_test = for_test
+        return
+    if kind == "proto":
+        # ≅ config_parser.py:1036 ProtoData emission
+        dc.type = "proto"
+        if rec.get("files"):
+            dc.files = rec["files"]
+        if rec.get("usage_ratio") is not None:
+            dc.usage_ratio = rec["usage_ratio"]
+        dc.for_test = for_test
+        return
+    if kind == "multi":
+        dc.type = "multi"
+        dc.for_test = for_test
+        for sub in rec.get("sub", ()):
+            _fill_data_config(dc.sub_data_configs.add(), sub,
+                              for_test=for_test)
         return
     dc.type = "py2" if kind == "py2" else "py"
     if rec.get("files"):

@@ -31,6 +31,9 @@ from paddle_tpu.trainer_config_helpers.optimizers import (  # noqa: F401
     AdaGradOptimizer,
     AdamaxOptimizer,
     AdamOptimizer,
+    BaseRegularization,
+    L1Regularization,
+    L2Regularization,
     MomentumOptimizer,
     RMSPropOptimizer,
     settings,
@@ -113,7 +116,9 @@ def data_layer(name, size=None, depth=None, height=None, width=None,
 from paddle_tpu.config.parse_state import (  # noqa: E402,F401
     HasInputsSet,
     Inputs,
+    MultiData,
     Outputs,
+    ProtoData,
     PyData,
     SimpleData,
     TestData,

@@ -1,0 +1,59 @@
+"""Parallel image preprocessing pipeline
+(≅ ``python/paddle/utils/image_multiproc.py``: the reference fans image
+decode/augment out to worker processes feeding the trainer).
+
+TPU-native version: the preprocessing (``utils/image.py`` transforms)
+runs in a thread pool via the reader combinator ``xmap_readers`` —
+NumPy/PIL release the GIL for the heavy parts, and the jitted train step
+owns the accelerator, so threads (not processes) saturate input
+preparation without pickling overhead.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.reader.decorator import xmap_readers
+from paddle_tpu.utils import image as img_utils
+
+
+class MultiProcessImageTransformer:
+    """Parallel train/test image transformer.
+
+    ``run(paths_and_labels)`` maps (path, label) rows to
+    (CHW float array, label) using ``procnum`` workers, preserving
+    order — the drop-in role of the reference class of the same name.
+    """
+
+    def __init__(self, procnum: int = 10, resize_size: int = 256,
+                 crop_size: int = 224, transpose=(2, 0, 1),
+                 channel_swap=None, mean=None, is_train: bool = True,
+                 is_color: bool = True, buffer_size: int = 1024):
+        self.procnum = max(int(procnum), 1)
+        self.resize_size = resize_size
+        self.crop_size = crop_size
+        self.is_train = is_train
+        self.is_color = is_color
+        self.mean = mean
+        self.buffer_size = buffer_size
+
+    def _one(self, row):
+        path, label = row
+        im = img_utils.load_and_transform(
+            path, self.resize_size, self.crop_size, self.is_train,
+            self.is_color)
+        if self.mean is not None:
+            im = im - self.mean
+        return im, label
+
+    def run(self, rows):
+        """rows: iterable of (image_path, label); returns an iterator of
+        transformed (array, label) pairs in input order."""
+        reader = xmap_readers(self._one, lambda: iter(rows),
+                              process_num=self.procnum,
+                              buffer_size=self.buffer_size, order=True)
+        return reader()
+
+    def reader(self, base_reader):
+        """Wrap a paddle reader of (path, label) samples."""
+        return xmap_readers(self._one, base_reader,
+                            process_num=self.procnum,
+                            buffer_size=self.buffer_size, order=True)

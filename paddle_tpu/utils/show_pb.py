@@ -1,0 +1,29 @@
+"""Print the content of a paddle proto data file
+(≅ ``python/paddle/utils/show_pb.py``): the DataHeader followed by every
+DataSample of a varint-framed DataFormat stream.
+
+Usage: python -m paddle_tpu.utils.show_pb PROTO_DATA_FILE
+"""
+
+from __future__ import annotations
+
+import sys
+
+from paddle_tpu.reader.proto_data import read_proto_stream
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 1:
+        print("Usage: python -m paddle_tpu.utils.show_pb PROTO_DATA_FILE",
+              file=sys.stderr)
+        return 1
+    header, samples = read_proto_stream(argv[0])
+    print(header)
+    for s in samples:
+        print(s)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

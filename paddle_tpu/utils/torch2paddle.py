@@ -1,0 +1,84 @@
+"""Convert torch parameter files to paddle model parameter files
+(≅ ``python/paddle/utils/torch2paddle.py``, which read torch7 ``.t7``
+blobs via the ``torchfile`` package and wrote one reference-binary file
+per layer).
+
+The modern equivalent: PyTorch checkpoints (``state_dict`` saved with
+``torch.save``).  Each tensor is written in the reference
+``Parameter::save`` binary format (``core/parameters.py``), one file per
+entry, into an output directory that ``Parameters.init_from_reference_dir``
+(or the reference framework itself) can load.  Linear weights are
+transposed torch [out, in] -> paddle [in, out], matching the original
+tool's ``reshape + transpose`` of torch blobs.
+
+Usage:
+    python -m paddle_tpu.utils.torch2paddle -i model.pt -o out_dir \
+        [-l name_map.txt]
+
+``name_map.txt``: optional ``torch_name<TAB>paddle_name`` lines (the
+original tool's ``layers.txt`` role); unmapped entries keep their torch
+name with dots replaced by underscores.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from paddle_tpu.core.parameters import save_reference_param
+
+
+def convert_state_dict(state, out_dir: str, name_map=None,
+                       transpose_linear: bool = True) -> list[str]:
+    """Write every floating tensor of a state_dict into ``out_dir``."""
+    os.makedirs(out_dir, exist_ok=True)
+    name_map = name_map or {}
+    written = []
+    for name, tensor in state.items():
+        arr = np.asarray(
+            tensor.detach().cpu().numpy() if hasattr(tensor, "detach")
+            else tensor)
+        if arr.dtype.kind != "f":
+            continue
+        if transpose_linear and arr.ndim == 2:
+            arr = arr.T  # torch Linear [out, in] -> paddle [in, out]
+        out_name = name_map.get(name, name.replace(".", "_"))
+        save_reference_param(os.path.join(out_dir, out_name), arr)
+        written.append(out_name)
+    return written
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-i", "--input", required=True,
+                    help="PyTorch checkpoint (torch.save state_dict)")
+    ap.add_argument("-o", "--output", required=True,
+                    help="output directory of paddle binary parameters")
+    ap.add_argument("-l", "--layer-map", default=None,
+                    help="torch_name<TAB>paddle_name lines")
+    ap.add_argument("--no-transpose", action="store_true",
+                    help="keep 2-D tensors in torch layout")
+    args = ap.parse_args(argv)
+
+    import torch
+
+    state = torch.load(args.input, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    name_map = {}
+    if args.layer_map:
+        with open(args.layer_map) as f:
+            for line in f:
+                if line.strip():
+                    k, v = line.rstrip("\n").split("\t")
+                    name_map[k] = v
+    written = convert_state_dict(state, args.output, name_map,
+                                 transpose_linear=not args.no_transpose)
+    print(f"wrote {len(written)} parameters to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

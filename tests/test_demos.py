@@ -1,0 +1,50 @@
+"""v1_api_demo parity runners (paddle_tpu.demo.*): the REFERENCE configs
+execute through our trainer — quick_start's trainer_config.lr.py runs
+completely unmodified; traffic_prediction's config is byte-identical
+with a py3 data provider; model_zoo's pretrained-binary-dir
+load/extract mechanism round-trips."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+REF = os.environ.get("PADDLE_REFERENCE_ROOT", "/root/reference")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF, "v1_api_demo")),
+    reason="reference checkout absent")
+
+
+def test_quick_start_reference_config(tmp_path, capsys):
+    from paddle_tpu.demo.quick_start import run
+
+    rc = run.main(["--workdir", str(tmp_path), "--passes", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "classification_error_evaluator" in out
+
+
+def test_traffic_prediction_reference_config(tmp_path, capsys):
+    from paddle_tpu.demo.traffic_prediction import run
+
+    rc = run.main(["--workdir", str(tmp_path), "--passes", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Cost" in out
+    # the reference config is used byte-identically
+    with open(os.path.join(
+            REF, "v1_api_demo/traffic_prediction/trainer_config.py")) as f:
+        ref = f.read()
+    with open(tmp_path / "trainer_config.py") as f:
+        assert f.read() == ref
+
+
+def test_model_zoo_feature_extraction(tmp_path, capsys):
+    from paddle_tpu.demo.model_zoo import run
+
+    rc = run.main(["--workdir", str(tmp_path), "--batches", "10"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "features from the reloaded binary-dir model match" in out

@@ -1,0 +1,193 @@
+"""ProtoDataProvider parity: the binary DataFormat.proto stream
+(varint-delimited DataHeader + DataSamples, ProtoReader.h framing) is
+read back into trainer feeds, sequences regrouped by ``is_beginning``,
+and a TrainData(ProtoData(...)) config trains end-to-end through the
+CLI.  MultiData zips two sources into one sample stream."""
+
+from __future__ import annotations
+
+import textwrap
+
+import numpy as np
+
+from paddle_tpu.proto.build import message_class
+from paddle_tpu.reader import proto_data as pdata
+
+DataHeader = message_class("DataHeader")
+DataSample = message_class("DataSample")
+
+
+def _mk_header(slots):
+    h = DataHeader()
+    for t, d in slots:
+        sd = h.slot_defs.add()
+        sd.type = t
+        sd.dim = d
+    return h
+
+
+def _dense_index_file(path, n=32, dim=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    header = _mk_header([(pdata.VECTOR_DENSE, dim), (pdata.INDEX, classes)])
+    samples = []
+    for _ in range(n):
+        y = int(rng.integers(0, classes))
+        x = rng.normal(size=(dim,)).astype(np.float32) * 0.1
+        x[y * 2:(y + 1) * 2] += 1.0
+        s = DataSample()
+        vs = s.vector_slots.add()
+        vs.values.extend(x.tolist())
+        s.id_slots.append(y)
+        samples.append(s)
+    pdata.write_proto_stream(path, header, samples)
+
+
+def test_proto_stream_roundtrip(tmp_path):
+    p = str(tmp_path / "d.bin")
+    _dense_index_file(p, n=5)
+    header, samples = pdata.read_proto_stream(p)
+    assert len(header.slot_defs) == 2 and len(samples) == 5
+    assert header.slot_defs[0].dim == 8
+    rows = list(pdata.proto_reader([p])())
+    assert len(rows) == 5
+    x, y = rows[0]
+    assert len(x) == 8 and isinstance(y, int)
+    # gzip variant
+    pz = str(tmp_path / "d.bin.gz")
+    _dense_index_file(pz, n=5)
+    assert len(list(pdata.proto_reader([pz])())) == 5
+
+
+def test_proto_sequences_regroup(tmp_path):
+    header = _mk_header([(pdata.INDEX, 10)])
+    samples = []
+    for begin, val in [(True, 1), (False, 2), (False, 3),
+                       (True, 4), (False, 5)]:
+        s = DataSample()
+        s.is_beginning = begin
+        s.id_slots.append(val)
+        samples.append(s)
+    p = str(tmp_path / "seq.bin")
+    pdata.write_proto_stream(p, header, samples)
+    rows = list(pdata.proto_reader([p])())
+    assert rows == [([1, 2, 3],), ([4, 5],)]
+    (t,) = pdata.input_types_from_header(p)
+    assert t.seq_type != 0  # sequence detected
+
+
+def test_cli_trains_from_proto_data(tmp_path):
+    _dense_index_file(str(tmp_path / "train.bin"), n=256)
+    (tmp_path / "train.list").write_text(str(tmp_path / "train.bin") + "\n")
+    cfg = tmp_path / "proto.conf"
+    cfg.write_text(textwrap.dedent(f"""
+        from paddle.trainer_config_helpers import *
+
+        TrainData(ProtoData(files='{tmp_path}/train.list'))
+        settings(batch_size=32, learning_rate=1e-2,
+                 learning_method=AdamOptimizer())
+        x = data_layer(name='x', size=8)
+        pred = fc_layer(input=x, size=4, act=SoftmaxActivation())
+        lbl = data_layer(name='label', size=4)
+        outputs(classification_cost(input=pred, label=lbl))
+    """))
+    from paddle_tpu.trainer import cli
+
+    rc = cli.main(["--config", str(cfg), "--job", "train",
+                   "--num_passes", "4"])
+    assert rc == 0
+
+
+def test_multi_reader_zips_sources(tmp_path):
+    p1, p2 = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+    _dense_index_file(p1, n=6, seed=1)
+    _dense_index_file(p2, n=9, seed=2)
+    r1 = pdata.proto_reader([p1])
+    r2 = pdata.proto_reader([p2])
+    rows = list(pdata.multi_reader([r1, r2])())
+    assert len(rows) == 6  # shortest source bounds the zip
+    assert len(rows[0]) == 4  # 2 slots from each source
+
+
+def test_show_pb_and_torch2paddle(tmp_path, capsys):
+    """The small-utils family: show_pb prints the stream; torch2paddle
+    writes reference-binary params a Parameters object loads back."""
+    p = str(tmp_path / "d.bin")
+    _dense_index_file(p, n=2)
+    from paddle_tpu.utils import show_pb
+
+    assert show_pb.main([p]) == 0
+    out = capsys.readouterr().out
+    assert "slot_defs" in out and "vector_slots" in out
+
+    import torch
+
+    from paddle_tpu.core.parameters import load_reference_param
+    from paddle_tpu.utils.torch2paddle import convert_state_dict
+
+    state = {"fc.weight": torch.arange(6, dtype=torch.float32).reshape(2, 3),
+             "fc.bias": torch.ones(2)}
+    written = convert_state_dict(state, str(tmp_path / "params"))
+    assert sorted(written) == ["fc_bias", "fc_weight"]
+    w = load_reference_param(str(tmp_path / "params" / "fc_weight"))
+    # [out=2, in=3] transposed to paddle [in, out] layout
+    np.testing.assert_array_equal(
+        w.reshape(3, 2), np.arange(6, dtype=np.float32).reshape(2, 3).T)
+
+
+def test_image_multiproc_transformer(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.utils.image_multiproc import MultiProcessImageTransformer
+
+    rows = []
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray(
+            rng.integers(0, 255, size=(40, 30, 3), dtype=np.uint8)).save(p)
+        rows.append((str(p), i))
+    tf = MultiProcessImageTransformer(procnum=2, resize_size=32, crop_size=24)
+    out = list(tf.run(rows))
+    assert [lab for _, lab in out] == [0, 1, 2, 3]  # order preserved
+    assert out[0][0].shape == (3, 24, 24)
+
+
+def test_length_one_sequences_keep_list_shape(tmp_path):
+    """A sequence dataset containing a length-1 sequence must still yield
+    per-timestep LISTS for every row (review finding r4)."""
+    header = _mk_header([(pdata.INDEX, 10)])
+    samples = []
+    for begin, val in [(True, 1), (False, 2), (True, 7), (True, 3),
+                       (False, 4)]:
+        s = DataSample()
+        s.is_beginning = begin
+        s.id_slots.append(val)
+        samples.append(s)
+    p = str(tmp_path / "seq1.bin")
+    pdata.write_proto_stream(p, header, samples)
+    rows = list(pdata.proto_reader([p], sequential=True)())
+    assert rows == [([1, 2],), ([7],), ([3, 4],)]
+
+
+def test_proto_config_emits_reference_dataconfig(tmp_path):
+    """TrainData(ProtoData(...)) serializes as DataConfig.type='proto'
+    with usage_ratio, like the reference's config_parser emission."""
+    import textwrap
+
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    cfg = tmp_path / "p.conf"
+    cfg.write_text(textwrap.dedent("""
+        from paddle.trainer_config_helpers import *
+        TrainData(ProtoData(files='train.list', usage_ratio=0.5))
+        settings(batch_size=8, learning_rate=1e-2)
+        x = data_layer(name='x', size=4)
+        pred = fc_layer(input=x, size=2, act=SoftmaxActivation())
+        lbl = data_layer(name='label', size=2)
+        outputs(classification_cost(input=pred, label=lbl))
+    """))
+    parsed = parse_config(str(cfg), "")
+    dc = parsed.trainer_config.data_config
+    assert dc.type == "proto"
+    assert dc.files == "train.list"
+    assert abs(dc.usage_ratio - 0.5) < 1e-9

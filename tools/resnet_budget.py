@@ -1,0 +1,133 @@
+"""ResNet-50 bs128 train-step HBM byte budget — bottom-up minimum traffic
+vs the profiler's measured byte counts (VERDICT r3 item 3: "is 43.2
+GB/step necessary?").
+
+The budget assumes PERFECT fusion and residency:
+
+- forward: every conv reads its input activation once (bf16, the
+  compute_dtype policy), reads its weights (bf16 copy of the f32
+  master), writes its output once; BN scale/shift and ReLU are epilogue
+  math (no extra traffic beyond the tiny stats vectors); each residual
+  add re-reads the skip tensor once.
+- batch-norm statistics: one extra READ of each conv output (the mean/var
+  reduction cannot fuse into the conv that produces the tensor on TPU —
+  XLA's conv epilogue cannot hold the cross-batch reduction) — this is
+  the one "optional" line the audit flags; a fully fused single-pass
+  Welford epilogue would remove it.
+- backward: for each conv, dY is read twice (once by the dX contraction,
+  once by dW), the saved bf16 input activation read once (dW), weights
+  read once (dX), dX written once, dW written once (f32).
+- optimizer (momentum): read grad f32 + master f32 + momentum f32, write
+  master + momentum  -> 5 x 4 bytes per parameter.
+- loss/head: logits [128, 1000] negligible.
+
+Run: PYTHONPATH=. python tools/resnet_budget.py
+"""
+
+from __future__ import annotations
+
+BS = 128
+BF16 = 2
+F32 = 4
+
+
+def resnet50_convs():
+    """(name, in_hw, cin, k, stride, out_hw, cout) for every conv,
+    including projection shortcuts (standard ResNet-50 v1.5 shapes)."""
+    convs = [("stem", 224, 3, 7, 2, 112, 64)]
+    stages = [  # (blocks, cin_first, mid, out, hw_in, stride_first)
+        (3, 64, 64, 256, 56, 1),
+        (4, 256, 128, 512, 56, 2),
+        (6, 512, 256, 1024, 28, 2),
+        (3, 1024, 512, 2048, 14, 2),
+    ]
+    for si, (blocks, cin0, mid, cout, hw_in, stride0) in enumerate(stages):
+        cin = cin0
+        hw = hw_in
+        for b in range(blocks):
+            stride = stride0 if b == 0 else 1
+            hw_out = hw // stride
+            tag = f"s{si+1}b{b+1}"
+            convs.append((f"{tag}.c1", hw, cin, 1, 1, hw, mid))
+            convs.append((f"{tag}.c2", hw, mid, 3, stride, hw_out, mid))
+            convs.append((f"{tag}.c3", hw_out, mid, 1, 1, hw_out, cout))
+            if b == 0:
+                convs.append((f"{tag}.proj", hw, cin, 1, stride, hw_out,
+                              cout))
+            cin = cout
+            hw = hw_out
+    return convs
+
+
+def budget(bs: int = BS):
+    convs = resnet50_convs()
+    act_in = act_out = weights = 0
+    n_params = 0
+    for name, hw, cin, k, stride, hwo, cout in convs:
+        a_in = bs * hw * hw * cin * BF16
+        a_out = bs * hwo * hwo * cout * BF16
+        w = k * k * cin * cout * BF16
+        n_params += k * k * cin * cout
+        act_in += a_in
+        act_out += a_out
+        weights += w
+
+    # residual skip adds: one extra read of each block output (16 blocks)
+    skip = 0
+    hw_map = [(3, 56, 256), (4, 28, 512), (6, 14, 1024), (3, 7, 2048)]
+    for blocks, hw, cout in hw_map:
+        skip += blocks * bs * hw * hw * cout * BF16
+
+    fwd = act_in + act_out + weights + skip
+    bn_stats = act_out  # one extra read of each conv output for mean/var
+    # BN backward reduction pass: dgamma/dbeta and the recentering terms
+    # need one read of dY and one of x_hat (= the saved conv output) that
+    # cannot fuse into the conv-bwd contractions' own operand reads
+    bn_bwd = 2 * act_out
+    # backward: dY read twice + act read once + W read + dX write + dW write
+    bwd = (2 * act_out        # dY read by dX and dW contractions
+           + act_in           # saved activations (dW)
+           + weights          # W read (dX)
+           + act_in           # dX written (same sizes as inputs)
+           + n_params * F32)  # dW written f32
+    opt = 5 * n_params * F32
+    total = fwd + bn_stats + bn_bwd + bwd + opt
+    rows = [
+        ("fwd: conv input reads (bf16)", act_in),
+        ("fwd: conv output writes (bf16)", act_out),
+        ("fwd: weight reads (bf16)", weights),
+        ("fwd: residual skip re-reads", skip),
+        ("BN statistics pass (re-read of conv outputs)", bn_stats),
+        ("BN backward reduce pass (dY + x_hat reads)", bn_bwd),
+        ("bwd: dY reads (x2: dX + dW contractions)", 2 * act_out),
+        ("bwd: saved activation reads", act_in),
+        ("bwd: weight reads", weights),
+        ("bwd: dX writes", act_in),
+        ("bwd: dW writes (f32)", n_params * F32),
+        ("optimizer (momentum, 5x f32/param)", opt),
+    ]
+    return rows, total, n_params
+
+
+def main():
+    rows, total, n_params = budget()
+    print(f"ResNet-50 bs{BS} minimum-traffic budget "
+          f"({n_params/1e6:.1f}M conv params):")
+    for name, b in rows:
+        print(f"  {name:48s} {b/1e9:7.2f} GB")
+    print(f"  {'TOTAL minimum':48s} {total/1e9:7.2f} GB")
+    print()
+    ms, stream = 45.25, 670.0
+    serviceable = ms * 1e-3 * stream
+    print("measured (tools/profile_resnet.py): 43.2 GB COUNTED per step —")
+    print("  per-op raw_bytes_accessed double-counts VMEM-served fusion")
+    print("  operands (the 'other' segment runs at 4000+ GB/s counted);")
+    print(f"  the step's {ms} ms at the {stream:.0f} GB/s STREAM ceiling can")
+    print(f"  physically service {serviceable:.1f} GB")
+    slack = serviceable - total / 1e9
+    print(f"slack: {serviceable:.1f} - {total/1e9:.1f} = {slack:.1f} GB "
+          f"({slack / serviceable * 100:.0f}% of serviceable)")
+
+
+if __name__ == "__main__":
+    main()
