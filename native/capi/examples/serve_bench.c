@@ -1,0 +1,113 @@
+/* Multi-threaded serving benchmark for the C inference ABI — the
+ * measured answer to "does create_shared_param give real concurrency?"
+ * (reference pattern: capi/gradient_machine.h:68, one shared-param
+ * machine per serving thread).
+ *
+ * Usage: serve_bench <merged_model> <rows> <threads> <iters> [--use_cpu]
+ * Creates one origin machine + (threads-1) shared-param machines (all
+ * aliasing ONE loaded artifact), runs <iters> forwards of a <rows>-row
+ * batch on each thread, prints aggregate forwards/s and rows/s.
+ */
+#define _POSIX_C_SOURCE 199309L
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#include "../paddle_capi.h"
+
+#define CHECK(stmt)                                      \
+  do {                                                   \
+    paddle_error e = (stmt);                             \
+    if (e != kPD_NO_ERROR) {                             \
+      fprintf(stderr, "FAIL %s -> %d\n", #stmt, (int)e); \
+      exit(1);                                           \
+    }                                                    \
+  } while (0)
+
+typedef struct {
+  paddle_gradient_machine machine;
+  uint64_t rows, dim, iters;
+} WorkerArgs;
+
+static void* worker(void* argp) {
+  WorkerArgs* a = (WorkerArgs*)argp;
+  paddle_matrix input = paddle_matrix_create(a->rows, a->dim);
+  for (uint64_t r = 0; r < a->rows; r++) {
+    float* row;
+    CHECK(paddle_matrix_get_row(input, r, &row));
+    for (uint64_t c = 0; c < a->dim; c++)
+      row[c] = (float)((r * 31 + c * 7) % 97) / 97.0f;
+  }
+  paddle_matrix outs[8];
+  for (uint64_t i = 0; i < a->iters; i++) {
+    uint64_t n_out = 8;
+    CHECK(paddle_gradient_machine_forward(a->machine, &input, 1, outs,
+                                          &n_out));
+    for (uint64_t o = 0; o < n_out; o++) paddle_matrix_destroy(outs[o]);
+  }
+  paddle_matrix_destroy(input);
+  return NULL;
+}
+
+static double now_sec(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s model.tar rows threads iters [--use_cpu]\n",
+            argv[0]);
+    return 2;
+  }
+  uint64_t rows = strtoull(argv[2], NULL, 10);
+  int threads = atoi(argv[3]);
+  uint64_t iters = strtoull(argv[4], NULL, 10);
+  if (rows == 0 || threads <= 0 || threads > 1024 || iters == 0) {
+    fprintf(stderr, "rows/threads/iters must be positive (threads <= 1024)\n");
+    return 2;
+  }
+
+  CHECK(paddle_init(argc - 1, argv + 1));
+
+  paddle_gradient_machine origin;
+  CHECK(paddle_gradient_machine_load_from_path(&origin, argv[1]));
+  uint64_t dim;
+  CHECK(paddle_gradient_machine_get_input_dim(origin, 0, &dim));
+
+  WorkerArgs* args = calloc(threads, sizeof(WorkerArgs));
+  args[0].machine = origin;
+  for (int t = 1; t < threads; t++)
+    CHECK(paddle_gradient_machine_create_shared_param(&args[t].machine,
+                                                      origin));
+  /* warm both paths (compile caches) */
+  for (int t = 0; t < threads; t++) {
+    args[t].rows = rows;
+    args[t].dim = dim;
+    args[t].iters = 1;
+    worker(&args[t]);
+    args[t].iters = iters;
+  }
+
+  pthread_t* tids = calloc(threads, sizeof(pthread_t));
+  double t0 = now_sec();
+  for (int t = 0; t < threads; t++)
+    pthread_create(&tids[t], NULL, worker, &args[t]);
+  for (int t = 0; t < threads; t++) pthread_join(tids[t], NULL);
+  double dt = now_sec() - t0;
+
+  double fwd = (double)threads * (double)iters;
+  printf("threads=%d rows=%llu iters=%llu wall=%.3fs forwards/s=%.1f "
+         "rows/s=%.0f\n",
+         threads, (unsigned long long)rows, (unsigned long long)iters, dt,
+         fwd / dt, fwd * (double)rows / dt);
+
+  for (int t = 1; t < threads; t++)
+    paddle_gradient_machine_destroy(args[t].machine);
+  paddle_gradient_machine_destroy(origin);
+  free(tids);
+  free(args);
+  return 0;
+}

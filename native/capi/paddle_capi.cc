@@ -119,6 +119,24 @@ paddle_error paddle_gradient_machine_create_for_inference_with_parameters(
   return kPD_NO_ERROR;
 }
 
+paddle_error paddle_gradient_machine_create_shared_param(
+    paddle_gradient_machine* machine, paddle_gradient_machine origin) {
+  if (!machine || !origin) return kPD_NULLPTR;
+  if (!g_initialized) return kPD_UNDEFINED_ERROR;
+  auto* orig = static_cast<Machine*>(origin);
+  GILGuard gil;
+  PyObject* ret = PyObject_CallMethod(Bridge(), "create_shared_machine", "l",
+                                      orig->handle);
+  if (!ret) {
+    PyErr_Print();
+    return kPD_UNDEFINED_ERROR;
+  }
+  long h = PyLong_AsLong(ret);
+  Py_DECREF(ret);
+  *machine = new Machine{h};
+  return kPD_NO_ERROR;
+}
+
 paddle_error paddle_gradient_machine_load_from_path(
     paddle_gradient_machine* machine, const char* path) {
   if (!machine || !path) return kPD_NULLPTR;

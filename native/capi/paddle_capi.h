@@ -6,9 +6,17 @@
  * through paddle_tpu.capi_bridge, so serving links against ONE .so and
  * needs no model code.
  *
- * Thread-safety: calls are serialized on the embedded interpreter's GIL;
- * for multi-threaded serving create one machine per thread (the
- * reference's create_shared_param pattern) — machines share nothing.
+ * Thread-safety: entry points take the embedded interpreter's GIL for
+ * marshalling; it is safe to call from N threads concurrently.  For
+ * multi-threaded serving create one machine per thread with
+ * paddle_gradient_machine_create_shared_param below — shared machines
+ * alias ONE loaded artifact (weights are baked into the compiled
+ * executable; the machine is a pure function), so there is no per-thread
+ * weight copy.  Measured on a single-core host, 1->8 threads are
+ * throughput-flat with <2% overhead (native/capi/examples/serve_bench.c,
+ * BENCHMARKS.md); per-thread compute overlap on multi-core hosts is not
+ * yet measured — the standard deployment there is one process per
+ * worker (the artifact file shared via the OS page cache).
  */
 #ifndef PADDLE_TPU_CAPI_H
 #define PADDLE_TPU_CAPI_H
@@ -56,6 +64,12 @@ paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
                                              uint64_t n_in,
                                              paddle_matrix* outs,
                                              uint64_t* n_out);
+/* New machine sharing ORIGIN's loaded artifact (reference
+ * gradient_machine.h:68 create_shared_param): no weight duplication —
+ * the weights live once inside the compiled executable both handles
+ * alias. Use one shared machine per serving thread. */
+paddle_error paddle_gradient_machine_create_shared_param(
+    paddle_gradient_machine* machine, paddle_gradient_machine origin);
 paddle_error paddle_gradient_machine_destroy(paddle_gradient_machine machine);
 /* Introspection: input count and per-input feature dim (meta.json order). */
 paddle_error paddle_gradient_machine_get_num_inputs(

@@ -34,6 +34,19 @@ def create_machine(model_bytes: bytes) -> int:
     return h
 
 
+def create_shared_machine(origin: int) -> int:
+    """A new machine handle sharing the ORIGIN's loaded artifact — the
+    reference's ``paddle_gradient_machine_create_shared_param``
+    (gradient_machine.h:68): weights are baked into the compiled StableHLO
+    executable and the machine is a pure function, so sharing is exact
+    aliasing with zero per-machine weight copies."""
+    m = _machines[origin]
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _machines[h] = m
+    return h
+
+
 def destroy_machine(handle: int) -> None:
     _machines.pop(handle, None)
 

@@ -444,21 +444,35 @@ class GeneratedSequence:
 
 def _nested_beam_group(name, beam_node, seq_inputs):
     """recurrent_group over subsequences whose step IS a beam_search (the
-    sample_trainer_nest_rnn_gen.conf shape): each subsequence generates
-    independently (the reference notes the outer memory is read-only and
-    unused), so execution flattens [B, S, ...] subsequences into a
-    [B*S]-row generation batch and re-attaches the outer LoD.  Generalizing
-    to inner steps that consume a live outer memory would need the outer
-    scan to carry GeneratedSequence state and is intentionally rejected
-    until a use case exists."""
+    sample_trainer_nest_rnn_gen.conf shape).
+
+    Two execution modes:
+
+    - independent subsequences (the reference test's shape — its outer
+      memory is read-only and unused): flatten [B, S, ...] into a
+      [B*S]-row generation batch, one fused run;
+    - LIVE outer memory (≅ RecurrentGradientMachine.cpp:1291, outer-frame
+      memory plumbed into inner frames via ScatterAgentLayer): an inner
+      memory whose ``boot_layer`` is an outer ``memory()`` placeholder
+      boots each subsequence's generation from the state the PREVIOUS
+      subsequence ended in (best beam); the outer loop runs the (static)
+      subsequence count sequentially, freezing the carry past each row's
+      ``seq_length``.
+    """
     enforce(len(seq_inputs) == 1,
             "nested beam generation supports exactly one subsequence input")
+    beam_info = beam_node.attrs.get("beam_run") or {}
+    live_idx = [i for i, bl in enumerate(beam_info.get("boot_layers", ()))
+                if bl is not None and bl.layer_type == "__memory__"]
+    if live_idx:
+        return _nested_beam_group_live(name, beam_node, seq_inputs,
+                                       beam_info, live_idx)
     enforce(
         len(beam_node.parents) == 1,
         "nested beam generation requires the inner beam_search to take "
-        "exactly one (read-only) outer input; extra StaticInputs or live "
-        "outer memories are not supported — restructure so the inner step "
-        "depends only on the subsequence input",
+        "exactly one (read-only) outer input; extra StaticInputs without "
+        "a live outer memory are not supported — restructure so the "
+        "inner step depends only on the subsequence input",
     )
     outer = seq_inputs[0]
     # the wrapper supersedes the inner node as "__beam_search_predict__"
@@ -483,6 +497,92 @@ def _nested_beam_group(name, beam_node, seq_inputs):
         attrs={**{k: v for k, v in beam_node.attrs.items()
                   if k != "__in_group__"},
                "aliases": inner_aliases or ("__beam_search_predict__",)},
+    )
+
+
+def _nested_beam_group_live(name, beam_node, seq_inputs, beam_info,
+                            live_idx):
+    """Live-outer-memory nested generation (see _nested_beam_group)."""
+    outer = seq_inputs[0]
+    run = beam_info["run"]
+    mems = beam_info["mems"]
+    boot_layers = list(beam_info["boot_layers"])
+    static_inputs = list(beam_info["static_inputs"])
+    enforce(
+        len(static_inputs) == 1
+        and static_inputs[0].layer_type == "__step_input__",
+        "live-outer-memory nested generation takes exactly one "
+        "subsequence input (plus outer memories)")
+    live_mem_names = [mems[i].name for i in live_idx]
+    outer_mem_phs = [boot_layers[i] for i in live_idx]
+    # the outer memories' own boots (real outer-graph layers, or zeros)
+    outer_boots = [ph._boot_layer for ph in outer_mem_phs]
+    # inner memories booted from a FIXED outer layer (not a live memory):
+    # same value every outer step
+    fixed_idx = [j for j, bl in enumerate(boot_layers)
+                 if bl is not None and j not in live_idx]
+    inner_aliases = beam_node.attrs.get("aliases", ())
+    beam_node.attrs["aliases"] = ()
+    beam_node.attrs["__in_group__"] = True
+
+    def fwd(ctx, params, states, outer_val, *pv):
+        enforce(isinstance(outer_val, NestedSequenceBatch),
+                "nested beam generation needs a NestedSequenceBatch feed "
+                "(sequence of subsequences)")
+        b, n_sub = outer_val.data.shape[:2]
+        # parent values: outer-memory boots first, then fixed boots —
+        # the order `parents` is declared in below
+        pv = list(pv)
+        carries = []
+        for ph, ob in zip(outer_mem_phs, outer_boots):
+            if ob is not None:
+                carries.append(_raw_boot(pv.pop(0)))
+            else:
+                carries.append(_boot_value(ph, None, b))
+        fixed_vals = {j: pv.pop(0) for j in fixed_idx}
+
+        per_step = []
+        for t in range(n_sub):
+            sub_t = SequenceBatch(data=outer_val.data[:, t],
+                                  length=outer_val.sub_length[:, t])
+            # boot list in `run`'s expected order: every not-None boot of
+            # boot_layers, live entries replaced by the running carry
+            boots_in = []
+            li = 0
+            for j, bl in enumerate(boot_layers):
+                if j in live_idx:
+                    boots_in.append(carries[li])
+                    li += 1
+                elif bl is not None:
+                    boots_in.append(fixed_vals[j])
+            gen, final = run(ctx, params, states, [sub_t], boots_in,
+                             return_final_mems=True)
+            # rows whose outer sequence already ended freeze their carry
+            active = (t < outer_val.seq_length)[:, None]
+            carries = [
+                jnp.where(active, final[nm], c)
+                for nm, c in zip(live_mem_names, carries)
+            ]
+            per_step.append(gen)
+        inner = GeneratedSequence(
+            ids=jnp.stack([g.ids for g in per_step], axis=1).reshape(
+                b * n_sub, *per_step[0].ids.shape[1:]),
+            length=jnp.stack([g.length for g in per_step], axis=1).reshape(
+                b * n_sub, -1),
+            score=jnp.stack([g.score for g in per_step], axis=1).reshape(
+                b * n_sub, -1),
+        )
+        return NestedGeneratedSequence(
+            inner=inner, seq_length=outer_val.seq_length, n_sub=n_sub)
+
+    parents = ((outer,) + tuple(ob for ob in outer_boots if ob is not None)
+               + tuple(boot_layers[j] for j in fixed_idx))
+    return LayerOutput(
+        name=name, layer_type="beam_search", size=beam_node.size,
+        parents=parents, param_specs=beam_node.param_specs,
+        state_specs=beam_node.state_specs, fn=fwd,
+        attrs={"aliases": ("__beam_search_predict__",) + tuple(inner_aliases),
+               "nested": True, "live_outer_memory": True},
     )
 
 
@@ -579,9 +679,8 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
                                  length=jnp.repeat(v.length, beam, axis=0))
         return jnp.repeat(v, beam, axis=0)
 
-    def fwd(ctx, params, states, *parent_values):
-        static_vals = parent_values[:n_static]
-        boot_vals_in = parent_values[n_static:]
+    def run(ctx, params, states, static_vals, boot_vals_in,
+            return_final_mems=False):
         if static_vals:
             sv0 = static_vals[0]
             b = sv0.batch_size if isinstance(sv0, SequenceBatch) else sv0.shape[0]
@@ -660,11 +759,26 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
         carry0 = (carry_mems, tokens0, scores0, finished0, lengths0, last0)
         (mems_c, tokens, scores, finished, lengths, last), _ = jax.lax.scan(
             body, carry0, jnp.arange(max_length, dtype=jnp.int32))
-        return GeneratedSequence(
+        gen = GeneratedSequence(
             ids=tokens[:, :n_res, :],
             length=lengths[:, :n_res],
             score=scores[:, :n_res],
         )
+        if return_final_mems:
+            # per inner memory: the BEST beam's final value [B, D] (beams
+            # come out of top_k score-sorted, best first) — the value a
+            # live outer memory carries to the next subsequence's frame
+            # (≅ RecurrentGradientMachine.cpp:1291 outer-frame plumbing)
+            final = {
+                m.name: v.reshape(b, beam, *v.shape[1:])[:, 0]
+                for m, v in ((m, mems_c[m.name]) for m in mems)
+            }
+            return gen, final
+        return gen
+
+    def fwd(ctx, params, states, *parent_values):
+        return run(ctx, params, states, parent_values[:n_static],
+                   parent_values[n_static:])
 
     return LayerOutput(
         name=name, layer_type="beam_search", size=gipt.size,
@@ -672,6 +786,9 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
         state_specs=tuple(state_specs), fn=fwd,
         attrs={"bos_id": bos_id, "eos_id": eos_id, "beam_size": beam_size,
                "max_length": max_length,
+               "beam_run": {"run": run, "mems": mems,
+                            "boot_layers": boot_layers,
+                            "static_inputs": static_inputs},
                # reference beam_search names its prediction output layer
                # "__beam_search_predict__" (networks.py); configs reference it
                "aliases": ("__beam_search_predict__",)},

@@ -71,3 +71,38 @@ def test_c_program_serves_model(tmp_path):
                     for line in out.stdout.decode().strip().splitlines()])
     assert got.shape == ref.shape
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_shared_param_machines(tmp_path):
+    """create_shared_param: shared machines alias ONE loaded artifact (no
+    per-machine weight copy) and produce identical outputs; the C-level
+    multi-thread serving bench (serve_bench.c) runs green."""
+    from paddle_tpu import capi_bridge
+
+    predict, parameters = _train_tiny()
+    model = str(tmp_path / "model.tar")
+    merge_v2_model(predict, parameters, model)
+
+    with open(model, "rb") as f:
+        origin = capi_bridge.create_machine(f.read())
+    shared = capi_bridge.create_shared_machine(origin)
+    # exact aliasing: one MergedModel object behind both handles
+    assert capi_bridge._machines[origin] is capi_bridge._machines[shared]
+
+    x = np.random.default_rng(0).normal(size=(4, 784)).astype("<f4")
+    a = capi_bridge.forward(origin, [x.tobytes()], 4)
+    b = capi_bridge.forward(shared, [x.tobytes()], 4)
+    assert a[0][0] == b[0][0]  # byte-identical outputs
+    capi_bridge.destroy_machine(shared)
+    # origin still serves after destroying the shared handle
+    assert capi_bridge.forward(origin, [x.tobytes()], 4)[0][0] == a[0][0]
+    capi_bridge.destroy_machine(origin)
+
+    exe = native_binary("serve_bench")
+    pypath = os.path.dirname(_NATIVE) + os.pathsep + \
+        os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=pypath)
+    out = subprocess.run([exe, model, "8", "2", "3", "--use_cpu"],
+                         stdout=subprocess.PIPE, env=env, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:]
+    assert b"threads=2" in out.stdout
