@@ -42,6 +42,69 @@ def define_py_data_sources2(train_list, test_list, module, obj, args=None):
 STATE = ParseState()
 
 
+# ≅ config_parser.py:116-123 g_default_* globals, set by the default_*()
+# config functions below and consumed by ParamAttr.make_initializer /
+# proto emission.  Reset per parse.
+G_DEFAULTS: dict = {"initial_std": None, "initial_mean": None,
+                    "decay_rate": None, "momentum": None, "device": None,
+                    "initial_strategy": None, "initial_smart": None,
+                    "num_batches_regularization": None}
+
+
+def reset_defaults() -> None:
+    for k in G_DEFAULTS:
+        G_DEFAULTS[k] = None
+
+
+def default_initial_std(val) -> None:
+    """≅ default_initial_std (config_parser.py:54)."""
+    G_DEFAULTS["initial_std"] = float(val)
+
+
+def default_initial_mean(val) -> None:
+    G_DEFAULTS["initial_mean"] = float(val)
+
+
+def default_decay_rate(val) -> None:
+    """≅ default_decay_rate (config_parser.py:57)."""
+    G_DEFAULTS["decay_rate"] = float(val)
+
+
+def default_momentum(val) -> None:
+    from paddle_tpu.core import logger as log
+
+    G_DEFAULTS["momentum"] = float(val)
+    log.warning("default_momentum: per-parameter momentum is a proto-"
+                "surface field here; the optimizer uses its own momentum "
+                "(settings learning_method) — value recorded, not applied")
+
+
+def _warn_unapplied(name):
+    from paddle_tpu.core import logger as log
+
+    log.warning("%s: accepted for config parity; not applied by this "
+                "runtime", name)
+
+
+def default_initial_strategy(val) -> None:
+    G_DEFAULTS["initial_strategy"] = int(val)
+
+
+def default_initial_smart(val) -> None:
+    G_DEFAULTS["initial_smart"] = bool(val)
+
+
+def default_num_batches_regularization(val) -> None:
+    G_DEFAULTS["num_batches_regularization"] = int(val)
+    _warn_unapplied("default_num_batches_regularization")
+
+
+def default_device(val) -> None:
+    """≅ default_device (config_parser.py:123): accepted for config
+    parity; placement on TPU is the mesh's job, not per-layer device ids."""
+    G_DEFAULTS["device"] = val
+
+
 def Inputs(*names: str) -> None:
     """≅ config_parser Inputs() (config_parser.py:209)."""
     STATE.input_layer_names.extend(names)

@@ -1273,6 +1273,8 @@ def emit_model_config(registry, input_names, output_names,
     for node in registry:
         if node.attrs.get("__in_group__") or node.layer_type in _SKIP_TYPES:
             continue  # emitted by their recurrent_layer_group node
+        if node.attrs.get("__hidden__"):
+            continue  # runtime-only companions (e.g. crf_decoding "#ids")
         fn = EMITTERS.get(node.layer_type)
         enforce(
             fn is not None,

@@ -1,0 +1,51 @@
+"""Python-3 data provider for the sequence_tagging demo configs
+(``v1_api_demo/sequence_tagging/{rnn_crf,linear_crf}.py`` run verbatim).
+
+The reference provider (python-2-only) builds CoNLL-2000 feature
+dictionaries with frequency cutoffs; the configs hardcode the resulting
+dims (features 76328, word 6778, pos 44, chunk 23).  This port keeps the
+exact slot contract — [sparse features, word id, pos id, chunk label]
+per token, one sequence per sentence — over a simple file format:
+``word_id pos_id chunk_id feat_id feat_id ...`` lines, blank line
+between sentences.
+"""
+
+from __future__ import annotations
+
+from paddle.trainer.PyDataProvider2 import (
+    integer_value_sequence,
+    provider,
+    sparse_binary_vector_sequence,
+)
+
+FEATURE_DIM = 76328
+WORD_DIM = 6778
+POS_DIM = 44
+CHUNK_DIM = 23
+
+
+@provider(input_types={
+    "features": sparse_binary_vector_sequence(FEATURE_DIM),
+    "word": integer_value_sequence(WORD_DIM),
+    "pos": integer_value_sequence(POS_DIM),
+    "chunk": integer_value_sequence(CHUNK_DIM),
+})
+def process(settings, file_name):
+    with open(file_name) as f:
+        feats, words, poss, chunks = [], [], [], []
+        for line in f:
+            line = line.strip()
+            if not line:
+                if words:
+                    yield {"features": feats, "word": words, "pos": poss,
+                           "chunk": chunks}
+                    feats, words, poss, chunks = [], [], [], []
+                continue
+            parts = [int(t) for t in line.split()]
+            words.append(parts[0])
+            poss.append(parts[1])
+            chunks.append(parts[2])
+            feats.append(parts[3:])
+        if words:
+            yield {"features": feats, "word": words, "pos": poss,
+                   "chunk": chunks}

@@ -1,0 +1,83 @@
+"""sequence_tagging — the reference CRF tagger configs
+(``v1_api_demo/sequence_tagging/rnn_crf.py`` and ``linear_crf.py``)
+executed verbatim (byte-identical copies; the py3 dataprovider port in
+this package shadows the python-2-only original) on synthetic
+CoNLL-2000-shaped data.  Exercises mixed/table projections, forward and
+reverse recurrent_layer, crf_layer + crf_decoding_layer, the chunk
+evaluator (IOB, 11 types) and sum evaluator, ModelAverage and LR decay.
+
+Run: python -m paddle_tpu.demo.sequence_tagging.run [--config rnn_crf.py]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import shutil
+
+from paddle_tpu.demo import REFERENCE_ROOT
+
+# dims hardcoded by the reference configs (rnn_crf.py:47-52); the
+# dataprovider module declares the same (it imports the `paddle` alias,
+# so it is only importable once a config parse installed it)
+FEATURE_DIM, WORD_DIM, POS_DIM, CHUNK_DIM = 76328, 6778, 44, 23
+
+
+def make_data(workdir: str, n_train: int = 64, n_test: int = 16) -> None:
+    data = os.path.join(workdir, "data")
+    os.makedirs(data, exist_ok=True)
+    rnd = random.Random(0)
+
+    def gen(path, n):
+        with open(path, "w") as f:
+            for _ in range(n):
+                length = rnd.randint(3, 8)
+                for _t in range(length):
+                    word = rnd.randrange(WORD_DIM)
+                    pos = rnd.randrange(POS_DIM)
+                    # IOB chunk ids: B=2*type, I=2*type+1, O=22
+                    chunk = (22 if rnd.random() < 0.4
+                             else 2 * rnd.randrange(11) + rnd.randint(0, 1))
+                    feats = sorted(rnd.sample(range(FEATURE_DIM), 6))
+                    f.write(" ".join(map(str, [word, pos, chunk] + feats))
+                            + "\n")
+                f.write("\n")
+
+    gen(os.path.join(data, "train.txt"), n_train)
+    gen(os.path.join(data, "test.txt"), n_test)
+    with open(os.path.join(data, "train.list"), "w") as f:
+        f.write("data/train.txt\n")
+    with open(os.path.join(data, "test.list"), "w") as f:
+        f.write("data/test.txt\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="rnn_crf.py",
+                    choices=["rnn_crf.py", "linear_crf.py"])
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--workdir", default="./sequence_tagging_work")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    make_data(args.workdir)
+    ref = os.path.join(REFERENCE_ROOT, "v1_api_demo/sequence_tagging",
+                       args.config)
+    shutil.copyfile(ref, os.path.join(args.workdir, args.config))
+    shutil.copyfile(
+        os.path.join(os.path.dirname(__file__), "dataprovider.py"),
+        os.path.join(args.workdir, "dataprovider.py"))
+    cwd = os.getcwd()
+    os.chdir(args.workdir)
+    try:
+        from paddle_tpu.trainer import cli
+
+        return cli.main(["--config", args.config, "--job", "train",
+                         "--num_passes", str(args.passes)])
+    finally:
+        os.chdir(cwd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -403,8 +403,25 @@ class DeclaredEvaluators:
                           for i in range(lb.shape[0])]
                 b.inst.eval_batch(logits=logits, label=labels)
             elif t == "chunk":
-                b.inst.eval_batch(pred=_np(ins[0]), label=_np(ins[1]),
-                                  lengths=_lengths(ins[0]))
+                # prefer the ids side of a dual-output layer (crf_decoding
+                # with label: value = error indicator, "#ids" = the path —
+                # the reference ChunkEvaluator reads arguments[0].ids)
+                from paddle_tpu.layers.base import companion_name
+
+                cname = companion_name(b.spec.input_layers[0])
+                pred = lookup.get(cname, ins[0])
+                p0 = _np(pred)
+                if (cname not in lookup and p0.ndim >= 2
+                        and p0.shape[-1] == 1
+                        and b.inst.num_chunk_types > 1):
+                    log.warning(
+                        "chunk evaluator %s: input %r looks like an "
+                        "error indicator, not decoded ids — its "
+                        "'#ids' companion layer is not in the "
+                        "topology (pass it via extra_layers)",
+                        b.spec.name, b.spec.input_layers[0])
+                b.inst.eval_batch(pred=_np(pred), label=_np(ins[1]),
+                                  lengths=_lengths(pred))
             elif t in ("sum", "last-column-sum"):
                 if len(ins) > 1:
                     v, w2, _ = _valid_frames(ins[0], ins[1])

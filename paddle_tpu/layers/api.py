@@ -61,6 +61,14 @@ def _pname(attr: ParamAttr | None, layer_name: str, suffix: str) -> str:
     return f"_{layer_name}.{suffix}"
 
 
+def _default_decay():
+    """config-level default_decay_rate() (≅ config_parser.py:3896:
+    ``decay_rate = default(decay_rate, g_default_decay_rate)``)."""
+    from paddle_tpu.config import parse_state
+
+    return parse_state.G_DEFAULTS["decay_rate"]
+
+
 def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
     a = param_attr_or_default(attr)
     fields = dict(
@@ -69,7 +77,7 @@ def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
         initializer=a.make_initializer(default_init),
         is_static=a.is_static,
         learning_rate=1.0 if a.learning_rate is None else a.learning_rate,
-        decay_rate=a.l2_rate,
+        decay_rate=a.l2_rate if a.l2_rate is not None else _default_decay(),
         attr=a,
         gradient_clipping_threshold=a.gradient_clipping_threshold,
         sparse=a.sparse_update,

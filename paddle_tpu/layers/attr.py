@@ -90,6 +90,21 @@ class ParamAttr:
             return I.uniform(lo, hi)
         if self.initial_std is not None or self.initial_mean is not None:
             return I.paddle_default(self.initial_mean or 0.0, self.initial_std)
+        # config-level defaults (default_initial_std()/default_initial_mean()/
+        # default_initial_strategy(), ≅ config_parser g_default_*).  Read at
+        # LAYER BUILD time (this method runs during config parsing); the
+        # dict resets on every parse_config AND on reset_name_counters(),
+        # so stale config defaults cannot leak into later model builds.
+        from paddle_tpu.config import parse_state as _ps
+
+        gd = _ps.G_DEFAULTS
+        if gd["initial_strategy"] == 1:
+            std = gd["initial_std"]
+            lo, hi = (-1.0, 1.0) if std is None else (-std, std)
+            return I.uniform(lo, hi)
+        if gd["initial_std"] is not None or gd["initial_mean"] is not None:
+            return I.paddle_default(gd["initial_mean"] or 0.0,
+                                    gd["initial_std"])
         return default
 
 

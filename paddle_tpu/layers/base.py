@@ -77,6 +77,11 @@ def gen_name(layer_type: str) -> str:
 def reset_name_counters() -> None:
     _name_counters.clear()
     _layer_registry.clear()
+    # config-level g_default_* must not outlive the model build they were
+    # declared in (every model builder/test resets counters first)
+    from paddle_tpu.config import parse_state
+
+    parse_state.reset_defaults()
 
 
 @dataclasses.dataclass(eq=False)
@@ -192,6 +197,15 @@ def evaluate(
 
 
 # ---- value helpers shared by layer impls -----------------------------------
+
+
+IDS_SUFFIX = "#ids"  # dual-output companions (crf_decoding's path side)
+
+
+def companion_name(name: str) -> str:
+    """Hidden runtime-only companion carrying a layer's ids side (the
+    reference Argument's value/ids duality)."""
+    return name + IDS_SUFFIX
 
 
 def is_sequence(v: Value) -> bool:

@@ -18,14 +18,15 @@ from paddle_tpu.core import initializer as I
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import SequenceBatch
 from paddle_tpu.layers.api import _wspec
-from paddle_tpu.layers.base import LayerOutput, gen_name, is_sequence, raw
+from paddle_tpu.layers.base import companion_name, LayerOutput, gen_name, is_sequence, raw
 from paddle_tpu.ops import crf as crf_ops
 from paddle_tpu.ops import ctc as ctc_ops
 
 
 def crf(input: LayerOutput, label: LayerOutput, size: int | None = None,
         weight: LayerOutput | None = None, param_attr=None,
-        name: str | None = None) -> LayerOutput:
+        name: str | None = None, layer_attr=None,
+        coeff: float | None = None) -> LayerOutput:
     """CRF negative log-likelihood cost (≅ crf_layer / LinearChainCRF).
     ``input`` are per-step emission scores [*, size]; parameter is the
     reference's [size+2, size] start/end/transition matrix.  To share the
@@ -42,7 +43,8 @@ def crf(input: LayerOutput, label: LayerOutput, size: int | None = None,
         nll = crf_ops.crf_nll(emis, lbl_seq, params[w.name])  # [B]
         if wgt:
             nll = nll * raw(wgt[0]).reshape(-1)
-        return jnp.mean(nll)
+        # reference crf_layer coeff: scales the cost (and thus gradients)
+        return jnp.mean(nll) * (1.0 if coeff is None else coeff)
 
     return LayerOutput(name=name, layer_type="crf", size=1,
                        parents=tuple(parents), param_specs=(w,), fn=fwd,
@@ -54,7 +56,7 @@ crf_layer = crf
 
 def crf_decoding(input: LayerOutput, size: int | None = None,
                  label: LayerOutput | None = None, param_attr=None,
-                 name: str | None = None) -> LayerOutput:
+                 name: str | None = None, layer_attr=None) -> LayerOutput:
     """Viterbi decode (≅ crf_decoding_layer).  Without ``label``: outputs the
     best path ids as an int sequence.  With ``label``: outputs a 0/1 error
     indicator per sequence (1 = path differs), like the reference."""
@@ -73,10 +75,24 @@ def crf_decoding(input: LayerOutput, size: int | None = None,
         diff = (path.data != y) & (mask > 0)
         return jnp.any(diff, axis=1).astype(jnp.float32)[:, None]
 
-    return LayerOutput(name=name, layer_type="crf_decoding",
+    node = LayerOutput(name=name, layer_type="crf_decoding",
                        size=(1 if label is not None else size),
                        parents=tuple(parents), param_specs=(w,), fn=fwd,
                        attrs={"num_classes": size})
+    if label is not None:
+        # the reference Argument carries BOTH the error indicator (value)
+        # and the decoded path (ids); evaluators like chunk F1 consume the
+        # ids (ChunkEvaluator::evalImp reads arguments[0].ids).  Expose the
+        # path as a hidden companion layer "<name>#ids" — XLA CSEs the
+        # duplicate Viterbi pass, and the evaluator runtime prefers it.
+        def ids_fwd(ctx, params, states, emis):
+            return crf_ops.crf_decode(emis, params[w.name])
+
+        LayerOutput(name=companion_name(name), layer_type="crf_decoding",
+                    size=size, parents=(input,), param_specs=(w,),
+                    fn=ids_fwd, attrs={"num_classes": size,
+                                       "__hidden__": True})
+    return node
 
 
 crf_decoding_layer = crf_decoding

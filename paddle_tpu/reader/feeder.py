@@ -79,6 +79,27 @@ class DataFeeder:
         elif seq == SeqType.SEQUENCE:
             if kind == DataKind.INTEGER:
                 seqs = [np.asarray(s, dtype=np.int32) for s in col]
+            elif kind == DataKind.SPARSE_BINARY:
+                # per-timestep id lists -> dense [T, dim] rows.  KNOWN
+                # INEFFICIENCY for very wide slots (sequence_tagging's
+                # 76k-dim features build ~40 MB/batch of mostly zeros):
+                # the byte-lean alternative is an embedding-style gather
+                # of weight rows at the ids, which needs the consuming
+                # projection to accept id lists — tracked as future work
+                seqs = []
+                for s in col:
+                    d = np.zeros((len(s), itype.dim), np.float32)
+                    for t, ids in enumerate(s):
+                        d[t, np.asarray(list(ids), dtype=np.int64)] = 1.0
+                    seqs.append(d)
+            elif kind == DataKind.SPARSE_FLOAT:
+                seqs = []
+                for s in col:
+                    d = np.zeros((len(s), itype.dim), np.float32)
+                    for t, pairs in enumerate(s):
+                        for j, v in pairs:
+                            d[t, j] = v
+                    seqs.append(d)
             else:
                 seqs = [np.asarray(s, dtype=np.float32) for s in col]
             return from_ragged(seqs)

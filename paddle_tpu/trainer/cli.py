@@ -263,6 +263,9 @@ def _build(parsed):
 
     ev_names = {n for s in (getattr(parsed, "evaluators", None) or [])
                 for n in s.input_layers}
+    from paddle_tpu.layers.base import companion_name
+
+    ev_names |= {companion_name(n) for n in set(ev_names)}
     extra = [lo for lo in layer_registry() if lo.name in ev_names]
     topo = Topology(parsed.output_layers(), extra_layers=extra)
     opt = get_settings_optimizer()

@@ -68,6 +68,16 @@ def make_config_environment(config_path: str, config_args: dict) -> dict:
         xrange=range,
         long=int,
         unicode=str,
+        # the reference config_parser's global-default setters
+        default_initial_std=parse_state.default_initial_std,
+        default_initial_mean=parse_state.default_initial_mean,
+        default_decay_rate=parse_state.default_decay_rate,
+        default_momentum=parse_state.default_momentum,
+        default_initial_strategy=parse_state.default_initial_strategy,
+        default_initial_smart=parse_state.default_initial_smart,
+        default_num_batches_regularization=(
+            parse_state.default_num_batches_regularization),
+        default_device=parse_state.default_device,
     )
     return env
 
@@ -87,6 +97,7 @@ def parse_config(trainer_config, config_arg_str: str = ""):
 
     layer_base.reset_name_counters()
     parse_state.STATE.reset()
+    parse_state.reset_defaults()
     from paddle_tpu.evaluator import declare as _declare
 
     _declare.reset()

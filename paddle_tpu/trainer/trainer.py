@@ -71,6 +71,20 @@ class SGD:
         self._tap_grads_eval = None
         if isinstance(cost, LayerOutput):
             cost = [cost]
+        # dual-output companions ("#ids") of declared evaluator inputs
+        # join the topology automatically, so the v2 path works like the
+        # CLI's without the caller passing extra_layers
+        from paddle_tpu.layers import base as layer_base
+        from paddle_tpu.layers.base import companion_name
+
+        ev_inputs = {n for b in self.declared_evaluators.bound
+                     for n in b.spec.input_layers}
+        wanted_extra = ev_inputs | {companion_name(n) for n in ev_inputs}
+        companions = [lo for lo in layer_base.layer_registry()
+                      if lo.name in wanted_extra]
+        extra_layers = list(extra_layers or []) + [
+            c for c in companions
+            if not any(c is e for e in (extra_layers or []))]
         self.topology = Topology(cost, extra_layers=extra_layers)
         self.parameters = parameters
         for spec in self.topology.param_specs():
@@ -99,13 +113,17 @@ class SGD:
     def _ensure_built(self):
         if self._train_step is None:
             node_names = {n.name for n in self.topology.nodes}
-            fetch = sorted({
+            wanted = {
                 name
                 for b in (self.declared_evaluators.bound
                           if self.declared_evaluators else [])
                 for name in b.spec.input_layers
-                if name in node_names
-            })
+            }
+            # "#ids" companions (crf_decoding's decoded path) ride along so
+            # evaluators can read the ids side of a dual-output layer
+            from paddle_tpu.layers.base import companion_name
+            wanted |= {companion_name(n) for n in set(wanted)}
+            fetch = sorted(wanted & node_names)
             self._train_step = build_train_step(
                 self.topology, self.optimizer, self.mesh,
                 compute_dtype=self.compute_dtype, fetch_layers=fetch)

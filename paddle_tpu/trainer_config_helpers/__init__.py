@@ -34,6 +34,7 @@ from paddle_tpu.trainer_config_helpers.optimizers import (  # noqa: F401
     BaseRegularization,
     L1Regularization,
     L2Regularization,
+    ModelAverage,
     MomentumOptimizer,
     RMSPropOptimizer,
     settings,
