@@ -420,7 +420,7 @@ class DeclaredEvaluators:
                         "'#ids' companion layer is not in the "
                         "topology (pass it via extra_layers)",
                         b.spec.name, b.spec.input_layers[0])
-                b.inst.eval_batch(pred=_np(pred), label=_np(ins[1]),
+                b.inst.eval_batch(pred=p0, label=_np(ins[1]),
                                   lengths=_lengths(pred))
             elif t in ("sum", "last-column-sum"):
                 if len(ins) > 1:

@@ -88,8 +88,6 @@ class ParamAttr:
             lo = self.initial_min if self.initial_min is not None else -1.0
             hi = self.initial_max if self.initial_max is not None else 1.0
             return I.uniform(lo, hi)
-        if self.initial_std is not None or self.initial_mean is not None:
-            return I.paddle_default(self.initial_mean or 0.0, self.initial_std)
         # config-level defaults (default_initial_std()/default_initial_mean()/
         # default_initial_strategy(), ≅ config_parser g_default_*).  Read at
         # LAYER BUILD time (this method runs during config parsing); the
@@ -98,13 +96,19 @@ class ParamAttr:
         from paddle_tpu.config import parse_state as _ps
 
         gd = _ps.G_DEFAULTS
+        mean = (self.initial_mean if self.initial_mean is not None
+                else gd["initial_mean"])
+        std = (self.initial_std if self.initial_std is not None
+               else gd["initial_std"])
         if gd["initial_strategy"] == 1:
-            std = gd["initial_std"]
-            lo, hi = (-1.0, 1.0) if std is None else (-std, std)
-            return I.uniform(lo, hi)
-        if gd["initial_std"] is not None or gd["initial_mean"] is not None:
-            return I.paddle_default(gd["initial_mean"] or 0.0,
-                                    gd["initial_std"])
+            # uniform over (mean - std, mean + std)
+            # (ParameterConfig.proto:51-53; config_parser.py:3920 applies
+            # the global strategy to per-attr std/mean too)
+            m = 0.0 if mean is None else mean
+            s_ = 0.01 if std is None else std  # g_default_initial_std
+            return I.uniform(m - s_, m + s_)
+        if std is not None or mean is not None:
+            return I.paddle_default(mean or 0.0, std)
         return default
 
 

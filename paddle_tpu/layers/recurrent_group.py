@@ -282,6 +282,48 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
             enforce(False,
                     "targetInlink must be one of the group's sequence inputs")
 
+    # ---- fused fast path: a step that is EXACTLY one standard gru_step
+    # (gru_group / networks.simple_gru) lowers to the Pallas GRU sequence
+    # kernel instead of the generic lax.scan — same freeze-mask semantics,
+    # same parameters, same emission metadata; only the runtime closure
+    # changes.  (The lstmemory analog lives in layers/api.py.)
+    fused_fwd = None
+    if (len(outs) == 1 and outs[0].layer_type == "gru_step"
+            and len(step_nodes) == 1 and len(mems) == 1
+            and link_targets[0] is outs[0]
+            and len(seq_inputs) == 1 and not static_inputs
+            and len(outs[0].parents) == 2
+            and outs[0].parents[0] in seq_ph_order
+            and outs[0].parents[1] is mems[0]
+            and outs[0].attrs.get("active_type") == "tanh"
+            and outs[0].attrs.get("active_gate_type") == "sigmoid"):
+        from paddle_tpu.ops import rnn as rnn_ops
+
+        g_node = outs[0]
+        g_size = g_node.size
+        g_wspec = g_node.param_specs[0]
+        g_mem = mems[0]
+        g_has_boot = boot_layers[0] is not None
+
+        def fused_fwd(ctx, params, states, *parent_values):
+            seq_val = parent_values[0]
+            enforce(isinstance(seq_val, SequenceBatch),
+                    "recurrent_group sequence inputs must be sequences")
+            boot = parent_values[1] if g_has_boot else None
+            init = _boot_value(
+                g_mem, _raw_boot(boot) if boot is not None else None,
+                seq_val.batch_size)
+            xw = seq_val.data
+            bias_name = g_node.attrs.get("bias_spec")
+            if bias_name:
+                xw = xw + params[bias_name]
+            w = params[g_wspec.name]
+            out, _ = rnn_ops.gru_fused(
+                SequenceBatch(xw, seq_val.length),
+                w[:, : 2 * g_size], w[:, 2 * g_size:], init,
+                reverse=reverse)
+            return out
+
     def fwd(ctx, params, states, *parent_values):
         seq_vals = parent_values[:n_seq]
         static_vals = parent_values[n_seq:n_seq + n_static]
@@ -323,7 +365,11 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
             for m, tgt in zip(mems, link_targets):
                 nv = vals[tgt.name]
                 nv = nv.data if isinstance(nv, SequenceBatch) else nv
-                new_carry[m.name] = mcol * nv + (1.0 - mcol) * mem_c[m.name]
+                # carry dtype follows the boot (e.g. a bf16 boot from a
+                # fused upstream group under the mixed-precision policy)
+                new_carry[m.name] = (
+                    mcol * nv + (1.0 - mcol) * mem_c[m.name]
+                ).astype(mem_c[m.name].dtype)
             step_out = tuple(_raw_boot(vals[o.name]) for o in outs)
             return (new_carry, states_n), step_out
 
@@ -381,7 +427,7 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
         layer_type="recurrent_layer_group",
         size=outs[0].size, parents=parents,
         param_specs=tuple(param_specs), state_specs=tuple(state_specs),
-        fn=fwd, attrs={
+        fn=fused_fwd if fused_fwd is not None else fwd, attrs={
             "reverse": reverse, "n_outputs": len(outs),
             "group": {
                 "marker": name,

@@ -17,6 +17,23 @@ from paddle_tpu.core.lod import from_nested_ragged, from_ragged
 from paddle_tpu.layers.data_type import DataKind, SeqType
 
 
+def _densify_ids(rows, dim: int) -> np.ndarray:
+    """id lists (one per row) -> dense 0/1 [len(rows), dim]."""
+    dense = np.zeros((len(rows), dim), np.float32)
+    for i, ids in enumerate(rows):
+        dense[i, np.asarray(list(ids), dtype=np.int64)] = 1.0
+    return dense
+
+
+def _densify_pairs(rows, dim: int) -> np.ndarray:
+    """(index, value) pair lists -> dense [len(rows), dim]."""
+    dense = np.zeros((len(rows), dim), np.float32)
+    for i, pairs in enumerate(rows):
+        for j, v in pairs:
+            dense[i, j] = v
+    return dense
+
+
 class DataFeeder:
     def __init__(self, data_types: Mapping[str, object] | Sequence[tuple],
                  feeding: Mapping[str, int] | Sequence[str] | None = None):
@@ -66,16 +83,9 @@ class DataFeeder:
             if kind == DataKind.INTEGER:
                 return jnp.asarray(np.asarray(col, dtype=np.int32).reshape(len(col)))
             if kind == DataKind.SPARSE_BINARY:
-                dense = np.zeros((len(col), itype.dim), np.float32)
-                for i, ids in enumerate(col):
-                    dense[i, np.asarray(list(ids), dtype=np.int64)] = 1.0
-                return jnp.asarray(dense)
+                return jnp.asarray(_densify_ids(col, itype.dim))
             if kind == DataKind.SPARSE_FLOAT:
-                dense = np.zeros((len(col), itype.dim), np.float32)
-                for i, pairs in enumerate(col):
-                    for j, v in pairs:
-                        dense[i, j] = v
-                return jnp.asarray(dense)
+                return jnp.asarray(_densify_pairs(col, itype.dim))
         elif seq == SeqType.SEQUENCE:
             if kind == DataKind.INTEGER:
                 seqs = [np.asarray(s, dtype=np.int32) for s in col]
@@ -86,20 +96,9 @@ class DataFeeder:
                 # the byte-lean alternative is an embedding-style gather
                 # of weight rows at the ids, which needs the consuming
                 # projection to accept id lists — tracked as future work
-                seqs = []
-                for s in col:
-                    d = np.zeros((len(s), itype.dim), np.float32)
-                    for t, ids in enumerate(s):
-                        d[t, np.asarray(list(ids), dtype=np.int64)] = 1.0
-                    seqs.append(d)
+                seqs = [_densify_ids(s, itype.dim) for s in col]
             elif kind == DataKind.SPARSE_FLOAT:
-                seqs = []
-                for s in col:
-                    d = np.zeros((len(s), itype.dim), np.float32)
-                    for t, pairs in enumerate(s):
-                        for j, v in pairs:
-                            d[t, j] = v
-                    seqs.append(d)
+                seqs = [_densify_pairs(s, itype.dim) for s in col]
             else:
                 seqs = [np.asarray(s, dtype=np.float32) for s in col]
             return from_ragged(seqs)

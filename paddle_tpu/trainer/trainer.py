@@ -80,8 +80,12 @@ class SGD:
         ev_inputs = {n for b in self.declared_evaluators.bound
                      for n in b.spec.input_layers}
         wanted_extra = ev_inputs | {companion_name(n) for n in ev_inputs}
+        # data layers stay OUT: evaluator data inputs outside the topology
+        # are resolved from the eval feed (runtime.eval_batch), and forcing
+        # them in would make DataFeeder demand feed slots for them
         companions = [lo for lo in layer_base.layer_registry()
-                      if lo.name in wanted_extra]
+                      if lo.name in wanted_extra
+                      and lo.layer_type != "data"]
         extra_layers = list(extra_layers or []) + [
             c for c in companions
             if not any(c is e for e in (extra_layers or []))]
