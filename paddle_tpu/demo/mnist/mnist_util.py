@@ -1,0 +1,29 @@
+"""Python-3 port of ``v1_api_demo/mnist/mnist_util.py`` (the original is
+python-2-only: ``xrange``) — same idx-ubyte reading and [-1, 1] pixel
+scaling, but the sample count comes from the idx HEADER instead of the
+original's hardcoded 60000/10000, so synthetic stand-in datasets of any
+size work.  ``mnist_provider.py`` and the configs run byte-identical."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy
+
+__all__ = ["read_from_mnist"]
+
+
+def read_from_mnist(filename):
+    imgf = filename + "-images-idx3-ubyte"
+    labelf = filename + "-labels-idx1-ubyte"
+    with open(imgf, "rb") as f, open(labelf, "rb") as l:  # noqa: E741
+        _, n, rows, cols = struct.unpack(">iiii", f.read(16))
+        l.read(8)
+        images = numpy.fromfile(
+            f, "ubyte", count=n * rows * cols).reshape(
+            (n, rows * cols)).astype("float32")
+        images = images / 255.0 * 2.0 - 1.0
+        labels = numpy.fromfile(l, "ubyte", count=n).astype("int")
+
+    for i in range(n):
+        yield {"pixel": images[i, :], "label": labels[i]}

@@ -1,0 +1,80 @@
+"""mnist — the reference configs (``v1_api_demo/mnist/light_mnist.py`` or
+``vgg_16_mnist.py``) and provider (``mnist_provider.py``) executed
+byte-identical on synthetic idx-format digit data; only ``mnist_util``
+is a py3 port (this package).
+
+Run: python -m paddle_tpu.demo.mnist.run [--config light_mnist.py]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import struct
+
+import numpy as np
+
+from paddle_tpu.demo import REFERENCE_ROOT
+
+
+def _write_idx(prefix: str, n: int, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=(n,)).astype(np.uint8)
+    images = rng.integers(0, 60, size=(n, 28, 28)).astype(np.uint8)
+    # class signal: a bright 6x6 patch whose position encodes the digit
+    for i, y in enumerate(labels):
+        r, c = divmod(int(y), 5)
+        images[i, 4 + r * 12: 10 + r * 12, 2 + c * 5: 8 + c * 5] = 250
+    with open(prefix + "-images-idx3-ubyte", "wb") as f:
+        f.write(struct.pack(">iiii", 0x803, n, 28, 28))
+        f.write(images.tobytes())
+    with open(prefix + "-labels-idx1-ubyte", "wb") as f:
+        f.write(struct.pack(">ii", 0x801, n))
+        f.write(labels.tobytes())
+
+
+def make_data(workdir: str, n_train: int = 1024, n_test: int = 256) -> None:
+    raw = os.path.join(workdir, "data", "raw_data")
+    os.makedirs(raw, exist_ok=True)
+    _write_idx(os.path.join(raw, "train"), n_train, seed=0)
+    _write_idx(os.path.join(raw, "t10k"), n_test, seed=1)
+    data = os.path.join(workdir, "data")
+    with open(os.path.join(data, "train.list"), "w") as f:
+        f.write("data/raw_data/train\n")
+    with open(os.path.join(data, "test.list"), "w") as f:
+        f.write("data/raw_data/t10k\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="light_mnist.py",
+                    choices=["light_mnist.py", "vgg_16_mnist.py"])
+    ap.add_argument("--passes", type=int, default=2)
+    ap.add_argument("--workdir", default="./mnist_work")
+    ap.add_argument("--n-train", type=int, default=1024)
+    ap.add_argument("--n-test", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    make_data(args.workdir, n_train=args.n_train, n_test=args.n_test)
+    src = os.path.join(REFERENCE_ROOT, "v1_api_demo/mnist")
+    for fn in (args.config, "mnist_provider.py"):
+        shutil.copyfile(os.path.join(src, fn),
+                        os.path.join(args.workdir, fn))  # byte-identical
+    shutil.copyfile(
+        os.path.join(os.path.dirname(__file__), "mnist_util.py"),
+        os.path.join(args.workdir, "mnist_util.py"))
+    cwd = os.getcwd()
+    os.chdir(args.workdir)
+    try:
+        from paddle_tpu.trainer import cli
+
+        return cli.main(["--config", args.config, "--job", "train",
+                         "--num_passes", str(args.passes)])
+    finally:
+        os.chdir(cwd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,6 +7,7 @@ from __future__ import annotations
 from paddle_tpu.layers import activation as act_mod
 from paddle_tpu.layers import api as layer
 from paddle_tpu.layers import pooling as pool_mod
+from paddle_tpu.layers.attr import ExtraAttr
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size, name=None,
@@ -50,6 +51,32 @@ def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
                 tmp = layer.dropout(input=tmp, dropout_rate=conv_batchnorm_drop_rate[i])
     return layer.img_pool(input=tmp, pool_size=pool_size, stride=pool_stride,
                           pool_type=pool_type or pool_mod.MaxPooling())
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    """≅ networks.small_vgg (networks.py:438): four BN'd VGG conv groups
+    (64x2, 128x2, 256x3, 512x3) + pool/dropout/fc/BN head -> softmax."""
+    def _vgg(ipt, num_filter, times, dropouts, num_channels_=None):
+        return img_conv_group(
+            input=ipt, num_channels=num_channels_, pool_size=2,
+            pool_stride=2, conv_num_filter=[num_filter] * times,
+            conv_filter_size=3, conv_act=act_mod.ReluActivation(),
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type=pool_mod.MaxPooling())
+
+    tmp = _vgg(input_image, 64, 2, [0.3, 0], num_channels)
+    tmp = _vgg(tmp, 128, 2, [0.4, 0])
+    tmp = _vgg(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = _vgg(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = layer.img_pool(input=tmp, stride=2, pool_size=2,
+                         pool_type=pool_mod.MaxPooling())
+    tmp = layer.dropout(input=tmp, dropout_rate=0.5)
+    tmp = layer.fc(input=tmp, size=512, act=act_mod.LinearActivation(),
+                   layer_attr=ExtraAttr(drop_rate=0.5))
+    tmp = layer.batch_norm(input=tmp, act=act_mod.ReluActivation())
+    return layer.fc(input=tmp, size=num_classes,
+                    act=act_mod.SoftmaxActivation())
 
 
 def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,

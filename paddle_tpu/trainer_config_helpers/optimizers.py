@@ -68,9 +68,18 @@ def get_settings_optimizer():
 # v1 method-object names accepted by settings(learning_method=...)
 class _Method:
     proto_name = "momentum"
+    #: positional parameter names in the reference class's __init__ order
+    #: (e.g. MomentumOptimizer(0.9) — optimizers.py:104)
+    pos_args: tuple = ()
 
-    def __init__(self, **kw):
-        self.kw = kw
+    def __init__(self, *args, **kw):
+        if len(args) > len(self.pos_args):
+            raise TypeError(
+                f"{type(self).__name__} takes at most "
+                f"{len(self.pos_args)} positional arguments "
+                f"({', '.join(self.pos_args) or 'none'}), got {len(args)}")
+        self.kw = dict(zip(self.pos_args, args))
+        self.kw.update(kw)
 
     def to_setting_kwargs(self) -> dict:
         """OptimizationConfig fields (≅ Optimizer.to_setting_kwargs)."""
@@ -79,6 +88,7 @@ class _Method:
 
 class MomentumOptimizer(_Method):
     proto_name = "momentum"
+    pos_args = ("momentum", "sparse")
 
     def to_setting_kwargs(self):
         if self.kw.get("sparse"):
@@ -88,6 +98,7 @@ class MomentumOptimizer(_Method):
 
 class AdamOptimizer(_Method):
     proto_name = "adam"
+    pos_args = ("beta1", "beta2", "epsilon")
 
     def to_setting_kwargs(self):
         return {
@@ -100,6 +111,7 @@ class AdamOptimizer(_Method):
 
 class AdamaxOptimizer(_Method):
     proto_name = "adamax"
+    pos_args = ("beta1", "beta2")
 
     def to_setting_kwargs(self):
         return {
@@ -115,6 +127,7 @@ class AdaGradOptimizer(_Method):
 
 class DecayedAdaGradOptimizer(_Method):
     proto_name = "decayed_adagrad"
+    pos_args = ("rho", "epsilon")
 
     def to_setting_kwargs(self):
         return {
@@ -126,6 +139,7 @@ class DecayedAdaGradOptimizer(_Method):
 
 class AdaDeltaOptimizer(_Method):
     proto_name = "adadelta"
+    pos_args = ("rho", "epsilon")
 
     def to_setting_kwargs(self):
         return {
@@ -137,6 +151,7 @@ class AdaDeltaOptimizer(_Method):
 
 class RMSPropOptimizer(_Method):
     proto_name = "rmsprop"
+    pos_args = ("rho", "epsilon")
 
     def to_setting_kwargs(self):
         return {

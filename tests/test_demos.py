@@ -166,3 +166,27 @@ def test_chunk_evaluator_reads_ids_companion_v2_path():
     # the mapping is learnable; a real (path-scored) F1 climbs well above
     # what scoring the [B,1] error indicator could ever produce
     assert f1 and f1[0] > 0.5, seen
+
+
+def test_mnist_reference_config(tmp_path, capsys):
+    """light_mnist.py + mnist_provider.py run byte-identical (only
+    mnist_util is a py3 port); the synthetic digits are learned."""
+    from paddle_tpu.demo.mnist import run
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    prev = mesh_mod.get_mesh()
+    try:
+        # the config's batch_size=50 doesn't divide the 8-device test mesh
+        mesh_mod.get_mesh({"data": 1})
+        rc = run.main(["--workdir", str(tmp_path), "--passes", "2",
+                       "--n-train", "512", "--n-test", "128"])
+    finally:
+        mesh_mod.set_mesh(prev)
+    assert rc == 0
+    for fn in ("light_mnist.py", "mnist_provider.py"):
+        with open(os.path.join(REF, "v1_api_demo/mnist", fn)) as f:
+            assert (tmp_path / fn).read_text() == f.read()
+    out = capsys.readouterr().out
+    last = [l for l in out.splitlines() if "Eval:" in l][-1]
+    err = float(last.split("classification_error_evaluator=")[1].split()[0])
+    assert err < 0.1, out
