@@ -1,0 +1,114 @@
+"""Image-classification dataset preprocessing
+(≅ ``python/paddle/utils/preprocess_img.py`` +
+``preprocess_util.py``: walk ``data_dir/<label>/*.jpg``, resize, split
+train/test, and write batched files a reader can stream).
+
+TPU-native shape: batches are ``.npz`` files (images uint8 CHW + int
+labels) instead of the original's cPickle blobs, with the same
+``batches/…, labels.txt, meta`` directory contract and a paddle reader
+over the result.
+
+Usage:
+    python -m paddle_tpu.utils.preprocess_img -i data_dir -s 32
+    # or
+    creator = ImageClassificationDatasetCreater(data_dir, 32)
+    creator.create_dataset()
+    reader = batch_reader(os.path.join(data_dir, "batches", "train"))
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import random
+
+import numpy as np
+
+from paddle_tpu.utils import image as img_utils
+
+
+class ImageClassificationDatasetCreater:
+    """≅ ImageClassificationDatasetCreater (preprocess_img.py:78)."""
+
+    def __init__(self, data_path: str, target_size: int, color: bool = True,
+                 num_per_batch: int = 1024, test_ratio: float = 0.1,
+                 seed: int = 0):
+        self.data_path = data_path
+        self.target_size = target_size
+        self.color = color
+        self.num_per_batch = num_per_batch
+        self.test_ratio = test_ratio
+        self.seed = seed
+
+    def _samples(self):
+        labels = sorted(
+            d for d in os.listdir(self.data_path)
+            if os.path.isdir(os.path.join(self.data_path, d))
+            and d != "batches")
+        rows = []
+        for li, lab in enumerate(labels):
+            for p in sorted(glob.glob(
+                    os.path.join(self.data_path, lab, "*"))):
+                rows.append((p, li))
+        rnd = random.Random(self.seed)
+        rnd.shuffle(rows)
+        return labels, rows
+
+    def _write_split(self, out_dir: str, tag: str, rows) -> None:
+        for bi in range(0, len(rows), self.num_per_batch):
+            chunk = rows[bi:bi + self.num_per_batch]
+            imgs, labs = [], []
+            for path, li in chunk:
+                im = img_utils.load_and_transform(
+                    path, self.target_size, self.target_size,
+                    is_train=False, is_color=self.color)
+                imgs.append(np.clip(im, 0, 255).astype(np.uint8))
+                labs.append(li)
+            np.savez_compressed(
+                os.path.join(out_dir, f"{tag}_batch_{bi // self.num_per_batch:04d}"),
+                images=np.stack(imgs), labels=np.asarray(labs, np.int32))
+
+    def create_dataset(self) -> str:
+        labels, rows = self._samples()
+        out = os.path.join(self.data_path, "batches")
+        os.makedirs(out, exist_ok=True)
+        n_test = int(len(rows) * self.test_ratio)
+        self._write_split(out, "test", rows[:n_test])
+        self._write_split(out, "train", rows[n_test:])
+        with open(os.path.join(out, "labels.txt"), "w") as f:
+            f.write("\n".join(labels) + "\n")
+        with open(os.path.join(out, "meta"), "w") as f:
+            f.write(f"target_size={self.target_size}\n"
+                    f"color={int(self.color)}\nnum_labels={len(labels)}\n")
+        return out
+
+
+def batch_reader(prefix: str):
+    """paddle reader over ``<prefix>_batch_*.npz`` files: yields
+    (CHW float image, int label) samples."""
+
+    def reader():
+        for path in sorted(glob.glob(prefix + "_batch_*.npz")):
+            z = np.load(path)
+            for im, lab in zip(z["images"], z["labels"]):
+                yield im.astype(np.float32), int(lab)
+
+    return reader
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-i", "--input", required=True,
+                    help="data dir with one sub-directory per label")
+    ap.add_argument("-s", "--size", type=int, required=True)
+    ap.add_argument("-c", "--color", type=int, default=1)
+    args = ap.parse_args(argv)
+    out = ImageClassificationDatasetCreater(
+        args.input, args.size, bool(args.color)).create_dataset()
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,6 +6,7 @@ CLI.  MultiData zips two sources into one sample stream."""
 
 from __future__ import annotations
 
+import os
 import textwrap
 
 import numpy as np
@@ -191,3 +192,82 @@ def test_proto_config_emits_reference_dataconfig(tmp_path):
     assert dc.type == "proto"
     assert dc.files == "train.list"
     assert abs(dc.usage_ratio - 0.5) < 1e-9
+
+
+def test_cli_trains_from_multi_data(tmp_path):
+    """MultiData: two ProtoData sub-providers zip into one sample stream
+    through the CLI (MultiDataProvider parity), and the TrainerConfig
+    emits nested sub_data_configs."""
+    import textwrap
+
+    # source A: dense features; source B: the label
+    ha = _mk_header([(pdata.VECTOR_DENSE, 8)])
+    hb = _mk_header([(pdata.INDEX, 4)])
+    rng = np.random.default_rng(0)
+    sa, sb = [], []
+    for _ in range(128):
+        y = int(rng.integers(0, 4))
+        x = rng.normal(size=(8,)).astype(np.float32) * 0.1
+        x[y * 2:(y + 1) * 2] += 1.0
+        s = DataSample()
+        s.vector_slots.add().values.extend(x.tolist())
+        sa.append(s)
+        s = DataSample()
+        s.id_slots.append(y)
+        sb.append(s)
+    pdata.write_proto_stream(str(tmp_path / "a.bin"), ha, sa)
+    pdata.write_proto_stream(str(tmp_path / "b.bin"), hb, sb)
+    (tmp_path / "a.list").write_text(str(tmp_path / "a.bin") + "\n")
+    (tmp_path / "b.list").write_text(str(tmp_path / "b.bin") + "\n")
+    cfg = tmp_path / "multi.conf"
+    cfg.write_text(textwrap.dedent(f"""
+        from paddle.trainer_config_helpers import *
+
+        TrainData(MultiData([ProtoData(files='{tmp_path}/a.list'),
+                             ProtoData(files='{tmp_path}/b.list')]))
+        settings(batch_size=32, learning_rate=1e-2,
+                 learning_method=AdamOptimizer())
+        x = data_layer(name='x', size=8)
+        pred = fc_layer(input=x, size=4, act=SoftmaxActivation())
+        lbl = data_layer(name='label', size=4)
+        outputs(classification_cost(input=pred, label=lbl))
+    """))
+    from paddle_tpu.trainer import cli
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    parsed = parse_config(str(cfg), "")
+    dc = parsed.trainer_config.data_config
+    assert dc.type == "multi" and len(dc.sub_data_configs) == 2
+    assert dc.sub_data_configs[0].type == "proto"
+
+    rc = cli.main(["--config", str(cfg), "--job", "train",
+                   "--num_passes", "4"])
+    assert rc == 0
+
+
+def test_preprocess_img_dataset_roundtrip(tmp_path):
+    """preprocess_img: label-dir tree -> batched npz + labels/meta, and
+    the reader streams (image, label) samples back."""
+    from PIL import Image
+
+    from paddle_tpu.utils.preprocess_img import (
+        ImageClassificationDatasetCreater,
+        batch_reader,
+    )
+
+    rng = np.random.default_rng(0)
+    for lab in ("cat", "dog"):
+        os.makedirs(tmp_path / lab)
+        for i in range(6):
+            Image.fromarray(rng.integers(
+                0, 255, size=(40, 30, 3), dtype=np.uint8)).save(
+                tmp_path / lab / f"{i}.png")
+    out = ImageClassificationDatasetCreater(
+        str(tmp_path), 16, test_ratio=0.25).create_dataset()
+    assert (open(os.path.join(out, "labels.txt")).read().split()
+            == ["cat", "dog"])
+    train = list(batch_reader(os.path.join(out, "train"))())
+    test = list(batch_reader(os.path.join(out, "test"))())
+    assert len(train) == 9 and len(test) == 3
+    im, lab = train[0]
+    assert im.shape == (3, 16, 16) and lab in (0, 1)
