@@ -16,6 +16,11 @@ datasets require downloads:
                              ``Parameter::save`` binary-dir layout and pull
                              hidden-layer features via ``paddle.infer``
                              (≅ ``model_zoo/resnet/classify.py``).
+- ``sequence_tagging``     — CRF tagger; ``rnn_crf.py``/``linear_crf.py``
+                             byte-identical (py3 provider port).
+- ``mnist``                — ``light_mnist.py``/``vgg_16_mnist.py`` AND
+                             ``mnist_provider.py`` run unmodified; only
+                             ``mnist_util`` is a py3 port.
 """
 
 import os
