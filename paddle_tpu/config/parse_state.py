@@ -71,12 +71,11 @@ def default_decay_rate(val) -> None:
 
 
 def default_momentum(val) -> None:
-    from paddle_tpu.core import logger as log
-
+    """≅ default_momentum (config_parser.py:60): per-parameter momentum
+    default.  Flows into ParamSpec.momentum (layers/api.py _wspec) and is
+    applied by the SGD/Momentum/SparseMomentum update rules, exactly as
+    ``paraConfig.momentum()`` drives ``sgdUpdate`` in the reference."""
     G_DEFAULTS["momentum"] = float(val)
-    log.warning("default_momentum: per-parameter momentum is a proto-"
-                "surface field here; the optimizer uses its own momentum "
-                "(settings learning_method) — value recorded, not applied")
 
 
 def _warn_unapplied(name):

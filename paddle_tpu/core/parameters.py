@@ -39,6 +39,11 @@ class ParamSpec:
     is_static: bool = False  # frozen (ParameterAttribute.is_static)
     learning_rate: float = 1.0  # per-param LR scale
     decay_rate: float | None = None  # per-param L2 override
+    # per-param momentum (ParameterConfig.proto field 4, set by
+    # ParamAttr(momentum=...) or default_momentum()); overrides the
+    # optimizer-level coefficient as paraConfig.momentum() does in
+    # FirstOrderOptimizer.h's sgdUpdate
+    momentum: float | None = None
     gradient_clipping_threshold: float | None = None
     sparse: bool = False  # embedding-style row-sparse grads
     sharding: tuple[str | None, ...] | None = None  # mesh axes per dim (tensor parallel)

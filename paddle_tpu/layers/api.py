@@ -69,6 +69,14 @@ def _default_decay():
     return parse_state.G_DEFAULTS["decay_rate"]
 
 
+def parse_state_momentum():
+    """config-level default_momentum() (≅ config_parser's
+    ``momentum = default(momentum, g_default_momentum)``)."""
+    from paddle_tpu.config import parse_state
+
+    return parse_state.G_DEFAULTS["momentum"]
+
+
 def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
     a = param_attr_or_default(attr)
     fields = dict(
@@ -78,6 +86,8 @@ def _wspec(attr, layer_name, suffix, shape, default_init, **kw) -> ParamSpec:
         is_static=a.is_static,
         learning_rate=1.0 if a.learning_rate is None else a.learning_rate,
         decay_rate=a.l2_rate if a.l2_rate is not None else _default_decay(),
+        momentum=(a.momentum if a.momentum is not None
+                  else parse_state_momentum()),
         attr=a,
         gradient_clipping_threshold=a.gradient_clipping_threshold,
         sparse=a.sparse_update,

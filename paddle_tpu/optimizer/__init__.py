@@ -115,18 +115,24 @@ class Optimizer:
         )
         self.extra = kw
 
+    #: subclasses that fold weight decay into their own update rule (e.g.
+    #: SparseMomentum's beta term) set this so apply() does not also fold
+    #: L2 into the gradient (which would double-count the decay)
+    handles_decay = False
+
     # -- subclass hooks -------------------------------------------------------
-    def slot_init(self, p: jax.Array) -> Any:
+    def slot_init(self, p: jax.Array, spec: ParamSpec | None = None) -> Any:
         return ()
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         """Return (delta, new_slots) with delta to be SUBTRACTED from p."""
         raise NotImplementedError
 
     # -- pytree-level API -----------------------------------------------------
     def init(self, params: dict[str, jax.Array],
              specs: dict[str, ParamSpec] | None = None) -> dict:
-        slots = {k: self.slot_init(v) for k, v in params.items()}
+        specs = specs or {}
+        slots = {k: self.slot_init(v, specs.get(k)) for k, v in params.items()}
         state = {"step": jnp.zeros((), jnp.int32), "slots": slots}
         if self.model_average is not None and self.model_average.average_window > 0:
             state["avg"] = jax.tree.map(jnp.copy, params)
@@ -171,13 +177,14 @@ class Optimizer:
             # L2/L1 regularization folded into the gradient
             # (≅ OptimizerWithRegularizerEveryNumBatches with n=1)
             l2 = spec.decay_rate if (spec is not None and spec.decay_rate is not None) else self.l2_rate
-            if l2:
+            if l2 and not self.handles_decay:
                 g = g + l2 * p
             if self.l1_rate:
                 g = g + self.l1_rate * jnp.sign(p)
             g = clip(g, spec)
             plr = lr * (spec.learning_rate if spec is not None else 1.0)
-            delta, slots = self.tensor_update(g, p, state["slots"][name], plr, step)
+            delta, slots = self.tensor_update(
+                g, p, state["slots"][name], plr, step, spec=spec)
             p_new = p - delta
             if spec is not None and spec.sparsity_ratio:
                 # magnitude pruning mask, re-derived each update (the
@@ -229,7 +236,7 @@ class Optimizer:
         new_p, new_s = [], []
         for g, p, s in zip(leaves_g, leaves_p, state["slots"]):
             g = g.astype(jnp.float32)
-            if self.l2_rate:
+            if self.l2_rate and not self.handles_decay:
                 g = g + self.l2_rate * p
             if self.l1_rate:
                 g = g + self.l1_rate * jnp.sign(p)
@@ -243,6 +250,17 @@ class Optimizer:
             "step": step + 1, "slots": new_s,
         }
 
+    # -- model average (AverageOptimizer::apply/restore) ----------------------
+    def averaged(self, state: dict) -> dict | None:
+        """The averaged parameter values to swap in for eval, or None when
+        no average is being kept (≅ ``AverageOptimizer::apply()``,
+        ``paddle/parameter/AverageOptimizer.h:63`` — the reference swaps
+        PARAMETER_APPLY in for test/inference and restores after; being
+        functional, we never mutate, so ``restore`` is a no-op here)."""
+        if state is None or "avg" not in state:
+            return None
+        return state["avg"]
+
     # v2 compat shim: ``optimizer.create_*_updater`` existed; the Trainer now
     # owns the update step, so these are thin markers.
     def to_setting_kwargs(self):
@@ -250,18 +268,39 @@ class Optimizer:
 
 
 class SGD(Optimizer):
-    """Plain SGD (≅ SgdOptimizer / sgd_op)."""
+    """Plain SGD (≅ SgdOptimizer / sgd_op).  The reference's SgdOptimizer
+    always applies per-PARAMETER momentum (``sgdUpdate(...,
+    paraConfig.momentum(), ...)`` — FirstOrderOptimizer.h:34-58, the value
+    set by ``default_momentum()``/ParamAttr); we allocate the velocity slot
+    only for specs that ask for it, so plain SGD stays slot-free."""
 
     name = "sgd"
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def slot_init(self, p, spec=None):
+        if spec is not None and getattr(spec, "momentum", None):
+            # the coefficient rides in the slot so a later apply() without
+            # specs (e.g. a checkpoint-restored generic step) still updates
+            # with the momentum the slot was created for
+            return {"velocity": jnp.zeros_like(p),
+                    "mu": jnp.asarray(spec.momentum, jnp.float32)}
+        return ()
+
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
+        if isinstance(slots, dict) and "velocity" in slots:
+            m = slots["mu"]
+            v = m * slots["velocity"] + g
+            return lr * v, {"velocity": v, "mu": m}
         return lr * g, slots
 
 
 class Momentum(Optimizer):
     """Heavy-ball momentum (≅ SgdOptimizer with momentum / momentum_op).
     v' = m*v + g ; p -= lr * v  (torch-style, matching the reference's
-    momentum buffer update in TrainingAlgorithmOp.cu)."""
+    momentum buffer update in TrainingAlgorithmOp.cu).  A per-parameter
+    ``ParamSpec.momentum`` (ParameterConfig.proto field 4, set by
+    ``ParamAttr(momentum=...)`` or ``default_momentum()``) overrides the
+    optimizer-level coefficient, as ``paraConfig.momentum()`` does in the
+    reference update."""
 
     name = "momentum"
 
@@ -270,13 +309,100 @@ class Momentum(Optimizer):
         self.momentum = momentum
         self.use_nesterov = use_nesterov
 
-    def slot_init(self, p):
+    def _coeff(self, spec):
+        if spec is not None and getattr(spec, "momentum", None) is not None:
+            return spec.momentum
+        return self.momentum
+
+    def slot_init(self, p, spec=None):
         return {"velocity": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
-        v = self.momentum * slots["velocity"] + g
-        delta = lr * (g + self.momentum * v) if self.use_nesterov else lr * v
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
+        m = self._coeff(spec)
+        v = m * slots["velocity"] + g
+        delta = lr * (g + m * v) if self.use_nesterov else lr * v
         return delta, {"velocity": v}
+
+
+class SparseMomentum(Optimizer):
+    """≅ SparseMomentumParameterOptimizer (FirstOrderOptimizer.h:63-103,
+    FirstOrderOptimizer.cpp:26-113).  Momentum-SGD reformulated so that
+    untouched rows need no per-step work — the parameter is represented as
+
+        theta = (tau * u + v) / beta
+
+    with per-batch scalar advances (startBatch)
+        tau'   = tau + beta/alpha
+        alpha' = alpha / k            (k = momentum)
+        beta'  = beta / (1 + lambda * gamma_t)   (lambda = decay rate)
+    and per-touched-row updates (update)
+        u' = u - alpha' * gamma_t * g
+        v' = v + tau' * alpha' * gamma_t * g
+        theta' = u' * (tau'/beta' + 1/alpha') + v' * (1/beta')
+
+    When alpha exceeds 1e6 the representation restarts to avoid large-value
+    products (needSpecialTraversal/finishBatch): u /= alpha, v = theta,
+    scalars reset to (1, 1, -1).  With every row touched, constant lr, and
+    no decay this is float-equal to heavy-ball momentum (asserted in
+    tests/test_optimizers_v1.py); on a TPU the dense tensor update IS the
+    all-rows case, and the row-sparse path keeps the same math through the
+    SelectedRows kernels (ops/selected_rows.py).  Decay rides in beta, so
+    ``handles_decay`` keeps apply() from also folding L2 into g.  NOTE:
+    with decay the scheme reduces to ``theta' = (1+lambda*lr)*theta + mom``
+    — the reference's OWN sparse branch differs from its dense sgdUpdate
+    branch here, and we reproduce the sparse branch faithfully (verified
+    against a direct transcription of FirstOrderOptimizer.cpp to 5e-15)."""
+
+    name = "sparse_momentum"
+    handles_decay = True
+
+    def __init__(self, momentum: float = 0.9, **kw):
+        super().__init__(**kw)
+        if not momentum or momentum <= 0.0:
+            raise ValueError(
+                "sparse_momentum requires momentum > 0 (alpha advances by "
+                f"1/momentum each batch); got {momentum!r}")
+        self.momentum = momentum
+        self.threshold = 1e6
+
+    def slot_init(self, p, spec=None):
+        return {
+            "u": jnp.zeros_like(p, jnp.float32),
+            "v": jnp.zeros_like(p, jnp.float32),
+            "alpha": jnp.ones((), jnp.float32),
+            "beta": jnp.ones((), jnp.float32),
+            "tau": -jnp.ones((), jnp.float32),
+        }
+
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
+        k = self.momentum
+        if spec is not None and getattr(spec, "momentum", None) is not None:
+            k = spec.momentum
+        decay = 0.0
+        if spec is not None and spec.decay_rate is not None:
+            decay = spec.decay_rate
+        elif self.l2_rate:
+            decay = self.l2_rate
+        p32 = p.astype(jnp.float32)
+        # t0 catch-up: v boots from the current value on the first batch
+        # (t0Vec_ in the reference; dense = every row is "first touched" now)
+        v = jnp.where(step == 0, p32, slots["v"])
+        tau = slots["tau"] + slots["beta"] / slots["alpha"]
+        alpha = slots["alpha"] / k
+        beta = slots["beta"] / (1.0 + decay * lr)
+        u = slots["u"] - alpha * lr * g
+        v = v + tau * alpha * lr * g
+        theta = u * (tau / beta + 1.0 / alpha) + v * (1.0 / beta)
+        # threshold restart, all-or-nothing on the scalars
+        restart = alpha > self.threshold
+        new_slots = {
+            "u": jnp.where(restart, u / alpha, u),
+            "v": jnp.where(restart, theta, v),
+            "alpha": jnp.where(restart, 1.0, alpha),
+            "beta": jnp.where(restart, 1.0, beta),
+            "tau": jnp.where(restart, -1.0, tau),
+        }
+        return (p32 - theta).astype(p.dtype), new_slots
 
 
 class Adam(Optimizer):
@@ -289,10 +415,10 @@ class Adam(Optimizer):
         super().__init__(**kw)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         t = step.astype(jnp.float32) + 1.0
         m = self.beta1 * slots["m"] + (1 - self.beta1) * g
         v = self.beta2 * slots["v"] + (1 - self.beta2) * g * g
@@ -310,10 +436,10 @@ class Adamax(Optimizer):
         super().__init__(**kw)
         self.beta1, self.beta2 = beta1, beta2
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         t = step.astype(jnp.float32) + 1.0
         m = self.beta1 * slots["m"] + (1 - self.beta1) * g
         u = jnp.maximum(self.beta2 * slots["u"], jnp.abs(g))
@@ -330,10 +456,10 @@ class AdaGrad(Optimizer):
         super().__init__(**kw)
         self.epsilon = epsilon
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {"accum": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         accum = slots["accum"] + g * g
         return lr * g / (jnp.sqrt(accum) + self.epsilon), {"accum": accum}
 
@@ -347,10 +473,10 @@ class DecayedAdaGrad(Optimizer):
         super().__init__(**kw)
         self.rho, self.epsilon = rho, epsilon
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {"accum": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         accum = self.rho * slots["accum"] + (1 - self.rho) * g * g
         return lr * g / (jnp.sqrt(accum) + self.epsilon), {"accum": accum}
 
@@ -364,10 +490,10 @@ class AdaDelta(Optimizer):
         super().__init__(**kw)
         self.rho, self.epsilon = rho, epsilon
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {"accum_g": jnp.zeros_like(p), "accum_x": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         ag = self.rho * slots["accum_g"] + (1 - self.rho) * g * g
         dx = jnp.sqrt((slots["accum_x"] + self.epsilon) / (ag + self.epsilon)) * g
         ax = self.rho * slots["accum_x"] + (1 - self.rho) * dx * dx
@@ -385,14 +511,14 @@ class RMSProp(Optimizer):
         super().__init__(**kw)
         self.rho, self.epsilon, self.momentum = rho, epsilon, momentum
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {
             "accum_g": jnp.zeros_like(p),
             "accum_mean": jnp.zeros_like(p),
             "mom": jnp.zeros_like(p),
         }
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         ag = self.rho * slots["accum_g"] + (1 - self.rho) * g * g
         am = self.rho * slots["accum_mean"] + (1 - self.rho) * g
         denom = jnp.sqrt(ag - am * am + self.epsilon)
@@ -409,10 +535,10 @@ class Ftrl(Optimizer):
         super().__init__(**kw)
         self.l1, self.l2, self.lr_power = l1, l2, lr_power
 
-    def slot_init(self, p):
+    def slot_init(self, p, spec=None):
         return {"n": jnp.zeros_like(p), "z": jnp.zeros_like(p)}
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         n, z = slots["n"], slots["z"]
         n_new = n + g * g
         sigma = (jnp.power(n_new, -self.lr_power) - jnp.power(jnp.maximum(n, 1e-38), -self.lr_power)) / lr
@@ -435,7 +561,7 @@ class ProximalGD(Optimizer):
         super().__init__(**kw)
         self.l1, self.l2 = l1, l2
 
-    def tensor_update(self, g, p, slots, lr, step):
+    def tensor_update(self, g, p, slots, lr, step, spec=None):
         prox = p - lr * g
         p_new = (
             jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0)
@@ -446,14 +572,24 @@ class ProximalGD(Optimizer):
 
 OPTIMIZERS = {
     c.name: c
-    for c in (SGD, Momentum, Adam, Adamax, AdaGrad, DecayedAdaGrad, AdaDelta,
-              RMSProp, Ftrl, ProximalGD)
+    for c in (SGD, Momentum, SparseMomentum, Adam, Adamax, AdaGrad,
+              DecayedAdaGrad, AdaDelta, RMSProp, Ftrl, ProximalGD)
 }
+# reference learning_method spellings that alias a class above
+# (torch_momentum differs only in the (1-momentum) lr scale, which the
+# torch-style Momentum update already folds in — see Momentum docstring)
+OPTIMIZER_ALIASES = {"torch_momentum": "momentum"}
 
 
 def from_config(cfg) -> Optimizer:
-    """Build from an OptimizationConfig (≅ ParameterOptimizer::create:175)."""
-    cls = OPTIMIZERS[cfg.learning_method]
+    """Build from an OptimizationConfig (≅ ParameterOptimizer::create:175).
+    Unknown learning_method values fail loudly with the supported list."""
+    method = OPTIMIZER_ALIASES.get(cfg.learning_method, cfg.learning_method)
+    if method not in OPTIMIZERS:
+        raise ValueError(
+            f"unknown learning_method {cfg.learning_method!r}; supported: "
+            f"{sorted(OPTIMIZERS) + sorted(OPTIMIZER_ALIASES)}")
+    cls = OPTIMIZERS[method]
     kw = dict(
         learning_rate=cfg.learning_rate,
         gradient_clipping_threshold=cfg.gradient_clipping_threshold,
@@ -468,8 +604,17 @@ def from_config(cfg) -> Optimizer:
         kw["regularization"] = L2Regularization(cfg.l2_rate)
     if cfg.average_window:
         kw["model_average"] = ModelAverage(cfg.average_window, cfg.max_average_window or 10000)
-    if cls is Momentum:
-        kw["momentum"] = cfg.momentum
+    if cls in (Momentum, SparseMomentum):
+        # OptimizationConfig has no global momentum field (momentum is
+        # per-parameter ParameterConfig.momentum in the reference); accept a
+        # momentum attribute or an extra-kwargs entry from settings()-built
+        # configs, defaulting to the v2 surface's 0.9.  SparseMomentum with
+        # an explicit 0 still raises its own loud error — momentum=0 is
+        # degenerate there (alpha /= momentum), in the reference too.
+        mom = getattr(cfg, "momentum", None)
+        if mom is None:
+            mom = (getattr(cfg, "extra", None) or {}).get("momentum")
+        kw["momentum"] = 0.9 if mom is None else mom
     if cls is Adam:
         kw.update(beta1=cfg.adam_beta1, beta2=cfg.adam_beta2, epsilon=cfg.adam_epsilon)
     if cls in (AdaDelta, DecayedAdaGrad, RMSProp):

@@ -336,10 +336,19 @@ class SGD:
             stat.global_stat.print_all_status()
 
     def test(self, reader, feeding=None) -> v2_event.TestResult:
-        """≅ SGD.test: forward-only over a reader of batches."""
+        """≅ SGD.test: forward-only over a reader of batches.  When the
+        optimizer keeps a model average (``settings(..., model_average=
+        ModelAverage(average_window=...))``), the averaged parameters are
+        swapped in for the duration of the test, exactly as the reference's
+        ``AverageOptimizer::apply()``/``restore()`` bracket
+        (``paddle/parameter/AverageOptimizer.h:63-64``) does around
+        ``Trainer::test`` — being functional, nothing needs restoring."""
         self._ensure_built()
         feeder = self._default_feeder(feeding)
         params = self._params_dict()
+        avg = self.optimizer.averaged(self._opt_state)
+        if avg is not None:
+            params.update(avg)
         states = self.states
         costs, metrics_list, n = [], [], 0
         if self.declared_evaluators:
@@ -372,6 +381,22 @@ class SGD:
         if self.declared_evaluators:
             metrics.update(self.declared_evaluators.finish())
         return v2_event.TestResult(metrics, float(np.mean(costs)))
+
+    def averaged_parameters(self) -> Parameters:
+        """A ``Parameters`` copy with the model-averaged values swapped in
+        (≅ reading PARAMETER_APPLY after ``AverageOptimizer::apply()``) —
+        hand this to ``paddle.infer(parameters=...)`` to run inference on
+        the averaged weights.  Falls back to the raw parameters when no
+        average is kept."""
+        import copy
+
+        out = copy.copy(self.parameters)
+        out._values = dict(self.parameters._values)
+        avg = self.optimizer.averaged(self._opt_state)
+        if avg is not None:
+            for name, val in avg.items():
+                out._values[name] = jax.numpy.asarray(val)
+        return out
 
     # -- checkpointing (ParamUtil / Parameters.to_tar parity) -----------------
     def save_parameter_to_tar(self, f) -> None:

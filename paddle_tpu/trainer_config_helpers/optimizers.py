@@ -43,15 +43,26 @@ def get_settings_optimizer():
         learning_rate_decay_a=_SETTINGS.get("learning_rate_decay_a", 0.0),
         learning_rate_decay_b=_SETTINGS.get("learning_rate_decay_b", 0.0),
     )
-    table = {
-        None: opt.SGD, "sgd": opt.SGD, "momentum": opt.Momentum,
-        "adam": opt.Adam, "adamax": opt.Adamax, "adagrad": opt.AdaGrad,
-        "adadelta": opt.AdaDelta, "rmsprop": opt.RMSProp,
-        "decayed_adagrad": opt.DecayedAdaGrad,
-    }
+    # single source of truth: the optimizer registry + its aliases
+    # (paddle_tpu.optimizer.OPTIMIZERS), so the two surfaces cannot drift
+    table = {None: opt.SGD, **opt.OPTIMIZERS,
+             **{alias: opt.OPTIMIZERS[target]
+                for alias, target in opt.OPTIMIZER_ALIASES.items()}}
     cls = opt.SGD
     if isinstance(method, str) or method is None:
-        cls = table.get(method if method is None else method.lower(), opt.SGD)
+        key = method if method is None else method.lower()
+        if key not in table:
+            # ≅ ParameterOptimizer::create's CHECK on learning_method — an
+            # advertised-surface config must never die in a bare KeyError
+            raise ValueError(
+                f"settings(learning_method={method!r}) is not a supported "
+                f"learning method; supported: {sorted(k for k in table if k)}")
+        cls = table[key]
+        if cls in (opt.Momentum, opt.SparseMomentum) \
+                and _SETTINGS.get("momentum") is not None:
+            # settings(learning_method='momentum', momentum=X) — the string
+            # path must carry the coefficient too
+            kwargs["momentum"] = _SETTINGS["momentum"]
     else:
         # v1 passes method OBJECTS (MomentumOptimizer(momentum=...)); map by
         # class name and forward its kwargs (momentum, beta1, rho, ...)
@@ -61,7 +72,12 @@ def get_settings_optimizer():
             if cname.startswith(key):
                 cls = table[key]
                 break
-        kwargs.update(getattr(method, "kw", {}))
+        mkw = dict(getattr(method, "kw", {}))
+        # MomentumOptimizer(momentum, sparse=True) selects the
+        # sparse_momentum method (reference optimizers.py:100)
+        if mkw.pop("sparse", False) and cls is opt.Momentum:
+            cls = opt.SparseMomentum
+        kwargs.update(mkw)
     return cls(**{k: v for k, v in kwargs.items() if v is not None})
 
 
