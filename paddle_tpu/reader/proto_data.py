@@ -12,7 +12,8 @@ trainer's feeder consumes.
 
 Slot -> feed conversion mirrors ``ProtoDataProvider::fillSlots``:
 VECTOR_DENSE -> float list, VECTOR_SPARSE_NON_VALUE -> id list,
-VECTOR_SPARSE_VALUE -> (ids, values), INDEX -> int.  Sequence datasets
+VECTOR_SPARSE_VALUE -> [(index, value), ...] pairs, INDEX -> int.
+Sequence datasets
 yield, per slot, the list of per-timestep values (length-1 sequences
 included); non-sequence datasets yield each timestep's value directly.
 """
@@ -114,12 +115,16 @@ def _slot_value(sample, table, slot_idx: int):
     if stype == VECTOR_SPARSE_NON_VALUE:
         return list(sample.vector_slots[kidx].ids)
     if stype == VECTOR_SPARSE_VALUE:
+        # (index, value) pair list — the v2 sparse_float convention the
+        # feeder's _densify_pairs consumes (reference SparseFloatScanner
+        # reads x[0]/x[1] per pair)
         vs = sample.vector_slots[kidx]
-        return (list(vs.ids), list(vs.values))
+        return list(zip(vs.ids, vs.values))
     return int(sample.id_slots[kidx])
 
 
-def proto_reader(file_list, sequential: bool | None = None):
+def proto_reader(file_list, sequential: bool | None = None,
+                 usage_ratio: float | None = None):
     """paddle reader over proto data files: one tuple per SEQUENCE, one
     entry per slot.
 
@@ -128,7 +133,15 @@ def proto_reader(file_list, sequential: bool | None = None):
     per-timestep list for every slot — including length-1 sequences —
     while non-sequence data yields each timestep's value directly.
     ``None`` auto-detects per file (any ``is_beginning=False`` sample).
-    """
+
+    ``usage_ratio`` < 1 consumes only that fraction of each file's
+    sequences per pass (``ProtoDataProvider::sequenceLoop``,
+    ProtoDataProvider.cpp:397-399: the SHUFFLED sequence list is truncated
+    to ``count * usage_ratio`` — the shuffle precedes the cut, so
+    successive passes sample different subsets and no fixed file tail is
+    starved)."""
+
+    import numpy as _np
 
     def reader():
         for path in file_list:
@@ -137,7 +150,6 @@ def proto_reader(file_list, sequential: bool | None = None):
             n_slots = len(header.slot_defs)
             has_seq = (any(not s.is_beginning for s in samples)
                        if sequential is None else sequential)
-            seq: list = []
 
             def emit(seq):
                 cols = []
@@ -146,6 +158,21 @@ def proto_reader(file_list, sequential: bool | None = None):
                     cols.append(vals if has_seq else vals[0])
                 return tuple(cols)
 
+            if usage_ratio is not None and usage_ratio < 1.0:
+                # group into sequences, shuffle, THEN truncate (fresh
+                # shuffle per reader() call = per pass)
+                seqs: list[list] = []
+                for s in samples:
+                    if s.is_beginning or not seqs:
+                        seqs.append([])
+                    seqs[-1].append(s)
+                keep = int(len(seqs) * usage_ratio)
+                order = _np.random.default_rng().permutation(len(seqs))
+                for idx in order[:keep]:
+                    yield emit(seqs[idx])
+                continue
+
+            seq: list = []
             for s in samples:
                 if s.is_beginning and seq:
                     yield emit(seq)
@@ -194,10 +221,13 @@ def input_types_from_header(path: str):
     return kinds
 
 
-def multi_reader(sub_readers, ratios=None):
+def multi_reader(sub_readers):
     """MultiDataProvider (MultiDataProvider.h:24): one sample per
     sub-provider per step, yielded as one concatenated tuple — the
-    reference feeds multiple data sources into one network."""
+    reference feeds multiple data sources into one network.  Per-sub
+    sub-sampling comes from each sub-provider's own DataConfig
+    usage_ratio (as in the reference, where every sub-DataProvider
+    carries its own ``usageRatio_``), not from a knob here."""
 
     def reader():
         its = [r() for r in sub_readers]

@@ -78,7 +78,9 @@ def _raw_reader_from_data_config(rec: dict, topo, input_order):
         types = proto_data.input_types_from_header(files[0])
         # row shape must match the header-derived types dataset-wide
         sequential = any(t.seq_type != 0 for t in types)
-        reader = proto_data.proto_reader(files, sequential=sequential)
+        reader = proto_data.proto_reader(
+            files, sequential=sequential,
+            usage_ratio=rec.get("usage_ratio"))
 
         class _ProtoObj:  # reader metadata the batching code consults
             should_shuffle = True

@@ -43,6 +43,18 @@ def get_settings_optimizer():
         learning_rate_decay_a=_SETTINGS.get("learning_rate_decay_a", 0.0),
         learning_rate_decay_b=_SETTINGS.get("learning_rate_decay_b", 0.0),
     )
+    ma = _SETTINGS.get("model_average")
+    if ma is not None:
+        # accept the v1 shim ModelAverage (kw dict) or the optimizer-level
+        # dataclass directly, so settings(model_average=...) actually keeps
+        # an average (consumed by trainer.test's apply-at-eval)
+        if isinstance(ma, opt.ModelAverage):
+            kwargs["model_average"] = ma
+        else:
+            mkw = getattr(ma, "kw", None) or {}
+            kwargs["model_average"] = opt.ModelAverage(
+                average_window=mkw.get("average_window", 0.0),
+                max_average_window=mkw.get("max_average_window") or 10000)
     # single source of truth: the optimizer registry + its aliases
     # (paddle_tpu.optimizer.OPTIMIZERS), so the two surfaces cannot drift
     table = {None: opt.SGD, **opt.OPTIMIZERS,

@@ -65,17 +65,16 @@ class TestSparseMomentum:
         np.testing.assert_allclose(np.asarray(ref["w"]), np.asarray(got["w"]),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_decay_follows_reference_beta_scheme(self):
-        """beta carries the decay term: the reference's sparse branch
-        reduces to the closed-form recurrence
+    def test_decay_is_decoupled_weight_decay(self):
+        """beta carries the decay term as true decoupled weight decay:
 
             mom_t   = k * mom_{t-1} - lr * g_t
-            theta_t = (1 + lambda*lr) * theta_{t-1} + mom_t
+            theta_t = (1 - lambda*lr) * theta_{t-1} + mom_t
 
-        which DIFFERS from its own dense branch (sgdUpdate folds
-        -lr*lambda*value into the momentum buffer); we reproduce the sparse
-        branch faithfully (verified against a direct numpy transcription of
-        FirstOrderOptimizer.cpp:49-83, max|Δ| ~ 5e-15 in f64)."""
+        NOTE this deliberately fixes the reference's sign
+        (FirstOrderOptimizer.cpp:54 divides beta by (1 + lambda*gamma),
+        under which decay GROWS theta by (1+lambda*lr) per step — verified
+        against a direct transcription; see the SparseMomentum docstring)."""
         params, grads = _toy_problem()
         lam, lr, k = 0.01, 0.05, 0.9
         specs = {"w": _spec("w", (8, 4), decay_rate=lam)}
@@ -85,9 +84,31 @@ class TestSparseMomentum:
         mom = np.zeros_like(theta)
         for g in grads:
             mom = k * mom - lr * np.asarray(g["w"], np.float64)
-            theta = (1.0 + lam * lr) * theta + mom
+            theta = (1.0 - lam * lr) * theta + mom
         np.testing.assert_allclose(theta, np.asarray(sparse["w"]),
                                    rtol=2e-4, atol=2e-5)
+
+    def test_decay_shrinks_with_zero_gradient(self):
+        """With g=0, decay must shrink the parameter, never amplify it."""
+        import jax.numpy as jnp
+
+        o = opt.SparseMomentum(momentum=0.9, learning_rate=0.05,
+                               regularization=opt.L2Regularization(0.1))
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        state = o.init(params)
+        for _ in range(50):
+            params, state = o.apply({"w": jnp.zeros((4,))}, params, state)
+        assert float(np.abs(np.asarray(params["w"])).max()) < 1.0
+
+    def test_spec_zero_momentum_rejected_per_param(self):
+        import jax.numpy as jnp
+
+        o = opt.SparseMomentum(momentum=0.9, learning_rate=0.05)
+        specs = {"w": _spec("w", (4,), momentum=0.0)}
+        params = {"w": jnp.ones((4,))}
+        state = o.init(params, specs)
+        with pytest.raises(ValueError, match="momentum > 0"):
+            o.apply({"w": jnp.ones((4,))}, params, state, specs)
 
     def test_zero_momentum_rejected(self):
         with pytest.raises(ValueError, match="momentum > 0"):
@@ -200,6 +221,24 @@ class TestFactoryEdgeCases:
                      learning_method="momentum", momentum=0.4)
         o = tch.optimizers.get_settings_optimizer()
         assert isinstance(o, opt.Momentum) and o.momentum == 0.4
+
+    def test_settings_forwards_model_average(self):
+        """settings(model_average=ModelAverage(...)) must reach the built
+        optimizer (else the apply-at-eval feature is silently inert)."""
+        import paddle_tpu.trainer_config_helpers as tch
+
+        tch.settings(batch_size=16, learning_rate=0.1,
+                     learning_method="momentum",
+                     model_average=tch.optimizers.ModelAverage(
+                         average_window=0.5, max_average_window=300))
+        o = tch.optimizers.get_settings_optimizer()
+        assert o.model_average is not None
+        assert o.model_average.average_window == 0.5
+        assert o.model_average.max_average_window == 300
+        import jax.numpy as jnp
+
+        state = o.init({"w": jnp.zeros((2,))})
+        assert "avg" in state
 
     def test_from_config_momentum_from_extra_kwargs(self):
         """settings()-built configs keep momentum in extra kwargs (the
