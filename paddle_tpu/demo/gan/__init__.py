@@ -1,0 +1,6 @@
+"""GAN demo — runs the reference's ``v1_api_demo/gan/gan_conf.py`` /
+``gan_conf_image.py`` VERBATIM (read from the reference tree at runtime)
+and reproduces ``gan_trainer.py:1-349``'s alternating two-GradientMachine
+loop through the v2 API: three machines parsed from one config with
+``mode=`` config-args, cross-machine parameter copying, and the
+strike-based choose-who-trains schedule."""

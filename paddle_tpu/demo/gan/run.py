@@ -1,0 +1,244 @@
+"""GAN demo runner — executes the reference config
+``v1_api_demo/gan/gan_conf.py`` (or ``gan_conf_image.py``) verbatim and
+reproduces the alternating two-machine training loop of
+``v1_api_demo/gan/gan_trainer.py:1-349``:
+
+- three machines parsed from ONE config via ``--config_args mode=...``
+  (generator_training / discriminator_training / generator), exactly as
+  ``gan_trainer.py:241-247`` calls ``parse_config`` three times;
+- cross-machine gradient flow through ``ParamAttr(is_static=...)``: the
+  generator trains THROUGH the frozen discriminator and vice versa;
+- ``copy_shared_parameters`` (``gan_trainer.py:50-71``) moves same-named
+  parameters (and BN moving stats) between machines after each update;
+- the strike schedule (``gan_trainer.py:299-331``): whoever has the
+  larger loss trains, but never more than MAX_strike=5 times in a row.
+
+Data sources: "uniform" (the reference's synthetic 2-D uniform,
+``load_uniform_data``, gan_trainer.py:113-116) needs no files; "mnist"
+writes synthetic idx images like the mnist demo.
+
+Run: python -m paddle_tpu.demo.gan.run [--data_source uniform]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from paddle_tpu.demo import REFERENCE_ROOT
+
+
+def _quiet(_event):
+    pass
+
+
+class Machine:
+    """One 'GradientMachine + Trainer' pair built from a parsed config
+    (the v2 replacement for ``api.GradientMachine.createFromConfigProto``
+    + ``api.Trainer.create``)."""
+
+    def __init__(self, parsed):
+        import paddle_tpu as paddle
+        from paddle_tpu.config.topology import Topology
+        from paddle_tpu.layers import data_type as dt
+        from paddle_tpu.trainer_config_helpers.optimizers import (
+            get_settings_optimizer,
+        )
+
+        self.topology = Topology(parsed.output_layers())
+        # the reference feeds the label slot as ids
+        # (prepare_discriminator_data_batch_*: setSlotIds); the config's
+        # data_layer(name="label", size=1) carries no type, so bind it here
+        # the way a provider would
+        label = self.topology.data_layers().get("label")
+        if label is not None:
+            it = dt.integer_value(2)
+            label.attrs.update(data_type=it.kind, seq_type=it.seq_type,
+                               dim=it.dim)
+        self.parameters = paddle.parameters.create(self.topology)
+        self.trainer = paddle.trainer.SGD(
+            cost=parsed.output_layers(), parameters=self.parameters,
+            update_equation=get_settings_optimizer())
+        self._feeding = None
+
+    def train_batch(self, batch) -> None:
+        self.trainer.train(reader=lambda: iter([batch]), num_passes=1,
+                           event_handler=_quiet)
+
+    def loss(self, batch) -> float:
+        """forward-only mean cost (``get_training_loss``,
+        gan_trainer.py:163-167)."""
+        return self.trainer.test(reader=lambda: iter([batch]),
+                                 feeding=self._feeding).cost
+
+
+def copy_shared_parameters(src, dst) -> None:
+    """``gan_trainer.py:50-71``: same-named parameters copy src -> dst;
+    BN moving stats (states here, PARAMETER-typed in the reference) ride
+    along.  dst may be a Machine or an Inference."""
+    src_params = src.parameters if hasattr(src, "parameters") else src
+    dst_params = dst.parameters
+    for name in dst_params.names():
+        if name in src_params:
+            dst_params[name] = np.asarray(src_params[name])
+    src_states = getattr(getattr(src, "trainer", src), "states", None) or {}
+    dst_owner = getattr(dst, "trainer", dst)
+    dst_states = getattr(dst_owner, "states", None)
+    if dst_states is not None:
+        import jax.numpy as jnp
+
+        for name in list(dst_states):
+            if name in src_states:
+                dst_states[name] = jnp.asarray(src_states[name])
+
+
+def get_noise(batch_size: int, noise_dim: int) -> np.ndarray:
+    return np.random.normal(size=(batch_size, noise_dim)).astype("float32")
+
+
+def load_uniform_data(n: int = 100000) -> np.ndarray:
+    """``load_uniform_data`` (gan_trainer.py:113-116) at demo scale."""
+    return np.random.rand(n, 2).astype("float32")
+
+
+def load_mnist_like(workdir: str, n: int = 4096) -> np.ndarray:
+    """Synthetic idx images in [-1, 1] (``load_mnist_data``,
+    gan_trainer.py:84-98), written/read through the same idx format the
+    mnist demo uses."""
+    from paddle_tpu.demo.mnist.run import make_data
+
+    make_data(workdir, n_train=n, n_test=64)
+    import struct
+
+    path = os.path.join(workdir, "data", "raw_data", "train-images-idx3-ubyte")
+    with open(path, "rb") as f:
+        f.read(16)
+        data = np.frombuffer(f.read(n * 28 * 28), np.uint8)
+    return (data.reshape(n, 28 * 28) / 255.0 * 2.0 - 1.0).astype("float32")
+
+
+def get_real_samples(batch_size: int, data_np: np.ndarray) -> np.ndarray:
+    return data_np[np.random.choice(data_np.shape[0], batch_size,
+                                    replace=False), :]
+
+
+def run(data_source: str = "uniform", num_iter: int = 120,
+        num_passes: int = 1, workdir: str = "./gan_work",
+        conf_override: str | None = None, log_period: int = 20):
+    """Returns (dis_losses, gen_losses, trained_sides) across iterations."""
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.trainer.config_parser import parse_config
+    from paddle_tpu.trainer.inference import Inference
+
+    assert data_source in ("uniform", "mnist", "cifar")
+    conf = conf_override or os.path.join(
+        REFERENCE_ROOT, "v1_api_demo/gan",
+        "gan_conf.py" if data_source == "uniform" else "gan_conf_image.py")
+    cargs = f"mode=%s" + (f",data={data_source}"
+                          if data_source != "uniform" else "")
+
+    gen_conf = parse_config(conf, cargs % "generator_training")
+    gen_training = Machine(gen_conf)
+    dis_conf = parse_config(conf, cargs % "discriminator_training")
+    dis_training = Machine(dis_conf)
+    generator_conf = parse_config(conf, cargs % "generator")
+    batch_size = dis_conf.opt_config.batch_size or 128
+    noise_dim = next(n.attrs["dim"] for n in generator_conf.layers
+                     if n.name == "noise")
+    import paddle_tpu as paddle
+
+    generator_machine = Inference(
+        generator_conf.output_layers(),
+        paddle.parameters.create(Topology(generator_conf.output_layers())))
+
+    if data_source == "uniform":
+        data_np = load_uniform_data()
+    else:
+        data_np = load_mnist_like(workdir)
+
+    # Sync parameters between networks at the beginning (gan_trainer:268)
+    copy_shared_parameters(gen_training, dis_training)
+    copy_shared_parameters(gen_training, generator_machine)
+
+    def fake_samples(noise):
+        # flatten any spatial output to the reference's flat-row convention
+        # (copyToNumpyMat returns [B, sample_dim])
+        out = np.asarray(generator_machine.infer([(row,) for row in noise]))
+        return out.reshape(len(noise), -1)
+
+    curr_train, curr_strike, MAX_strike = "dis", 0, 5
+    dis_losses, gen_losses, sides = [], [], []
+    for train_pass in range(num_passes):
+        for i in range(num_iter):
+            noise = get_noise(batch_size, noise_dim)
+            real = get_real_samples(batch_size, data_np)
+            ones = np.ones(batch_size, dtype="int32")
+            zeros = np.zeros(batch_size, dtype="int32")
+            batch_dis_pos = [(real[j], int(ones[j]))
+                             for j in range(batch_size)]
+            fake = fake_samples(noise)
+            batch_dis_neg = [(fake[j], int(zeros[j]))
+                             for j in range(batch_size)]
+            batch_gen = [(noise[j], int(ones[j]))
+                         for j in range(batch_size)]
+
+            dis_loss_pos = dis_training.loss(batch_dis_pos)
+            dis_loss_neg = dis_training.loss(batch_dis_neg)
+            dis_loss = (dis_loss_pos + dis_loss_neg) / 2.0
+            gen_loss = gen_training.loss(batch_gen)
+            dis_losses.append(dis_loss)
+            gen_losses.append(gen_loss)
+
+            if i % log_period == 0:
+                print(f"pass {train_pass} iter {i}: d_loss {dis_loss:.4f} "
+                      f"(pos {dis_loss_pos:.4f} neg {dis_loss_neg:.4f}) "
+                      f"g_loss {gen_loss:.4f} training={curr_train}")
+
+            # strike schedule (gan_trainer.py:299-331)
+            if (not (curr_train == "dis" and curr_strike == MAX_strike)) and \
+               ((curr_train == "gen" and curr_strike == MAX_strike)
+                    or dis_loss > gen_loss):
+                if curr_train == "dis":
+                    curr_strike += 1
+                else:
+                    curr_train, curr_strike = "dis", 1
+                dis_training.train_batch(batch_dis_neg)
+                dis_training.train_batch(batch_dis_pos)
+                copy_shared_parameters(dis_training, gen_training)
+            else:
+                if curr_train == "gen":
+                    curr_strike += 1
+                else:
+                    curr_train, curr_strike = "gen", 1
+                gen_training.train_batch(batch_gen)
+                copy_shared_parameters(gen_training, dis_training)
+                copy_shared_parameters(gen_training, generator_machine)
+            sides.append(curr_train)
+
+    final = fake_samples(get_noise(batch_size, noise_dim))
+    print("generated sample mean:", np.mean(final, 0),
+          "std:", np.std(final, 0))
+    return dis_losses, gen_losses, sides, final
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-d", "--data_source", default="uniform",
+                    choices=["uniform", "mnist", "cifar"])
+    ap.add_argument("--num_iter", type=int, default=120)
+    ap.add_argument("--num_passes", type=int, default=1)
+    ap.add_argument("--workdir", default="./gan_work")
+    args = ap.parse_args(argv)
+    dis_losses, gen_losses, sides, _ = run(
+        data_source=args.data_source, num_iter=args.num_iter,
+        num_passes=args.num_passes, workdir=args.workdir)
+    trained_both = len(set(sides)) == 2
+    print(f"trained sides: {sorted(set(sides))}; "
+          f"final d_loss {dis_losses[-1]:.4f} g_loss {gen_losses[-1]:.4f}")
+    return 0 if trained_both and np.isfinite(dis_losses[-1]) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,6 @@
+"""VAE demo — runs the reference's ``v1_api_demo/vae/vae_conf.py``
+VERBATIM (read from the reference tree at runtime) and reproduces
+``vae_train.py:1-175``'s loop through the v2 API: a training machine
+(``is_generating=False``) and a generator machine
+(``is_generating=True``) sharing parameters by name via
+``copy_shared_parameters``."""

@@ -1,0 +1,107 @@
+"""VAE demo runner — executes the reference config
+``v1_api_demo/vae/vae_conf.py`` verbatim and reproduces
+``v1_api_demo/vae/vae_train.py:110-172``'s loop through the v2 API:
+
+- two machines parsed from ONE config via ``is_generating`` config-args
+  (``vae_train.py:111-112``): the full encoder(q_func) ->
+  reparameterization -> generator ELBO network, and the decoder-only
+  generator network;
+- the MNIST loader's [-1, 1] mapping (``dataloader.py:33``) replaced by
+  the same synthetic idx digits the mnist demo writes;
+- ``copy_shared_parameters`` (``vae_train.py:55-75``) syncs the decoder
+  weights (hidden.w/prob.w named via ParamAttr) into the generator
+  machine before sampling.
+
+Run: python -m paddle_tpu.demo.vae.run [--num_batches 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from paddle_tpu.demo import REFERENCE_ROOT
+from paddle_tpu.demo.gan.run import copy_shared_parameters, _quiet
+
+
+def load_batches(workdir: str, n: int = 4096,
+                 batch_size: int = 32) -> list[np.ndarray]:
+    """Synthetic idx digits in [-1, 1] (shared gan-demo loader, same
+    mapping as ``dataloader.MNISTloader._extract_images``,
+    vae/dataloader.py:28-38), pre-split into batches like the loader."""
+    from paddle_tpu.demo.gan.run import load_mnist_like
+
+    data = load_mnist_like(workdir, n=n)
+    return [data[i:i + batch_size]
+            for i in range(0, n - batch_size + 1, batch_size)]
+
+
+def run(num_batches: int = 120, workdir: str = "./vae_work",
+        log_period: int = 20):
+    """Returns (losses, samples): per-batch VAE loss and a final block of
+    generated samples."""
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.trainer.config_parser import parse_config
+    from paddle_tpu.trainer.inference import Inference
+    from paddle_tpu.trainer_config_helpers.optimizers import (
+        get_settings_optimizer,
+    )
+
+    conf = os.path.join(REFERENCE_ROOT, "v1_api_demo/vae/vae_conf.py")
+    trainer_conf = parse_config(conf, "is_generating=False")
+    parameters = paddle.parameters.create(Topology(
+        trainer_conf.output_layers()))
+    trainer = paddle.trainer.SGD(
+        cost=trainer_conf.output_layers(), parameters=parameters,
+        update_equation=get_settings_optimizer())
+
+    gener_conf = parse_config(conf, "is_generating=True")
+    generator_machine = Inference(
+        gener_conf.output_layers(),
+        paddle.parameters.create(Topology(gener_conf.output_layers())))
+    batch_size = trainer_conf.opt_config.batch_size or 32
+    noise_dim = next(n.attrs["dim"] for n in gener_conf.layers
+                     if n.name == "noise")
+
+    batches = load_batches(workdir, batch_size=batch_size)
+    losses = []
+    for it in range(num_batches):
+        X = batches[it % len(batches)]
+        batch = [(row,) for row in X]
+        if it % log_period == 0:
+            loss = trainer.test(reader=lambda: iter([batch])).cost
+            losses.append(loss)
+            print(f"iter {it:03d}: VAE loss {loss:.4f}")
+        trainer.train(reader=lambda: iter([batch]), num_passes=1,
+                      event_handler=_quiet)
+    final_loss = trainer.test(
+        reader=lambda: iter([[(row,) for row in batches[0]]])).cost
+    losses.append(final_loss)
+    print(f"final VAE loss {final_loss:.4f}")
+
+    # sample from the decoder (vae_train.py:153-158)
+    copy_shared_parameters(trainer, generator_machine)
+    z = np.random.randn(batch_size, noise_dim).astype("float32")
+    samples = np.asarray(generator_machine.infer([(row,) for row in z]))
+    print("sample stats: mean", float(samples.mean()),
+          "min", float(samples.min()), "max", float(samples.max()))
+    return losses, samples
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_batches", type=int, default=120)
+    ap.add_argument("--workdir", default="./vae_work")
+    args = ap.parse_args(argv)
+    losses, samples = run(num_batches=args.num_batches,
+                          workdir=args.workdir)
+    ok = np.isfinite(losses[-1]) and losses[-1] < losses[0]
+    print(f"ELBO decreased: {losses[0]:.2f} -> {losses[-1]:.2f} ({ok})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

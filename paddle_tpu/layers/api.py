@@ -326,13 +326,23 @@ def img_conv(
     name: str | None = None,
     trans: bool = False,
     dilation=1,
+    filter_size_y=None,
+    stride_y=None,
+    padding_y=None,
 ) -> LayerOutput:
     """≅ img_conv_layer (layers.py:2379) over ExpandConvLayer/CudnnConvLayer;
-    XLA conv on NHWC replaces im2col+gemm (paddle/function/GemmConvOp.cpp)."""
+    XLA conv on NHWC replaces im2col+gemm (paddle/function/GemmConvOp.cpp).
+    ``*_y`` kwargs follow the reference convention: None means "same as x"."""
     name = name or gen_name("conv")
     kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
     sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
     ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if filter_size_y is not None:
+        kh = filter_size_y
+    if stride_y is not None:
+        sh = stride_y
+    if padding_y is not None:
+        ph = padding_y
     c_in = num_channels or input.depth
     h_in, w_in = input.height, input.width
     if not (h_in and w_in):

@@ -31,9 +31,21 @@ def soft_cross_entropy(probs: jax.Array, soft_label: jax.Array, eps: float = 1e-
 
 
 def binary_cross_entropy(p: jax.Array, label: jax.Array, eps: float = 1e-10) -> jax.Array:
-    """Element-wise BCE summed over features (≅ MultiBinaryLabelCrossEntropy)."""
+    """Element-wise BCE summed over features (≅ MultiBinaryLabelCrossEntropy).
+
+    Stability note: the guard must be a CLIP, not ``log(1 - p + eps)`` —
+    under jit, XLA's algebraic simplifier reassociates ``1 - p + eps`` to
+    ``(1 + eps) - p`` which rounds back to ``1 - p`` in f32, so a saturated
+    sigmoid (p == 1.0) produced log(0) = -inf in the compiled graph while
+    the eager computation was finite.  The upper clip uses 1e-7 because
+    1 - 1e-10 is not representable in f32 (ulp at 1.0 is ~6e-8); p is
+    upcast to f32 FIRST since 1 - 1e-7 itself rounds to 1.0 in bf16 (ulp
+    at 1.0 is ~0.0078), which would resurrect the -inf on the bf16
+    compute path."""
+    p = p.astype(jnp.float32)
     label = label.astype(p.dtype)
-    ce = -(label * jnp.log(p + eps) + (1.0 - label) * jnp.log(1.0 - p + eps))
+    p = jnp.clip(p, eps, 1.0 - 1e-7)
+    ce = -(label * jnp.log(p) + (1.0 - label) * jnp.log1p(-p))
     return jnp.sum(ce, axis=-1) if ce.ndim > 1 else ce
 
 

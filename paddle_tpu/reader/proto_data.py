@@ -167,7 +167,9 @@ def proto_reader(file_list, sequential: bool | None = None,
                         seqs.append([])
                     seqs[-1].append(s)
                 keep = int(len(seqs) * usage_ratio)
-                order = _np.random.default_rng().permutation(len(seqs))
+                # global np.random so np.random.seed() makes data
+                # selection reproducible (repo-wide convention)
+                order = _np.random.permutation(len(seqs))
                 for idx in order[:keep]:
                     yield emit(seqs[idx])
                 continue
