@@ -13,7 +13,6 @@ comparisons.
 
 from __future__ import annotations
 
-from google.protobuf import descriptor as _desc
 
 
 def py2_float_repr(v: float) -> str:
@@ -85,7 +84,7 @@ def _print_msg(msg, indent: int, out: list, int_style=None) -> None:
     pad = "  " * indent
     mid = id(msg)
     for fd in msg.DESCRIPTOR.fields:  # descriptor order == declaration order
-        if fd.label == _desc.FieldDescriptor.LABEL_REPEATED:
+        if fd.is_repeated:  # label() is deprecated in protobuf>=5
             values = getattr(msg, fd.name)
             for v in values:
                 if fd.type == fd.TYPE_MESSAGE:
