@@ -26,6 +26,74 @@ def _float_var(block, name):
     return v.dtype is None or v.dtype.startswith("float") or v.dtype.startswith("bfloat")
 
 
+def _recurrent_outer_reads(program, block, op) -> list[str]:
+    """Outer-scope variables a recurrent op's step net reads (parameters
+    created while building layers inside ``rnn.step()``, shared weights,
+    …): read by sub-block ops, not produced inside the sub-block, not a
+    step placeholder, resolvable in the parent block."""
+    produced = set(op.attrs["step_inputs"]) | set(op.attrs["ex_states"])
+    declared = (set(op.inputs.get("inputs", ()))
+                | set(op.inputs.get("initial_states", ())))
+    reads: list[str] = []
+
+    def walk(blk):
+        for o in blk.ops:
+            for n in o.input_names():
+                if (n and n not in produced and n not in declared
+                        and n not in reads and block.has_var(n)):
+                    reads.append(n)
+            # recurse into nested control flow (a cond/recurrent inside
+            # the step net reads outer vars too)
+            for key in ("sub_block", "true_block", "false_block"):
+                if key in o.attrs:
+                    walk(program.blocks[o.attrs[key]])
+            produced.update(x for x in o.output_names() if x)
+
+    walk(program.blocks[op.attrs["sub_block"]])
+    return reads
+
+
+def _append_recurrent_grad(block, op, outer, need, pending, _declare,
+                           get_grad):
+    """Emit a ``__recurrent_grad__`` op (executor lowers it to jax.vjp
+    around the same lax.scan the forward ran — the functional analog of
+    the reference's per-step backward scopes, recurrent_op.cc grad)."""
+    out_names = list(op.outputs.get("outputs", ()))
+    og, has_any = [], False
+    for n in out_names:
+        g = get_grad(n) if n and n in pending else None
+        og.append(g or "")
+        has_any = has_any or g is not None
+    if not has_any:
+        return
+
+    slots = {
+        "inputs": list(op.inputs.get("inputs", ())),
+        "initial_states": list(op.inputs.get("initial_states", ())),
+        "outer": list(outer),
+    }
+    outputs = {}
+    for slot, names in slots.items():
+        outs = []
+        for n in names:
+            if n and n in need and _float_var(block, n):
+                k = len(pending.setdefault(n, []))
+                gname = grad_var_name(n) + ("@C0" if k == 0
+                                            else "@RENAME%d" % k)
+                _declare(gname, n)
+                pending[n].append(gname)
+                outs.append(gname)
+            else:
+                outs.append("")
+        outputs[slot + "@GRAD"] = outs
+    attrs = dict(op.attrs)
+    attrs["__outer__"] = list(outer)
+    block.append_op(
+        "__recurrent_grad__",
+        {**op.inputs, "outer": list(outer), "OG:outputs": og},
+        outputs, attrs)
+
+
 def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
     """Append grad ops for ``loss`` to its program; returns [(param, grad_var)].
 
@@ -45,16 +113,28 @@ def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
 
     fwd_ops = list(block.ops)
 
+    # recurrent ops read outer-scope variables (parameters created by
+    # layers built inside the step net) that are NOT in op.inputs; the
+    # grad pass must see those reads (reference recurrent_op grad links
+    # parameter grads out of per-step scopes)
+    outer_reads: dict[int, list[str]] = {}
+    for op in fwd_ops:
+        if op.type == "recurrent":
+            outer_reads[id(op)] = _recurrent_outer_reads(program, block, op)
+
+    def _in_names(op):
+        return list(op.input_names()) + outer_reads.get(id(op), [])
+
     # Vars on a grad path: descendants of params intersected with ancestors of
     # loss (plus the loss itself).
     desc = {p.name for p in params}
     for op in fwd_ops:
-        if any(n in desc for n in op.input_names()):
+        if any(n in desc for n in _in_names(op)):
             desc.update(n for n in op.output_names() if n)
     anc = {loss.name}
     for op in reversed(fwd_ops):
         if any(n in anc for n in op.output_names()):
-            anc.update(n for n in op.input_names() if n)
+            anc.update(n for n in _in_names(op) if n)
     need = ((desc & anc) | {loss.name}) - no_grad
 
     for op in fwd_ops:
@@ -105,6 +185,10 @@ def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
         return canon
 
     for op in reversed(fwd_ops):
+        if op.type == "recurrent":
+            _append_recurrent_grad(block, op, outer_reads[id(op)], need,
+                                   pending, _declare, get_grad)
+            continue
         # incoming grads for this op's outputs
         og_inputs = {}
         has_any = False

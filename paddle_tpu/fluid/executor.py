@@ -44,6 +44,8 @@ def _run_op(op: framework.Operator, env: dict, rng, program=None):
         return _run_cond(op, env, rng, program)
     if op.type == "recurrent":
         return _run_recurrent(op, env, rng, program)
+    if op.type == "__recurrent_grad__":
+        return _run_recurrent_grad(op, env, rng, program)
     kernel = get_kernel(op.type)
     ins = {}
     for slot, names in op.inputs.items():
@@ -124,6 +126,19 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
     ``shrink_rnn_memory``, done with masks under static shapes).
     """
     enforce(program is not None, "recurrent op needs its owning program")
+    ys, final_state = _recurrent_scan(op, env, rng, program)
+    out_names = [n for n in op.outputs.get("outputs", ()) if n]
+    ex_states = op.attrs["ex_states"]
+    for n, y in zip(out_names, ys):
+        env[n] = y
+    for name, ex in zip(op.outputs.get("final_states", ()), ex_states):
+        if name:
+            env[name] = final_state[ex]
+
+
+def _recurrent_scan(op: framework.Operator, env: dict, rng, program):
+    """The shared scan core of the recurrent op: returns (stacked step
+    outputs, final state dict keyed by ex_state name)."""
     sub = program.blocks[op.attrs["sub_block"]]
     in_names = [n for n in op.inputs.get("inputs", ()) if n]
     boot_names = [n for n in op.inputs.get("initial_states", ()) if n]
@@ -131,7 +146,6 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
     ex_states = op.attrs["ex_states"]
     states = op.attrs["states"]
     step_out = op.attrs["step_outputs"]
-    out_names = [n for n in op.outputs.get("outputs", ()) if n]
     reverse = bool(op.attrs.get("reverse", False))
     len_name = (op.inputs.get("sequence_lengths") or [None])[0]
 
@@ -170,11 +184,61 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
     t_ids = jnp.arange(t_len, dtype=jnp.int32)
     final_state, ys = jax.lax.scan(
         body, boots, (t_ids,) + tuple(xs), reverse=reverse)
-    for n, y in zip(out_names, ys):
-        env[n] = y
-    for name, ex in zip(op.outputs.get("final_states", ()), ex_states):
-        if name:
-            env[name] = final_state[ex]
+    return ys, final_state
+
+
+def _run_recurrent_grad(op: framework.Operator, env: dict, rng, program):
+    """Backward of the recurrent op: jax.vjp around the SAME lax.scan the
+    forward ran (the functional analog of recurrent_op.cc's per-step
+    backward scopes).  Differentiates the stacked step outputs wrt the
+    sequence inputs, the boot states, and outer-scope reads (parameters
+    used inside the step net, listed in attrs['__outer__']).
+
+    The vjp primal re-traces the same scan the forward op ran; both live
+    in one jitted segment, where XLA's CSE merges the two structurally
+    identical loops (the reference's grad likewise re-walks the step net
+    over saved per-step scopes).  If a profile ever shows the forward
+    scan twice, the fix is to fuse this op with its forward and emit
+    outputs + cotangents from a single jax.vjp call."""
+    enforce(program is not None, "recurrent grad needs its owning program")
+    slots = {
+        "inputs": list(op.inputs.get("inputs", ())),
+        "initial_states": list(op.inputs.get("initial_states", ())),
+        "outer": list(op.attrs.get("__outer__", ())),
+    }
+    # (fwd var, grad name) pairs; a var appearing twice (same sequence fed
+    # as two step inputs) gets its total vjp gradient on the FIRST grad
+    # name and zeros on the rest — backward.py declared one grad output
+    # per occurrence and will sum them
+    pairs: list[tuple[str, str]] = []
+    for slot, names in slots.items():
+        for n, g in zip(names, op.outputs.get(slot + "@GRAD", ())):
+            if n and g:
+                pairs.append((n, g))
+    diff = {n: env[n] for n, _ in pairs
+            if hasattr(env.get(n), "dtype")
+            and jnp.issubdtype(env[n].dtype, jnp.floating)}
+
+    def f(d):
+        local = dict(env)
+        local.update(d)
+        ys, _ = _recurrent_scan(op, local, rng, program)
+        return ys
+
+    out, vjp = jax.vjp(f, diff)
+    og_names = op.inputs.get("OG:outputs", ())
+    cts = tuple(
+        env[g] if g else jnp.zeros_like(y)
+        for g, y in zip(og_names, out)
+    )
+    (d_in,) = vjp(cts)
+    seen: set = set()
+    for n, gname in pairs:
+        if n in d_in and n not in seen:
+            env[gname] = d_in[n]
+            seen.add(n)
+        else:  # duplicate occurrence or non-float input: zeros
+            env[gname] = jnp.zeros_like(env[n])
 
 
 def _while_carried(op: framework.Operator, sub) -> list[str]:
@@ -220,7 +284,7 @@ def _run_cond(op: framework.Operator, env: dict, rng, program):
 def _sub_blocks(op: framework.Operator, program):
     if program is None:
         return []
-    if op.type in ("while", "recurrent"):
+    if op.type in ("while", "recurrent", "__recurrent_grad__"):
         return [program.blocks[op.attrs["sub_block"]]]
     if op.type == "cond":
         return [program.blocks[op.attrs["true_block"]],
@@ -234,7 +298,7 @@ def sub_block_external_reads(op: framework.Operator, program):
     out = []
     # recurrent step placeholders are bound by the op itself, not the scope
     bound = set()
-    if op.type == "recurrent":
+    if op.type in ("recurrent", "__recurrent_grad__"):
         bound = set(op.attrs.get("step_inputs", ())) | set(
             op.attrs.get("ex_states", ()))
     for sub in _sub_blocks(op, program):

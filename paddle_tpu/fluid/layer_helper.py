@@ -60,7 +60,24 @@ class LayerHelper:
         if init is None:
             init = (XavierInitializer() if suffix == "w"
                     else ConstantInitializer(0.0))
-        block = self.main_program.current_block()
+        # parameters ALWAYS live in the global block (reference
+        # layer_helper.py creates them there), even when the layer is being
+        # built inside a sub-block (StaticRNN step nets): the recurrent
+        # grad needs them enumerable from block.all_parameters()
+        block = self.main_program.global_block()
+        # parameter sharing by explicit name (reference param_attr=
+        # {'name': 'shared_w'}, e.g. test_word2vec.py's shared embedding):
+        # a second creation with the same name reuses the first parameter
+        # (and must not re-append its init op)
+        existing = self.main_program.global_block().vars.get(name)
+        if existing is not None and getattr(existing, "trainable", None) is not None:
+            enforce(tuple(existing.shape) == tuple(shape),
+                    "shared parameter %r shape mismatch: %s vs %s"
+                    % (name, existing.shape, shape))
+            enforce(existing.dtype == dtype,
+                    "shared parameter %r dtype mismatch: %s vs %s"
+                    % (name, existing.dtype, dtype))
+            return existing
         param = block.create_parameter(
             name=name, shape=shape, dtype=dtype,
             trainable=attr.get("trainable", True),

@@ -59,8 +59,16 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     return helper.append_activation(pre_act, act)
 
 
-def embedding(input, size, param_attr=None, dtype="float32", name=None,
-              main_program=None, startup_program=None):
+def embedding(input, size, dtype="float32", is_sparse=False, param_attr=None,
+              name=None, data_type=None, main_program=None,
+              startup_program=None):
+    """Positional order mirrors the reference (layers.py:64: input, size,
+    data_type, is_sparse, param_attr); ``data_type`` is accepted as the
+    reference spelling of ``dtype``.  ``is_sparse`` is parity surface —
+    the XLA gather is the same op either way and row-sparse gradients
+    ride the SelectedRows machinery where used."""
+    if data_type is not None:
+        dtype = data_type
     helper = LayerHelper("embedding", name=name, main_program=main_program,
                          startup_program=startup_program)
     w = helper.create_parameter(param_attr, shape=tuple(size), dtype=dtype)
@@ -342,7 +350,7 @@ for _op in ("sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink",
 
 
 def _make_binary_layer(op_type):
-    def layer(x, y, axis=-1, name=None, main_program=None,
+    def layer(x, y, axis=-1, act=None, name=None, main_program=None,
               startup_program=None):
         helper = LayerHelper(op_type, input=x, name=name,
                              main_program=main_program,
@@ -350,7 +358,7 @@ def _make_binary_layer(op_type):
         out = helper.create_tmp_variable(dtype=x.dtype, shape=x.shape)
         helper.append_op(op_type, {"X": [x.name], "Y": [y.name]},
                          {"Out": [out.name]}, {"axis": axis})
-        return out
+        return helper.append_activation(out, act)
     layer.__name__ = op_type
     return layer
 
@@ -466,8 +474,13 @@ class StaticRNN:
 
     def output(self, *outputs):
         self._assert_in_rnn_block("output")
+        # the time dim is static when the first step_input's is (keeps
+        # downstream fc weight sizing correct, e.g. layers.lstm -> fc)
+        t_dim = -1
+        if self.inputs and self.inputs[0][0].shape:
+            t_dim = self.inputs[0][0].shape[0]
         for o in outputs:
-            shape = [-1] + list(o.shape) if o.shape is not None else None
+            shape = [t_dim] + list(o.shape) if o.shape is not None else None
             outer = self._parent_block.create_var(
                 name=framework.unique_name(f"{self.helper.name}.out"),
                 shape=shape, dtype=o.dtype)
@@ -505,6 +518,99 @@ class StaticRNN:
                 "StaticRNN not finalized; use `with rnn.step():`")
         outs = [outer for _, outer in self.outputs]
         return outs[0] if len(outs) == 1 else outs
+
+
+def transpose(x, axis, name=None, main_program=None, startup_program=None):
+    """≅ layers.transpose (transpose_op.cc)."""
+    helper = LayerHelper("transpose", input=x, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    shape = (tuple(x.shape[a] for a in axis)
+             if x.shape is not None else None)
+    out = helper.create_tmp_variable(dtype=x.dtype, shape=shape)
+    helper.append_op("transpose", {"X": [x.name]}, {"Out": [out.name]},
+                     {"axis": list(axis)})
+    return out
+
+
+def sequence_pool(input, pool_type, name=None, main_program=None,
+                  startup_program=None, **kw):
+    """≅ layers.sequence_pool (layers.py:404 / sequence_pool_op.cc):
+    per-sequence reduction of a LoD variable — SUM/AVERAGE/SQRT/MAX/
+    LAST/FIRST."""
+    helper = LayerHelper("sequence_pool", input=input, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    shape = input.shape or (-1, -1)
+    out = helper.create_tmp_variable(dtype=input.dtype,
+                                     shape=(shape[0], shape[-1]))
+    helper.append_op("sequence_pool", {"X": [input.name]},
+                     {"Out": [out.name]},
+                     {"pooltype": str(pool_type).upper()})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  act=None, padding=None, bias_attr=None, param_attr=None,
+                  name=None, main_program=None, startup_program=None):
+    """≅ layers.sequence_conv (layers.py:309): context projection of a LoD
+    sequence through a [filter_size*D, num_filters] filter.  Like the
+    reference (which ignores ``padding`` and fixes contextStride), only
+    stride 1 is supported — rejected loudly rather than silently."""
+    enforce(filter_stride == 1,
+            "sequence_conv supports filter_stride=1 only (the reference "
+            "sequence_conv_op enforces contextStride == 1 as well)")
+    helper = LayerHelper("sequence_conv", input=input, act=act, name=name,
+                         main_program=main_program,
+                         startup_program=startup_program)
+    dtype = input.dtype
+    d_in = input.shape[-1]
+    filt = helper.create_parameter(
+        param_attr, shape=(filter_size * d_in, num_filters), dtype=dtype)
+    shape = input.shape or (-1, -1)
+    pre_bias = helper.create_tmp_variable(
+        dtype=dtype, shape=tuple(shape[:-1]) + (num_filters,), lod_level=1)
+    helper.append_op(
+        "sequence_conv", {"X": [input.name], "Filter": [filt.name]},
+        {"Out": [pre_bias.name]},
+        {"contextStride": filter_stride,
+         "contextStart": -int(filter_size // 2),
+         "contextLength": filter_size})
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, dim_start=-1,
+                                    size=num_filters)
+    return helper.append_activation(pre_act, act)
+
+
+def lstm(x, c_pre_init, hidden_dim, forget_bias=None, main_program=None,
+         startup_program=None):
+    """≅ layers.lstm (layers.py:796): a StaticRNN over time-major
+    [T, B, D] input; each step concats (x_t, c_pre), runs one fc to the
+    fused [B, 4H] pre-activation, and applies the lstm_unit gate op
+    (lstm_unit_op.h:61-76)."""
+    helper = LayerHelper("lstm_unit", main_program=main_program,
+                         startup_program=startup_program)
+    rnn = StaticRNN(main_program=main_program,
+                    startup_program=startup_program)
+    with rnn.step():
+        c_pre = rnn.memory(init=c_pre_init)
+        x_t = rnn.step_input(x)
+        before_fc = concat(input=[x_t, c_pre], axis=1,
+                           main_program=main_program,
+                           startup_program=startup_program)
+        after_fc = fc(input=before_fc, size=hidden_dim * 4,
+                      main_program=main_program,
+                      startup_program=startup_program)
+        dtype = x.dtype
+        c = helper.create_tmp_variable(dtype=dtype, shape=c_pre.shape)
+        h = helper.create_tmp_variable(dtype=dtype, shape=c_pre.shape)
+        helper.append_op(
+            "lstm_unit",
+            {"X": [after_fc.name], "C_prev": [c_pre.name]},
+            {"C": [c.name], "H": [h.name]},
+            {"forget_bias": 0.0 if forget_bias is None else forget_bias})
+        rnn.update_memory(c_pre, c)
+        rnn.output(h)
+    return rnn()
 
 
 def lod_rank_table(x, level=0, main_program=None):

@@ -15,6 +15,14 @@ def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
                          pool_stride=pool_stride, **kw)
 
 
+def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
+                       pool_type="max", **kw):
+    """≅ nets.sequence_conv_pool (nets.py:101)."""
+    conv_out = layers.sequence_conv(input, num_filters=num_filters,
+                                    filter_size=filter_size, act=act, **kw)
+    return layers.sequence_pool(conv_out, pool_type=pool_type, **kw)
+
+
 def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
                    conv_filter_size=3, conv_act=None, conv_with_batchnorm=False,
                    conv_batchnorm_drop_rate=None, pool_stride=1,

@@ -162,10 +162,25 @@ def _bcast_y(x, y, axis):
     return y.reshape(shape)
 
 
+def _lod_unwrap(v):
+    """LoD values (SequenceBatch) are transparent to dense row-wise ops,
+    exactly as reference LoD tensors are plain tensors + offsets."""
+    from paddle_tpu.core.lod import SequenceBatch as _SB
+
+    if isinstance(v, _SB):
+        return v.data, v.length
+    return v, None
+
+
 def _elementwise(fn):
     def kernel(ins, attrs, rng):
         x, y = ins["X"][0], ins["Y"][0]
-        return {"Out": [fn(x, _bcast_y(x, y, attrs.get("axis", -1)))]}
+        xd, xlen = _lod_unwrap(x)
+        yd, _ = _lod_unwrap(y)
+        out = fn(xd, _bcast_y(xd, yd, attrs.get("axis", -1)))
+        if xlen is not None:
+            out = type(x)(data=out, length=xlen)
+        return {"Out": [out]}
     return kernel
 
 
@@ -312,7 +327,12 @@ def _dropout(ins, attrs, rng):
 
 def _unary(fn):
     def kernel(ins, attrs, rng):
-        return {"Out": [fn(ins["X"][0], attrs)]}
+        x = ins["X"][0]
+        xd, xlen = _lod_unwrap(x)
+        out = fn(xd, attrs)
+        if xlen is not None:
+            out = type(x)(data=out, length=xlen)
+        return {"Out": [out]}
     return kernel
 
 
@@ -487,7 +507,10 @@ def _lrn(ins, attrs, rng):
 def _lookup_table(ins, attrs, rng):
     w, ids = ins["W"][0], ins["Ids"][0]
     if not hasattr(ids, "reshape"):  # LoD ids -> LoD embeddings
-        emb = jnp.take(w, ids.data.astype(jnp.int32), axis=0)
+        idata = ids.data
+        if idata.ndim > 2 and idata.shape[-1] == 1:
+            idata = idata[..., 0]  # [B,T,1] id columns, like the dense path
+        emb = jnp.take(w, idata.astype(jnp.int32), axis=0)
         return {"Out": [type(ids)(data=emb, length=ids.length)]}
     flat = ids.reshape(-1)
     out = jnp.take(w, flat, axis=0)
@@ -1044,6 +1067,17 @@ def _gru_op(ins, attrs, rng):
 
 @register_op("lstm_unit")
 def _lstm_unit(ins, attrs, rng):
+    if "C_prev" in ins:
+        # reference fluid lstm_unit_op.h:61-76: X is the [B, 4H] fused
+        # pre-activation (i|f|o|g slabs), C_prev the carried cell; the op
+        # applies gates only (layers.lstm builds the fc outside)
+        x, c_prev = ins["X"][0], ins["C_prev"][0]
+        fb = attrs.get("forget_bias") or 0.0
+        i, f, o, g = jnp.split(x, 4, axis=-1)
+        c = (jax.nn.sigmoid(f + fb) * c_prev
+             + jax.nn.sigmoid(i) * jnp.tanh(g))
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return {"C": [c], "H": [h]}
     state = _rnn.LSTMState(h=ins["HPrev"][0], c=ins["CPrev"][0])
     new = _rnn.lstm_cell(ins["X"][0], state, ins["WeightH"][0])
     return {"H": [new.h], "C": [new.c]}
