@@ -53,17 +53,40 @@ def _recurrent_outer_reads(program, block, op) -> list[str]:
     return reads
 
 
+def _declare_grad_output(block, n, need, pending, _declare) -> str:
+    """One grad-output name for forward var ``n`` under the @C0/@RENAME
+    accumulate-then-sum protocol (shared by the generic path and the
+    recurrent grad), or "" when no grad is wanted."""
+    if not (n and n in need and _float_var(block, n)):
+        return ""
+    k = len(pending.setdefault(n, []))
+    gname = grad_var_name(n) + ("@C0" if k == 0 else "@RENAME%d" % k)
+    _declare(gname, n)
+    pending[n].append(gname)
+    return gname
+
+
 def _append_recurrent_grad(block, op, outer, need, pending, _declare,
                            get_grad):
     """Emit a ``__recurrent_grad__`` op (executor lowers it to jax.vjp
     around the same lax.scan the forward ran — the functional analog of
-    the reference's per-step backward scopes, recurrent_op.cc grad)."""
+    the reference's per-step backward scopes, recurrent_op.cc grad).
+    Cotangents are collected for BOTH the stacked outputs and the
+    final-state outputs."""
     out_names = list(op.outputs.get("outputs", ()))
-    og, has_any = [], False
-    for n in out_names:
-        g = get_grad(n) if n and n in pending else None
-        og.append(g or "")
-        has_any = has_any or g is not None
+    fs_names = list(op.outputs.get("final_states", ()))
+    has_any = False
+
+    def _og(names):
+        nonlocal has_any
+        og = []
+        for n in names:
+            g = get_grad(n) if n and n in pending else None
+            og.append(g or "")
+            has_any = has_any or g is not None
+        return og
+
+    og_out, og_final = _og(out_names), _og(fs_names)
     if not has_any:
         return
 
@@ -72,25 +95,17 @@ def _append_recurrent_grad(block, op, outer, need, pending, _declare,
         "initial_states": list(op.inputs.get("initial_states", ())),
         "outer": list(outer),
     }
-    outputs = {}
-    for slot, names in slots.items():
-        outs = []
-        for n in names:
-            if n and n in need and _float_var(block, n):
-                k = len(pending.setdefault(n, []))
-                gname = grad_var_name(n) + ("@C0" if k == 0
-                                            else "@RENAME%d" % k)
-                _declare(gname, n)
-                pending[n].append(gname)
-                outs.append(gname)
-            else:
-                outs.append("")
-        outputs[slot + "@GRAD"] = outs
+    outputs = {
+        slot + "@GRAD": [_declare_grad_output(block, n, need, pending,
+                                              _declare) for n in names]
+        for slot, names in slots.items()
+    }
     attrs = dict(op.attrs)
     attrs["__outer__"] = list(outer)
     block.append_op(
         "__recurrent_grad__",
-        {**op.inputs, "outer": list(outer), "OG:outputs": og},
+        {**op.inputs, "outer": list(outer), "OG:outputs": og_out,
+         "OG:final_states": og_final},
         outputs, attrs)
 
 
@@ -209,22 +224,12 @@ def append_backward_ops(loss: Variable, parameter_list=None, no_grad_set=None):
         if not grad_slots:
             continue
 
-        outputs = {}
-        for slot in grad_slots:
-            outs = []
-            for n in op.inputs[slot]:
-                if n and n in need and _float_var(block, n):
-                    k = len(pending.setdefault(n, []))
-                    gname = grad_var_name(n) + ("" if k == 0 else "@RENAME%d" % k)
-                    # reserve the canonical name for the final accumulation
-                    if k == 0:
-                        gname = grad_var_name(n) + "@C0"
-                    _declare(gname, n)
-                    pending[n].append(gname)
-                    outs.append(gname)
-                else:
-                    outs.append("")
-            outputs[slot + "@GRAD"] = outs
+        outputs = {
+            slot + "@GRAD": [_declare_grad_output(block, n, need, pending,
+                                                  _declare)
+                             for n in op.inputs[slot]]
+            for slot in grad_slots
+        }
 
         attrs = dict(op.attrs)
         attrs["__fwd_type__"] = op.type

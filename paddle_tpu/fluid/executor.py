@@ -126,7 +126,25 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
     ``shrink_rnn_memory``, done with masks under static shapes).
     """
     enforce(program is not None, "recurrent op needs its owning program")
-    ys, final_state = _recurrent_scan(op, env, rng, program)
+    grad_op = _find_recurrent_grad(op, program)
+    if grad_op is not None:
+        # fused forward+vjp: one scan computes the outputs AND the vjp
+        # closure the grad op will use — the training path never runs
+        # the forward scan twice
+        pairs = _recurrent_grad_pairs(grad_op)
+        diff = {n: env[n] for n, _ in pairs
+                if hasattr(env.get(n), "dtype")
+                and jnp.issubdtype(env[n].dtype, jnp.floating)}
+
+        def f(d):
+            local = dict(env)
+            local.update(d)
+            return _recurrent_scan(op, local, rng, program)
+
+        (ys, final_state), vjp = jax.vjp(f, diff)
+        env[_vjp_key(op)] = (vjp, ys, final_state)
+    else:
+        ys, final_state = _recurrent_scan(op, env, rng, program)
     out_names = [n for n in op.outputs.get("outputs", ()) if n]
     ex_states = op.attrs["ex_states"]
     for n, y in zip(out_names, ys):
@@ -134,6 +152,39 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
     for name, ex in zip(op.outputs.get("final_states", ()), ex_states):
         if name:
             env[name] = final_state[ex]
+
+
+def _vjp_key(op: framework.Operator) -> str:
+    return "__rnn_vjp_%d__" % op.attrs["sub_block"]
+
+
+def _find_recurrent_grad(op: framework.Operator, program):
+    """The __recurrent_grad__ op paired with this forward op (same
+    sub-block), if the program trains through it."""
+    for blk in program.blocks:
+        for o in blk.ops:
+            if (o.type == "__recurrent_grad__"
+                    and o.attrs.get("sub_block") == op.attrs["sub_block"]):
+                return o
+    return None
+
+
+def _recurrent_grad_pairs(op: framework.Operator) -> list:
+    """(fwd var, grad name) pairs a __recurrent_grad__ op wants.  A var
+    appearing twice (same sequence fed as two step inputs) gets its total
+    vjp gradient on the FIRST grad name and zeros on the rest —
+    backward.py declared one grad output per occurrence and sums them."""
+    slots = {
+        "inputs": list(op.inputs.get("inputs", ())),
+        "initial_states": list(op.inputs.get("initial_states", ())),
+        "outer": list(op.attrs.get("__outer__", ())),
+    }
+    pairs: list = []
+    for slot, names in slots.items():
+        for n, g in zip(names, op.outputs.get(slot + "@GRAD", ())):
+            if n and g:
+                pairs.append((n, g))
+    return pairs
 
 
 def _recurrent_scan(op: framework.Operator, env: dict, rng, program):
@@ -194,44 +245,44 @@ def _run_recurrent_grad(op: framework.Operator, env: dict, rng, program):
     sequence inputs, the boot states, and outer-scope reads (parameters
     used inside the step net, listed in attrs['__outer__']).
 
-    The vjp primal re-traces the same scan the forward op ran; both live
-    in one jitted segment, where XLA's CSE merges the two structurally
-    identical loops (the reference's grad likewise re-walks the step net
-    over saved per-step scopes).  If a profile ever shows the forward
-    scan twice, the fix is to fuse this op with its forward and emit
-    outputs + cotangents from a single jax.vjp call."""
+    Normally the paired forward op already computed the vjp closure in
+    the same trace (the fused path in _run_recurrent) and stashed it
+    under _vjp_key, so the forward scan runs exactly once per training
+    step; the recompute fallback below only fires if forward and grad
+    ended up in different jit segments (a host op between them)."""
     enforce(program is not None, "recurrent grad needs its owning program")
-    slots = {
-        "inputs": list(op.inputs.get("inputs", ())),
-        "initial_states": list(op.inputs.get("initial_states", ())),
-        "outer": list(op.attrs.get("__outer__", ())),
-    }
-    # (fwd var, grad name) pairs; a var appearing twice (same sequence fed
-    # as two step inputs) gets its total vjp gradient on the FIRST grad
-    # name and zeros on the rest — backward.py declared one grad output
-    # per occurrence and will sum them
-    pairs: list[tuple[str, str]] = []
-    for slot, names in slots.items():
-        for n, g in zip(names, op.outputs.get(slot + "@GRAD", ())):
-            if n and g:
-                pairs.append((n, g))
-    diff = {n: env[n] for n, _ in pairs
-            if hasattr(env.get(n), "dtype")
-            and jnp.issubdtype(env[n].dtype, jnp.floating)}
+    pairs = _recurrent_grad_pairs(op)
+    stash = env.get(_vjp_key(op))
+    if stash is not None:
+        vjp, ys, final_state = stash
+    else:
+        diff = {n: env[n] for n, _ in pairs
+                if hasattr(env.get(n), "dtype")
+                and jnp.issubdtype(env[n].dtype, jnp.floating)}
 
-    def f(d):
-        local = dict(env)
-        local.update(d)
-        ys, _ = _recurrent_scan(op, local, rng, program)
-        return ys
+        def f(d):
+            local = dict(env)
+            local.update(d)
+            return _recurrent_scan(op, local, rng, program)
 
-    out, vjp = jax.vjp(f, diff)
+        (ys, final_state), vjp = jax.vjp(f, diff)
+
     og_names = op.inputs.get("OG:outputs", ())
-    cts = tuple(
+    ys_ct = tuple(
         env[g] if g else jnp.zeros_like(y)
-        for g, y in zip(og_names, out)
+        for g, y in zip(og_names, ys)
     )
-    (d_in,) = vjp(cts)
+    # cotangents for the final-state outputs too (a model may consume
+    # only the last state; its grad must not be silently dropped)
+    og_final = op.inputs.get("OG:final_states", ())
+    ex_states = op.attrs["ex_states"]
+    fs_ct = {}
+    for ex in ex_states:
+        fs_ct[ex] = jnp.zeros_like(final_state[ex])
+    for ex, g in zip(ex_states, og_final):
+        if g:
+            fs_ct[ex] = env[g]
+    (d_in,) = vjp((ys_ct, fs_ct))
     seen: set = set()
     for n, gname in pairs:
         if n in d_in and n not in seen:

@@ -106,3 +106,72 @@ def test_shared_param_machines(tmp_path):
                          stdout=subprocess.PIPE, env=env, timeout=300)
     assert out.returncode == 0, out.stdout[-2000:]
     assert b"threads=2" in out.stdout
+
+
+def test_forward_releases_gil_for_overlap(tmp_path):
+    """Decides the serving thread-overlap question BY CONSTRUCTION
+    (VERDICT r4 #9): during ``MergedModel.forward`` — the exact call the
+    C ABI's ``paddle_gradient_machine_forward`` lands in — the GIL is
+    released by jaxlib's PJRT execute, so a concurrent thread makes
+    Python progress while the device computes.  A 1 kHz ticker thread
+    heartbeats through a multi-forward window; the assertion is on the
+    LONGEST inter-heartbeat gap (see the comment below for why a tick
+    count cannot discriminate), which is valid on a single-core host
+    too."""
+    import threading
+    import time
+
+    from paddle_tpu.layers import api as layer, base, data_type
+
+    base.reset_name_counters()
+    x = layer.data(name="gx", type=data_type.dense_vector(2048))
+    h = x
+    for _ in range(12):
+        h = layer.fc(input=h, size=2048)
+    parameters = paddle.parameters.create(paddle.topology.Topology(h))
+    path = str(tmp_path / "big.tar")
+    merge_v2_model(h, parameters, path)
+    m = MergedModel.from_path(path)
+
+    batch = np.random.default_rng(0).normal(
+        size=(512, 2048)).astype(np.float32)
+    m.forward(batch)  # compile outside the measured window
+
+    stamps: list[float] = []
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            stamps.append(time.monotonic())
+            time.sleep(0.001)
+
+    # one forward's duration, marshalling included — the discriminating
+    # statistic below is relative to it
+    t0 = time.monotonic()
+    m.forward(batch)
+    per_fwd = time.monotonic() - t0
+
+    t = threading.Thread(target=ticker, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    t0 = time.monotonic()
+    for _ in range(4):
+        m.forward(batch)
+    t1 = time.monotonic()
+    stop.set()
+    t.join(timeout=2)
+
+    # Discriminator: the LONGEST gap between ticker heartbeats inside
+    # the forward window.  If PJRT held the GIL during device execution,
+    # the ticker would starve for one whole execute stretch (most of
+    # per_fwd) — interpreter switch intervals cannot preempt a C
+    # extension that holds the GIL.  With the release in place, gaps
+    # stay at scheduler scale even on one core.  (A mere tick COUNT
+    # cannot distinguish these: ticks also accrue in the Python
+    # marshalling slices between executes.)
+    inside = [s for s in stamps if t0 - 0.002 <= s <= t1]
+    assert len(inside) >= 3, (len(stamps), per_fwd)
+    gaps = [b - a for a, b in zip(inside, inside[1:])]
+    max_gap = max(gaps + [t1 - inside[-1], inside[0] - t0])
+    assert per_fwd > 0.05, per_fwd  # model must be heavy enough to judge
+    assert max_gap < 0.6 * per_fwd, (max_gap, per_fwd)

@@ -53,11 +53,16 @@ def test_word2vec_ngram_lm_trains():
     avg_cost = layers.mean(cost)
     fluid.SGDOptimizer(learning_rate=0.1).minimize(avg_cost)
 
-    # shared_w really is shared: one parameter, used by all four lookups
+    # shared_w really is shared: the four embedding calls return the SAME
+    # parameter object, every lookup reads it, and the program holds
+    # exactly the expected parameter set (shared_w + 2 fc pairs)
     block = fluid.default_main_program().global_block()
-    assert sum(1 for v in block.vars.values()
-               if v.name == "shared_w") == 1
+    shared = [v for v in block.all_parameters() if v.name == "shared_w"]
+    assert len(shared) == 1
+    assert len(block.all_parameters()) == 5, sorted(
+        p.name for p in block.all_parameters())
     lookup_ins = [op for op in block.ops if op.type == "lookup_table"]
+    assert len(lookup_ins) == 4
     assert all(op.inputs["W"] == ["shared_w"] for op in lookup_ins)
 
     reader = paddle.reader.batch(paddle.dataset.imikolov.train(word_dict, N),
