@@ -172,6 +172,11 @@ class NestedGeneratedSequence:
     n_sub: int = dataclasses.field(metadata=dict(static=True))
 
 
+#: sink-the-scan-tail optimization toggle (tests flip it to prove
+#: numerical equivalence of the sunk and per-step paths)
+SINK_SCAN_TAIL = True
+
+
 def recurrent_group(step: Callable, input, reverse: bool = False,
                     name: str | None = None, targetInlink=None):
     """≅ recurrent_group (layers.py:3862).  Scatters sequence inputs into
@@ -324,6 +329,62 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                 reverse=reverse)
             return out
 
+    # ---- sink the feed-forward tail out of the scan (round 5): output-
+    # side step nodes that feed NO memory update are a pure per-step
+    # function of the recurrence's frontier values, so they run ONCE on
+    # the time-stacked sequence instead of T times inside the serial
+    # loop.  For the canonical NMT decoder step (simple_attention +
+    # gru_step -> softmax fc, the reference's networks.py:1304 pattern)
+    # this moves the [B,V] vocab projection, its softmax, AND their
+    # backward out of the sequential chain — T small [B,H]x[H,V] matmuls
+    # become one MXU-shaped [B*T,H]x[H,V] matmul, and the per-step [B,V]
+    # output stacking disappears.  Emission metadata and parameters are
+    # untouched: only the runtime closure changes.
+    _SINKABLE = {"fc", "mixed", "addto", "slope_intercept", "scaling"}
+    needed_ids: set = set()
+    if not SINK_SCAN_TAIL:
+        _SINKABLE = set()
+    _stk = list(link_targets)
+    while _stk:
+        _nd = _stk.pop()
+        if id(_nd) in needed_ids:
+            continue
+        needed_ids.add(id(_nd))
+        _stk.extend(_nd.parents)
+    sunk: list = []           # tail nodes applied outside the scan
+    sink_frontier: list = []  # step nodes whose stacked values feed them
+    if fused_fwd is None and len(outs) == 1 \
+            and id(outs[0]) not in needed_ids and not reverse:
+        chain_ok = True
+        _pending = [outs[0]]
+        _seen: set = set()
+        while _pending and chain_ok:
+            nd = _pending.pop()
+            if id(nd) in _seen:
+                continue
+            _seen.add(id(nd))
+            if (nd.layer_type not in _SINKABLE or nd.state_specs
+                    or nd.attrs.get("drop_rate")):
+                chain_ok = False
+                break
+            sunk.append(nd)
+            for p in nd.parents:
+                if id(p) in needed_ids:
+                    if not any(p is f for f in sink_frontier):
+                        sink_frontier.append(p)
+                elif any(p is ph for ph in seq_ph_order):
+                    pass  # outer sequence value feeds the tail directly
+                elif any(p is ph for ph in static_ph_order):
+                    # static inputs carry the WHOLE sequence per step;
+                    # their layout differs outside — don't sink
+                    chain_ok = False
+                    break
+                else:
+                    _pending.append(p)
+        if not chain_ok or not sink_frontier:
+            sunk, sink_frontier = [], []
+    inner_outs = sink_frontier if sunk else outs
+
     def fwd(ctx, params, states, *parent_values):
         seq_vals = parent_values[:n_seq]
         static_vals = parent_values[n_seq:n_seq + n_static]
@@ -370,15 +431,40 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                 new_carry[m.name] = (
                     mcol * nv + (1.0 - mcol) * mem_c[m.name]
                 ).astype(mem_c[m.name].dtype)
-            step_out = tuple(_raw_boot(vals[o.name]) for o in outs)
+            step_out = tuple(_raw_boot(vals[o.name]) for o in inner_outs)
             return (new_carry, states_n), step_out
 
         t_ids = jnp.arange(t_len, dtype=jnp.int32)
         (_, states_final), ys = jax.lax.scan(
             body, (carry0, dict(states)), (t_ids, ms) + xs, reverse=reverse)
-        results = tuple(
-            SequenceBatch(data=jnp.swapaxes(y, 0, 1), length=length)
-            for y in ys)
+        stacked = {
+            o.name: SequenceBatch(data=jnp.swapaxes(y, 0, 1), length=length)
+            for o, y in zip(inner_outs, ys)
+        }
+        if sunk:
+            # apply the sunk tail once over the stacked sequences (layer
+            # fns are sequence-aware: fc/mixed on [B,T,...] broadcast
+            # over time exactly as the per-step application did)
+            outer_vals: dict = dict(stacked)
+            for ph, sv in zip(seq_ph_order, seq_vals):
+                outer_vals[ph.name] = sv
+            remaining = list(sunk)
+            while remaining:
+                progressed = False
+                for nd in list(remaining):
+                    if all(p.name in outer_vals for p in nd.parents):
+                        pv = [outer_vals[p.name] for p in nd.parents]
+                        pvals = {s.name: params[s.name]
+                                 for s in nd.param_specs}
+                        res = nd.fn(ctx, pvals, {}, *pv)
+                        outer_vals[nd.name] = res
+                        remaining.remove(nd)
+                        progressed = True
+                enforce(progressed, "recurrent_group sink: unresolvable "
+                        "tail dependency")
+            results = tuple(outer_vals[o.name] for o in outs)
+        else:
+            results = tuple(stacked[o.name] for o in outs)
         result = results[0] if single else results
         if state_specs:
             # stateful layers (e.g. BN) inside the group: updated running

@@ -190,3 +190,81 @@ def test_seqtoseq_train_generate_share_all_params_same_process():
     # exist in the trained set
     missing = gen_names - train_names
     assert not missing, f"generation params not trained: {missing}"
+
+
+def test_scan_tail_sink_equivalence():
+    """The sunk feed-forward tail (vocab fc outside the scan) is float-
+    equal to the per-step application, for cost AND gradients, on the
+    canonical NMT decoder step (simple_attention + gru_step -> fc)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.core import flags
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.layers import base, recurrent_group as rg
+    from paddle_tpu.models import seqtoseq as S
+
+    rng = np.random.default_rng(0)
+    bs, tlen, vocab = 4, 6, 50
+
+    def build():
+        base.reset_name_counters()
+        cost = S.seqtoseq_net(vocab, vocab, word_vector_dim=8,
+                              encoder_size=8, decoder_size=8)
+        topo = Topology(cost)
+        return cost, topo
+
+    def run(topo, cost, params):
+        from paddle_tpu.layers.base import Context, evaluate
+
+        def f(params):
+            ctx = Context(is_train=True, key=jax.random.key(0))
+            ids = rng_feed
+            vals, _ = evaluate([cost], ctx, params, topo.init_states(), ids)
+            v = vals[cost.name]
+            return v if v.ndim == 0 else v.mean()
+
+        loss, grads = jax.value_and_grad(f)(params)
+        return loss, grads
+
+    def seq(r):
+        return SequenceBatch(data=r.integers(0, vocab, size=(bs, tlen)),
+                             length=np.full((bs,), tlen, np.int32))
+
+    r1 = np.random.default_rng(1)
+    rng_feed = {"source_language_word": seq(r1),
+                "target_language_word": seq(r1),
+                "target_language_next_word": seq(r1)}
+
+    prev_bf16 = flags.get("bf16")
+    flags.set("bf16", False)
+    try:
+        from paddle_tpu.core import rng as prng
+
+        assert rg.SINK_SCAN_TAIL
+        cost, topo = build()
+        prng.seed(11)
+        params = paddle.parameters.create(topo).as_dict()
+        loss_sink, grads_sink = run(topo, cost, params)
+
+        rg.SINK_SCAN_TAIL = False
+        cost2, topo2 = build()
+        # identical init: same names + same seed path
+        prng.seed(11)
+        params2 = paddle.parameters.create(topo2).as_dict()
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(params[k]),
+                                          np.asarray(params2[k]))
+        loss_ref, grads_ref = run(topo2, cost2, params2)
+    finally:
+        rg.SINK_SCAN_TAIL = True
+        flags.set("bf16", prev_bf16)
+
+    np.testing.assert_allclose(float(loss_sink), float(loss_ref),
+                               rtol=1e-6)
+    for k in grads_ref:
+        np.testing.assert_allclose(
+            np.asarray(grads_sink[k]), np.asarray(grads_ref[k]),
+            rtol=1e-5, atol=1e-7, err_msg=k)

@@ -39,9 +39,10 @@ if _TOOLS_DIR not in sys.path:
     sys.path.insert(0, _TOOLS_DIR)
 
 # HLO op-name prefixes that are cross-device communication
+# (partition-id/replica-id are device-LOCAL and deliberately excluded)
 COLLECTIVE_PREFIXES = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
-    "all-to-all", "collective-broadcast", "partition-id", "replica-id",
+    "all-to-all", "collective-broadcast",
 )
 
 
@@ -171,7 +172,8 @@ def _collective_census_from_trace(run_once, steps: int):
     comp = coll = 0.0
     census: dict[str, float] = {}
     for r in rows:
-        ms = r["dur_us"] / 1000.0
+        # dur_us is the CROSS-step total; r["ms"] is per-step
+        ms = r.get("ms", r["dur_us"] / 1000.0 / max(steps, 1))
         name = r.get("name", "")
         if _is_collective(name):
             coll += ms
@@ -185,8 +187,12 @@ def _collective_census_from_trace(run_once, steps: int):
 
 
 def _collective_census_from_hlo(hlo_text_fn) -> dict[str, int]:
-    """Exact collective op inventory from the compiled HLO text (works on
-    every backend; counts, not times)."""
+    """STATIC collective op inventory from the compiled HLO text (works
+    on every backend).  These are program-text counts, not per-step
+    execution counts: an op inside a while/fori loop body appears once
+    here but executes once per iteration (e.g. pipeline_apply's permutes
+    run ~n_microbatches+n_stages-1 times per step).  Per-step EXECUTION
+    time comes from the trace split where available."""
     import re
 
     try:
