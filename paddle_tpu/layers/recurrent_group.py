@@ -384,6 +384,16 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
         if not chain_ok or not sink_frontier:
             sunk, sink_frontier = [], []
     inner_outs = sink_frontier if sunk else outs
+    if sunk:
+        # the scan must trace ONLY the recurrence (+frontier): leaving the
+        # tail in `roots` would trace its per-step ops into the scan jaxpr
+        # and rest the speedup on XLA DCE
+        scan_roots: list = []
+        for n in list(link_targets) + list(sink_frontier):
+            if not any(n is r for r in scan_roots):
+                scan_roots.append(n)
+    else:
+        scan_roots = roots
 
     def fwd(ctx, params, states, *parent_values):
         seq_vals = parent_values[:n_seq]
@@ -420,7 +430,8 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
             key = (jax.random.fold_in(ctx._key, t_idx)
                    if ctx._key is not None else None)
             sub_ctx = Context(is_train=ctx.is_train, key=key)
-            vals, states_n = evaluate(roots, sub_ctx, params, states_c, feed)
+            vals, states_n = evaluate(scan_roots, sub_ctx, params, states_c,
+                                      feed)
             mcol = mt[:, None]
             new_carry = {}
             for m, tgt in zip(mems, link_targets):
@@ -462,7 +473,18 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                         progressed = True
                 enforce(progressed, "recurrent_group sink: unresolvable "
                         "tail dependency")
-            results = tuple(outer_vals[o.name] for o in outs)
+
+            def _with_govern_length(v):
+                # group outputs always carry the GOVERNING sequence's
+                # lengths (per-step path semantics); a tail that consumed
+                # a non-governing input must not leak that input's
+                # lengths onto the output
+                if isinstance(v, SequenceBatch):
+                    return SequenceBatch(data=v.data, length=length)
+                return v
+
+            results = tuple(_with_govern_length(outer_vals[o.name])
+                            for o in outs)
         else:
             results = tuple(stacked[o.name] for o in outs)
         result = results[0] if single else results

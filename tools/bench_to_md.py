@@ -69,6 +69,21 @@ def main(path: str):
         cfg = f" — {r['config']}" if "config" in r else ""
         print(f"| {label}{cfg} | **{r['value']:,.0f} {r['unit']}**{extra} |")
 
+    sat = [(m, r) for m, r in recs.items() if m.endswith("_saturated")]
+    if sat:
+        print("\n## Saturated-batch rows (bench_saturation — the "
+              "latency-bound verdicts completed; see the saturation "
+              "section for the revision)\n")
+        print("| row | value | MFU | GB/s (vs STREAM) |")
+        print("|---|---|---|---|")
+        for m, r in sorted(sat):
+            val = (f"{r['seq_per_sec']:,.0f} seq/s ({r['value']} ms)"
+                   if "seq_per_sec" in r
+                   else f"{r['value']:,.0f} {r['unit']}")
+            print(f"| {m.replace('_saturated', '')} | **{val}** | "
+                  f"{r.get('mfu_pct', '-')}% | {r.get('achieved_gbps', '-')}"
+                  f" ({r.get('hbm_pct', '-')}%) |")
+
 
 if __name__ == "__main__":
     main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench.jsonl")
