@@ -174,9 +174,21 @@ def batch_norm(
 
 
 def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) * lax.rsqrt(var + eps) * scale + bias
+    """Single-pass LN: one f32 upcast, var = E[x^2] - E[x]^2 (one fused
+    reduction pair instead of jnp.var's mean-then-moment second pass).
+    Measured -1.65 ms/step on the 124M LM at bs16 (BENCHMARKS.md round-5
+    LM notes).  The E[x^2] form's cancellation error is benign here:
+    LN inputs are O(1)-O(10) activations and the subtraction happens in
+    f32 regardless of x's dtype."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    msq = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    # clamp like batch_norm above: f32 rounding can leave msq - mean^2
+    # slightly NEGATIVE for a constant row with large mean, and
+    # rsqrt(negative + eps) would be NaN
+    var = jnp.maximum(msq - mean * mean, 0.0)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    return out.astype(x.dtype) * scale + bias
 
 
 def cross_map_normal(
