@@ -37,6 +37,17 @@ def emits(*types):
     return deco
 
 
+def emission_parents(node):
+    """The parents the WIRE CONFIG shows: runtime rewires (the fused-CE
+    logits companion) stash the original wiring in __emit_parent_nodes__,
+    and runtime-only extra parents are trimmed via __emit_parents__."""
+    parents = node.attrs.get("__emit_parent_nodes__") or node.parents
+    n_emit = node.attrs.get("__emit_parents__")
+    if n_emit is not None:
+        parents = parents[:n_emit]
+    return parents
+
+
 class Emitter:
     """One ModelConfig under construction (≅ config_parser globals)."""
 
@@ -92,7 +103,7 @@ class Emitter:
         if node.attrs.get("coeff_field") is not None:
             lc.coeff = float(node.attrs["coeff_field"])
         if inputs:
-            for p in node.parents:
+            for p in emission_parents(node):
                 lc.inputs.add().input_layer_name = p.name
         self.cur_submodel.layer_names.append(node.name)
         self._layer_names.add(node.name)
@@ -299,7 +310,7 @@ def _data(E: Emitter, node: LayerOutput):
 def _fc(E: Emitter, node: LayerOutput):
     lc = E.layer(node)
     ws, _ = E.split_specs(node)
-    for i, (p, spec) in enumerate(zip(node.parents, ws)):
+    for i, (p, spec) in enumerate(zip(emission_parents(node), ws)):
         E.input_param(lc, i, spec, p.size * node.size, [p.size, node.size])
     E.bias_param(lc, node, node.size)
 

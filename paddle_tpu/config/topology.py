@@ -73,7 +73,11 @@ class Topology:
         evaluator)."""
         out = []
         for n in self.nodes:
-            m = n.attrs.get("metric")
+            # metric_runtime overrides where the RUNTIME reads values
+            # (e.g. the fused-CE cost points classification_error at its
+            # logits companion — argmax-equal to the probs) while the
+            # emitted evaluator block keeps the reference layer names
+            m = n.attrs.get("metric_runtime") or n.attrs.get("metric")
             if m:
                 names = m[1] if isinstance(m[1], (list, tuple)) else [m[1], m[2]]
                 out.append((m[0], names[0], names[1], n.name))

@@ -395,7 +395,7 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
     else:
         scan_roots = roots
 
-    def fwd(ctx, params, states, *parent_values):
+    def fwd(ctx, params, states, *parent_values, __final_logits__=False):
         seq_vals = parent_values[:n_seq]
         static_vals = parent_values[n_seq:n_seq + n_static]
         boot_vals_in = parent_values[n_seq + n_static:]
@@ -467,7 +467,12 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                         pv = [outer_vals[p.name] for p in nd.parents]
                         pvals = {s.name: params[s.name]
                                  for s in nd.param_specs}
-                        res = nd.fn(ctx, pvals, {}, *pv)
+                        fn = nd.fn
+                        if __final_logits__ and nd is outs[0]:
+                            # the fused-CE path wants the tail's final
+                            # softmax fc as PRE-activation logits
+                            fn = nd.attrs["__fc_logits__"]
+                        res = fn(ctx, pvals, {}, *pv)
                         outer_vals[nd.name] = res
                         remaining.remove(nd)
                         progressed = True
@@ -549,6 +554,16 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
         },
     )
     if single:
+        if (sunk and fused_fwd is None
+                and outs[0].attrs.get("__fc_logits__") is not None):
+            # propagate the logits hook through the group: same contract
+            # (drop-in for fn, same parents, returns pre-softmax logits);
+            # classification_cost's fused path then skips the [B,T,V]
+            # softmax round-trip entirely — the scan portion is shared
+            # with (or replaces) the probs path
+            group.attrs["__fc_logits__"] = (
+                lambda ctx, params, states, *pv: fwd(
+                    ctx, params, states, *pv, __final_logits__=True))
         return group
     # selector children expose each output as its own node
     sels = []

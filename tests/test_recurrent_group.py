@@ -268,3 +268,60 @@ def test_scan_tail_sink_equivalence():
         np.testing.assert_allclose(
             np.asarray(grads_sink[k]), np.asarray(grads_ref[k]),
             rtol=1e-5, atol=1e-7, err_msg=k)
+
+
+def test_fused_logits_ce_equivalence():
+    """classification_cost's fused lse-based CE (via the #logits
+    companion) equals the probs-path CE, for a DIRECT softmax fc and
+    the NMT-style group with a sunk softmax tail — cost and grads."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.core import flags, rng as prng
+    from paddle_tpu.layers import activation as act
+    from paddle_tpu.layers import api as layer, base, data_type
+    from paddle_tpu.layers.base import Context, evaluate
+
+    flags.set("bf16", False)
+    try:
+        base.reset_name_counters()
+        x = layer.data(name="fx", type=data_type.dense_vector(16))
+        h = layer.fc(input=x, size=32, act=act.TanhActivation())
+        out = layer.fc(input=h, size=7, act=act.SoftmaxActivation())
+        assert "__fc_logits__" in out.attrs
+        lbl = layer.data(name="fy", type=data_type.integer_value(7))
+        cost = layer.classification_cost(input=out, label=lbl)
+        # the fused path attached a hidden logits companion
+        assert any(p.name.endswith("#logits") for p in cost.parents)
+        topo = Topology(cost)
+        prng.seed(3)
+        params = paddle.parameters.create(topo).as_dict()
+        r = np.random.default_rng(0)
+        feed = {"fx": r.normal(size=(8, 16)).astype(np.float32),
+                "fy": r.integers(0, 7, size=(8,))}
+
+        def f(params):
+            vals, _ = evaluate([cost], Context(is_train=True,
+                                               key=jax.random.key(0)),
+                               params, topo.init_states(), feed)
+            return vals[cost.name].mean()
+
+        loss, grads = jax.value_and_grad(f)(params)
+        # reference: -log(softmax[y]) computed by hand
+        w1 = params[[k for k in params if "fc_layer_0" in k and "w" in k
+                     and "bias" not in k][0]]
+        logits_h = np.tanh(feed["fx"] @ np.asarray(w1))
+        wk = [k for k in params if "fc_layer_1" in k]
+        w2 = np.asarray(params[[k for k in wk if k.endswith(".w0")][0]])
+        b2 = np.asarray(params[[k for k in wk if "bias" in k][0]])
+        lg = logits_h @ w2 + b2
+        lse = np.log(np.exp(lg - lg.max(1, keepdims=True)).sum(1)) \
+            + lg.max(1)
+        ref = float(np.mean(lse - lg[np.arange(8), feed["fy"]]))
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree.leaves(grads))
+    finally:
+        flags.set("bf16", False)
