@@ -122,7 +122,13 @@ class LayerOutput:
 
 
 def _jsonable(v) -> bool:
-    return isinstance(v, (int, float, str, bool, list, tuple, type(None)))
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return True
+    if isinstance(v, (list, tuple)):
+        # containers must be jsonable all the way down (runtime attrs
+        # like __emit_parent_nodes__ hold LayerOutput objects)
+        return all(_jsonable(x) for x in v)
+    return False
 
 
 def topo_sort(outputs: Sequence[LayerOutput]) -> list[LayerOutput]:
