@@ -131,17 +131,8 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
         # fused forward+vjp: one scan computes the outputs AND the vjp
         # closure the grad op will use — the training path never runs
         # the forward scan twice
-        pairs = _recurrent_grad_pairs(grad_op)
-        diff = {n: env[n] for n, _ in pairs
-                if hasattr(env.get(n), "dtype")
-                and jnp.issubdtype(env[n].dtype, jnp.floating)}
-
-        def f(d):
-            local = dict(env)
-            local.update(d)
-            return _recurrent_scan(op, local, rng, program)
-
-        (ys, final_state), vjp = jax.vjp(f, diff)
+        (ys, final_state), vjp = _recurrent_vjp(
+            op, env, rng, program, _recurrent_grad_pairs(grad_op))
         env[_vjp_key(op)] = (vjp, ys, final_state)
     else:
         ys, final_state = _recurrent_scan(op, env, rng, program)
@@ -156,6 +147,23 @@ def _run_recurrent(op: framework.Operator, env: dict, rng, program):
 
 def _vjp_key(op: framework.Operator) -> str:
     return "__rnn_vjp_%d__" % op.attrs["sub_block"]
+
+
+def _recurrent_vjp(op: framework.Operator, env: dict, rng, program,
+                   pairs):
+    """((ys, final_state), vjp) for the recurrent scan, differentiating
+    the floating env values the grad pairs name.  Shared by the fused
+    forward path and the grad op's recompute fallback."""
+    diff = {n: env[n] for n, _ in pairs
+            if hasattr(env.get(n), "dtype")
+            and jnp.issubdtype(env[n].dtype, jnp.floating)}
+
+    def f(d):
+        local = dict(env)
+        local.update(d)
+        return _recurrent_scan(op, local, rng, program)
+
+    return jax.vjp(f, diff)
 
 
 def _find_recurrent_grad(op: framework.Operator, program):
@@ -256,16 +264,8 @@ def _run_recurrent_grad(op: framework.Operator, env: dict, rng, program):
     if stash is not None:
         vjp, ys, final_state = stash
     else:
-        diff = {n: env[n] for n, _ in pairs
-                if hasattr(env.get(n), "dtype")
-                and jnp.issubdtype(env[n].dtype, jnp.floating)}
-
-        def f(d):
-            local = dict(env)
-            local.update(d)
-            return _recurrent_scan(op, local, rng, program)
-
-        (ys, final_state), vjp = jax.vjp(f, diff)
+        (ys, final_state), vjp = _recurrent_vjp(op, env, rng, program,
+                                                pairs)
 
     og_names = op.inputs.get("OG:outputs", ())
     ys_ct = tuple(

@@ -70,7 +70,10 @@ class LayerHelper:
         # a second creation with the same name reuses the first parameter
         # (and must not re-append its init op)
         existing = self.main_program.global_block().vars.get(name)
-        if existing is not None and getattr(existing, "trainable", None) is not None:
+        if existing is not None:
+            enforce(getattr(existing, "trainable", None) is not None,
+                    "parameter name %r collides with an existing "
+                    "non-parameter variable" % name)
             enforce(tuple(existing.shape) == tuple(shape),
                     "shared parameter %r shape mismatch: %s vs %s"
                     % (name, existing.shape, shape))

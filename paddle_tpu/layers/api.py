@@ -1605,8 +1605,10 @@ def classification_cost(input: LayerOutput, label: LayerOutput, weight=None,
         node.attrs["__emit_parents__"] = n_emit
         # runtime metric reads the logits (argmax-equal); the emitted
         # evaluator block keeps the reference's probs-layer name
+        # logits_node.name, NOT name+"#logits": a second cost on the same
+        # softmax layer reuses the FIRST call's companion
         node.attrs["metric_runtime"] = (
-            "classification_error", [name + "#logits", label.name])
+            "classification_error", [logits_node.name, label.name])
     node.attrs["v1_cost"] = True  # LayerType.COST — outputs() DFS predicate
     return node
 

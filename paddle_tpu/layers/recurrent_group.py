@@ -369,16 +369,20 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                 break
             sunk.append(nd)
             for p in nd.parents:
-                if id(p) in needed_ids:
-                    if not any(p is f for f in sink_frontier):
-                        sink_frontier.append(p)
-                elif any(p is ph for ph in seq_ph_order):
-                    pass  # outer sequence value feeds the tail directly
-                elif any(p is ph for ph in static_ph_order):
+                # placeholder checks FIRST: a static input that also
+                # feeds the recurrence is in needed_ids, and stacking its
+                # whole-sequence per-step value would be wrong — the
+                # rejection must win over the frontier classification
+                if any(p is ph for ph in static_ph_order):
                     # static inputs carry the WHOLE sequence per step;
                     # their layout differs outside — don't sink
                     chain_ok = False
                     break
+                if any(p is ph for ph in seq_ph_order):
+                    pass  # outer sequence value feeds the tail directly
+                elif id(p) in needed_ids:
+                    if not any(p is f for f in sink_frontier):
+                        sink_frontier.append(p)
                 else:
                     _pending.append(p)
         if not chain_ok or not sink_frontier:

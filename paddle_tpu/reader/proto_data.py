@@ -167,6 +167,16 @@ def proto_reader(file_list, sequential: bool | None = None,
                         seqs.append([])
                     seqs[-1].append(s)
                 keep = int(len(seqs) * usage_ratio)
+                if keep == 0:
+                    # reference-faithful floor (sequenceLoop casts
+                    # count*ratio to int64 too) — but be LOUD about a
+                    # file contributing nothing, a zero-batch pass NaNs
+                    from paddle_tpu.core import logger as _log
+
+                    _log.warning(
+                        "usage_ratio=%.3f keeps 0 of %d sequences in %s "
+                        "— the file contributes no data this pass",
+                        usage_ratio, len(seqs), path)
                 # global np.random so np.random.seed() makes data
                 # selection reproducible (repo-wide convention)
                 order = _np.random.permutation(len(seqs))

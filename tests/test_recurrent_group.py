@@ -325,3 +325,96 @@ def test_fused_logits_ce_equivalence():
                    for g in jax.tree.leaves(grads))
     finally:
         flags.set("bf16", False)
+
+
+def test_sink_rejects_static_input_tail():
+    """A tail that reads a StaticInput must NOT sink, even when that
+    static also feeds the recurrence (its per-step value is the whole
+    sequence — stacking it would be wrong); the group falls back to the
+    per-step path and still computes correctly."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.layers import activation as act
+    from paddle_tpu.layers import api as layer, base, data_type
+    from paddle_tpu.layers.base import Context, evaluate
+    from paddle_tpu.layers.recurrent_group import (
+        StaticInput, memory, recurrent_group,
+    )
+    import jax
+
+    base.reset_name_counters()
+    seq = layer.data(name="stx", type=data_type.dense_vector_sequence(4))
+    outer = layer.fc(input=layer.first_seq(input=seq), size=4,
+                     act=act.TanhActivation(), name="outer_ctx")
+
+    def step(s_t, ctx_static):
+        mem = memory(name="st_step", size=4)
+        h = layer.fc(input=[s_t, mem], size=4, act=act.TanhActivation(),
+                     name="st_step")
+        # tail reads BOTH the recurrence value and the static input
+        out = layer.fc(input=[h, ctx_static], size=3,
+                       act=act.SoftmaxActivation())
+        return out
+
+    g = recurrent_group(step=step,
+                        input=[seq, StaticInput(input=outer)],
+                        name="static_tail_group")
+    topo = Topology(g)
+    params = paddle.parameters.create(topo).as_dict()
+    r = np.random.default_rng(0)
+    sb = SequenceBatch(data=r.normal(size=(2, 5, 4)).astype(np.float32),
+                       length=np.array([5, 3], np.int32))
+    vals, _ = evaluate([g], Context(is_train=False, key=jax.random.key(0)),
+                       params, topo.init_states(), {"stx": sb})
+    out = vals[g.name]
+    assert out.data.shape == (2, 5, 3)
+    np.testing.assert_allclose(np.asarray(out.data).sum(-1)[0, 0], 1.0,
+                               rtol=1e-5)  # softmax rows
+
+
+def test_two_costs_share_one_logits_companion():
+    """Two classification_cost calls on the same softmax fc reuse ONE
+    #logits companion; both runtime metrics point at the node that
+    actually exists."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.layers import activation as act
+    from paddle_tpu.layers import api as layer, base, data_type
+
+    base.reset_name_counters()
+    x = layer.data(name="tcx", type=data_type.dense_vector(8))
+    out = layer.fc(input=x, size=4, act=act.SoftmaxActivation())
+    y1 = layer.data(name="tcy1", type=data_type.integer_value(4))
+    y2 = layer.data(name="tcy2", type=data_type.integer_value(4))
+    c1 = layer.classification_cost(input=out, label=y1, name="costA")
+    c2 = layer.classification_cost(input=out, label=y2, name="costB")
+    companions = {p.name for c in (c1, c2) for p in c.parents
+                  if p.name.endswith("#logits")}
+    assert companions == {"costA#logits"}  # ONE shared companion
+    topo = Topology([c1, c2])
+    node_names = {n.name for n in topo.nodes}
+    for kind, pred, lbl, tag in topo.metrics():
+        assert pred in node_names, (pred, tag)
+    # and the whole thing trains
+    params = paddle.parameters.create(topo).as_dict()
+    from paddle_tpu.trainer.step import build_train_step
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.parallel.mesh import get_mesh
+    import jax
+    import numpy as np
+
+    step = build_train_step(topo, SGD(learning_rate=0.1))
+    specs = {s.name: s for s in topo.param_specs()}
+    opt_state = SGD(learning_rate=0.1).init(params, specs)
+    r = np.random.default_rng(0)
+    feed = {"tcx": r.normal(size=(8, 8)).astype(np.float32),
+            "tcy1": r.integers(0, 4, size=(8,)),
+            "tcy2": r.integers(0, 4, size=(8,))}
+    params2, _, _, cost, metrics = step(params, opt_state, topo.init_states(),
+                                        feed, jax.random.key(0))
+    assert np.isfinite(float(cost))
