@@ -987,6 +987,14 @@ def _mixed(E, node):
 def _concat2(E, node):
     lc = E.layer(node)
     _emit_mixed_items(E, node, lc)
+    bias_size = node.attrs.get("bias_size", 0)
+    if bias_size:
+        # config_parser.py:3544-3553: conv projections share a per-channel
+        # bias (psize = sum num_filters); others bias the full output
+        if node.attrs.get("shared_biases"):
+            lc.shared_biases = True
+        lc.bias_size = bias_size
+        E.bias_param(lc, node, bias_size)
 
 
 @emits("detection_output")

@@ -1,0 +1,25 @@
+"""The reference's own benchmark harness, run verbatim.
+
+``/root/reference/benchmark/paddle/`` is the suite behind every number
+in ``benchmark/README.md`` (the BASELINE.md anchors): image nets
+(alexnet / googlenet / resnet / vgg / smallnet_mnist_cifar) driven by
+``run.sh`` as ``paddle train --job=time --config=<net>.py
+--config_args=batch_size=N``, and the IMDB LSTM sweep
+(``rnn/rnn.py``) with batch/hidden/lstm_num config args.
+
+This package executes those config files BYTE-IDENTICAL (copied from
+``$PADDLE_REFERENCE_ROOT/benchmark/paddle``) through the paddle_tpu
+trainer CLI's ``--job=time`` (≅ TrainerBenchmark.cpp) on synthetic
+data.  Only the data-prep shims are py3 ports, same policy as the
+other demo families:
+
+- ``provider_image``  — py3 port of ``image/provider.py`` (xrange).
+- ``provider_rnn``    — py3 port of ``rnn/provider.py`` (map()/file()).
+- ``imdb_synth``      — hermetic stand-in for ``rnn/imdb.py``, whose
+  original downloads imdb.pkl from the network; generates synthetic
+  variable-length id sequences in the same two-pickle layout.
+
+Run: ``python -m paddle_tpu.demo.benchmark.run --net smallnet
+--batch_size 64``; ``--net all`` sweeps the reference's single-device
+grid from ``image/run.sh`` / ``rnn/run.sh``.
+"""

@@ -1,0 +1,43 @@
+"""Hermetic stand-in for ``benchmark/paddle/rnn/imdb.py``.
+
+The reference module downloads ``imdb.pkl`` and splits it into
+``imdb.train.pkl`` / ``imdb.test.pkl`` (each a ``(x, y)`` pair: list of
+word-id sequences, list of 0/1 labels), then writes ``train.list``.
+This stand-in synthesizes the same two-pickle layout with
+variable-length random sequences (zero egress), keeping ``rnn.py``'s
+``import imdb; imdb.create_data('imdb.pkl')`` call verbatim.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+N_SAMPLES = int(os.environ.get("PADDLE_TPU_IMDB_SYNTH_N", "2048"))
+
+
+def _synth(n, seed):
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(20, 120, size=n)
+    x = [rng.integers(2, 35000, size=int(L)).tolist() for L in lengths]
+    y = rng.integers(0, 2, size=n).tolist()
+    return x, y
+
+
+def create_data(path="imdb.pkl"):
+    if not os.path.isfile('imdb.train.pkl'):
+        with open('imdb.train.pkl', 'wb') as f:
+            pickle.dump(_synth(N_SAMPLES, seed=0), f)
+        with open('imdb.test.pkl', 'wb') as f:
+            pickle.dump(_synth(N_SAMPLES // 4, seed=1), f)
+    if not os.path.isfile('train.list'):
+        with open('train.list', 'w') as f:
+            f.write('imdb.train.pkl\n')
+
+
+def main():
+    create_data('imdb.pkl')
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Drive the reference's benchmark configs verbatim.
+
+``image/run.sh`` is ``paddle train --job=time --config=<net>.py
+--config_args=batch_size=N`` over alexnet/googlenet/smallnet (and
+resnet/vgg via ``run_mkldnn.sh``); ``rnn/run.sh`` sweeps rnn.py over
+batch/hidden_size/lstm_num.  This runner reproduces that invocation
+through the paddle_tpu CLI: the config files are copied byte-identical
+from the reference tree; only the data shims are py3 ports (see the
+package docstring).
+
+Examples:
+    python -m paddle_tpu.demo.benchmark.run --net smallnet --batch_size 64
+    python -m paddle_tpu.demo.benchmark.run --net rnn \
+        --config_args hidden_size=128,lstm_num=2
+    python -m paddle_tpu.demo.benchmark.run --net all   # run.sh 1-device grid
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+
+from paddle_tpu.demo import REFERENCE_ROOT
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# net -> (reference config path, family, run.sh default batch)
+NETS = {
+    "alexnet": ("benchmark/paddle/image/alexnet.py", "image", 128),
+    "googlenet": ("benchmark/paddle/image/googlenet.py", "image", 128),
+    "resnet": ("benchmark/paddle/image/resnet.py", "image", 64),
+    "vgg": ("benchmark/paddle/image/vgg.py", "image", 64),
+    "smallnet": ("benchmark/paddle/image/smallnet_mnist_cifar.py",
+                 "image", 64),
+    "rnn": ("benchmark/paddle/rnn/rnn.py", "rnn", 128),
+}
+
+# the reference's single-device sweep (image/run.sh lines 28-42; rnn
+# analog at the README's bs 64-256 table)
+RUN_SH_GRID = [
+    ("alexnet", 64), ("alexnet", 128), ("alexnet", 256), ("alexnet", 512),
+    ("googlenet", 64), ("googlenet", 128), ("googlenet", 256),
+    ("smallnet", 64), ("smallnet", 128), ("smallnet", 256),
+    ("smallnet", 512),
+    ("rnn", 64), ("rnn", 128), ("rnn", 256),
+]
+
+
+def setup_workdir(net: str, workdir: str) -> str:
+    """Copy the reference config (byte-identical) + py3 data shims."""
+    cfg_rel, family, _ = NETS[net]
+    d = os.path.join(workdir, family)
+    os.makedirs(d, exist_ok=True)
+    cfg = os.path.basename(cfg_rel)
+    shutil.copyfile(os.path.join(REFERENCE_ROOT, cfg_rel),
+                    os.path.join(d, cfg))  # byte-identical
+    if family == "image":
+        shutil.copyfile(os.path.join(HERE, "provider_image.py"),
+                        os.path.join(d, "provider.py"))
+        with open(os.path.join(d, "train.list"), "w") as f:
+            f.write("train\n")  # provider ignores the entry (run.sh: echo)
+    else:
+        shutil.copyfile(os.path.join(HERE, "provider_rnn.py"),
+                        os.path.join(d, "provider.py"))
+        shutil.copyfile(os.path.join(HERE, "imdb_synth.py"),
+                        os.path.join(d, "imdb.py"))
+    return d
+
+
+def run_one(net: str, batch_size: int | None, job: str, workdir: str,
+            config_args: str = "", num_passes: int = 1,
+            seq_dim: int = 100) -> int:
+    cfg_rel, family, default_bs = NETS[net]
+    d = setup_workdir(net, workdir)
+    bs = batch_size or default_bs
+    cargs = f"batch_size={bs}"
+    if config_args:
+        cargs += "," + config_args
+    argv = ["--config", os.path.basename(cfg_rel), "--job", job,
+            "--config_args", cargs, "--num_passes", str(num_passes),
+            "--log_period", "10"]
+    if family == "rnn":
+        argv += ["--seq_dim", str(seq_dim)]  # run.sh pads to fixedlen=100
+    # each family ships its own provider.py/imdb.py: drop stale imports
+    for mod in ("provider", "imdb"):
+        sys.modules.pop(mod, None)
+    cwd = os.getcwd()
+    os.chdir(d)
+    sys.path.insert(0, os.getcwd())  # rnn.py does `import imdb` at parse
+    try:
+        from paddle_tpu.trainer import cli
+
+        return cli.main(argv)
+    finally:
+        sys.path.pop(0)
+        os.chdir(cwd)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--net", default="smallnet",
+                    choices=sorted(NETS) + ["all"])
+    ap.add_argument("--batch_size", type=int, default=None,
+                    help="default: the net's run.sh batch")
+    ap.add_argument("--job", default="time", choices=["time", "train"])
+    ap.add_argument("--config_args", default="",
+                    help="extra k=v,... appended (hidden_size, lstm_num, "
+                         "layer_num, pad_seq)")
+    ap.add_argument("--num_passes", type=int, default=1)
+    ap.add_argument("--seq_dim", type=int, default=100,
+                    help="--job=time synthetic timesteps for rnn "
+                         "(reference fixedlen)")
+    ap.add_argument("--workdir", default="./benchmark_work")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    if args.net == "all":
+        rc = 0
+        for net, bs in RUN_SH_GRID:
+            print(f"=== {net} batch_size={bs} ===", flush=True)
+            rc |= run_one(net, bs, args.job, args.workdir,
+                          args.config_args, args.num_passes, args.seq_dim)
+        return rc
+    return run_one(args.net, args.batch_size, args.job, args.workdir,
+                   args.config_args, args.num_passes, args.seq_dim)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -411,7 +411,7 @@ def img_conv(
             attrs={
                 "filter_size": [kh, kw], "stride": [sh, sw], "padding": [ph, pw],
                 "num_filters": num_filters, "groups": groups, "trans": trans,
-                "active_type": activation.name,
+                "channels": c_in, "active_type": activation.name,
             },
         ),
         layer_attr,
@@ -799,9 +799,7 @@ def concat(input, act=None, name: str | None = None,
     inputs = _as_list(input)
     name = name or gen_name("concat")
     if inputs and isinstance(inputs[0], mixed_mod.Projection):
-        enforce(bias_attr is None or bias_attr is False,
-                "concat2 (concat of projections) does not support bias yet")
-        return _concat_projections(inputs, act, name)
+        return _concat_projections(inputs, act, name, bias_attr)
     activation = act_mod.get(act)
     total = sum(i.size for i in inputs)
     same_image = all(i.height == inputs[0].height and i.width == inputs[0].width
@@ -834,8 +832,11 @@ def concat(input, act=None, name: str | None = None,
 concat_layer = concat
 
 
-def _concat_projections(projs, act, name: str) -> LayerOutput:
-    """'concat2' (ConcatenateLayer2): per-projection outputs concatenated."""
+def _concat_projections(projs, act, name: str, bias_attr=None) -> LayerOutput:
+    """'concat2' (ConcatenateLayer2): per-projection outputs concatenated.
+    With conv projections, ``bias_attr`` is a shared per-channel bias of
+    size sum(num_filters) (config_parser.py:3545-3553, ConvProjection
+    ``calc_bias_size``); otherwise a plain full-size bias."""
     from paddle_tpu.core.parameters import ParamSpec  # noqa: F401
     from paddle_tpu.layers import mixed as mixed_mod
 
@@ -863,12 +864,43 @@ def _concat_projections(projs, act, name: str) -> LayerOutput:
         })
     total = sum(p.size for p in projs)
 
+    use_bias = bias_attr is True or isinstance(bias_attr, ParamAttr)
+    all_conv = all(p.proj_type in ("conv", "convt") for p in projs)
+    bspec = None
+    bias_size = 0
+    if use_bias:
+        if all_conv:
+            bias_size = sum(p.proto["num_filters"] for p in projs)
+        else:
+            bias_size = total
+        battr = bias_attr if isinstance(bias_attr, ParamAttr) else None
+        bspec = _wspec(battr, name, "wbias", (bias_size,), I.constant(0.0))
+        specs.append(bspec)
+
+    def _add_shared_bias(outs, params):
+        # per-channel bias over each conv projection's [co, oh*ow] block
+        b = params[bspec.name]
+        off = 0
+        biased = []
+        for p, o in zip(projs, outs):
+            co = p.proto["num_filters"]
+            spatial = p.size // co
+            o = o.reshape(o.shape[0], co, spatial) + b[off:off + co][:, None]
+            biased.append(o.reshape(o.shape[0], -1))
+            off += co
+        return biased
+
     def fwd(ctx, params, states, *vals):
         outs = [raw(fn(params, vals[i])) for fn, i in fns]
         template = next((v for v in vals if is_sequence(v)), None)
-        y = activation(jnp.concatenate(
+        if use_bias and all_conv:
+            outs = _add_shared_bias(outs, params)
+        y = jnp.concatenate(
             [o.reshape(o.shape[0], -1) if template is None else o for o in outs],
-            axis=-1))
+            axis=-1)
+        if use_bias and not all_conv:
+            y = y + params[bspec.name]
+        y = activation(y)
         if template is not None:
             return SequenceBatch(data=y, length=template.length)
         return y
@@ -876,7 +908,9 @@ def _concat_projections(projs, act, name: str) -> LayerOutput:
     return LayerOutput(
         name=name, layer_type="concat2", size=total, parents=tuple(slots),
         param_specs=tuple(specs), fn=fwd,
-        attrs={"mixed_items": items, "active_type": activation.name},
+        attrs={"mixed_items": items, "active_type": activation.name,
+               "bias_size": bias_size,
+               "shared_biases": bool(use_bias and all_conv)},
     )
 
 

@@ -41,6 +41,17 @@ class _Settings:
         self.input_types = input_types
         self.__dict__.update(kwargs)
 
+    # the reference accepts either name for the type declaration
+    # (PyDataProvider2.py: ``slots`` is the pre-input_types spelling,
+    # still used by benchmark/paddle/image/provider.py)
+    @property
+    def slots(self):
+        return self.input_types
+
+    @slots.setter
+    def slots(self, value):
+        self.input_types = value
+
 
 def provider(input_types=None, should_shuffle=None, pool_size=-1,
              min_pool_size=-1, can_over_batch_size=True, calc_batch_size=None,

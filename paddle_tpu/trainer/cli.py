@@ -53,12 +53,25 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="accepted for v1 compat")
     p.add_argument("--saving_period", type=int, default=1,
                    help="save a pass checkpoint every N passes")
+    p.add_argument("--seq_dim", type=int, default=8,
+                   help="timesteps per synthetic sequence for --job=time/"
+                        "checkgrad feeds (the reference RNN benchmark pads "
+                        "to 100, benchmark/paddle/rnn/rnn.py:8)")
     # checkgrad knobs (Trainer.cpp:332 checkgrad_eps analog)
     p.add_argument("--checkgrad_eps", type=float, default=1e-3,
                    help="tolerance scale for the gradient check")
     p.add_argument("--checkgrad_samples", type=int, default=6,
                    help="random entries probed per parameter")
     return p
+
+
+def _provider_args(rec: dict) -> dict:
+    """define_py_data_sources2 args=... -> init_hook kwargs (dict or
+    'k=v,...' string form)."""
+    args = rec.get("args") or {}
+    if isinstance(args, str):
+        args = dict(f.split("=", 1) for f in args.split(",") if "=" in f)
+    return args
 
 
 def _raw_reader_from_data_config(rec: dict, topo, input_order):
@@ -131,10 +144,7 @@ def _raw_reader_from_data_config(rec: dict, topo, input_order):
     # config-supplied provider kwargs (define_py_data_sources2 args=...)
     # reach the init_hook; types may be declared there rather than in the
     # decorator, so bind them AFTER make_reader ran the hook
-    args = rec.get("args") or {}
-    if isinstance(args, str):
-        args = dict(f.split("=", 1) for f in args.split(",") if f)
-    reader = obj.make_reader(files, **args)
+    reader = obj.make_reader(files, **_provider_args(rec))
     if topo is not None:
         _apply_provider_types(topo, obj, input_order)
     return reader, obj
@@ -248,6 +258,14 @@ def _load_provider_types(args, parsed, topo):
         obj = getattr(mod, rec["obj"])
     except Exception:
         return  # provider unavailable: dense placeholders stand
+    if getattr(obj, "input_types", None) is None:
+        # init_hook providers declare types on ``settings`` at reader
+        # construction (benchmark/paddle/image/provider.py pattern); run
+        # the hook over an empty file list just to harvest them
+        try:
+            obj.make_reader([], **_provider_args(rec))
+        except Exception:
+            pass
     _apply_provider_types(topo, obj, parsed.input_layer_names)
 
 
@@ -388,7 +406,7 @@ def cmd_time(args, parsed) -> int:
     opt_state = opt.init(params, specs)
     states = topo.init_states()
     step = build_train_step(topo, opt)
-    feed = _synthetic_feed(topo, batch_size)
+    feed = _synthetic_feed(topo, batch_size, seq_dim=args.seq_dim)
     key = jax.random.key(0)
 
     def one(params, opt_state, states):
@@ -403,7 +421,7 @@ def cmd_time(args, parsed) -> int:
     return 0
 
 
-def _synthetic_feed(topo, batch_size: int):
+def _synthetic_feed(topo, batch_size: int, seq_dim: int = 8):
     from paddle_tpu.core.lod import SequenceBatch
     from paddle_tpu.layers.data_type import DataKind, SeqType
 
@@ -418,7 +436,7 @@ def _synthetic_feed(topo, batch_size: int):
         else:
             data = rng.normal(size=(batch_size, dim)).astype(np.float32)
         if seq and seq != SeqType.NO_SEQUENCE:
-            tdim = 8
+            tdim = seq_dim
             if kind == DataKind.INTEGER:
                 data = rng.integers(0, dim, size=(batch_size, tdim))
             else:
@@ -462,7 +480,7 @@ def cmd_checkgrad(args, parsed) -> int:
     }
     states = {k: jnp.asarray(np.asarray(v), jnp.float64)
               for k, v in topo.init_states().items()}
-    feed = _synthetic_feed(topo, batch_size)
+    feed = _synthetic_feed(topo, batch_size, seq_dim=args.seq_dim)
     key = jax.random.key(0)
 
     @jax.jit

@@ -1,0 +1,128 @@
+"""The reference's benchmark harness configs
+(``benchmark/paddle/image/*.py``, ``benchmark/paddle/rnn/rnn.py``) run
+byte-identical through ``paddle_tpu.demo.benchmark.run`` — the
+``--job=time`` invocation mirrors ``image/run.sh``; a ``--job=train``
+pass exercises the py3 provider ports end to end."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+REF = os.environ.get("PADDLE_REFERENCE_ROOT", "/root/reference")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF, "benchmark/paddle")),
+    reason="reference checkout absent")
+
+
+def _copied_verbatim(tmp_path, family, cfg):
+    with open(os.path.join(REF, "benchmark/paddle", family, cfg)) as f:
+        ref = f.read()
+    with open(tmp_path / family / cfg) as f:
+        ours = f.read()
+    assert ours == ref
+
+
+def test_smallnet_time_job(tmp_path, capsys):
+    from paddle_tpu.demo.benchmark import run
+
+    rc = run.main(["--net", "smallnet", "--batch_size", "8",
+                   "--workdir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ms/batch" in out
+    _copied_verbatim(tmp_path, "image", "smallnet_mnist_cifar.py")
+
+
+def test_rnn_time_job(tmp_path, capsys):
+    from paddle_tpu.demo.benchmark import run
+
+    rc = run.main(["--net", "rnn", "--batch_size", "8",
+                   "--config_args", "hidden_size=32",
+                   "--seq_dim", "16", "--workdir", str(tmp_path)])
+    assert rc == 0
+    assert "ms/batch" in capsys.readouterr().out
+    _copied_verbatim(tmp_path, "rnn", "rnn.py")
+
+
+def test_smallnet_train_pass(tmp_path, capsys, monkeypatch):
+    from paddle_tpu.demo.benchmark import run
+
+    rc = run.main(["--net", "smallnet", "--batch_size", "256",
+                   "--job", "train", "--workdir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Pass 0" in out
+
+
+def test_rnn_train_pass(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_IMDB_SYNTH_N", "64")
+    from paddle_tpu.demo.benchmark import run
+
+    rc = run.main(["--net", "rnn", "--batch_size", "16", "--job", "train",
+                   "--config_args", "hidden_size=32",
+                   "--workdir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Pass 0" in out
+
+
+CONCAT2_CFG = """
+from paddle.trainer_config_helpers import *
+
+settings(batch_size=4, learning_rate=0.1)
+img = data_layer(name='img', size=192, height=8, width=8)
+p1 = conv_projection(input=img, filter_size=1, num_filters=4, num_channels=3)
+p2 = conv_projection(input=img, filter_size=3, num_filters=2, num_channels=3,
+                     padding=1)
+cat = concat_layer(name='cat', input=[p1, p2], bias_attr=True,
+                   act=LinearActivation())
+outputs(cat)
+"""
+
+
+def test_concat2_conv_projection_bias(tmp_path):
+    """concat_layer(bias_attr=True) over conv projections (the googlenet
+    inception block, benchmark/paddle/image/googlenet.py:138-142): shared
+    per-channel bias of size sum(num_filters)
+    (config_parser.py:3544-3553); forward adds it channel-wise."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    cfg = tmp_path / "concat2_bias.py"
+    cfg.write_text(CONCAT2_CFG)
+    parsed = parse_config(str(cfg))
+
+    lc = next(l for l in parsed.model_config.layers if l.name == "cat")
+    assert lc.bias_size == 6
+    assert lc.shared_biases
+    assert lc.bias_parameter_name == "_cat.wbias"
+    pconf = next(p for p in parsed.model_config.parameters
+                 if p.name == "_cat.wbias")
+    assert pconf.size == 6
+
+    topo = Topology(parsed.output_layers())
+    specs = {s.name: s for s in topo.param_specs()}
+    assert tuple(specs["_cat.wbias"].shape) == (6,)
+
+    params = paddle.parameters.create(topo).as_dict()
+    feed = {"img": np.random.default_rng(0).normal(
+        size=(2, 192)).astype(np.float32)}
+    base0, _ = topo.forward(params, {}, feed, False, jax.random.key(0))
+    y0 = np.asarray(base0["cat"])
+    # bump channel 0 of projection 2's bias; exactly its 64 spatial
+    # outputs (after the first projection's 4*64 block) shift by +1
+    params["_cat.wbias"] = params["_cat.wbias"].at[4].add(1.0)
+    base1, _ = topo.forward(params, {}, feed, False, jax.random.key(0))
+    y1 = np.asarray(base1["cat"])
+    delta = y1 - y0
+    assert np.allclose(delta[:, 4 * 64:5 * 64], 1.0, atol=1e-5)
+    mask = np.ones(y0.shape[1], bool)
+    mask[4 * 64:5 * 64] = False
+    assert np.allclose(delta[:, mask], 0.0, atol=1e-6)
