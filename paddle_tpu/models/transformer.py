@@ -55,18 +55,52 @@ class TransformerConfig:
     # dynamic-slice/update overhead (measured 70.7 -> 63.0 ms/step on the
     # 124M bench, +12%); deep stacks keep the rolled scan's fast compiles
     scan_unroll: object = "auto"
+    # Mixture-of-Experts: >0 replaces every block's dense FFN with
+    # moe_experts expert FFNs (parallel/moe.py GShard/Switch routing);
+    # experts shard over an "expert" mesh axis with all_to_all dispatch
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
 
     @property
     def head_dim(self) -> int:
         return self.embed_dim // self.num_heads
+
+    @property
+    def moe(self):
+        from paddle_tpu.parallel.moe import MoEConfig
+
+        if not self.moe_experts:
+            return None
+        return MoEConfig(num_experts=self.moe_experts, mlp_dim=self.mlp_dim,
+                         top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor,
+                         aux_loss_weight=self.moe_aux_weight)
 
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
     """Stacked-layer params: block weights have leading dim num_layers."""
     e, h, m, v_sz = cfg.embed_dim, cfg.num_heads * cfg.head_dim, cfg.mlp_dim, cfg.vocab_size
     s = cfg.num_layers
-    k = iter(jax.random.split(key, 12))
+    k = iter(jax.random.split(key, 14))
     norm = lambda *shape: jax.random.normal(next(k), shape, cfg.dtype)
+    if cfg.moe_experts:
+        ex = cfg.moe_experts
+        ffn = {
+            "wg": norm(s, e, ex) * (e ** -0.5),
+            "w1": norm(s, ex, e, m) * (2.0 / e) ** 0.5,
+            "b1": jnp.zeros((s, ex, m), cfg.dtype),
+            "w2": norm(s, ex, m, e) * (m ** -0.5) / (2 * s) ** 0.5,
+            "b2": jnp.zeros((s, ex, e), cfg.dtype),
+        }
+    else:
+        ffn = {
+            "w_in": norm(s, e, m) * (e ** -0.5),
+            "b_in": jnp.zeros((s, m), cfg.dtype),
+            "w_out": norm(s, m, e) * (m ** -0.5) / (2 * s) ** 0.5,
+            "b_out": jnp.zeros((s, e), cfg.dtype),
+        }
     return {
         "embed": norm(v_sz, e) * (e ** -0.5),
         "pos_embed": norm(cfg.max_seq_len, e) * 0.02,
@@ -79,10 +113,7 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
             "wo": norm(s, h, e) * (h ** -0.5) / (2 * s) ** 0.5,
             "ln2_g": jnp.ones((s, e), cfg.dtype),
             "ln2_b": jnp.zeros((s, e), cfg.dtype),
-            "w_in": norm(s, e, m) * (e ** -0.5),
-            "b_in": jnp.zeros((s, m), cfg.dtype),
-            "w_out": norm(s, m, e) * (m ** -0.5) / (2 * s) ** 0.5,
-            "b_out": jnp.zeros((s, e), cfg.dtype),
+            **ffn,
         },
         "ln_f_g": jnp.ones((e,), cfg.dtype),
         "ln_f_b": jnp.zeros((e,), cfg.dtype),
@@ -95,6 +126,18 @@ def param_shardings(cfg: TransformerConfig) -> dict:
     MeshContext.param_sharding semantics; used directly with NamedSharding
     they must exist)."""
     col, row = P(None, None, "model"), P(None, "model", None)
+    if cfg.moe_experts:
+        # experts over the "expert" axis (layer-stack dim first)
+        ffn = {
+            "wg": P(),
+            "w1": P(None, "expert", None, None),
+            "b1": P(None, "expert", None),
+            "w2": P(None, "expert", None, None),
+            "b2": P(None, "expert", None),
+        }
+    else:
+        ffn = {"w_in": col, "b_in": P(None, "model"),
+               "w_out": row, "b_out": P()}
     return {
         "embed": P("model", None),  # vocab-sharded table (in-mesh pserver)
         "pos_embed": P(),
@@ -103,8 +146,7 @@ def param_shardings(cfg: TransformerConfig) -> dict:
             "wq": col, "wk": col, "wv": col,
             "wo": row,
             "ln2_g": P(), "ln2_b": P(),
-            "w_in": col, "b_in": P(None, "model"),
-            "w_out": row, "b_out": P(),
+            **ffn,
         },
         "ln_f_g": P(), "ln_f_b": P(),
     }
@@ -197,8 +239,18 @@ def _block(cfg: TransformerConfig, mesh, x, layer, remat_dots=False):
     def tail_fn(x, a, layer):
         x = x + a.reshape(b, t, nh * hd) @ layer["wo"]
         h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+        if cfg.moe_experts:
+            from paddle_tpu.parallel.moe import moe_ffn, moe_ffn_sharded
+
+            moe_p = {n: layer[n] for n in ("wg", "w1", "b1", "w2", "b2")}
+            if mesh is not None and "expert" in mesh.axis_names:
+                y, aux = moe_ffn_sharded(moe_p, h, cfg.moe, mesh)
+            else:
+                y, aux = moe_ffn(moe_p, h, cfg.moe)
+            return x + y, aux
         h = jax.nn.gelu(h @ layer["w_in"] + layer["b_in"])
-        return x + h @ layer["w_out"] + layer["b_out"]
+        return x + h @ layer["w_out"] + layer["b_out"], jnp.zeros(
+            (), jnp.float32)
 
     attn = functools.partial(_attention, cfg, mesh=mesh)
     if remat_dots:
@@ -217,6 +269,13 @@ def _block(cfg: TransformerConfig, mesh, x, layer, remat_dots=False):
 def forward(cfg: TransformerConfig, params: dict, ids: jax.Array,
             mesh=None) -> jax.Array:
     """ids [B, T] -> logits [B, T, V]."""
+    return forward_with_aux(cfg, params, ids, mesh=mesh)[0]
+
+
+def forward_with_aux(cfg: TransformerConfig, params: dict, ids: jax.Array,
+                     mesh=None):
+    """(logits [B, T, V], aux): aux is the mean MoE load-balancing loss
+    across layers (0.0 for dense FFNs)."""
     b, t = ids.shape
     x = params["embed"][ids] + params["pos_embed"][:t][None]
 
@@ -230,18 +289,16 @@ def forward(cfg: TransformerConfig, params: dict, ids: jax.Array,
         if cfg.remat:
             block = jax.checkpoint(block)
 
-    def scan_body(x, layer):
-        return block(x, layer), None
-
     unroll = cfg.scan_unroll
     if unroll == "auto":
         unroll = cfg.num_layers if cfg.num_layers <= 16 else 1
     elif not isinstance(unroll, (bool, int)):
         raise ValueError(f"scan_unroll must be 'auto', a bool, or an int; "
                          f"got {unroll!r}")
-    x, _ = lax.scan(scan_body, x, params["blocks"], unroll=unroll)
+    # block's (x, aux) return is already scan's (carry, y) contract
+    x, auxes = lax.scan(block, x, params["blocks"], unroll=unroll)
     x = _ln(x, params["ln_f_g"], params["ln_f_b"])
-    return x @ params["embed"].T
+    return x @ params["embed"].T, jnp.mean(auxes)
 
 
 def loss_fn(cfg: TransformerConfig, params: dict, ids: jax.Array,
@@ -255,11 +312,14 @@ def loss_fn(cfg: TransformerConfig, params: dict, ids: jax.Array,
     63.0 ms/step at the 124M bench): XLA fuses the CE chain into the
     LM-head backward matmuls, which the opaque pallas_call boundary
     prevents — kept as a library op and a documented negative result."""
-    logits = forward(cfg, params, ids[:, :-1], mesh=mesh)
+    logits, aux = forward_with_aux(cfg, params, ids[:, :-1], mesh=mesh)
     targets = ids[:, 1:]
     lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt.astype(jnp.float32))
+    ce = jnp.mean(lse - tgt.astype(jnp.float32))
+    if cfg.moe_experts:
+        ce = ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def build_train_step(cfg: TransformerConfig, optimizer, mesh=None,

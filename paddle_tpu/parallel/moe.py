@@ -1,0 +1,236 @@
+"""Mixture-of-Experts with expert parallelism over an ``expert`` mesh axis.
+
+The reference has no MoE (2017); its closest capability is the sparse
+pserver path — only-touched rows move over the wire
+(``SparseRemoteParameterUpdater``, ``SparseRowMatrix.h:204``).  This
+module is the TPU-native upgrade of that idea, designed from the GShard /
+Switch-Transformer formulation (PAPERS.md): conditional computation where
+each token activates ``top_k`` of ``num_experts`` FFNs, experts are
+sharded across devices, and tokens move to their experts via
+``lax.all_to_all`` riding ICI — the role NCCL alltoall plays in GPU MoE
+stacks.
+
+Everything is static-shaped for XLA: routing produces dense one-hot
+dispatch/combine tensors ``[T, E, C]`` (capacity ``C`` tokens per expert
+per group; overflow tokens are dropped, the standard capacity-factor
+semantics), so the whole layer is einsums + one pair of all_to_alls, all
+differentiable (gates included) under ``jax.grad``/``shard_map``.
+
+Two execution paths with identical math:
+
+- ``moe_ffn(...)``         — single-group dense dispatch (no mesh): the
+                             reference implementation and single-chip path.
+- ``moe_ffn_sharded(...)`` — tokens AND experts sharded over the mesh's
+                             ``expert`` axis; per-shard routing (each shard
+                             is one GShard "group"), all_to_all exchanges
+                             ``[E, C, D] -> [E_local, shards*C, D]``,
+                             local expert FFNs, all_to_all back, combine.
+
+``aux_load_balancing_loss`` is the Switch loss: E * mean(load_fraction *
+mean_gate_prob) per expert, pushing the router toward uniform load.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2              # 1 = Switch routing, 2 = GShard routing
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(
+                f"top_k must be 1 (Switch) or 2 (GShard); got {self.top_k}")
+
+
+def init_moe_params(key: jax.Array, embed_dim: int, cfg: MoEConfig,
+                    dtype=jnp.float32) -> dict:
+    """Router + per-expert FFN weights (experts stacked on axis 0)."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    E, D, H = cfg.num_experts, embed_dim, cfg.mlp_dim
+    return {
+        "wg": (jax.random.normal(kg, (D, E)) * (1.0 / D ** 0.5)).astype(dtype),
+        "w1": (jax.random.normal(k1, (E, D, H)) * (2.0 / D) ** 0.5).astype(dtype),
+        "b1": jnp.zeros((E, H), dtype),
+        "w2": (jax.random.normal(k2, (E, H, D)) * (1.0 / H) ** 0.5).astype(dtype),
+        "b2": jnp.zeros((E, D), dtype),
+    }
+
+
+def capacity(tokens_per_group: int, cfg: MoEConfig) -> int:
+    """Static per-expert buffer size for one routing group."""
+    c = int(cfg.capacity_factor * cfg.top_k * tokens_per_group
+            / cfg.num_experts)
+    return max(c, 1)
+
+
+def _one_hot_positions(mask: jax.Array, cap: int, offset=None):
+    """mask [T, E] 0/1 -> (kept mask, position one-hot [T, E, C]).
+
+    A token's position inside its expert's buffer is its running count
+    (cumsum over the group's token order); positions >= cap drop out —
+    the deterministic, order-based capacity rule (GShard §3.2).
+    """
+    pos = jnp.cumsum(mask, axis=0) - 1.0
+    if offset is not None:
+        pos = pos + offset[None, :]
+    keep = mask * (pos < cap).astype(mask.dtype)
+    pos_oh = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), cap, dtype=mask.dtype)
+    return keep, pos_oh
+
+
+def route(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
+    """Tokens [T, D] -> (dispatch [T,E,C], combine [T,E,C], aux_loss).
+
+    combine carries the (renormalized) gate probabilities, so gradients
+    flow into the router; dispatch is its 0/1 support.
+    """
+    f32 = jnp.float32
+    logits = x.astype(f32) @ wg.astype(f32)          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    E = cfg.num_experts
+
+    idx1 = jnp.argmax(probs, axis=-1)                # [T]
+    mask1 = jax.nn.one_hot(idx1, E, dtype=f32)
+    gate1 = jnp.sum(probs * mask1, axis=-1)          # [T]
+
+    # Switch aux loss over the FIRST choice: fraction of tokens routed
+    # to each expert x mean router prob, scaled by E (minimum 1.0 at
+    # uniform load)
+    load = jnp.mean(mask1, axis=0)
+    importance = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(load * importance)
+
+    keep1, oh1 = _one_hot_positions(mask1, cap)
+    combine = (gate1 * keep1.max(-1))[:, None, None] * oh1 * mask1[..., None]
+
+    if cfg.top_k >= 2:
+        probs2 = probs * (1.0 - mask1)               # mask out the winner
+        idx2 = jnp.argmax(probs2, axis=-1)
+        mask2 = jax.nn.one_hot(idx2, E, dtype=f32)
+        gate2 = jnp.sum(probs * mask2, axis=-1)
+        # second choices queue BEHIND every first-choice token
+        # (GShard: the expert's buffer fills greedily by priority)
+        expert_load1 = jnp.sum(keep1, axis=0)        # [E]
+        keep2, oh2 = _one_hot_positions(mask2, cap, offset=expert_load1)
+        # renormalize the two gates over what survived
+        g1 = gate1 * keep1.max(-1)
+        g2 = gate2 * keep2.max(-1)
+        denom = jnp.maximum(g1 + g2, 1e-9)
+        combine = ((g1 / denom)[:, None, None] * oh1 * mask1[..., None]
+                   + (g2 / denom)[:, None, None] * oh2 * mask2[..., None])
+
+    dispatch = (combine > 0.0).astype(f32)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w1, b1, w2, b2, xe):
+    """xe [E, C, D] through each expert's FFN (batched einsum)."""
+    f32 = jnp.float32
+    h = jnp.einsum("ecd,edh->ech", xe, w1.astype(xe.dtype)) + b1[:, None, :]
+    h = jax.nn.gelu(h.astype(f32)).astype(xe.dtype)
+    return jnp.einsum("ech,ehd->ecd", h, w2.astype(xe.dtype)) + b2[:, None, :]
+
+
+def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
+            cap: int | None = None):
+    """Dense-dispatch MoE over one token group.
+
+    x: [T, D] (or [B, T, D], flattened to one group).  Returns
+    (y like x, aux_loss scalar).
+    """
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    T = x2.shape[0]
+    c = cap or capacity(T, cfg)
+    dispatch, combine, aux = route(x2, params["wg"], cfg, c)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
+    ye = _expert_ffn(params["w1"], params["b1"], params["w2"], params["b2"],
+                     xe)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x2.dtype), ye)
+    return y.reshape(shape), aux
+
+
+def moe_ffn_sharded(params: dict, x: jax.Array, cfg: MoEConfig, mesh,
+                    axis: str = "expert",
+                    batch_axes: tuple[str, ...] | None = None,
+                    cap: int | None = None):
+    """Expert-parallel MoE: tokens and experts sharded over ``axis``.
+
+    x: [T, D] (or [B, T, D]) with the leading dim divisible by the
+    sharding axes; params["w1"/"b1"/"w2"/"b2"] sharded on their expert
+    dim, ``wg`` replicated.  Each shard routes its local tokens (one
+    GShard "group"), all_to_all sends each expert's ``[E, C, D]`` slice
+    to the expert's owner (becoming ``[E_local, n*C, D]``), the local
+    FFNs run, and the reverse all_to_all brings expert outputs home for
+    the combine.
+
+    ``batch_axes``: additional mesh axes the token batch is sharded
+    over (e.g. ``("data",)`` inside a dp+ep step) — experts stay
+    replicated across them; the all_to_all runs within each batch
+    slice.  Defaults to ``("data",)`` when the mesh has one.  Returns
+    (y, aux_loss averaged over every shard).
+    """
+    n = mesh.shape[axis]
+    E = cfg.num_experts
+    if E % n:
+        raise ValueError(f"num_experts {E} not divisible by mesh axis "
+                         f"'{axis}' size {n}")
+    if batch_axes is None:
+        batch_axes = ("data",) if "data" in mesh.axis_names else ()
+    n_tok_shards = n
+    for a in batch_axes:
+        n_tok_shards *= mesh.shape[a]
+    T = x.reshape(-1, x.shape[-1]).shape[0]
+    c = cap or capacity(T // n_tok_shards, cfg)
+    all_axes = tuple(batch_axes) + (axis,)
+
+    def body(wg, w1, b1, w2, b2, xs):
+        x2 = xs.reshape(-1, xs.shape[-1])
+        dispatch, combine, aux = route(x2, wg, cfg, c)
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
+        # [E, C, D] -> [E_local, n*C, D]: tokens travel to expert owners
+        xe = lax.all_to_all(xe, axis, split_axis=0, concat_axis=1,
+                            tiled=True)
+        ye = _expert_ffn(w1, b1, w2, b2, xe)
+        # [E_local, n*C, D] -> [E, C, D]: results return to token owners
+        ye = lax.all_to_all(ye, axis, split_axis=1, concat_axis=0,
+                            tiled=True)
+        y = jnp.einsum("tec,ecd->td", combine.astype(x2.dtype), ye)
+        return y.reshape(xs.shape), lax.pmean(aux, all_axes)
+
+    tok = P(all_axes) if x.ndim == 2 else P(all_axes, *([None] * (x.ndim - 1)))
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(axis, None, None), P(axis, None),
+                  P(axis, None, None), P(axis, None), tok),
+        out_specs=(tok, P()),
+        check_vma=False,
+    )
+    return fn(params["wg"], params["w1"], params["b1"], params["w2"],
+              params["b2"], x)
+
+
+def place_moe_params(params: dict, mesh, axis: str = "expert") -> dict:
+    """Device-put expert-stacked weights sharded over ``axis``."""
+    from jax.sharding import NamedSharding
+
+    def put(name, v):
+        if name == "wg":
+            return jax.device_put(v, NamedSharding(mesh, P()))
+        spec = P(axis, *([None] * (v.ndim - 1)))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    return {k: put(k, v) for k, v in params.items()}

@@ -264,8 +264,13 @@ def _load_provider_types(args, parsed, topo):
         # the hook over an empty file list just to harvest them
         try:
             obj.make_reader([], **_provider_args(rec))
-        except Exception:
-            pass
+        except Exception as e:
+            from paddle_tpu.core import logger as log
+
+            log.warning(
+                "provider init_hook type harvest failed (%s); synthetic "
+                "feeds fall back to dense placeholders — --job=time may "
+                "benchmark a different input topology", e)
     _apply_provider_types(topo, obj, parsed.input_layer_names)
 
 
