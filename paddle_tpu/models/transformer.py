@@ -323,14 +323,19 @@ def loss_fn(cfg: TransformerConfig, params: dict, ids: jax.Array,
 
 
 def build_train_step(cfg: TransformerConfig, optimizer, mesh=None,
-                     compute_dtype=None):
+                     compute_dtype=None, zero1=False):
     """(params, opt_state, ids) -> (params, opt_state, loss), jitted.
     With a mesh: batch sharded ("data","seq" on time), params per TP layout;
     GSPMD inserts every collective.
 
     ``compute_dtype=jnp.bfloat16`` is the proper mixed-precision policy:
     master params (and Adam moments) stay f32; the forward/backward run on
-    a bf16 cast, and the cast's cotangent upcasts grads back to f32."""
+    a bf16 cast, and the cast's cotangent upcasts grads back to f32.
+
+    ``zero1=True`` (needs a mesh with a ``data`` axis) pins the optimizer
+    slots sharded over data-parallel ranks (parallel/zero.py — the
+    pserver's sharded-optimizer-state property, in-mesh); pair with
+    ``zero.shard_opt_state`` for the initial placement."""
 
     def step(params, opt_state, ids):
         def lf(p):
@@ -341,6 +346,13 @@ def build_train_step(cfg: TransformerConfig, optimizer, mesh=None,
 
         loss, grads = jax.value_and_grad(lf)(params)
         new_params, new_opt = optimizer.apply_tree(grads, params, opt_state)
+        if zero1:
+            from paddle_tpu.parallel.zero import (
+                constrain_opt_state, zero1_specs)
+
+            specs = zero1_specs(new_opt, params, mesh,
+                                param_specs=param_shardings(cfg))
+            new_opt = constrain_opt_state(new_opt, specs, mesh)
         return new_params, new_opt, loss
 
     return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,111 @@
+"""ZeRO-1: optimizer-state sharding over the ``data`` mesh axis.
+
+The reference's parameter server IS sharded optimizer state: parameter
+blocks hash across pservers and each server applies the update rule to
+its shard only (``ParameterServer2.h:73-666``, ``addGradient:482`` →
+server-side SGD; the Go path likewise splits parameters across pserver
+indices, ``go/pserver/client/c/cclient.go``).  Rounds 2-4 replaced the
+pserver wholesale with ICI all-reduce and *replicated* optimizer state;
+this module restores the sharded-state property in-mesh — the ZeRO-1 /
+FSDP spelling of the same idea:
+
+- every Adam ``m``/``v`` buffer (any slot pytree) is sharded 1/n per
+  data-parallel rank, cutting optimizer memory from 2x params to
+  2x/n per device;
+- the update is annotated with ``with_sharding_constraint`` so GSPMD
+  keeps the state resident in shards and lowers the grad flow into
+  reduce-scatter + sharded update + all-gather over ICI, instead of
+  all-reduce + replicated update.
+
+Sharding choice per leaf: keep whatever axes the leaf's parameter
+already uses (TP composes), then lay ``data`` on the largest remaining
+dimension it divides; leaves with no divisible free dim stay
+replicated (scalars, tiny biases — their memory is noise).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _leaf_spec(shape, n: int, axis: str, base: P | None) -> P:
+    used = list(base) if base is not None else [None] * len(shape)
+    used += [None] * (len(shape) - len(used))
+    best, best_size = None, 0
+    for d, size in enumerate(shape):
+        if used[d] is None and size % n == 0 and size > best_size:
+            best, best_size = d, size
+    if best is None:
+        return P(*used) if base is not None else P()
+    used[best] = axis
+    return P(*used)
+
+
+def zero1_specs(opt_state, params, mesh, axis: str = "data",
+                param_specs=None):
+    """PartitionSpec pytree matching ``opt_state`` (the Optimizer
+    init_tree/apply_tree layout: {"step", "slots": [per-leaf slot dicts]}).
+
+    ``param_specs``: optional PartitionSpec pytree matching ``params``
+    (e.g. transformer.param_shardings) whose axes are preserved; the
+    ``axis`` shards one remaining dimension of every slot buffer.
+    """
+    n = mesh.shape[axis]
+    leaves = jax.tree.leaves(params)
+    if param_specs is None:
+        base_list = [None] * len(leaves)
+    else:
+        present = set(mesh.axis_names)
+        base_list = [
+            P(*[a if a in present else None for a in sp])
+            for sp in jax.tree.leaves(
+                param_specs, is_leaf=lambda x: isinstance(x, P))
+        ]
+    slot_specs = [
+        jax.tree.map(
+            lambda s, _p=p, _b=base: _leaf_spec(_p.shape, n, axis, _b),
+            slots)
+        for p, base, slots in zip(leaves, base_list, opt_state["slots"])
+    ]
+    specs = {k: jax.tree.map(lambda _: P(), v)
+             for k, v in opt_state.items()}
+    specs["slots"] = slot_specs
+    return specs
+
+
+def shard_opt_state(opt_state, params, mesh, axis: str = "data",
+                    param_specs=None):
+    """device_put the optimizer state per zero1_specs."""
+    specs = zero1_specs(opt_state, params, mesh, axis,
+                        param_specs=param_specs)
+    return _put_tree(opt_state, specs, mesh)
+
+
+def _put_tree(state, specs, mesh):
+    flat_s, treedef = jax.tree.flatten(state)
+    flat_p = treedef.flatten_up_to(specs)
+    placed = [jax.device_put(x, NamedSharding(mesh, sp))
+              for x, sp in zip(flat_s, flat_p)]
+    return jax.tree.unflatten(treedef, placed)
+
+
+def constrain_opt_state(opt_state, specs, mesh):
+    """with_sharding_constraint over the state pytree (inside jit): pins
+    the updated slots to their shards so GSPMD keeps the sharded-update
+    form instead of replicating."""
+    flat_s, treedef = jax.tree.flatten(opt_state)
+    flat_p = treedef.flatten_up_to(specs)
+    out = [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, sp))
+           for x, sp in zip(flat_s, flat_p)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def state_bytes_per_device(opt_state) -> int:
+    """Addressable bytes of one device's shard of the slot buffers."""
+    total = 0
+    for leaf in jax.tree.leaves(opt_state["slots"]):
+        shard = leaf.addressable_shards[0]
+        total += shard.data.size * shard.data.dtype.itemsize
+    return total

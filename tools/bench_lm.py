@@ -164,6 +164,22 @@ def main():
         elif v == "mp32":
             run_variant(v, gpt2_cfg(remat="dots", dtype=jnp.float32),
                         compute_dtype=jnp.bfloat16, bs=32)
+        elif v == "mom16":
+            # the bench shape (bs16, no remat, f32 masters) with bf16
+            # Adam moments — the HBM lever on the ~5 ms Adam line
+            run_variant(v, gpt2_cfg(remat=False, dtype=jnp.float32),
+                        compute_dtype=jnp.bfloat16, bs=16,
+                        opt=Adam(learning_rate=1e-4,
+                                 moment_dtype=jnp.bfloat16))
+        elif v == "mom16_bs24":
+            run_variant(v, gpt2_cfg(remat=False, dtype=jnp.float32),
+                        compute_dtype=jnp.bfloat16, bs=24,
+                        opt=Adam(learning_rate=1e-4,
+                                 moment_dtype=jnp.bfloat16))
+        elif v == "mp16_ref":
+            # f32-moment control at the identical bench shape
+            run_variant(v, gpt2_cfg(remat=False, dtype=jnp.float32),
+                        compute_dtype=jnp.bfloat16, bs=16)
         else:
             print(f"unknown variant {v}")
 
