@@ -453,7 +453,11 @@ class Adam(Optimizer):
         v = self.beta2 * slots["v"].astype(f32) + (1 - self.beta2) * g * g
         mhat = m / (1 - jnp.power(self.beta1, t))
         vhat = v / (1 - jnp.power(self.beta2, t))
-        dt = self.moment_dtype or slots["m"].dtype
+        # without moment_dtype, keep the pre-feature promotion semantics:
+        # the f32 update result is stored at >= f32 (bf16-param models
+        # historically carried f32 moments from step 1 on)
+        dt = self.moment_dtype or jnp.promote_types(
+            slots["m"].dtype, jnp.float32)
         return (lr * mhat / (jnp.sqrt(vhat) + self.epsilon),
                 {"m": m.astype(dt), "v": v.astype(dt)})
 

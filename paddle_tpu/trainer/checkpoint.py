@@ -34,10 +34,20 @@ from paddle_tpu.core.enforce import enforce
 MANIFEST = "checkpoint.json"
 
 
+def _npz_safe(arr: np.ndarray) -> np.ndarray:
+    """npz drops extension dtypes (ml_dtypes bfloat16 round-trips as raw
+    ``|V2`` bytes) — store them upcast to f32 (lossless); the load side
+    casts back to the template's dtype."""
+    if arr.dtype.kind == "V" or str(arr.dtype) in ("bfloat16", "float8_e4m3fn",
+                                                   "float8_e5m2", "float16"):
+        return arr.astype(np.float32)
+    return arr
+
+
 def _tree_to_flat(tree) -> dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        flat[jax.tree_util.keystr(path)] = np.asarray(leaf)
+        flat[jax.tree_util.keystr(path)] = _npz_safe(np.asarray(leaf))
     return flat
 
 
@@ -53,7 +63,10 @@ def _tree_from_flat(template, flat: dict[str, np.ndarray]):
         enforce(tuple(arr.shape) == tuple(np.shape(leaf)),
                 f"checkpoint slot {key!r} shape {arr.shape} != "
                 f"{np.shape(leaf)}")
-        new_leaves.append(jax.numpy.asarray(arr))
+        # restore the template's dtype (extension dtypes were stored f32)
+        dt = getattr(leaf, "dtype", None)
+        new_leaves.append(jax.numpy.asarray(arr)
+                          if dt is None else jax.numpy.asarray(arr, dtype=dt))
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
@@ -79,13 +92,14 @@ def save_checkpoint(ckpt_dir: str, pass_id: int, params: dict,
     os.makedirs(tmp, exist_ok=True)
     try:
         np.savez(os.path.join(tmp, "params.npz"),
-                 **{k: np.asarray(v) for k, v in params.items()})
+                 **{k: _npz_safe(np.asarray(v)) for k, v in params.items()})
         if opt_state is not None:
             np.savez(os.path.join(tmp, "opt_state.npz"),
                      **_tree_to_flat(opt_state))
         if states:
             np.savez(os.path.join(tmp, "states.npz"),
-                     **{k: np.asarray(v) for k, v in states.items()})
+                     **{k: _npz_safe(np.asarray(v))
+                        for k, v in states.items()})
         manifest = {
             "uuid": uuid_mod.uuid4().hex,
             "pass_id": pass_id,
@@ -171,3 +185,56 @@ def load_checkpoint(path: str, opt_state_template=None):
     if opt_flat and opt_state_template is not None:
         opt_state = _tree_from_flat(opt_state_template, opt_flat)
     return params, opt_state, states, manifest
+
+
+class AsyncCheckpointer:
+    """Non-blocking checkpoint writes.
+
+    The go pserver keeps its periodic checkpoint off the optimization
+    path (``go/pserver/service.go:119-156`` — a ticker goroutine, not the
+    SendGrad handler); the in-trainer analog keeps disk serialization off
+    the step loop.  ``save()`` materializes a consistent host snapshot
+    synchronously (device->host copies), then hands the npz/manifest
+    write to a single daemon worker; at most one write is in flight — a
+    new ``save()`` first joins the previous one, and a failed write
+    re-raises from the next ``save()``/``wait()`` so errors are never
+    silently dropped.  Writes stay atomic (tmp dir + rename in
+    ``save_checkpoint``), so a crash mid-write never corrupts the newest
+    valid checkpoint.
+    """
+
+    def __init__(self):
+        self._thread = None
+        self._err = None
+
+    def save(self, ckpt_dir: str, pass_id: int, params: dict,
+             opt_state=None, states: dict | None = None,
+             meta: dict | None = None, keep_last: int = 3) -> None:
+        import threading
+
+        self.wait()
+        params_h = {k: np.asarray(v) for k, v in params.items()}
+        opt_h = None if opt_state is None else jax.tree.map(
+            np.asarray, opt_state)
+        states_h = None if not states else {
+            k: np.asarray(v) for k, v in states.items()}
+
+        def run():
+            try:
+                save_checkpoint(ckpt_dir, pass_id, params_h, opt_state=opt_h,
+                                states=states_h, meta=meta,
+                                keep_last=keep_last)
+            except BaseException as e:  # surfaced on next save()/wait()
+                self._err = e
+
+        self._thread = threading.Thread(
+            target=run, name=f"ckpt-pass-{pass_id}", daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err

@@ -156,14 +156,18 @@ class SGD:
     def train(self, reader, num_passes: int = 1,
               event_handler: Callable | None = None, feeding=None,
               checkpoint_dir: str | None = None, checkpoint_period: int = 1,
-              resume: bool = True):
+              resume: bool = True, checkpoint_async: bool = False):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
 
         ``checkpoint_dir`` enables full crash-safe checkpoints (parameters +
         optimizer slots + states + pass cursor, uuid/sha manifest — see
         ``trainer/checkpoint.py``); with ``resume`` the newest valid one is
-        loaded and training continues from the following pass."""
+        loaded and training continues from the following pass.
+        ``checkpoint_async`` moves the disk write off the step loop
+        (``AsyncCheckpointer``: host snapshot taken synchronously, npz +
+        manifest written by a worker thread; the preemption save stays
+        synchronous)."""
         if event_handler is None:
             event_handler = _default_event_handler
         prev_debug_nans = jax.config.jax_debug_nans
@@ -204,7 +208,8 @@ class SGD:
         try:
             self._train_loop(reader, num_passes, event_handler, feeder,
                              params, states, opt_state, checkpoint_dir,
-                             checkpoint_period, resume, preempted)
+                             checkpoint_period, resume, preempted,
+                             checkpoint_async=checkpoint_async)
         finally:
             jax.config.update("jax_debug_nans", prev_debug_nans)
             if prev_handler is not None:
@@ -214,8 +219,12 @@ class SGD:
 
     def _train_loop(self, reader, num_passes, event_handler, feeder,
                     params, states, opt_state, checkpoint_dir,
-                    checkpoint_period, resume, preempted):
+                    checkpoint_period, resume, preempted,
+                    checkpoint_async=False):
         from paddle_tpu.trainer import checkpoint as ckpt
+
+        writer = ckpt.AsyncCheckpointer() if (
+            checkpoint_async and checkpoint_dir) else None
 
         start_pass = flags.get("start_pass")
         if checkpoint_dir and resume:
@@ -239,6 +248,32 @@ class SGD:
                 start_pass = max(start_pass, manifest["pass_id"] + 1)
                 log.info("resumed from %s (pass %d)", path,
                          manifest["pass_id"])
+        try:
+            self._run_passes(start_pass, num_passes, reader, event_handler,
+                             feeder, params, states, opt_state,
+                             checkpoint_dir, checkpoint_period, preempted,
+                             writer)
+        finally:
+            if writer is not None:
+                import sys
+
+                if sys.exc_info()[0] is None:
+                    writer.wait()  # surface deferred write errors; flush
+                else:
+                    # a training exception is already propagating — don't
+                    # let a checkpoint IO error supersede it
+                    try:
+                        writer.wait()
+                    except Exception as e:
+                        log.warning(
+                            "async checkpoint write failed during "
+                            "abort: %s", e)
+
+    def _run_passes(self, start_pass, num_passes, reader, event_handler,
+                    feeder, params, states, opt_state, checkpoint_dir,
+                    checkpoint_period, preempted, writer):
+        from paddle_tpu.trainer import checkpoint as ckpt
+
         for pass_id in range(start_pass, num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             batch_costs, batch_metrics = [], []
@@ -304,6 +339,15 @@ class SGD:
                 # twice.  No EndPass fires for a partial pass, and the save
                 # ignores checkpoint_period.
                 if checkpoint_dir:
+                    if writer is not None:
+                        # eviction save must be durable AND must not be
+                        # skipped by a stale deferred write error
+                        try:
+                            writer.wait()
+                        except Exception as e:
+                            log.warning("async checkpoint write had "
+                                        "failed (%s); writing eviction "
+                                        "checkpoint synchronously", e)
                     ckpt.save_checkpoint(
                         checkpoint_dir, pass_id,
                         {n: np.asarray(params[n]) for n in params},
@@ -326,7 +370,8 @@ class SGD:
                     os.path.join(save_dir, f"pass-{pass_id:05d}.tar")
                 )
             if checkpoint_dir and (pass_id % max(checkpoint_period, 1) == 0):
-                ckpt.save_checkpoint(
+                save = ckpt.save_checkpoint if writer is None else writer.save
+                save(
                     checkpoint_dir, pass_id,
                     {n: np.asarray(params[n]) for n in params},
                     opt_state=opt_state, states=dict(states),

@@ -162,3 +162,78 @@ def test_sigterm_preemption_checkpoints_and_resumes(tmp_path):
         event_handler=lambda e: passes.append(e.pass_id)
         if isinstance(e, paddle.event.BeginPass) else None)
     assert passes and passes[0] == saved_pass + 1
+
+
+def test_async_checkpointer_writes_and_raises(tmp_path):
+    """AsyncCheckpointer: identical artifacts to the sync path, one write
+    in flight, deferred errors re-raise on wait()."""
+    import pytest
+
+    d = str(tmp_path / "a")
+    w = ckpt.AsyncCheckpointer()
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    w.save(d, 0, params, states={"s": np.ones(2, np.float32)},
+           meta={"tag": 1})
+    w.wait()
+    path, manifest = ckpt.latest_checkpoint(d)
+    assert manifest["pass_id"] == 0 and manifest["meta"] == {"tag": 1}
+    loaded, _, states, _ = ckpt.load_checkpoint(path)
+    np.testing.assert_array_equal(loaded["w"], params["w"])
+    np.testing.assert_array_equal(states["s"], np.ones(2, np.float32))
+
+    # a failing write surfaces at the next wait(), not silently
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    w.save(str(blocker / "denied"), 1, params)
+    with pytest.raises(OSError):
+        w.wait()
+    w.wait()  # error consumed; idempotent afterwards
+
+
+def test_trainer_async_checkpoint_and_resume(tmp_path):
+    """checkpoint_async=True produces the same resumable checkpoints."""
+    d = str(tmp_path / "ckpt")
+    tr = _tiny_trainer()
+    tr.train(reader=_reader(), num_passes=2, checkpoint_dir=d,
+             checkpoint_async=True)
+    # train() returned only after the writer flushed
+    assert ckpt.latest_checkpoint(d)[1]["pass_id"] == 1
+
+    tr2 = _tiny_trainer()
+    seen = []
+    tr2.train(reader=_reader(), num_passes=3, checkpoint_dir=d,
+              checkpoint_async=True,
+              event_handler=lambda e: seen.append(
+                  e.pass_id) if isinstance(e, paddle.event.BeginPass)
+              else None)
+    assert seen == [2]
+    np.testing.assert_allclose(
+        tr2.parameters["_out.w0"],
+        ckpt.load_checkpoint(ckpt.latest_checkpoint(d)[0])[0]["_out.w0"])
+
+
+def test_bf16_moment_opt_state_roundtrip(tmp_path):
+    """npz loses extension dtypes (bfloat16 -> |V2); the checkpoint layer
+    stores them f32 and restores the template dtype, so
+    Adam(moment_dtype=bf16) states resume exactly."""
+    from paddle_tpu.optimizer import Adam
+
+    opt = Adam(learning_rate=1e-3, moment_dtype=jnp.bfloat16)
+    params = {"w": jnp.arange(8, dtype=jnp.float32).reshape(2, 4)}
+    state = opt.init_tree(params)
+    grads = {"w": jnp.full((2, 4), 0.5, jnp.float32)}
+    params, state = opt.apply_tree(grads, params, state)
+    assert state["slots"][0]["m"].dtype == jnp.bfloat16
+
+    d = str(tmp_path / "c")
+    ckpt.save_checkpoint(d, 0, {"w": np.asarray(params["w"])},
+                         opt_state=state)
+    template = Adam(learning_rate=1e-3,
+                    moment_dtype=jnp.bfloat16).init_tree(params)
+    _, restored, _, _ = ckpt.load_checkpoint(
+        ckpt.latest_checkpoint(d)[0], opt_state_template=template)
+    assert restored["slots"][0]["m"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(restored["slots"][0]["m"].astype(jnp.float32)),
+        np.asarray(state["slots"][0]["m"].astype(jnp.float32)))
+    assert int(restored["step"]) == int(state["step"])
