@@ -441,8 +441,10 @@ class Adam(Optimizer):
         self.moment_dtype = moment_dtype
 
     def slot_init(self, p, spec=None):
-        # zeros_like keeps a placed param's NamedSharding on the slots
-        dt = self.moment_dtype or p.dtype
+        # zeros_like keeps a placed param's NamedSharding on the slots;
+        # the default promotes to >= f32 (same rule as tensor_update's
+        # store) so init/step/checkpoint-template dtypes all agree
+        dt = self.moment_dtype or jnp.promote_types(p.dtype, jnp.float32)
         return {"m": jnp.zeros_like(p, dtype=dt),
                 "v": jnp.zeros_like(p, dtype=dt)}
 

@@ -198,3 +198,20 @@ def device_step_ms(step_fn, steps: int = 10, warmup: int = 3) -> float:
         return read_device_trace(logdir)[1] / steps
     finally:
         shutil.rmtree(logdir, ignore_errors=True)
+
+
+def step_ms_with_fallback(step_fn, wall_fn, steps: int = 10,
+                          warmup: int = 3) -> tuple[float, str, str]:
+    """(ms, "device-side"|"wall-clock", reason): try device_step_ms, fall
+    back to ``wall_fn()`` (a callable returning ms) when the trace is
+    unavailable OR empty (non-TPU backends write traces whose module
+    filter matches nothing — a 0.0 must never masquerade as a
+    measurement).  The reason string records why the fallback fired."""
+    try:
+        ms = device_step_ms(step_fn, steps=steps, warmup=warmup)
+        if ms > 0:
+            return ms, "device-side", ""
+        reason = "empty device trace (non-TPU backend?)"
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"[:120]
+    return wall_fn(), "wall-clock", reason

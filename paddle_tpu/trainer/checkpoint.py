@@ -35,11 +35,12 @@ MANIFEST = "checkpoint.json"
 
 
 def _npz_safe(arr: np.ndarray) -> np.ndarray:
-    """npz drops extension dtypes (ml_dtypes bfloat16 round-trips as raw
-    ``|V2`` bytes) — store them upcast to f32 (lossless); the load side
-    casts back to the template's dtype."""
-    if arr.dtype.kind == "V" or str(arr.dtype) in ("bfloat16", "float8_e4m3fn",
-                                                   "float8_e5m2", "float16"):
+    """npz drops EXTENSION dtypes (ml_dtypes bfloat16 round-trips as raw
+    ``|V2`` bytes) — store those upcast to f32 (lossless); the load side
+    casts back to the template's dtype.  Native numpy dtypes (incl.
+    float16) round-trip exactly and pass through untouched."""
+    if arr.dtype.kind == "V" or str(arr.dtype) in (
+            "bfloat16", "float8_e4m3fn", "float8_e5m2"):
         return arr.astype(np.float32)
     return arr
 

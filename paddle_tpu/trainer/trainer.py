@@ -240,8 +240,13 @@ class SGD:
                 if copt is not None:
                     opt_state = self.mesh.replicate(copt)
                 if cstates:
-                    states = self.mesh.replicate(
-                        {k: jax.numpy.asarray(v) for k, v in cstates.items()})
+                    # restore each state at its template dtype (bf16/f8
+                    # states were stored f32 by the npz layer)
+                    tmpl = self.states
+                    states = self.mesh.replicate({
+                        k: jax.numpy.asarray(
+                            v, dtype=getattr(tmpl.get(k), "dtype", None))
+                        for k, v in cstates.items()})
                 if manifest.get("meta", {}).get("rng") is not None:
                     rng.set_state(np.asarray(manifest["meta"]["rng"],
                                              dtype=np.uint32))
