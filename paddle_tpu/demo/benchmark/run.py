@@ -71,7 +71,7 @@ def setup_workdir(net: str, workdir: str) -> str:
 
 def run_one(net: str, batch_size: int | None, job: str, workdir: str,
             config_args: str = "", num_passes: int = 1,
-            seq_dim: int = 100) -> int:
+            seq_dim: int = 100, extra_argv: list[str] | None = None) -> int:
     cfg_rel, family, default_bs = NETS[net]
     d = setup_workdir(net, workdir)
     bs = batch_size or default_bs
@@ -80,7 +80,7 @@ def run_one(net: str, batch_size: int | None, job: str, workdir: str,
         cargs += "," + config_args
     argv = ["--config", os.path.basename(cfg_rel), "--job", job,
             "--config_args", cargs, "--num_passes", str(num_passes),
-            "--log_period", "10"]
+            "--log_period", "10"] + list(extra_argv or [])
     if family == "rnn":
         argv += ["--seq_dim", str(seq_dim)]  # run.sh pads to fixedlen=100
     # each family ships its own provider.py/imdb.py: drop stale imports
@@ -113,7 +113,7 @@ def main(argv=None) -> int:
                     help="--job=time synthetic timesteps for rnn "
                          "(reference fixedlen)")
     ap.add_argument("--workdir", default="./benchmark_work")
-    args = ap.parse_args(argv)
+    args, extra = ap.parse_known_args(argv)  # e.g. --bf16 -> trainer gflags
 
     os.makedirs(args.workdir, exist_ok=True)
     if args.net == "all":
@@ -121,10 +121,12 @@ def main(argv=None) -> int:
         for net, bs in RUN_SH_GRID:
             print(f"=== {net} batch_size={bs} ===", flush=True)
             rc |= run_one(net, bs, args.job, args.workdir,
-                          args.config_args, args.num_passes, args.seq_dim)
+                          args.config_args, args.num_passes, args.seq_dim,
+                          extra_argv=extra)
         return rc
     return run_one(args.net, args.batch_size, args.job, args.workdir,
-                   args.config_args, args.num_passes, args.seq_dim)
+                   args.config_args, args.num_passes, args.seq_dim,
+                   extra_argv=extra)
 
 
 if __name__ == "__main__":

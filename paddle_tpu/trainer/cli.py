@@ -548,17 +548,41 @@ def cmd_checkgrad(args, parsed) -> int:
 
 
 def main(argv=None) -> int:
-    args = build_argparser().parse_args(argv)
+    # args argparse doesn't know go to the gflags registry (TrainerMain
+    # passes unparsed argv into gflags the same way) — e.g. --bf16,
+    # --with_timer, --debug_nans
+    args, extra = build_argparser().parse_known_args(argv)
+    changed: dict = {}
+    if extra:
+        from paddle_tpu.core import flags as _flags
+
+        before = _flags.all_flags()
+        leftover = _flags.parse_args(extra)
+        # cli.main may be called in-process (demo runners, tests):
+        # restore exactly the flags THIS call changed, on every exit path
+        changed = {k: v for k, v in before.items() if _flags.get(k) != v}
+        if leftover:
+            for k, v in changed.items():
+                _flags.set(k, v)
+            build_argparser().error(
+                f"unrecognized arguments: {' '.join(leftover)}")
     from paddle_tpu.trainer.config_parser import parse_config
 
-    parsed = parse_config(args.config, args.config_args)
-    jobs = {
-        "train": cmd_train,
-        "test": cmd_test,
-        "time": cmd_time,
-        "checkgrad": cmd_checkgrad,
-    }
-    return jobs[args.job](args, parsed)
+    try:
+        parsed = parse_config(args.config, args.config_args)
+        jobs = {
+            "train": cmd_train,
+            "test": cmd_test,
+            "time": cmd_time,
+            "checkgrad": cmd_checkgrad,
+        }
+        return jobs[args.job](args, parsed)
+    finally:
+        if changed:
+            from paddle_tpu.core import flags as _flags
+
+            for k, v in changed.items():
+                _flags.set(k, v)
 
 
 if __name__ == "__main__":

@@ -221,3 +221,30 @@ def test_light_mnist_parses_and_trains():
         last = c
     assert np.isfinite(last)
     assert last < first * 1.5  # trains without diverging in a few steps
+
+
+def test_cli_gflags_passthrough_and_restore(tmp_path):
+    """Unknown argparse args route to the gflags registry (TrainerMain's
+    gflags convention), apply for the job, and restore afterwards."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.trainer import cli
+
+    cfg = tmp_path / "c.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=4, learning_rate=0.1)\n"
+        "x = data_layer('x', 4)\n"
+        "y = fc_layer(input=x, size=2, act=LinearActivation())\n"
+        "lab = data_layer('l', 2)\n"
+        "outputs(mse_cost(input=y, label=lab))\n")
+    assert flags.get("with_timer") is False
+    rc = cli.main(["--config", str(cfg), "--job", "time",
+                   "--with_timer", "--bf16"])
+    assert rc == 0
+    # restored after the in-process call
+    assert flags.get("with_timer") is False
+    assert flags.get("bf16") is False
+
+    import pytest
+    with pytest.raises(SystemExit):
+        cli.main(["--config", str(cfg), "--job", "time", "--not_a_flag"])
