@@ -26,6 +26,7 @@ chained-dispatch method with a scalar readback fence if tracing fails.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -447,18 +448,26 @@ def bench_resnet(records):
 def main() -> None:
     records: list[dict] = []
     failures = []
-    for fn in (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
-               bench_nmt, bench_ctr, bench_crnn, bench_saturation,
-               bench_transformer):
+    rows = (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
+            bench_nmt, bench_ctr, bench_crnn, bench_saturation,
+            bench_transformer)
+    # debugging aid: `python bench.py transformer resnet` runs a subset;
+    # the driver's no-arg invocation runs everything
+    selected = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if selected:
+        rows = tuple(f for f in rows
+                     if any(s in f.__name__ for s in selected))
+    for fn in rows:
         try:
             fn(records)
         except Exception as e:  # keep the headline alive
             failures.append(f"{fn.__name__}: {type(e).__name__}: {e}")
-    try:
-        headline = bench_resnet(records)
-    except Exception as e:
-        failures.append(f"bench_resnet: {type(e).__name__}: {e}")
-        headline = None
+    headline = None
+    if not selected or any("resnet" in s for s in selected):
+        try:
+            headline = bench_resnet(records)
+        except Exception as e:
+            failures.append(f"bench_resnet: {type(e).__name__}: {e}")
     for r in records:
         print(json.dumps(r))
     if failures:
