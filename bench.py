@@ -457,13 +457,17 @@ def main() -> None:
     if selected:
         rows = tuple(f for f in rows
                      if any(s in f.__name__ for s in selected))
+        if not rows and not any(s in "bench_resnet" for s in selected):
+            sys.stderr.write(
+                f"bench.py: no bench rows match {selected}\n")
+            sys.exit(2)
     for fn in rows:
         try:
             fn(records)
         except Exception as e:  # keep the headline alive
             failures.append(f"{fn.__name__}: {type(e).__name__}: {e}")
     headline = None
-    if not selected or any("resnet" in s for s in selected):
+    if not selected or any(s in "bench_resnet" for s in selected):
         try:
             headline = bench_resnet(records)
         except Exception as e:
