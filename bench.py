@@ -454,10 +454,11 @@ def main() -> None:
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything
     selected = [a for a in sys.argv[1:] if not a.startswith("-")]
+    wants_resnet = not selected or any(s in "bench_resnet" for s in selected)
     if selected:
         rows = tuple(f for f in rows
                      if any(s in f.__name__ for s in selected))
-        if not rows and not any(s in "bench_resnet" for s in selected):
+        if not rows and not wants_resnet:
             sys.stderr.write(
                 f"bench.py: no bench rows match {selected}\n")
             sys.exit(2)
@@ -467,7 +468,7 @@ def main() -> None:
         except Exception as e:  # keep the headline alive
             failures.append(f"{fn.__name__}: {type(e).__name__}: {e}")
     headline = None
-    if not selected or any(s in "bench_resnet" for s in selected):
+    if wants_resnet:
         try:
             headline = bench_resnet(records)
         except Exception as e:

@@ -46,8 +46,10 @@ class TransformerConfig:
     # elementwise tail (measured fastest at train shapes), False = none.
     remat: object = True
     # attention implementation: "exact" | "blockwise" | "flash" (Pallas
-    # kernel, ops/pallas/flash_attention.py) | "ring" (ring needs a
-    # mesh with a seq axis and activations sharded over it)
+    # kernel, ops/pallas/flash_attention.py) | "ring" | "ulysses" (the
+    # last two need a mesh with a seq axis and activations sharded over
+    # it; ring rotates K/V via ppermute, ulysses all_to_alls the
+    # sequence<->head sharding — see ops/attention.py)
     attn_impl: str = "exact"
     attn_block_size: int = 1024
     # layer-scan unrolling: "auto" fully unrolls shallow stacks (<= 16
@@ -172,11 +174,14 @@ from paddle_tpu.ops.nn import layer_norm as _ln  # shared with the v2 path
 
 
 def _attention(cfg: TransformerConfig, q, k, v, mesh):
-    if cfg.attn_impl == "ring":
+    if cfg.attn_impl in ("ring", "ulysses"):
         assert mesh is not None and "seq" in mesh.axis_names, (
-            "ring attention needs a mesh with a 'seq' axis"
+            f"{cfg.attn_impl} attention needs a mesh with a 'seq' axis"
         )
-        return attn_ops.attention_with_sequence_parallel(
+        fn = (attn_ops.attention_with_sequence_parallel
+              if cfg.attn_impl == "ring"
+              else attn_ops.attention_with_ulysses)
+        return fn(
             q, k, v, mesh, causal=True,
             head_axis="model" if "model" in mesh.axis_names else None,
         )
@@ -193,9 +198,10 @@ def _attention(cfg: TransformerConfig, q, k, v, mesh):
             return flash_attention(q, k, v, True, None, bs, bs)
         # pallas_call has no GSPMD partitioning rule — run the kernel
         # per-device under shard_map (batch over data, heads over model;
-        # sequence sharding needs attn_impl="ring" instead)
+        # sequence sharding needs attn_impl="ring" or "ulysses" instead)
         assert "seq" not in mesh.axis_names, (
-            "attn_impl='flash' does not shard the sequence; use 'ring'"
+            "attn_impl='flash' does not shard the sequence; use 'ring' "
+            "or 'ulysses'"
         )
         from jax import shard_map
 

@@ -207,11 +207,9 @@ def multi_head_attention(
     return out.reshape(b, t_q, hd) @ wo
 
 
-def attention_with_sequence_parallel(
-    q, k, v, mesh, causal: bool = False, axis_name: str = "seq",
-    head_axis: str | None = None,
-):
-    """Convenience: run ring_attention under shard_map on a mesh whose
+def _seq_parallel_call(attn_fn, q, k, v, mesh, causal, axis_name,
+                       head_axis):
+    """Shared shard_map wrapper for sequence-parallel attention impls:
     ``seq`` axis shards dim 1 of q/k/v (batch over ``data`` if present;
     heads over ``head_axis`` if given — composes SP with TP)."""
     from jax.sharding import PartitionSpec as P
@@ -220,10 +218,65 @@ def attention_with_sequence_parallel(
     batch_ax = "data" if "data" in mesh.axis_names else None
     spec = P(batch_ax, axis_name, head_axis, None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        functools.partial(attn_fn, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def attention_with_sequence_parallel(
+    q, k, v, mesh, causal: bool = False, axis_name: str = "seq",
+    head_axis: str | None = None,
+):
+    """Ring attention under shard_map (see ``_seq_parallel_call``)."""
+    return _seq_parallel_call(ring_attention, q, k, v, mesh, causal,
+                              axis_name, head_axis)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq",
+                      causal: bool = False, scale: float | None = None):
+    """DeepSpeed-Ulysses sequence parallelism (inside shard_map).
+
+    Where ring attention rotates K/V around the ``seq`` axis, Ulysses
+    swaps WHAT is sharded: an all_to_all re-shards [B, T/n, H, D] into
+    [B, T, H/n, D] (each rank trades its sequence slice of every head
+    for the full sequence of its head group), full-sequence attention
+    runs locally — any local impl, plain softmax here — and the inverse
+    all_to_all restores sequence sharding.  Two all-to-alls each way vs
+    ring's n-1 ppermutes; needs local heads divisible by the axis size.
+    Designed from the Ulysses paper (PAPERS.md); exact, differentiable
+    (all_to_all transposes to all_to_all)."""
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses: local head count {q.shape[2]} not divisible by "
+            f"mesh axis '{axis_name}' size {n}")
+
+    def gather_seq(x):  # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    q_full, k_full, v_full = gather_seq(q), gather_seq(k), gather_seq(v)
+    t = q_full.shape[1]
+    # blockwise (online-softmax) local attention: O(T) activation memory
+    # — materializing [Tg, Tg] scores would negate the long-context point
+    out = blockwise_attention(q_full, k_full, v_full,
+                              block_size=min(1024, t), causal=causal,
+                              scale=scale)
+    # [B, T, H/n, D] -> [B, T/n, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def attention_with_ulysses(
+    q, k, v, mesh, causal: bool = False, axis_name: str = "seq",
+    head_axis: str | None = None,
+):
+    """Ulysses under shard_map on the same layout contract as
+    ``attention_with_sequence_parallel`` (composes with data/TP axes:
+    the divisibility requirement applies to the PER-TP-SHARD heads)."""
+    return _seq_parallel_call(ulysses_attention, q, k, v, mesh, causal,
+                              axis_name, head_axis)
