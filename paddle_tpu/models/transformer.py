@@ -64,6 +64,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
+    moe_dispatch: str = "sort"  # "einsum" = dense one-hot GShard tensors
 
     @property
     def head_dim(self) -> int:
@@ -78,7 +79,8 @@ class TransformerConfig:
         return MoEConfig(num_experts=self.moe_experts, mlp_dim=self.mlp_dim,
                          top_k=self.moe_top_k,
                          capacity_factor=self.moe_capacity_factor,
-                         aux_loss_weight=self.moe_aux_weight)
+                         aux_loss_weight=self.moe_aux_weight,
+                         dispatch=self.moe_dispatch)
 
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:

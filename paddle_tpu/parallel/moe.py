@@ -10,11 +10,15 @@ sharded across devices, and tokens move to their experts via
 ``lax.all_to_all`` riding ICI — the role NCCL alltoall plays in GPU MoE
 stacks.
 
-Everything is static-shaped for XLA: routing produces dense one-hot
-dispatch/combine tensors ``[T, E, C]`` (capacity ``C`` tokens per expert
-per group; overflow tokens are dropped, the standard capacity-factor
-semantics), so the whole layer is einsums + one pair of all_to_alls, all
-differentiable (gates included) under ``jax.grad``/``shard_map``.
+Everything is static-shaped for XLA: routing assigns each (token,
+choice) a fixed slot in its expert's capacity buffer (capacity ``C``
+tokens per expert per group; overflow tokens are dropped, the standard
+capacity-factor semantics).  Token movement has two equivalent forms —
+``dispatch="sort"`` (default): scatter/gather by flat slot id, O(T·D)
+data movement; ``dispatch="einsum"``: the GShard dense one-hot
+``[T, E, C]`` dispatch/combine tensors.  Either way the layer is a few
+array ops + one pair of all_to_alls, all differentiable (gates
+included) under ``jax.grad``/``shard_map``.
 
 Two execution paths with identical math:
 
@@ -47,11 +51,20 @@ class MoEConfig:
     top_k: int = 2              # 1 = Switch routing, 2 = GShard routing
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
+    # token movement: "sort" (default) = scatter/gather by flat slot
+    # index e*C+pos — O(T*D) data movement; "einsum" = the dense one-hot
+    # GShard tensors [T,E,C], O(T*E*C*D) FLOPs.  Same routing decisions
+    # exactly (tests pin value+grad equality); sort measured +56%/+36%
+    # tok/s (top-2/top-1) at the 8-expert GPT-2-width bench shape.
+    dispatch: str = "sort"
 
     def __post_init__(self):
         if self.top_k not in (1, 2):
             raise ValueError(
                 f"top_k must be 1 (Switch) or 2 (GShard); got {self.top_k}")
+        if self.dispatch not in ("einsum", "sort"):
+            raise ValueError(
+                f"dispatch must be 'einsum' or 'sort'; got {self.dispatch}")
 
 
 def init_moe_params(key: jax.Array, embed_dim: int, cfg: MoEConfig,
@@ -75,8 +88,8 @@ def capacity(tokens_per_group: int, cfg: MoEConfig) -> int:
     return max(c, 1)
 
 
-def _one_hot_positions(mask: jax.Array, cap: int, offset=None):
-    """mask [T, E] 0/1 -> (kept mask, position one-hot [T, E, C]).
+def _positions(mask: jax.Array, cap: int, offset=None):
+    """mask [T, E] 0/1 -> (kept mask [T, E], positions [T, E] float).
 
     A token's position inside its expert's buffer is its running count
     (cumsum over the group's token order); positions >= cap drop out —
@@ -86,16 +99,18 @@ def _one_hot_positions(mask: jax.Array, cap: int, offset=None):
     if offset is not None:
         pos = pos + offset[None, :]
     keep = mask * (pos < cap).astype(mask.dtype)
-    pos_oh = keep[..., None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), cap, dtype=mask.dtype)
-    return keep, pos_oh
+    return keep, pos
 
 
-def route(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
-    """Tokens [T, D] -> (dispatch [T,E,C], combine [T,E,C], aux_loss).
+def route_choices(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
+    """Routing core shared by both dispatch forms.
 
-    combine carries the (renormalized) gate probabilities, so gradients
-    flow into the router; dispatch is its 0/1 support.
+    Returns (choices, aux): ``choices`` is a list over the top_k
+    assignment slots of dicts with per-token ``eid`` (expert id, int),
+    ``pos`` (position in the expert's capacity buffer, int), ``keep``
+    (0/1 f32 survived capacity), and ``w`` (the renormalized combine
+    weight, already zeroed for dropped tokens).  Gradients flow into
+    the router through ``w``.
     """
     f32 = jnp.float32
     logits = x.astype(f32) @ wg.astype(f32)          # [T, E]
@@ -113,8 +128,13 @@ def route(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
     importance = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(load * importance)
 
-    keep1, oh1 = _one_hot_positions(mask1, cap)
-    combine = (gate1 * keep1.max(-1))[:, None, None] * oh1 * mask1[..., None]
+    def per_token(grid, eid):
+        return jnp.take_along_axis(grid, eid[:, None], axis=1)[:, 0]
+
+    keep1g, pos1g = _positions(mask1, cap)
+    k1 = per_token(keep1g, idx1)
+    choices = [{"eid": idx1, "pos": per_token(pos1g, idx1).astype(jnp.int32),
+                "keep": k1, "w": gate1 * k1}]
 
     if cfg.top_k >= 2:
         probs2 = probs * (1.0 - mask1)               # mask out the winner
@@ -123,17 +143,66 @@ def route(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
         gate2 = jnp.sum(probs * mask2, axis=-1)
         # second choices queue BEHIND every first-choice token
         # (GShard: the expert's buffer fills greedily by priority)
-        expert_load1 = jnp.sum(keep1, axis=0)        # [E]
-        keep2, oh2 = _one_hot_positions(mask2, cap, offset=expert_load1)
+        expert_load1 = jnp.sum(keep1g, axis=0)       # [E]
+        keep2g, pos2g = _positions(mask2, cap, offset=expert_load1)
+        k2 = per_token(keep2g, idx2)
         # renormalize the two gates over what survived
-        g1 = gate1 * keep1.max(-1)
-        g2 = gate2 * keep2.max(-1)
+        g1, g2 = gate1 * k1, gate2 * k2
         denom = jnp.maximum(g1 + g2, 1e-9)
-        combine = ((g1 / denom)[:, None, None] * oh1 * mask1[..., None]
-                   + (g2 / denom)[:, None, None] * oh2 * mask2[..., None])
+        choices[0]["w"] = g1 / denom * k1
+        choices.append(
+            {"eid": idx2, "pos": per_token(pos2g, idx2).astype(jnp.int32),
+             "keep": k2, "w": g2 / denom * k2})
+    return choices, aux
 
+
+def route(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
+    """Tokens [T, D] -> (dispatch [T,E,C], combine [T,E,C], aux_loss):
+    the dense one-hot tensors built from ``route_choices``.  combine
+    carries the (renormalized) gate probabilities; dispatch is its 0/1
+    support."""
+    choices, aux = route_choices(x, wg, cfg, cap)
+    E = cfg.num_experts
+    f32 = jnp.float32
+    combine = 0.0
+    for c in choices:
+        oh = (jax.nn.one_hot(c["eid"], E, dtype=f32)[:, :, None]
+              * jax.nn.one_hot(c["pos"], cap, dtype=f32)[:, None, :])
+        combine = combine + c["w"][:, None, None] * oh  # w already keep-zeroed
     dispatch = (combine > 0.0).astype(f32)
     return dispatch, combine, aux
+
+
+def _slot_ids(choices, E: int, cap: int):
+    """Per choice: flat buffer slot e*C+pos for kept tokens, E*C (the
+    junk row) for dropped ones."""
+    return [jnp.where(c["keep"] > 0, c["eid"] * cap + c["pos"], E * cap)
+            for c in choices]
+
+
+def _scatter_tokens(x2, choices, E: int, cap: int):
+    """Tokens -> expert buffers [E, C, D] by scatter (no [T,E,C] tensor).
+
+    Slots are unique by construction (each (expert, pos<C) pair belongs
+    to exactly one (token, choice)), so the scatter-add never collides
+    except in the junk row."""
+    d = x2.shape[1]
+    slots = _slot_ids(choices, E, cap)
+    s = jnp.concatenate(slots)
+    upd = jnp.concatenate([x2] * len(choices), axis=0)
+    xe_flat = jnp.zeros((E * cap + 1, d), x2.dtype).at[s].add(upd)
+    return xe_flat[:-1].reshape(E, cap, d), slots
+
+
+def _gather_tokens(ye, choices, slots):
+    """Expert outputs [E, C, D] -> tokens [T, D] by weighted gather."""
+    e, cap, d = ye.shape
+    ye_pad = jnp.concatenate(
+        [ye.reshape(e * cap, d), jnp.zeros((1, d), ye.dtype)], axis=0)
+    y = 0.0
+    for c, s in zip(choices, slots):
+        y = y + c["w"].astype(ye.dtype)[:, None] * ye_pad[s]
+    return y
 
 
 def _expert_ffn(w1, b1, w2, b2, xe):
@@ -155,11 +224,19 @@ def moe_ffn(params: dict, x: jax.Array, cfg: MoEConfig,
     x2 = x.reshape(-1, shape[-1])
     T = x2.shape[0]
     c = cap or capacity(T, cfg)
-    dispatch, combine, aux = route(x2, params["wg"], cfg, c)
-    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
-    ye = _expert_ffn(params["w1"], params["b1"], params["w2"], params["b2"],
-                     xe)
-    y = jnp.einsum("tec,ecd->td", combine.astype(x2.dtype), ye)
+    E = cfg.num_experts
+    if cfg.dispatch == "sort":
+        choices, aux = route_choices(x2, params["wg"], cfg, c)
+        xe, slots = _scatter_tokens(x2, choices, E, c)
+        ye = _expert_ffn(params["w1"], params["b1"], params["w2"],
+                         params["b2"], xe)
+        y = _gather_tokens(ye, choices, slots)
+    else:
+        dispatch, combine, aux = route(x2, params["wg"], cfg, c)
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
+        ye = _expert_ffn(params["w1"], params["b1"], params["w2"],
+                         params["b2"], xe)
+        y = jnp.einsum("tec,ecd->td", combine.astype(x2.dtype), ye)
     return y.reshape(shape), aux
 
 
@@ -199,8 +276,12 @@ def moe_ffn_sharded(params: dict, x: jax.Array, cfg: MoEConfig, mesh,
 
     def body(wg, w1, b1, w2, b2, xs):
         x2 = xs.reshape(-1, xs.shape[-1])
-        dispatch, combine, aux = route(x2, wg, cfg, c)
-        xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
+        if cfg.dispatch == "sort":
+            choices, aux = route_choices(x2, wg, cfg, c)
+            xe, slots = _scatter_tokens(x2, choices, E, c)
+        else:
+            dispatch, combine, aux = route(x2, wg, cfg, c)
+            xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
         # [E, C, D] -> [E_local, n*C, D]: tokens travel to expert owners
         xe = lax.all_to_all(xe, axis, split_axis=0, concat_axis=1,
                             tiled=True)
@@ -208,7 +289,10 @@ def moe_ffn_sharded(params: dict, x: jax.Array, cfg: MoEConfig, mesh,
         # [E_local, n*C, D] -> [E, C, D]: results return to token owners
         ye = lax.all_to_all(ye, axis, split_axis=1, concat_axis=0,
                             tiled=True)
-        y = jnp.einsum("tec,ecd->td", combine.astype(x2.dtype), ye)
+        if cfg.dispatch == "sort":
+            y = _gather_tokens(ye, choices, slots)
+        else:
+            y = jnp.einsum("tec,ecd->td", combine.astype(x2.dtype), ye)
         return y.reshape(xs.shape), lax.pmean(aux, all_axes)
 
     tok = P(all_axes) if x.ndim == 2 else P(all_axes, *([None] * (x.ndim - 1)))

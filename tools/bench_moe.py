@@ -46,11 +46,31 @@ def main():
                 embed_dim=768, mlp_dim=3072, max_seq_len=2048,
                 dtype=jnp.float32, remat=False, attn_impl="flash",
                 attn_block_size=1024)
-    run("dense-124M", T.TransformerConfig(**base), bs=8)
-    run("moe-8e-top2", T.TransformerConfig(
-        **base, moe_experts=8, moe_top_k=2, moe_capacity_factor=1.25), bs=8)
-    run("moe-8e-top1", T.TransformerConfig(
-        **base, moe_experts=8, moe_top_k=1, moe_capacity_factor=1.25), bs=8)
+    import sys
+
+    known = ["dense", "top2", "top1", "top2sort", "top1sort"]
+    sel = sys.argv[1:] or known
+    bad = [s for s in sel if s not in known]
+    if bad:
+        raise SystemExit(f"unknown variants {bad}; choose from {known}")
+    if "dense" in sel:
+        run("dense-124M", T.TransformerConfig(**base), bs=8)
+    if "top2" in sel:
+        run("moe-8e-top2", T.TransformerConfig(
+            **base, moe_experts=8, moe_top_k=2,
+            moe_capacity_factor=1.25), bs=8)
+    if "top1" in sel:
+        run("moe-8e-top1", T.TransformerConfig(
+            **base, moe_experts=8, moe_top_k=1,
+            moe_capacity_factor=1.25), bs=8)
+    if "top2sort" in sel:
+        run("moe-8e-top2-sort", T.TransformerConfig(
+            **base, moe_experts=8, moe_top_k=2, moe_capacity_factor=1.25,
+            moe_dispatch="sort"), bs=8)
+    if "top1sort" in sel:
+        run("moe-8e-top1-sort", T.TransformerConfig(
+            **base, moe_experts=8, moe_top_k=1, moe_capacity_factor=1.25,
+            moe_dispatch="sort"), bs=8)
 
 
 if __name__ == "__main__":
