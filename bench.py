@@ -204,6 +204,7 @@ def _lstm_classify_cost(hidden, vocab=30000, embed=128):
 def bench_lstm(records, bs=64, hiddens=(256, 512, 1280),
                saturated=False):
     import jax
+    import jax.numpy as jnp
 
     from paddle_tpu.core.lod import SequenceBatch
     from paddle_tpu.optimizer import Adam
@@ -222,7 +223,8 @@ def bench_lstm(records, bs=64, hiddens=(256, 512, 1280),
 
     for h in hiddens:
         step = _topology_step(lambda h=h: _lstm_classify_cost(h), feed_fn,
-                              optimizer=Adam(learning_rate=2e-3))
+                              optimizer=Adam(learning_rate=2e-3,
+                                             moment_dtype=jnp.bfloat16))
         ms = _two_point(step, n2=10 if saturated else 15)
         row = {
             "metric": f"lstm_text_train_ms_per_batch_h{h}_bs{bs}"
@@ -237,6 +239,8 @@ def bench_lstm(records, bs=64, hiddens=(256, 512, 1280),
 
 
 def bench_nmt(records, bs=64, saturated=False):
+    import jax.numpy as jnp
+
     from paddle_tpu.core.lod import SequenceBatch
     from paddle_tpu.models import seqtoseq as S
     from paddle_tpu.optimizer import Adam
@@ -258,13 +262,14 @@ def bench_nmt(records, bs=64, saturated=False):
     step = _topology_step(
         lambda: S.seqtoseq_net(vocab, vocab, word_vector_dim=512,
                                encoder_size=512, decoder_size=512),
-        feed_fn, optimizer=Adam(learning_rate=5e-4))
+        feed_fn, optimizer=Adam(learning_rate=5e-4,
+                                moment_dtype=jnp.bfloat16))
     ms = _two_point(step, n2=10 if saturated else 15)
     records.append({
         "metric": "nmt_attention_train_seq_per_sec"
                   + (f"_bs{bs}_saturated" if saturated else ""),
         "value": round(bs / ms * 1000.0, 1), "unit": "seq/s",
-        "config": f"vocab {vocab}, dim 512, len {tlen}, bs {bs}, bf16 mixed precision",
+        "config": f"vocab {vocab}, dim 512, len {tlen}, bs {bs}, bf16 mixed precision, bf16 Adam moments",
         "vs_baseline": 0,
         **_utilization(step),
     })
@@ -311,6 +316,7 @@ def bench_ctr(records, bs=1024, saturated=False):
 
 def bench_crnn(records, bs=64, saturated=False):
     import jax
+    import jax.numpy as jnp
 
     from paddle_tpu.core.lod import SequenceBatch
     from paddle_tpu.models.ocr_crnn import crnn_ctc_cost
@@ -332,13 +338,14 @@ def bench_crnn(records, bs=64, saturated=False):
     step = _topology_step(
         lambda: crnn_ctc_cost(image_height=h, image_width=w,
                               num_classes=classes)[0],
-        feed_fn, optimizer=Adam(learning_rate=1e-3))
+        feed_fn, optimizer=Adam(learning_rate=1e-3,
+                                moment_dtype=jnp.bfloat16))
     ms = _two_point(step, n2=10 if saturated else 15)
     records.append({
         "metric": "ocr_crnn_ctc_train_samples_per_sec"
                   + (f"_bs{bs}_saturated" if saturated else ""),
         "value": round(bs / ms * 1000.0, 0), "unit": "samples/s",
-        "config": f"32x96 conv+BiLSTM+CTC, bs {bs}, bf16 mixed precision",
+        "config": f"32x96 conv+BiLSTM+CTC, bs {bs}, bf16 mixed precision, bf16 Adam moments",
         "vs_baseline": 0,
         **_utilization(step),
     })
