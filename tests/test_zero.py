@@ -30,6 +30,21 @@ def _ids(bsz, seed=0):
         np.random.default_rng(seed).integers(0, 64, (bsz, 17)))
 
 
+def _zero1_setup(mesh, cfg=None, seed=0):
+    """(params, state, step, sharded ids, specs) — the common ZeRO-1
+    harness: placed params, sharded slots, zero1 train step."""
+    cfg = cfg or _cfg()
+    opt = Adam(learning_rate=1e-3)
+    params = T.place_params(T.init_params(cfg, jax.random.key(seed)),
+                            mesh, cfg)
+    specs = T.param_shardings(cfg)
+    state = shard_opt_state(opt.init_tree(params), params, mesh,
+                            param_specs=specs)
+    step = T.build_train_step(cfg, opt, mesh=mesh, zero1=True)
+    ids = jax.device_put(_ids(8), NamedSharding(mesh, P("data", None)))
+    return params, state, step, ids, specs
+
+
 def test_zero1_matches_replicated_step():
     devs = jax.devices()[:4]
     mesh = Mesh(np.asarray(devs).reshape(4), ("data",))
@@ -46,11 +61,7 @@ def test_zero1_matches_replicated_step():
         p_ref, s_ref, loss_ref = step_ref(p_ref, s_ref, ids)
 
     # zero-1 sharded state
-    p_z = T.place_params(T.init_params(cfg, jax.random.key(0)), mesh, cfg)
-    s_z = shard_opt_state(opt.init_tree(p_z), p_z, mesh,
-                          param_specs=T.param_shardings(cfg))
-    step_z = T.build_train_step(cfg, opt, mesh=mesh, zero1=True)
-    ids_z = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    p_z, s_z, step_z, ids_z, _ = _zero1_setup(mesh, cfg)
     for _ in range(3):
         p_z, s_z, loss_z = step_z(p_z, s_z, ids_z)
 
@@ -64,11 +75,7 @@ def test_zero1_matches_replicated_step():
 def test_zero1_state_is_sharded_quarter_bytes():
     devs = jax.devices()[:4]
     mesh = Mesh(np.asarray(devs).reshape(4), ("data",))
-    cfg = _cfg()
-    opt = Adam(learning_rate=1e-3)
-    params = T.place_params(T.init_params(cfg, jax.random.key(0)), mesh, cfg)
-    state = shard_opt_state(opt.init_tree(params), params, mesh,
-                            param_specs=T.param_shardings(cfg))
+    params, state, step, ids, _ = _zero1_setup(mesh)
     total = sum(l.size * l.dtype.itemsize
                 for l in jax.tree.leaves(state["slots"]))
     per_dev = state_bytes_per_device(state)
@@ -76,8 +83,6 @@ def test_zero1_state_is_sharded_quarter_bytes():
     assert per_dev < total / 3, (per_dev, total)
 
     # the step KEEPS the state sharded (with_sharding_constraint holds)
-    step = T.build_train_step(cfg, opt, mesh=mesh, zero1=True)
-    ids = jax.device_put(_ids(8), NamedSharding(mesh, P("data", None)))
     params, state, _ = step(params, state, ids)
     m = state["slots"][0]["m"]  # embed-table moment
     assert "data" in jax.tree.leaves(
@@ -99,11 +104,7 @@ def test_zero1_composes_with_tp():
     step_ref = T.build_train_step(cfg, opt)
     p_ref, s_ref, _ = step_ref(p_ref, s_ref, ids)
 
-    p_z = T.place_params(T.init_params(cfg, jax.random.key(0)), mesh, cfg)
-    specs = T.param_shardings(cfg)
-    s_z = shard_opt_state(opt.init_tree(p_z), p_z, mesh, param_specs=specs)
-    step_z = T.build_train_step(cfg, opt, mesh=mesh, zero1=True)
-    ids_z = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    p_z, s_z, step_z, ids_z, specs = _zero1_setup(mesh, cfg)
     p_z, s_z, _ = step_z(p_z, s_z, ids_z)
 
     for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_z)):
@@ -117,3 +118,45 @@ def test_zero1_composes_with_tp():
         wq_spec["slots"], is_leaf=lambda x: isinstance(x, P))
     axes = {a for sp in flat for a in sp if a is not None}
     assert "data" in axes and "model" in axes
+
+
+def test_zero1_state_checkpoint_roundtrip(tmp_path):
+    """A ZeRO-1-sharded run survives save/load: params + sharded slots
+    checkpoint after step 1, a fresh process-style rebuild restores them,
+    and step 2 from the restored state equals step 2 of the
+    uninterrupted run — the full resume-equivalence check."""
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4), ("data",))
+    params, state, step, ids, specs = _zero1_setup(mesh)
+    params, state, _ = step(params, state, ids)
+
+    d = str(tmp_path / "z")
+    flat_params = {f"p{i}": np.asarray(l)
+                   for i, l in enumerate(jax.tree.leaves(params))}
+    ckpt.save_checkpoint(d, 0, flat_params, opt_state=state)
+    # host copy BEFORE the continuation step donates the buffers
+    state_host = jax.tree.map(np.asarray, state)
+    # the uninterrupted continuation (reference trajectory)
+    p_cont, s_cont, _ = step(params, state, ids)
+    p_cont_host = jax.tree.map(np.asarray, p_cont)
+
+    # fresh rebuild (as a restarted process would), then restore
+    params2, tmpl, step2, ids2, _ = _zero1_setup(mesh)
+    loaded_p, restored, _, _ = ckpt.load_checkpoint(
+        ckpt.latest_checkpoint(d)[0], opt_state_template=tmpl)
+    treedef = jax.tree.structure(params2)
+    params2 = jax.tree.unflatten(
+        treedef, [jnp.asarray(loaded_p[f"p{i}"])
+                  for i in range(treedef.num_leaves)])
+    params2 = T.place_params(params2, mesh, _cfg())
+    restored = shard_opt_state(restored, params2, mesh, param_specs=specs)
+
+    for a, b in zip(jax.tree.leaves(state_host), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6, atol=1e-7)
+    # resumed step 2 == uninterrupted step 2
+    p_res, s_res, loss = step2(params2, restored, ids2)
+    assert np.isfinite(float(loss))
+    for a, b in zip(jax.tree.leaves(p_cont_host), jax.tree.leaves(p_res)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-5, atol=1e-6)
