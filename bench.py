@@ -25,7 +25,6 @@ chained-dispatch method with a scalar readback fence if tracing fails.
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 
@@ -480,22 +479,29 @@ def main() -> None:
             headline = bench_resnet(records)
         except Exception as e:
             failures.append(f"bench_resnet: {type(e).__name__}: {e}")
+    # rows flow through the telemetry sink API (paddle_tpu/metrics.py) so
+    # bench and trainer step records share one schema/toolchain — a JSONL
+    # capture of this stdout feeds bench_to_md.py AND metrics_to_md.py
+    from paddle_tpu.telemetry import JsonlSink, MetricsRegistry
+
+    reg = MetricsRegistry("bench")
+    reg.add_sink(JsonlSink(sys.stdout))
     for r in records:
-        print(json.dumps(r))
+        reg.emit(r, kind="bench")
     if failures:
-        print(json.dumps({"metric": "bench_failures", "value": len(failures),
-                          "unit": "count", "detail": failures,
-                          "vs_baseline": 0}))
+        reg.emit({"metric": "bench_failures", "value": len(failures),
+                  "unit": "count", "detail": failures,
+                  "vs_baseline": 0}, kind="bench")
     if TIMING_FALLBACKS:
-        print(json.dumps({
+        reg.emit({
             "metric": "timing_wall_clock_fallbacks",
             "value": len(TIMING_FALLBACKS), "unit": "count",
             "detail": TIMING_FALLBACKS[:5],
             "note": "these rows used wall-clock two-point timing, NOT "
-                    "device-side traces", "vs_baseline": 0}))
+                    "device-side traces", "vs_baseline": 0}, kind="bench")
     # the driver-recorded headline: north-star ResNet-50 throughput
     if headline is not None:
-        print(json.dumps(headline))
+        reg.emit(headline, kind="bench")
 
 
 if __name__ == "__main__":

@@ -58,6 +58,8 @@ _API_MAP = {
     "config": "paddle_tpu.config",
     "ops": "paddle_tpu.ops",
     "utils": "paddle_tpu.utils",
+    "metrics": "paddle_tpu.metrics",
+    "telemetry": "paddle_tpu.telemetry",
 }
 
 

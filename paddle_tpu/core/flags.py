@@ -118,3 +118,10 @@ define("debug_nans", False, "enable jax nan-checking (was: feenableexcept)")
 # preferred — an explicit mixed-precision policy (build_train_step's
 # compute_dtype / SGD(compute_dtype=bfloat16)), which bench.py uses.
 define("bf16", False, "force bfloat16 MXU compute for float32 operands")
+# telemetry (see paddle_tpu/metrics.py): the structured per-step stream
+# and the multihost flight recorder's crash-dump location
+define("metrics_jsonl", "", "append one JSON metrics record per train step "
+                            "to this file (empty = no JSONL sink)")
+define("flight_recorder_dir", "", "directory for flight-recorder crash dumps "
+                                  "(empty = <tmpdir>/paddle_tpu_flight)")
+define("flight_recorder_size", 256, "step records kept in the flight ring")

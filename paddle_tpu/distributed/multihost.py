@@ -172,6 +172,160 @@ def shard_reader(reader, index: int | None = None,
     return sharded
 
 
+# -- flight recorder ----------------------------------------------------------
+
+class FlightRecorder:
+    """Post-mortem ring buffer for multihost hang/desync diagnosis.
+
+    Keeps the last ``capacity`` step records (the structured dicts
+    ``StepTelemetry`` builds) plus recent heartbeat timestamps for THIS
+    host, and serializes them to ``<dump_dir>/flight-host<k>.json`` when
+    training dies — on exception (``SGD.train`` wraps its loop), on
+    SIGTERM (the pod-eviction signal; the trainer's handler calls
+    :meth:`dump`, or install :func:`install_flight_signal_handler`
+    standalone), or explicitly.  On a real pod every host writes its own
+    file, so comparing ``last heartbeat`` / ``records[-1]["step"]``
+    across hosts pins which worker desynced or hung and at which step.
+
+    Appends are O(1) deque ops with no device interaction — cheap enough
+    to stay always-on in the train loop.
+    """
+
+    def __init__(self, capacity: int | None = None,
+                 heartbeat_capacity: int = 512):
+        import collections
+
+        from paddle_tpu.core import flags
+
+        if capacity is None:
+            capacity = max(int(flags.get("flight_recorder_size")), 1)
+        self.capacity = capacity
+        self._records: "collections.deque" = collections.deque(
+            maxlen=capacity)
+        self._heartbeats: "collections.deque" = collections.deque(
+            maxlen=heartbeat_capacity)
+        # RLock: dump() runs from SIGTERM handlers on the same thread
+        # that may be inside record()/heartbeat() when the signal lands
+        self._lock = __import__("threading").RLock()
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._records.append(dict(rec))
+
+    def heartbeat(self, tag: str = "alive", step: int | None = None) -> None:
+        import time
+
+        hb = {"ts": time.time(), "tag": tag}
+        if step is not None:
+            hb["step"] = step
+        with self._lock:
+            self._heartbeats.append(hb)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._heartbeats.clear()
+
+    @property
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    @property
+    def heartbeats(self) -> list[dict]:
+        with self._lock:
+            return list(self._heartbeats)
+
+    def dump_path(self, dump_dir: str | None = None) -> str:
+        import tempfile
+
+        from paddle_tpu.core import flags
+        from paddle_tpu.telemetry import host_index
+
+        d = dump_dir or flags.get("flight_recorder_dir") or os.path.join(
+            tempfile.gettempdir(), "paddle_tpu_flight")
+        return os.path.join(d, f"flight-host{host_index()}.json")
+
+    def dump(self, reason: str = "", dump_dir: str | None = None,
+             ) -> str | None:
+        """Write the ring to disk; returns the path, or None on failure
+        (a dump must never mask the exception that triggered it)."""
+        import json
+        import time
+
+        from paddle_tpu.telemetry import host_index, json_default
+
+        path = self.dump_path(dump_dir)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with self._lock:
+                payload = {
+                    "schema": "paddle_tpu.flight/1",
+                    # same host-index source as the step records, so
+                    # cross-host comparisons line up
+                    "host": host_index(),
+                    "pid": os.getpid(),
+                    "reason": reason,
+                    "created": time.time(),
+                    "heartbeats": list(self._heartbeats),
+                    "records": list(self._records),
+                }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=json_default)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+
+_flight: FlightRecorder | None = None
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-global recorder ``SGD.train`` feeds."""
+    global _flight
+    if _flight is None:
+        _flight = FlightRecorder()
+    return _flight
+
+
+def chain_signal(signum, frame, prev) -> None:
+    """Invoke a signal's pre-install disposition after our handler ran:
+    call a Python ``prev`` handler; keep SIG_IGN ignored; for SIG_DFL —
+    and for None, where the previous handler lives in C and cannot be
+    re-invoked from Python — reinstall the default and re-deliver, so
+    the signal's terminating effect (pod eviction!) is never swallowed.
+    Shared by the trainer's SIGTERM path and
+    :func:`install_flight_signal_handler`."""
+    import signal
+
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_IGN:
+        signal.signal(signum, signal.SIG_IGN)
+    else:  # SIG_DFL, or None (unknowable C handler): default + re-deliver
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_flight_signal_handler(signum=None) -> None:
+    """Dump the flight ring on SIGTERM, then chain to the previous
+    disposition (``chain_signal``), so pod eviction still terminates the
+    process.  For standalone operators; the trainer's own SIGTERM path
+    calls ``flight_recorder().dump`` itself."""
+    import signal
+
+    signum = signal.SIGTERM if signum is None else signum
+    prev = signal.getsignal(signum)
+
+    def handler(sig, frame):
+        flight_recorder().dump(reason=f"signal {sig}")
+        chain_signal(sig, frame, prev)
+
+    signal.signal(signum, handler)
+
+
 def global_batch(local_arrays, mesh, spec=None):
     """Assemble per-host arrays into one globally-sharded array
     (``jax.make_array_from_process_local_data``) — the input side of

@@ -205,7 +205,7 @@ def _attention(cfg: TransformerConfig, q, k, v, mesh):
             "attn_impl='flash' does not shard the sequence; use 'ring' "
             "or 'ulysses'"
         )
-        from jax import shard_map
+        from paddle_tpu.compat import shard_map
 
         spec = P(
             "data" if "data" in mesh.axis_names else None,

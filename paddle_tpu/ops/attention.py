@@ -20,6 +20,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu import compat
 from paddle_tpu.core import dtype as dt
 from jax import lax
 
@@ -139,7 +140,7 @@ def ring_attention(
     Communication: each device sends/receives K,V N-1 times — the
     ring-attention schedule from the paper, on ICI instead of NCCL.
     """
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
@@ -213,7 +214,7 @@ def _seq_parallel_call(attn_fn, q, k, v, mesh, causal, axis_name,
     ``seq`` axis shards dim 1 of q/k/v (batch over ``data`` if present;
     heads over ``head_axis`` if given — composes SP with TP)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from paddle_tpu.compat import shard_map
 
     batch_ax = "data" if "data" in mesh.axis_names else None
     spec = P(batch_ax, axis_name, head_axis, None)
@@ -249,7 +250,7 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
     ring's n-1 ppermutes; needs local heads divisible by the axis size.
     Designed from the Ulysses paper (PAPERS.md); exact, differentiable
     (all_to_all transposes to all_to_all)."""
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(
             f"ulysses: local head count {q.shape[2]} not divisible by "

@@ -7,6 +7,20 @@ Inside compiled programs these lower to ICI all-reduce / all-gather /
 reduce-scatter / collective-permute; across slices XLA routes them over DCN.
 No host-side transport exists or is needed — the "network" is the compiler's
 problem, which is the whole point of the TPU-native redesign (SURVEY §2.3).
+
+Observability: every wrapper below (a) runs under a ``jax.named_scope``
+(``comm.<op>.<axis>``) so profiler traces attribute collective time to
+the call site, and (b) reports its per-shard payload bytes through
+``paddle_tpu.telemetry.record_comm`` while XLA traces the program —
+shapes are static, so one trace of a program body gives that body's
+per-execution payload.  ``SGD.train`` lowers its step under
+``telemetry.capture_comm`` to attach exactly that program's bytes to
+each step record (``comm_bytes``); outside a capture the global
+``comm_bytes``/``comm_calls`` counters accumulate across traces.
+Known limit: a collective inside a ``lax.scan``/``fori_loop`` body is
+traced once but executes once per iteration, so loop-carried comm
+(pipeline handoffs, ring attention) is undercounted by the trip count —
+use the ``comm.<op>.<axis>`` trace annotations for exact loop timing.
 """
 
 from __future__ import annotations
@@ -15,56 +29,113 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from paddle_tpu import compat
+from paddle_tpu.compat import shard_map
+
+
+def _comm_record(op: str, axis_name, x) -> None:
+    """Account one traced collective call site (never raises — telemetry
+    must not break compilation)."""
+    try:
+        from paddle_tpu.telemetry import record_comm
+
+        nbytes = 0
+        for leaf in jax.tree.leaves(x):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            n = 1
+            for d in shape:
+                n *= int(d)
+            nbytes += n * jnp.dtype(dtype).itemsize
+        axis = "+".join(axis_name) if isinstance(axis_name, (tuple, list)) \
+            else str(axis_name)
+        record_comm(op, axis, nbytes)
+    except Exception:
+        pass
+
+
+def _scope(op: str, axis_name):
+    axis = "+".join(axis_name) if isinstance(axis_name, (tuple, list)) \
+        else str(axis_name)
+    return jax.named_scope(f"comm.{op}.{axis}")
 
 
 def all_reduce(x, axis_name: str, op: str = "sum"):
     """≅ NCCLAllReduce (nccl_op.cc:66); the gradient-sync primitive that
     replaces ParameterServer2::addGradient + getParameter round-trips."""
-    if op == "sum":
-        return lax.psum(x, axis_name)
-    if op == "mean":
-        return lax.pmean(x, axis_name)
-    if op == "max":
-        return lax.pmax(x, axis_name)
-    if op == "min":
-        return lax.pmin(x, axis_name)
+    _comm_record("all_reduce", axis_name, x)
+    with _scope("all_reduce", axis_name):
+        if op == "sum":
+            return lax.psum(x, axis_name)
+        if op == "mean":
+            return lax.pmean(x, axis_name)
+        if op == "max":
+            return lax.pmax(x, axis_name)
+        if op == "min":
+            return lax.pmin(x, axis_name)
     raise ValueError(f"unknown reduce op {op!r}")
 
 
 def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
     """Gather shards along ``axis`` from every device on the mesh axis."""
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    _comm_record("all_gather", axis_name, x)
+    with _scope("all_gather", axis_name):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str, axis: int = 0):
     """Sum-reduce then scatter shards — the ZeRO/“sharded grads” primitive."""
-    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    _comm_record("reduce_scatter", axis_name, x)
+    with _scope("reduce_scatter", axis_name):
+        return lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int,
+               tiled: bool = True):
+    """≅ NCCL alltoall — the MoE token-exchange primitive (each shard
+    sends slice i of ``split_axis`` to rank i, receiving along
+    ``concat_axis``)."""
+    _comm_record("all_to_all", axis_name, x)
+    with _scope("all_to_all", axis_name):
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
 
 
 def broadcast(x, axis_name: str, root: int = 0):
     """≅ NCCLBcast: every device gets root's value."""
-    idx = lax.axis_index(axis_name)
-    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return lax.psum(masked, axis_name)
+    _comm_record("broadcast", axis_name, x)
+    with _scope("broadcast", axis_name):
+        idx = lax.axis_index(axis_name)
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name)
 
 
 def permute(x, axis_name: str, perm: list[tuple[int, int]]):
     """≅ collective-permute (pipeline-stage handoff, ring rotation)."""
-    return lax.ppermute(x, axis_name, perm)
+    _comm_record("permute", axis_name, x)
+    with _scope("permute", axis_name):
+        return lax.ppermute(x, axis_name, perm)
 
 
 def ring_shift(x, axis_name: str, shift: int = 1):
     """Rotate shards around the mesh axis ring."""
-    n = lax.axis_size(axis_name)
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis_name, perm)
+    _comm_record("ring_shift", axis_name, x)
+    with _scope("ring_shift", axis_name):
+        n = compat.axis_size(axis_name)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, axis_name, perm)
 
 
 def psum_tree(tree, axis_name: str):
     """All-reduce every leaf of a pytree (the whole-gradient sync)."""
-    return jax.tree.map(lambda g: lax.psum(g, axis_name), tree)
+    _comm_record("psum_tree", axis_name, tree)
+    with _scope("psum_tree", axis_name):
+        return jax.tree.map(lambda g: lax.psum(g, axis_name), tree)
 
 
 def on_mesh(mesh, fn, in_specs, out_specs):

@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from paddle_tpu.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.enforce import enforce

@@ -40,8 +40,11 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.compat import shard_map
+from paddle_tpu.parallel import collective
 
 
 @dataclass(frozen=True)
@@ -111,6 +114,18 @@ def route_choices(x: jax.Array, wg: jax.Array, cfg: MoEConfig, cap: int):
     (0/1 f32 survived capacity), and ``w`` (the renormalized combine
     weight, already zeroed for dropped tokens).  Gradients flow into
     the router through ``w``.
+
+    Top-2 gate normalization convention (intentional divergence from
+    GShard): the two gates are renormalized over the SURVIVING choices
+    only — ``w_i = g_i * keep_i / max(g1*keep1 + g2*keep2, eps)`` — so a
+    token whose first choice is capacity-dropped routes with full
+    weight 1.0 to its second expert.  GShard's reference formulation
+    normalizes by ``g1 + g2`` computed BEFORE capacity drops, which
+    down-weights such tokens by their lost first-choice share.
+    Post-drop renormalization keeps every surviving token's combine
+    weights summing to 1 (no silent output scaling under congestion);
+    switch the ``denom`` below to the pre-drop ``gate1 + gate2`` to
+    reproduce GShard exactly.
     """
     f32 = jnp.float32
     logits = x.astype(f32) @ wg.astype(f32)          # [T, E]
@@ -283,12 +298,13 @@ def moe_ffn_sharded(params: dict, x: jax.Array, cfg: MoEConfig, mesh,
             dispatch, combine, aux = route(x2, wg, cfg, c)
             xe = jnp.einsum("tec,td->ecd", dispatch.astype(x2.dtype), x2)
         # [E, C, D] -> [E_local, n*C, D]: tokens travel to expert owners
-        xe = lax.all_to_all(xe, axis, split_axis=0, concat_axis=1,
-                            tiled=True)
+        # (collective.all_to_all: trace-annotated + comm-bytes-counted)
+        xe = collective.all_to_all(xe, axis, split_axis=0, concat_axis=1,
+                                   tiled=True)
         ye = _expert_ffn(w1, b1, w2, b2, xe)
         # [E_local, n*C, D] -> [E, C, D]: results return to token owners
-        ye = lax.all_to_all(ye, axis, split_axis=1, concat_axis=0,
-                            tiled=True)
+        ye = collective.all_to_all(ye, axis, split_axis=1, concat_axis=0,
+                                   tiled=True)
         if cfg.dispatch == "sort":
             y = _gather_tokens(ye, choices, slots)
         else:

@@ -16,15 +16,19 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from paddle_tpu import compat
+from paddle_tpu.compat import shard_map
+from paddle_tpu.parallel import collective
 
 
 def _stage_loop(stage_fn, n_micro: int, axis_name: str, params, x_mb):
     """Runs inside shard_map: params is this stage's slice (leading dim 1);
     x_mb is [n_micro, mb, ...] microbatches (replicated)."""
     stage = lax.axis_index(axis_name)
-    n_stages = lax.axis_size(axis_name)
+    n_stages = compat.axis_size(axis_name)
     params = jax.tree.map(lambda p: p[0], params)
     total = n_micro + n_stages - 1
     perm = [(i, i + 1) for i in range(n_stages - 1)]  # forward handoff chain
@@ -54,8 +58,10 @@ def _stage_loop(stage_fn, n_micro: int, axis_name: str, params, x_mb):
             ),
             outs, y,
         )
+        # stage handoff via the observability-wrapped collective (trace
+        # annotation + per-step comm-bytes accounting)
         state = jax.tree.map(
-            lambda a: lax.ppermute(a, axis_name, perm), y
+            lambda a: collective.permute(a, axis_name, perm), y
         )
         return state, outs
 

@@ -80,7 +80,17 @@ def shard_opt_state(opt_state, params, mesh, axis: str = "data",
     """device_put the optimizer state per zero1_specs."""
     specs = zero1_specs(opt_state, params, mesh, axis,
                         param_specs=param_specs)
-    return _put_tree(opt_state, specs, mesh)
+    placed = _put_tree(opt_state, specs, mesh)
+    try:  # telemetry gauge: per-device slot residency (ZeRO-1 headline)
+        from paddle_tpu.telemetry import get_default_registry
+
+        get_default_registry().gauge(
+            "zero1_state_bytes_per_device",
+            "addressable optimizer-slot bytes on one device").set(
+            float(state_bytes_per_device(placed)), axis=axis)
+    except Exception:
+        pass
+    return placed
 
 
 def _put_tree(state, specs, mesh):
@@ -97,8 +107,9 @@ def constrain_opt_state(opt_state, specs, mesh):
     form instead of replicating."""
     flat_s, treedef = jax.tree.flatten(opt_state)
     flat_p = treedef.flatten_up_to(specs)
-    out = [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, sp))
-           for x, sp in zip(flat_s, flat_p)]
+    with jax.named_scope("zero1.constrain_opt_state"):
+        out = [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, sp))
+               for x, sp in zip(flat_s, flat_p)]
     return jax.tree.unflatten(treedef, out)
 
 

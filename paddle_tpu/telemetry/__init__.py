@@ -1,0 +1,34 @@
+"""paddle_tpu.telemetry — the unified metrics/observability layer.
+
+See :mod:`paddle_tpu.metrics` (the user-facing facade) for the overview;
+this package holds the implementation:
+
+- ``registry``     — MetricsRegistry + Counter/Gauge/Histogram + comm
+  accounting used by the collective wrappers;
+- ``sinks``        — JsonlSink / MemorySink / LoggingSink;
+- ``step_metrics`` — StepTelemetry, the per-step record builder behind
+  ``SGD.train`` and ``trainer/cli.py``.
+"""
+
+from paddle_tpu.telemetry.registry import (  # noqa: F401
+    SCHEMA,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    capture_comm,
+    comm_snapshot,
+    get_default_registry,
+    host_index,
+    record_comm,
+)
+from paddle_tpu.telemetry.sinks import (  # noqa: F401
+    JsonlSink,
+    LoggingSink,
+    MemorySink,
+    json_default,
+)
+from paddle_tpu.telemetry.step_metrics import (  # noqa: F401
+    StepTelemetry,
+    tokens_in_feed,
+)

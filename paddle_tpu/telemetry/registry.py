@@ -1,0 +1,349 @@
+"""Metric primitives + the registry that owns them.
+
+One structured stream unifies what used to be scattered (``core/stat.py``
+scope timers, ``profiler.py`` MFU accounting, bench JSONL): a
+:class:`MetricsRegistry` holds named counters / gauges / histograms with
+labeled series (pull side, cheap in-process aggregates) and a list of
+pluggable sinks (push side: one dict per emitted record — JSONL file,
+in-memory for tests, logging).  The per-step train records of
+``SGD.train`` / ``trainer/cli.py`` and the rows of ``bench.py`` flow
+through the same :meth:`MetricsRegistry.emit`, so operators and offline
+tooling (``tools/metrics_to_md.py``, ``tools/bench_to_md.py``) read one
+schema.
+
+Comm accounting: the collective wrappers in ``parallel/collective.py``
+call :func:`record_comm` while XLA traces the program, so the counters
+hold bytes-moved-per-executed-step of each compiled program (shapes are
+static; one trace per compile signature).  ``comm_snapshot()`` flattens
+them into the per-step records.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any
+
+SCHEMA = "paddle_tpu.metrics/1"
+
+# histogram bucket upper bounds (ms-oriented default; values above the
+# last edge land in the +Inf bucket)
+DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                   1000.0, 2500.0, 5000.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, registry: "MetricsRegistry"):
+        self.name = name
+        self.help = help
+        self._registry = registry
+        self._series: dict[tuple, Any] = {}
+
+    def _lock(self):
+        return self._registry._lock
+
+    def labels_of(self) -> list[dict]:
+        return [dict(k) for k in self._series]
+
+
+class Counter(_Metric):
+    """Monotonically increasing value per label set."""
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise ValueError(f"counter {self.name}: negative inc {value}")
+        key = _label_key(labels)
+        with self._lock():
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels) -> float:
+        return self._series.get(_label_key(labels), 0.0)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock():
+            return [{**dict(k), "value": v} for k, v in self._series.items()]
+
+
+class Gauge(_Metric):
+    """Last-set value per label set."""
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock():
+            self._series[_label_key(labels)] = float(value)
+
+    def value(self, **labels) -> float | None:
+        return self._series.get(_label_key(labels))
+
+    def snapshot(self) -> list[dict]:
+        with self._lock():
+            return [{**dict(k), "value": v} for k, v in self._series.items()]
+
+
+@dataclasses.dataclass
+class _Hist:
+    count: int = 0
+    total: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+    buckets: list[int] = dataclasses.field(default_factory=list)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket distribution per label set (bucket edges are upper
+    bounds; one overflow bucket beyond the last edge)."""
+
+    def __init__(self, name, help, registry, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, registry)
+        self.bucket_edges = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock():
+            h = self._series.get(key)
+            if h is None:
+                h = self._series[key] = _Hist(
+                    buckets=[0] * (len(self.bucket_edges) + 1))
+            h.count += 1
+            h.total += value
+            h.min = min(h.min, value)
+            h.max = max(h.max, value)
+            for i, edge in enumerate(self.bucket_edges):
+                if value <= edge:
+                    h.buckets[i] += 1
+                    break
+            else:
+                h.buckets[-1] += 1
+
+    def summary(self, **labels) -> dict | None:
+        h = self._series.get(_label_key(labels))
+        if h is None:
+            return None
+        return {"count": h.count, "sum": h.total,
+                "avg": h.total / h.count if h.count else 0.0,
+                "min": h.min, "max": h.max,
+                "buckets": dict(zip([str(e) for e in self.bucket_edges]
+                                    + ["+Inf"], h.buckets))}
+
+    def snapshot(self) -> list[dict]:
+        with self._lock():
+            return [{**dict(k), **self.summary(**dict(k))}
+                    for k in list(self._series)]
+
+
+class MetricsRegistry:
+    """Named metrics + sink fan-out.
+
+    ``counter/gauge/histogram`` are get-or-create (re-registering the
+    same name with a different type is an error).  ``emit`` stamps the
+    record with schema/ts/host and writes it to every sink; with no
+    sinks it is a no-op, so instrumented code paths can always call it
+    (``active`` lets callers skip expensive record assembly entirely).
+    """
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Metric] = {}
+        self._sinks: list = []
+
+    # -- metric construction --------------------------------------------------
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, self, **kw)
+            elif type(m) is not cls:
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        return self._metrics.get(name)
+
+    # -- sinks ----------------------------------------------------------------
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+    def clear_sinks(self) -> None:
+        with self._lock:
+            for s in self._sinks:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+            self._sinks = []
+
+    @property
+    def sinks(self) -> list:
+        return list(self._sinks)
+
+    @property
+    def active(self) -> bool:
+        return bool(self._sinks)
+
+    # -- the structured stream ------------------------------------------------
+    def emit(self, record: dict, kind: str | None = None) -> dict:
+        """Stamp + fan a record out to every sink; returns the stamped
+        record (emitted or not, so callers can reuse it — e.g. the
+        flight recorder keeps records the sinks never saw)."""
+        rec = dict(record)
+        rec.setdefault("schema", SCHEMA)
+        if kind is not None:
+            rec.setdefault("kind", kind)
+        rec.setdefault("ts", time.time())
+        if "host" not in rec:
+            rec["host"] = host_index()
+        for sink in self._sinks:
+            try:
+                sink.write(rec)
+            except Exception as e:
+                # telemetry must never abort training: a full disk or a
+                # revoked path drops records, not the run (warn once per
+                # sink so a long run doesn't drown in repeats)
+                if not getattr(sink, "_write_failed", False):
+                    try:
+                        sink._write_failed = True
+                        from paddle_tpu.core import logger
+
+                        logger.get_logger("paddle_tpu.metrics").warning(
+                            "metrics sink %s write failed (%s); further "
+                            "records to it may be lost",
+                            type(sink).__name__, e)
+                    except Exception:
+                        pass
+        return rec
+
+    def flush(self) -> None:
+        for sink in self._sinks:
+            try:
+                sink.flush()
+            except Exception:
+                pass
+
+    def snapshot(self) -> dict:
+        """{metric name: list of labeled series dicts} — the pull-side
+        view of every counter/gauge/histogram."""
+        with self._lock:
+            return {name: m.snapshot() for name, m in self._metrics.items()}
+
+
+def host_index() -> int:
+    """This process's host/worker index — ``jax.process_index`` whenever
+    it can be read WITHOUT forcing backend init (telemetry must be
+    importable before ``jax.distributed.initialize``); falls back to
+    PADDLE_TPU_TRAINER_ID.  The single implementation step records AND
+    flight dumps stamp with, so cross-host comparisons line up.
+
+    Standard TPU pods auto-detect multihost without
+    ``jax.distributed.is_initialized()`` ever flipping true, so the real
+    gate is "has a backend already been created" — by emit/dump time in
+    a train loop it always has, and ``process_index`` is then correct
+    and free."""
+    try:
+        import jax
+
+        if getattr(jax.distributed, "is_initialized", None) and \
+                jax.distributed.is_initialized():
+            return jax.process_index()
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:  # initialized already: reading is safe
+            return jax.process_index()
+    except Exception:
+        pass
+    import os
+
+    return int(os.environ.get("PADDLE_TPU_TRAINER_ID", "0") or 0)
+
+
+# -- the default (process-global) registry ------------------------------------
+
+_default = MetricsRegistry()
+
+
+def get_default_registry() -> MetricsRegistry:
+    return _default
+
+
+# -- comm accounting (called by parallel/collective.py at trace time) ---------
+#
+# jax traces a program's Python body ONCE per signature (lower() and the
+# jit call share the trace cache), so record_comm fires exactly once per
+# compiled program.  Two consumers ride that single firing:
+# - a scoped capture (capture_comm): StepTelemetry lowers a program under
+#   it to get THAT program's per-execution payload, {"op/axis": bytes} —
+#   what step records carry;
+# - the global counters: every trace increments them (captured or not),
+#   so they accumulate across compiles — a cumulative pull-side metric,
+#   NOT a per-step number.
+# Caveat for both: a collective inside a lax.scan/fori_loop body is
+# traced once but executed once per iteration, so loop-carried comm is
+# undercounted by the trip count.
+
+_capture = threading.local()
+
+
+@contextlib.contextmanager
+def capture_comm():
+    """Collect record_comm events into a {"op/axis": bytes} dict for the
+    duration (typically one jit lowering).  The global counters still
+    accumulate — the trace cache guarantees this is the program's only
+    trace, so there is no double count.  NOTE: a capture over a program
+    whose signature was already traced (e.g. a second lowering of the
+    same jit object) stays empty — the cached trace skips the Python
+    body entirely."""
+    stack = getattr(_capture, "stack", None)
+    if stack is None:
+        stack = _capture.stack = []
+    acc: dict[str, float] = {}
+    stack.append(acc)
+    try:
+        yield acc
+    finally:
+        stack.pop()
+
+
+def record_comm(op: str, axis: str, nbytes: int, registry=None) -> None:
+    """One collective call site traced: bytes are the per-shard payload of
+    one execution of the traced program body."""
+    key = f"{op}/{axis}"
+    for acc in getattr(_capture, "stack", None) or ():
+        acc[key] = acc.get(key, 0.0) + float(nbytes)
+    reg = registry or _default
+    reg.counter("comm_bytes",
+                "payload bytes of traced collectives (cumulative over "
+                "traces)").inc(float(nbytes), op=op, axis=axis)
+    reg.counter("comm_calls", "traced collective call sites").inc(
+        1.0, op=op, axis=axis)
+
+
+def comm_snapshot(registry=None) -> dict[str, float]:
+    """Flatten the cumulative comm counters into {"op/axis": bytes}."""
+    reg = registry or _default
+    c = reg.get("comm_bytes")
+    if c is None:
+        return {}
+    return {f"{s['op']}/{s['axis']}": s["value"] for s in c.snapshot()}

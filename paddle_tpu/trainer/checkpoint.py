@@ -45,6 +45,44 @@ def _npz_safe(arr: np.ndarray) -> np.ndarray:
     return arr
 
 
+def _dtype_names(arrays: dict) -> dict[str, str]:
+    """{name: original dtype} for entries _npz_safe will upcast — the
+    manifest record that lets the load side restore bf16/fp8 exactly.
+    Dtype-only inspection: no data is materialized (save already pays
+    one full device->host copy; this must not add a second)."""
+    out = {}
+    for k, v in arrays.items():
+        dt = getattr(v, "dtype", None)
+        if dt is None:
+            continue  # python scalars/lists: npz stores them natively
+        name = str(dt)
+        if np.dtype(dt).kind == "V" or name in (
+                "bfloat16", "float8_e4m3fn", "float8_e5m2"):
+            out[k] = name
+    return out
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 / float8_* live here
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _restore_dtypes(arrays: dict[str, np.ndarray],
+                    dtypes: dict[str, str]) -> dict[str, np.ndarray]:
+    for k, name in (dtypes or {}).items():
+        if k in arrays:
+            try:
+                arrays[k] = arrays[k].astype(_dtype_from_name(name))
+            except (TypeError, AttributeError) as e:
+                log.warning("checkpoint: cannot restore dtype %s for %r "
+                            "(%s); leaving %s", name, k, e, arrays[k].dtype)
+    return arrays
+
+
 def _tree_to_flat(tree) -> dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
@@ -108,6 +146,14 @@ def save_checkpoint(ckpt_dir: str, pass_id: int, params: dict,
             "files": {
                 f: _sha256(os.path.join(tmp, f))
                 for f in sorted(os.listdir(tmp))
+            },
+            # npz stores extension dtypes (bf16/fp8) upcast to f32; the
+            # originals are recorded here so load_checkpoint hands back
+            # the exact dtypes — otherwise a bf16 model resumes f32 and
+            # silently recompiles under a different signature
+            "dtypes": {
+                "params": _dtype_names(params),
+                "states": _dtype_names(states or {}),
             },
             "meta": meta or {},
         }
@@ -179,8 +225,9 @@ def load_checkpoint(path: str, opt_state_template=None):
         with np.load(p) as z:
             return {k: z[k] for k in z.files}
 
-    params = load_npz("params.npz")
-    states = load_npz("states.npz")
+    dtypes = manifest.get("dtypes", {})
+    params = _restore_dtypes(load_npz("params.npz"), dtypes.get("params"))
+    states = _restore_dtypes(load_npz("states.npz"), dtypes.get("states"))
     opt_state = None
     opt_flat = load_npz("opt_state.npz")
     if opt_flat and opt_state_template is not None:

@@ -428,9 +428,20 @@ def cmd_time(args, parsed) -> int:
         carry["s"] = (p, o, s)
         return c
 
+    def _deleted(x):
+        try:
+            return x.is_deleted()
+        except Exception:
+            return False
+
     def wall():
-        # carry holds the live buffers (the donating step may have
-        # consumed the originals during the device-timing attempt)
+        # the donating step consumes its inputs, so if it raised MID-call
+        # during the device-timing attempt, carry["s"] references deleted
+        # buffers and the retry would die on an unrelated deleted-buffer
+        # error (ADVICE round 5) — the state is synthetic, so rebuild it
+        if any(_deleted(leaf) for leaf in jax.tree.leaves(carry["s"])):
+            p2 = paddle.parameters.create(topo).as_dict()
+            carry["s"] = (p2, opt.init(p2, specs), topo.init_states())
         res = profiler.benchmark(one, carry["s"],
                                  name=os.path.basename(args.config))
         return res.seconds_per_step * 1000.0
@@ -441,6 +452,18 @@ def cmd_time(args, parsed) -> int:
 
         log.warning("--job=time device timing unavailable (%s); "
                     "wall-clock two-point used", why)
+    # the benchmark result joins the structured metrics stream (same
+    # schema as bench.py rows; JSONL sink via --metrics_jsonl)
+    from paddle_tpu import metrics as metrics_mod
+
+    reg = metrics_mod.get_registry()
+    if reg.active:
+        reg.emit({
+            "metric": "trainer_time_ms_per_batch",
+            "value": round(ms, 3), "unit": "ms", "run": "time",
+            "config": os.path.basename(args.config),
+            "batch_size": batch_size, "timing": how,
+        }, kind="bench")
     print(f"TrainerBenchmark {args.config}: {ms:.3f} ms/batch "
           f"(batch_size={batch_size}, {how})")
     return 0
@@ -568,6 +591,11 @@ def main(argv=None) -> int:
                 f"unrecognized arguments: {' '.join(leftover)}")
     from paddle_tpu.trainer.config_parser import parse_config
 
+    # --metrics_jsonl=PATH (a registry flag, not argparse): attach the
+    # JSONL sink so every job mode emits through the telemetry stream
+    from paddle_tpu import metrics as _metrics
+
+    _metrics.configure_from_flags()
     try:
         parsed = parse_config(args.config, args.config_args)
         jobs = {

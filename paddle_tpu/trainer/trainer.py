@@ -10,6 +10,7 @@ step; pass/batch iteration stays in Python exactly as in v2."""
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Callable
 
 import jax
@@ -108,6 +109,8 @@ class SGD:
         self._train_step = None
         self._eval_step = None
         self._compiled_sigs: set = set()
+        self._telemetry = None  # StepTelemetry, bound by train()
+        self._telemetry_costs: dict = {}  # per-signature cost analysis
         self.__gradient_machine__ = self  # v2 attr some user code touches
 
     # -- internal -------------------------------------------------------------
@@ -156,7 +159,8 @@ class SGD:
     def train(self, reader, num_passes: int = 1,
               event_handler: Callable | None = None, feeding=None,
               checkpoint_dir: str | None = None, checkpoint_period: int = 1,
-              resume: bool = True, checkpoint_async: bool = False):
+              resume: bool = True, checkpoint_async: bool = False,
+              metrics_registry=None):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
 
@@ -167,9 +171,30 @@ class SGD:
         ``checkpoint_async`` moves the disk write off the step loop
         (``AsyncCheckpointer``: host snapshot taken synchronously, npz +
         manifest written by a worker thread; the preemption save stays
-        synchronous)."""
+        synchronous).
+
+        Telemetry (see ``paddle_tpu/metrics.py``): one structured record
+        per step — {step, loss, step_ms, examples_per_sec, tokens_per_sec,
+        mfu_pct, hbm_gbps, comm_bytes, metrics} — flows through
+        ``metrics_registry`` (default: the process-global registry, JSONL
+        sink attachable via ``--metrics_jsonl``/``PADDLE_TPU_METRICS_JSONL``
+        or ``metrics.configure``).  Every record also lands in the
+        multihost flight recorder, whose ring buffer is dumped to disk on
+        exception or SIGTERM (``distributed/multihost.py``)."""
+        from paddle_tpu import metrics as metrics_mod
+        from paddle_tpu.distributed import multihost as mh
+        from paddle_tpu.telemetry import StepTelemetry
+
         if event_handler is None:
             event_handler = _default_event_handler
+        metrics_mod.configure_from_flags(metrics_registry)
+        # the cost cache lives on the SGD (same lifetime as _train_step):
+        # a second train() on this trainer hits the jit trace cache, so
+        # re-lowering would yield empty comm captures — reuse instead
+        self._telemetry = StepTelemetry(
+            registry=metrics_registry, run="train",
+            flight=mh.flight_recorder(),
+            cost_cache=self._telemetry_costs)
         prev_debug_nans = jax.config.jax_debug_nans
         if flags.get("debug_nans"):
             # the documented jax nan-checking traps at the originating op;
@@ -188,22 +213,29 @@ class SGD:
             opt_state = self._opt_state
 
         # preemption handling (SURVEY §5/§7.8): on SIGTERM (the TPU-pod
-        # eviction signal) finish the current batch, checkpoint, and exit —
-        # resume picks up from the saved pass
+        # eviction signal) the flight ring is dumped ALWAYS; with a
+        # checkpoint_dir the run additionally finishes the current batch,
+        # checkpoints, and exits — resume picks up from the saved pass.
+        # Without one, the pre-train disposition is re-delivered after
+        # the dump (the process still dies, but the post-mortem exists).
         preempted = {"flag": False}
-        prev_handler = None
-        if checkpoint_dir:
-            import signal
+        prev = {"handler": None, "installed": False}
+        import signal
 
-            def _on_sigterm(signum, frame):
+        def _on_sigterm(signum, frame):
+            mh.flight_recorder().dump(reason="SIGTERM")
+            if checkpoint_dir:
                 preempted["flag"] = True
                 log.info("SIGTERM received: checkpointing at the next "
                          "batch boundary")
+                return
+            mh.chain_signal(signum, frame, prev["handler"])
 
-            try:
-                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
-            except ValueError:  # non-main thread: no handler, no preemption
-                prev_handler = None
+        try:
+            prev["handler"] = signal.signal(signal.SIGTERM, _on_sigterm)
+            prev["installed"] = True
+        except ValueError:  # non-main thread: no handler, no preemption
+            pass
 
         try:
             self._train_loop(reader, num_passes, event_handler, feeder,
@@ -212,10 +244,8 @@ class SGD:
                              checkpoint_async=checkpoint_async)
         finally:
             jax.config.update("jax_debug_nans", prev_debug_nans)
-            if prev_handler is not None:
-                import signal
-
-                signal.signal(signal.SIGTERM, prev_handler)
+            if prev["installed"] and prev["handler"] is not None:
+                signal.signal(signal.SIGTERM, prev["handler"])
 
     def _train_loop(self, reader, num_passes, event_handler, feeder,
                     params, states, opt_state, checkpoint_dir,
@@ -258,6 +288,17 @@ class SGD:
                              feeder, params, states, opt_state,
                              checkpoint_dir, checkpoint_period, preempted,
                              writer)
+        except BaseException as e:
+            # post-mortem: the flight ring (last N step records +
+            # heartbeats) goes to disk so pod hangs/desyncs are
+            # diagnosable after the process is gone; dump() never raises
+            from paddle_tpu.distributed import multihost as mh
+
+            path = mh.flight_recorder().dump(
+                reason=f"{type(e).__name__}: {e}"[:200])
+            if path:
+                log.info("flight recorder dumped to %s", path)
+            raise
         finally:
             if writer is not None:
                 import sys
@@ -295,16 +336,34 @@ class SGD:
                     if len(self._compiled_sigs) > 1:
                         log.info("train step: compiling new feed signature %s", sig)
                 step_key = rng.next_key()
+                telem = self._telemetry
+                if telem is not None and telem.registry.active:
+                    # FLOPs/bytes/comm of THIS signature's program
+                    # (cached; lower() only traces — the live args are
+                    # not read)
+                    step_flops, step_bytes, step_comm = telem.cost_for(
+                        sig, lambda: self._train_step.lower(
+                            params, opt_state, states, feed, step_key))
+                else:
+                    step_flops, step_bytes, step_comm = 0.0, 0.0, {}
                 if self._tap_grads is not None:
                     # same key as the step: the printed d(cost)/d(layer)
                     # corresponds to the exact update being taken
                     tap_grads = self._tap_grads(params, states, feed, step_key)
                 else:
                     tap_grads = None
+                if telem is not None and telem.flight is not None:
+                    # pre-step heartbeat: a hang inside the step leaves
+                    # "begin_batch" as this host's last sign of life
+                    telem.flight.heartbeat("begin_batch",
+                                           step=telem.global_step)
+                t_step0 = _time.perf_counter()
                 with stat.timer("forwardBackward+update"):
                     params, opt_state, states, cost, metrics = self._train_step(
                         params, opt_state, states, feed, step_key
                     )
+                cost_f = float(cost)  # device fence: step really finished
+                step_ms = (_time.perf_counter() - t_step0) * 1e3
                 if self.declared_evaluators:
                     # layer values ride along in the metrics dict from the
                     # SAME forward the update used (fetch_layers) — no
@@ -317,7 +376,6 @@ class SGD:
                 metrics = {k: v for k, v in metrics.items()
                            if not k.startswith("layer:")}
                 event_handler(v2_event.EndForwardBackward(pass_id, batch_id, self))
-                cost_f = float(cost)
                 if not np.isfinite(cost_f) and flags.get("debug_nans"):
                     # ≅ the reference's feenableexcept FP trapping
                     # (TrainerMain.cpp:49): stop at the poisoned batch
@@ -327,6 +385,16 @@ class SGD:
                 metrics_f = {k: float(v) for k, v in metrics.items()}
                 batch_costs.append(cost_f)
                 batch_metrics.append(metrics_f)
+                if telem is not None:
+                    from paddle_tpu.telemetry import tokens_in_feed
+
+                    telem.record_step(
+                        loss=cost_f, step_ms=step_ms,
+                        examples=len(data_batch),
+                        tokens=tokens_in_feed(feed),
+                        flops=step_flops, bytes_accessed=step_bytes,
+                        pass_id=pass_id, batch_id=batch_id,
+                        metrics=metrics_f, comm=step_comm)
                 event_handler(
                     v2_event.EndIteration(pass_id, batch_id, cost_f, metrics_f, self)
                 )

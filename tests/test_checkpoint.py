@@ -212,6 +212,43 @@ def test_trainer_async_checkpoint_and_resume(tmp_path):
         ckpt.load_checkpoint(ckpt.latest_checkpoint(d)[0])[0]["_out.w0"])
 
 
+def test_bf16_params_dtype_roundtrip(tmp_path):
+    """ADVICE round 5 (checkpoint.py:182): params saved bf16/fp8 must come
+    back bf16/fp8 — the npz layer stores them f32, and without the
+    manifest dtype record a resume would silently recompile the train
+    step under an f32 signature."""
+    d = str(tmp_path / "c")
+    params = {
+        "w_bf16": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3),
+        "w_f32": np.arange(4, dtype=np.float32),
+        "w_f16": np.arange(4, dtype=np.float16),  # native: untouched
+    }
+    states = {"bn.mean": jnp.full((3,), 0.5, jnp.bfloat16)}
+    ckpt.save_checkpoint(d, 0, params, states=states)
+    path, manifest = ckpt.latest_checkpoint(d)
+    assert manifest["dtypes"]["params"] == {"w_bf16": "bfloat16"}
+    assert manifest["dtypes"]["states"] == {"bn.mean": "bfloat16"}
+    p2, _, s2, _ = ckpt.load_checkpoint(path)
+    assert str(p2["w_bf16"].dtype) == "bfloat16"
+    assert p2["w_f32"].dtype == np.float32
+    assert p2["w_f16"].dtype == np.float16
+    assert str(s2["bn.mean"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(p2["w_bf16"], np.float32),
+        np.asarray(params["w_bf16"], np.float32))
+
+    # pre-dtype-manifest checkpoints (no "dtypes" key) still load
+    import json as _json
+    mpath = os.path.join(path, ckpt.MANIFEST)
+    m = _json.load(open(mpath))
+    del m["dtypes"]
+    with open(mpath, "w") as f:
+        _json.dump(m, f)
+    # manifest hash doesn't cover itself, so the edit is legal
+    p3, _, _, _ = ckpt.load_checkpoint(path)
+    assert p3["w_bf16"].dtype == np.float32  # legacy behavior preserved
+
+
 def test_bf16_moment_opt_state_roundtrip(tmp_path):
     """npz loses extension dtypes (bfloat16 -> |V2); the checkpoint layer
     stores them f32 and restores the template dtype, so

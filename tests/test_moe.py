@@ -217,7 +217,10 @@ def test_moe_layer_trains():
         return jax.tree.map(lambda w, gw: w - 0.1 * gw, p, g), l
 
     losses = []
-    for _ in range(30):
+    # 60 steps: the init draw differs across jax PRNG streams, and at 30
+    # steps the slowest observed stream sits right on the 0.7 threshold
+    # (0.72 on jax 0.4.37 cpu); convergence, not speed, is the claim
+    for _ in range(60):
         params, l = step(params)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]

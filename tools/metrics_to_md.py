@@ -1,0 +1,143 @@
+"""Summarize a JSONL telemetry stream (the ``paddle_tpu.metrics`` schema)
+into markdown: a per-step table with loss/latency/throughput/MFU, an
+aggregate row, the comm-bytes breakdown, and any bench-kind rows.
+
+The stream is whatever a JSONL sink captured — ``SGD.train`` /
+``trainer/cli.py`` step records (``--metrics_jsonl=PATH`` or
+``metrics.configure(jsonl=...)``) and/or ``python bench.py`` output
+(bench rows flow through the same sink API).  For the BENCHMARKS.md
+reference tables specifically, use ``tools/bench_to_md.py`` on the same
+capture.
+
+Usage: python tools/metrics_to_md.py /path/to/metrics.jsonl [--last N]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return str(v)
+
+
+def load(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                pass  # torn tail line of a live file
+    return records
+
+
+def step_table(steps: list[dict], last: int | None = None) -> None:
+    if last:
+        shown = steps[-last:]
+        if len(shown) < len(steps):
+            print(f"_showing the last {len(shown)} of {len(steps)} steps_\n")
+    else:
+        shown = steps
+    has_tok = any("tokens_per_sec" in r for r in shown)
+    has_hbm = any("hbm_gbps" in r for r in shown)
+    hdr = ["step", "pass", "loss", "step ms", "ex/s"]
+    if has_tok:
+        hdr.append("tok/s")
+    hdr.append("MFU %")
+    if has_hbm:
+        hdr.append("HBM GB/s")
+    print("| " + " | ".join(hdr) + " |")
+    print("|" + "---|" * len(hdr))
+    for r in shown:
+        row = [str(r.get("step", "-")), str(r.get("pass_id", "-")),
+               _fmt(r.get("loss"), 5), _fmt(r.get("step_ms")),
+               _fmt(r.get("examples_per_sec"), 1)]
+        if has_tok:
+            row.append(_fmt(r.get("tokens_per_sec"), 0))
+        row.append(_fmt(r.get("mfu_pct")))
+        if has_hbm:
+            row.append(_fmt(r.get("hbm_gbps")))
+        print("| " + " | ".join(row) + " |")
+
+    n = len(steps)
+    ms = [r["step_ms"] for r in steps if "step_ms" in r]
+    exs = [r["examples_per_sec"] for r in steps if "examples_per_sec" in r]
+    mfu = [r["mfu_pct"] for r in steps if "mfu_pct" in r]
+    print(f"\n**{n} steps** · step ms min/mean/max = "
+          f"{_fmt(min(ms))}/{_fmt(sum(ms) / len(ms))}/{_fmt(max(ms))}"
+          if ms else f"\n**{n} steps**", end="")
+    if exs:
+        print(f" · mean {_fmt(sum(exs) / len(exs), 1)} ex/s", end="")
+    if mfu:
+        print(f" · mean MFU {_fmt(sum(mfu) / len(mfu))}%", end="")
+    print()
+
+
+def comm_table(steps: list[dict]) -> None:
+    comm = None
+    for r in reversed(steps):  # counters are cumulative: latest wins
+        if r.get("comm_bytes"):
+            comm = r["comm_bytes"]
+            break
+    if not comm:
+        return
+    print("\n## Collective traffic (per-step bytes, traced)\n")
+    print("| collective/axis | bytes/step |")
+    print("|---|---|")
+    for key, v in sorted(comm.items(), key=lambda kv: -kv[1]):
+        print(f"| {key} | {v:,.0f} |")
+
+
+def bench_table(rows: list[dict]) -> None:
+    if not rows:
+        return
+    print("\n## Bench rows\n")
+    print("| metric | value | MFU % |")
+    print("|---|---|---|")
+    for r in rows:
+        if "metric" not in r:
+            continue
+        val = f"{r.get('value', '-')} {r.get('unit', '')}".strip()
+        print(f"| {r['metric']} | **{val}** | {r.get('mfu_pct', '-')} |")
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 2
+    last = None
+    if "--last" in argv:
+        i = argv.index("--last")
+        last = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    records = load(argv[0])
+    steps = [r for r in records if r.get("kind") == "step"]
+    bench = [r for r in records
+             if r.get("kind") == "bench" or
+             ("metric" in r and "kind" not in r)]  # pre-schema bench rows
+    print(f"# Telemetry summary — {argv[0]}\n")
+    if steps:
+        by_run: dict[str, list] = {}
+        for r in steps:
+            by_run.setdefault(r.get("run", "train"), []).append(r)
+        for run, rs in by_run.items():
+            print(f"## Steps — run `{run}`\n")
+            step_table(rs, last=last)
+        comm_table(steps)
+    bench_table(bench)
+    if not steps and not bench:
+        print("_no step or bench records found_")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
