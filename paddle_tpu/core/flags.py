@@ -59,6 +59,28 @@ def set(name: str, value: Any) -> None:  # noqa: A001 - mirrors gflags SetComman
     f.value = value
 
 
+def is_set(name: str) -> bool:
+    """True when the flag was explicitly overridden (env var, parse_args
+    or flags.set) rather than resting at its default — lets callers with
+    their own defaults (the trainer CLI) still honor an operator's
+    PADDLE_TPU_* override."""
+    return _REGISTRY[name].value is not None
+
+
+def snapshot_raw() -> dict:
+    """{name: raw override or None} — the exact override state.  Use
+    with :func:`restore_raw` for save/restore: restoring a default
+    through ``flags.set`` would leave the flag marked explicitly set
+    (poisoning :func:`is_set`), while restoring the raw value does not."""
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+def restore_raw(snap: dict) -> None:
+    for n, v in snap.items():
+        if n in _REGISTRY:
+            _REGISTRY[n].value = v
+
+
 def parse_args(argv: list[str]) -> list[str]:
     """Parse ``--name=value`` / ``--name value`` style args; returns leftovers."""
     rest: list[str] = []
@@ -125,3 +147,17 @@ define("metrics_jsonl", "", "append one JSON metrics record per train step "
 define("flight_recorder_dir", "", "directory for flight-recorder crash dumps "
                                   "(empty = <tmpdir>/paddle_tpu_flight)")
 define("flight_recorder_size", 256, "step records kept in the flight ring")
+# input pipeline & overlapped step loop (reader/prefetch.py, SGD.train)
+# 0 (synchronous) by default for the v2 API, matching sync_period=1: an
+# unmodified train() call must not move the user's reader onto a worker
+# thread behind their back.  The trainer CLI and bench default to the
+# overlapped configuration (--prefetch=2 --sync_period=8).
+define("prefetch_depth", 0, "device-resident feeds the input pipeline stages "
+                            "ahead of the step loop (0 = synchronous feed)")
+define("sync_period", 1, "fence device costs every N steps; 1 = exact v2 "
+                         "per-batch events, larger defers EndIteration into "
+                         "bursts so the host never blocks on the device "
+                         "mid-window")
+define("batch_remainder", "error", "partial-batch policy for mesh sharding: "
+                                   "error | drop | pad (see mesh."
+                                   "apply_remainder)")

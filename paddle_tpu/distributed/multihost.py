@@ -212,12 +212,18 @@ class FlightRecorder:
         with self._lock:
             self._records.append(dict(rec))
 
-    def heartbeat(self, tag: str = "alive", step: int | None = None) -> None:
+    def heartbeat(self, tag: str = "alive", step: int | None = None,
+                  **extra) -> None:
+        """``extra`` (e.g. pass_id/batch_id) rides along in the heartbeat
+        — under deferred fencing the step counter only advances at fence
+        time, so the dispatch position must be stamped explicitly for the
+        dump to pin a hang to the right batch."""
         import time
 
         hb = {"ts": time.time(), "tag": tag}
         if step is not None:
             hb["step"] = step
+        hb.update(extra)
         with self._lock:
             self._heartbeats.append(hb)
 

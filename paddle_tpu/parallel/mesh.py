@@ -91,9 +91,28 @@ class MeshContext:
         axes = [a if a in present else None for a in spec_axes]
         return NamedSharding(self.mesh, P(*axes))
 
-    def shard_batch(self, tree):
-        """Place a feed pytree with batch-dim sharding (device_put is async)."""
+    def shard_batch(self, tree, remainder: str = "error"):
+        """Place a feed pytree with batch-dim sharding (device_put is async).
+
+        ``remainder`` is the partial-batch policy: "error" (default)
+        keeps the strict divisibility check below; "drop"/"pad" first run
+        :func:`apply_remainder` so the last partial batch of a pass can't
+        kill a multi-device run (opt-in — see that function's caveats).
+        A batch that "drop" empties entirely raises here (a direct caller
+        gets a clear error); the trainer's feed iterators
+        (``reader/prefetch.py``) apply the policy themselves and SKIP
+        such batches instead."""
         dp = self.mesh.shape.get("data", 1)
+        if remainder != "error":
+            # validated (and applied) even at dp=1, so a typo'd policy
+            # fails on the dev box, not first on the pod
+            adjusted = apply_remainder(tree, dp, remainder)
+            enforce(
+                adjusted is not None,
+                f"batch smaller than the mesh data axis ({dp}) was fully "
+                f"dropped by remainder='drop'; nothing left to shard",
+            )
+            tree = adjusted
 
         def place(x):
             if hasattr(x, "ndim") and x.ndim >= 1:
@@ -122,6 +141,53 @@ class MeshContext:
             axes = getattr(spec, "sharding", None) if spec is not None else None
             out[name] = jax.device_put(v, self.param_sharding(axes, v.ndim))
         return out
+
+
+def apply_remainder(tree, multiple: int, policy: str):
+    """Make every batch-dim leaf of a feed pytree divisible by ``multiple``.
+
+    - ``"drop"``: trim to the largest multiple, dropping tail samples.
+      Returns None when nothing is left (callers skip the batch).
+    - ``"pad"``: repeat the LAST sample up to the next multiple.  The
+      padded rows are real duplicated samples, so the final partial batch
+      of a pass weights its last sample slightly more in the loss — fine
+      for throughput runs, wrong for exact-metric evaluation (use "drop"
+      or full batches there).
+    - ``"error"``: return the tree unchanged (shard_batch then enforces).
+
+    Leaves without a leading batch dim (scalars) pass through; ragged
+    pytrees (SequenceBatch data+length) stay consistent because every
+    batch-dim leaf shares the same leading size.
+    """
+    if policy == "error":
+        return tree
+    enforce(policy in ("drop", "pad"),
+            f"unknown batch remainder policy {policy!r} "
+            "(expected 'error', 'drop' or 'pad')")
+    batched = [x for x in jax.tree.leaves(tree)
+               if hasattr(x, "ndim") and x.ndim >= 1]
+    if not batched:
+        return tree
+    b = batched[0].shape[0]
+    r = b % multiple
+    if r == 0:
+        return tree
+    if policy == "drop":
+        keep = b - r
+        if keep == 0:
+            return None
+        return jax.tree.map(
+            lambda x: x[:keep]
+            if hasattr(x, "ndim") and x.ndim >= 1 else x, tree)
+    pad = multiple - r
+
+    def _pad(x):
+        if not (hasattr(x, "ndim") and x.ndim >= 1):
+            return x
+        a = np.asarray(x)
+        return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+
+    return jax.tree.map(_pad, tree)
 
 
 def get_mesh(shape: dict[str, int] | None = None) -> MeshContext:

@@ -12,3 +12,8 @@ from paddle_tpu.reader.decorator import (  # noqa: F401
     xmap_readers,
 )
 from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401
+from paddle_tpu.reader.prefetch import (  # noqa: F401
+    DevicePrefetcher,
+    FeedBatch,
+    SynchronousFeeds,
+)

@@ -10,6 +10,7 @@ import itertools
 import queue
 import random as _random
 import threading
+import time as _time
 from typing import Callable, Iterable
 
 import numpy as np
@@ -63,29 +64,87 @@ def compose(*readers, check_alignment: bool = True):
     return composed
 
 
+class _ProducerError:
+    """Exception raised on a reader/mapper worker thread, carried across
+    the queue so the consumer re-raises it instead of seeing a silently
+    truncated stream.  Shared by buffered/xmap_readers here and by
+    ``reader/prefetch.py``."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _guarded_put(q: queue.Queue, item, cancelled: threading.Event,
+                 timeout: float = 0.05) -> bool:
+    """Bounded put that gives up once ``cancelled`` is set — the shared
+    primitive that keeps producer threads from blocking forever in
+    ``Queue.put`` after the consumer walked away."""
+    while not cancelled.is_set():
+        try:
+            q.put(item, timeout=timeout)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _drain_and_join(q: queue.Queue, threads, cancelled: threading.Event,
+                    deadline_s: float = 2.0) -> None:
+    """Shutdown counterpart of :func:`_guarded_put`: set ``cancelled``,
+    then drain the queue (unblocking producers mid-put) until every
+    thread exits or the deadline passes — a producer blocked outside its
+    put (e.g. on IO) stays a daemon thread rather than hanging us."""
+    cancelled.set()
+    deadline = _time.monotonic() + deadline_s
+    while (any(t.is_alive() for t in threads)
+           and _time.monotonic() < deadline):
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            _time.sleep(0.005)
+    for t in threads:
+        t.join(timeout=max(deadline - _time.monotonic(), 0.0))
+
+
 def buffered(reader, size: int):
     """Double-buffered async read-ahead (≅ DataProvider's
-    getNextBatchFromBuffer:375 background loading)."""
+    getNextBatchFromBuffer:375 background loading).
+
+    A reader exception propagates to the consumer (it used to be
+    swallowed, truncating the dataset as if the epoch had ended), and a
+    consumer that abandons the generator early (``break`` / ``close()``)
+    unblocks the producer instead of leaking a thread stuck in
+    ``Queue.put``."""
 
     end = object()
 
     def buffered_reader():
         q: queue.Queue = queue.Queue(maxsize=size)
+        abandoned = threading.Event()
 
         def producer():
             try:
                 for e in reader():
-                    q.put(e)
+                    if not _guarded_put(q, e, abandoned):
+                        return
+            except BaseException as exc:
+                _guarded_put(q, _ProducerError(exc), abandoned)
             finally:
-                q.put(end)
+                _guarded_put(q, end, abandoned)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            e = q.get()
-            if e is end:
-                break
-            yield e
+        try:
+            while True:
+                e = q.get()
+                if e is end:
+                    break
+                if isinstance(e, _ProducerError):
+                    raise e.exc
+                yield e
+        finally:
+            # consumer done or abandoned: release the producer and drain
+            _drain_and_join(q, [t], abandoned)
 
     return buffered_reader
 
@@ -99,51 +158,87 @@ def firstn(reader, n: int):
 
 def xmap_readers(mapper: Callable, reader, process_num: int, buffer_size: int,
                  order: bool = False):
-    """Parallel map over a reader with worker threads (≅ xmap_readers)."""
+    """Parallel map over a reader with worker threads (≅ xmap_readers).
+
+    A mapper (or source-reader) exception is put on the output queue and
+    re-raised at the consumer.  The seed behavior — the worker dying
+    without its ``end`` sentinel, leaving the consumer spinning forever on
+    ``finished < process_num`` — is exactly the hang this guards against.
+    """
 
     end = object()
 
     def xreader():
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
+        abandoned = threading.Event()
 
         def feeder():
-            for i, e in enumerate(reader()):
-                in_q.put((i, e))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, e in enumerate(reader()):
+                    if not _guarded_put(in_q, (i, e), abandoned):
+                        return
+            except BaseException as exc:
+                # source reader failed: surface it, then still release the
+                # workers so their end sentinels keep the consumer's
+                # bookkeeping intact
+                _guarded_put(out_q, _ProducerError(exc), abandoned)
+            finally:
+                for _ in range(process_num):
+                    _guarded_put(in_q, end, abandoned)
 
         def worker():
-            while True:
-                item = in_q.get()
-                if item is end:
-                    out_q.put(end)
-                    break
-                i, e = item
-                out_q.put((i, mapper(e)))
+            try:
+                while True:
+                    try:
+                        # timed get: when the consumer abandons early the
+                        # feeder's end sentinels never arrive (its puts
+                        # cancel), so workers must notice and exit rather
+                        # than block in in_q.get() forever
+                        item = in_q.get(timeout=0.05)
+                    except queue.Empty:
+                        if abandoned.is_set():
+                            return
+                        continue
+                    if item is end:
+                        break
+                    i, e = item
+                    if not _guarded_put(out_q, (i, mapper(e)), abandoned):
+                        return
+            except BaseException as exc:
+                _guarded_put(out_q, _ProducerError(exc), abandoned)
+            finally:
+                _guarded_put(out_q, end, abandoned)
 
         threading.Thread(target=feeder, daemon=True).start()
-        workers = [threading.Thread(target=worker, daemon=True) for _ in range(process_num)]
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(process_num)]
         for w in workers:
             w.start()
         finished = 0
         pending: dict[int, object] = {}
         next_i = 0
-        while finished < process_num:
-            item = out_q.get()
-            if item is end:
-                finished += 1
-                continue
-            if not order:
-                yield item[1]
-            else:
-                pending[item[0]] = item[1]
-                while next_i in pending:
-                    yield pending.pop(next_i)
-                    next_i += 1
-        if order:
-            for i in sorted(pending):
-                yield pending[i]
+        try:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                if isinstance(item, _ProducerError):
+                    raise item.exc
+                if not order:
+                    yield item[1]
+                else:
+                    pending[item[0]] = item[1]
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
+            if order:
+                for i in sorted(pending):
+                    yield pending[i]
+        finally:
+            # error or early consumer exit: unblock every producer put
+            _drain_and_join(out_q, workers, abandoned)
 
     return xreader
 

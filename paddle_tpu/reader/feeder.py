@@ -13,25 +13,76 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
-from paddle_tpu.core.lod import from_nested_ragged, from_ragged
+from paddle_tpu.core.lod import (
+    SequenceBatch,
+    bucket_length,
+    from_nested_ragged,
+    from_ragged,
+)
 from paddle_tpu.layers.data_type import DataKind, SeqType
 
 
 def _densify_ids(rows, dim: int) -> np.ndarray:
-    """id lists (one per row) -> dense 0/1 [len(rows), dim]."""
-    dense = np.zeros((len(rows), dim), np.float32)
-    for i, ids in enumerate(rows):
-        dense[i, np.asarray(list(ids), dtype=np.int64)] = 1.0
+    """id lists (one per row) -> dense 0/1 [len(rows), dim].
+
+    One flat fancy-indexed scatter instead of a per-row Python loop: the
+    row index of every id comes from ``np.repeat`` over the per-row
+    counts, so the whole batch densifies in a single C-level assignment
+    (duplicate ids within a row collapse to 1, as before)."""
+    rows = [r if hasattr(r, "__len__") else list(r) for r in rows]
+    n = len(rows)
+    dense = np.zeros((n, dim), np.float32)
+    counts = np.fromiter((len(r) for r in rows), np.int64, count=n)
+    total = int(counts.sum())
+    if total:
+        cols = np.fromiter((int(j) for r in rows for j in r), np.int64,
+                           count=total)
+        dense[np.repeat(np.arange(n), counts), cols] = 1.0
     return dense
 
 
 def _densify_pairs(rows, dim: int) -> np.ndarray:
-    """(index, value) pair lists -> dense [len(rows), dim]."""
-    dense = np.zeros((len(rows), dim), np.float32)
-    for i, pairs in enumerate(rows):
-        for j, v in pairs:
-            dense[i, j] = v
+    """(index, value) pair lists -> dense [len(rows), dim].
+
+    One flat fancy-indexed assignment for the whole batch.  Duplicate
+    indices within a row keep the seed's last-write-wins semantic
+    (numpy applies repeated-index assignments in order), so existing
+    sparse_float datasets produce bit-identical feeds.  The per-row
+    ``reshape(len(r), 2)`` keeps the seed's fail-fast on malformed
+    pairs (arity != 2) — a flat scan would silently misalign every
+    later pair instead."""
+    rows = [r if hasattr(r, "__len__") else list(r) for r in rows]
+    n = len(rows)
+    dense = np.zeros((n, dim), np.float32)
+    counts = np.fromiter((len(r) for r in rows), np.int64, count=n)
+    if int(counts.sum()):
+        flat = np.concatenate(
+            [np.asarray(r, dtype=np.float64).reshape(len(r), 2)
+             for r in rows if len(r)], axis=0)
+        cols = flat[:, 0].astype(np.int64)
+        if not np.array_equal(cols, flat[:, 0]):
+            # the seed's per-element indexing raised on j=1.5; a silent
+            # truncation here would train on corrupted features
+            raise IndexError(
+                "sparse_float pair indices must be integers; got a "
+                "fractional index")
+        dense[np.repeat(np.arange(n), counts),
+              cols] = flat[:, 1].astype(np.float32)
     return dense
+
+
+def _stack_uniform(col, dtype) -> np.ndarray | None:
+    """[B] list of equal-length samples -> one stacked [B, T, ...] array
+    via a single conversion, or None when the column is ragged/opaque —
+    the vectorized fast path for sequence columns."""
+    try:
+        first_len = len(col[0])
+        if all(len(s) == first_len for s in col):
+            arr = np.asarray(col, dtype=dtype)
+            return arr if arr.ndim >= 2 else None
+    except (TypeError, ValueError):
+        pass
+    return None
 
 
 class DataFeeder:
@@ -87,6 +138,24 @@ class DataFeeder:
             if kind == DataKind.SPARSE_FLOAT:
                 return jnp.asarray(_densify_pairs(col, itype.dim))
         elif seq == SeqType.SEQUENCE:
+            if kind in (DataKind.INTEGER, DataKind.DENSE):
+                # uniform-length columns (the common synthetic/bucketed
+                # case): ONE stacked conversion + one bucket-pad alloc
+                # instead of a per-row asarray loop through pad_sequences
+                dt = np.int32 if kind == DataKind.INTEGER else np.float32
+                stacked = _stack_uniform(col, dt)
+                if stacked is not None:
+                    t_true = stacked.shape[1]
+                    t = bucket_length(t_true)
+                    if t != t_true:
+                        padded = np.zeros(
+                            (len(col), t) + stacked.shape[2:], dt)
+                        padded[:, :t_true] = stacked
+                        stacked = padded
+                    return SequenceBatch(
+                        data=jnp.asarray(stacked),
+                        length=jnp.asarray(
+                            np.full((len(col),), t_true, np.int32)))
             if kind == DataKind.INTEGER:
                 seqs = [np.asarray(s, dtype=np.int32) for s in col]
             elif kind == DataKind.SPARSE_BINARY:

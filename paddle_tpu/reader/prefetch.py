@@ -1,0 +1,218 @@
+"""Asynchronous device-feed pipeline — the overlap layer between a batch
+reader and the jitted train step.
+
+The synchronous v2 loop runs ``DataFeeder.feed`` (host numpy), device
+placement (``mesh.shard_batch``) and the step strictly in sequence, so the
+TPU idles during every Python-side conversion and the host idles during
+every step.  :class:`DevicePrefetcher` moves the host half onto a worker
+thread and keeps a bounded queue (default depth 2) of device-resident
+sharded feeds staged ahead of the consumer — ``jax.device_put`` is async,
+so by the time the step loop dequeues a feed its transfer has typically
+already overlapped prior compute.
+
+Both iterators here yield :class:`FeedBatch` ``(examples, feed,
+input_wait_ms)`` so the trainer accounts input wait identically for the
+overlapped and the synchronous path:
+
+- ``DevicePrefetcher`` — reader + feeder + shard on a worker thread;
+  ``input_wait_ms`` is the time the consumer spent blocked on the queue
+  (0 when the pipeline keeps up).
+- ``SynchronousFeeds`` — the seed behavior (everything inline on the
+  consumer thread); ``input_wait_ms`` is the full conversion+placement
+  time, all of it on the critical path.
+
+Error/shutdown contract (the parts thread pipelines usually get wrong):
+
+- a reader or feeder exception is re-raised at the consumer's ``next()``,
+  not swallowed into a truncated stream;
+- ``close()`` stops the producer, drains staged feeds and joins the
+  thread — the trainer calls it on preemption (SIGTERM) and on any exit
+  from the pass loop, so the checkpoint path always sees a consistent
+  batch boundary and no thread is left blocked in ``Queue.put``;
+- the consumer waits with a timeout and re-checks producer liveness, so
+  a killed producer can never hang the step loop (and on the main
+  thread the timed wait stays signal-interruptible for SIGTERM).
+
+Partial final batches: ``remainder="drop"`` / ``"pad"`` apply
+:func:`paddle_tpu.parallel.mesh.apply_remainder` before sharding so the
+last batch of a pass cannot break mesh divisibility (see that function
+for the exact semantics); ``"error"`` keeps ``shard_batch``'s strict
+check.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, NamedTuple
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.reader.decorator import (
+    _drain_and_join,
+    _guarded_put,
+    _ProducerError,
+)
+
+
+class FeedBatch(NamedTuple):
+    """One step's worth of input, ready for the jitted step."""
+
+    examples: int          # samples in the ORIGINAL batch (pre drop/pad)
+    feed: dict             # sharded feed pytree
+    input_wait_ms: float   # host time this batch kept the step loop waiting
+
+
+class _EndOfStream:
+    pass
+
+
+_END = _EndOfStream()
+
+
+def _convert(batch, feeder, mesh, remainder: str):
+    """batch -> (examples, sharded feed) | None (batch fully dropped)."""
+    examples = len(batch) if hasattr(batch, "__len__") else 0
+    feed = feeder(batch) if feeder is not None else batch
+    if mesh is not None:
+        if remainder != "error":
+            from paddle_tpu.parallel.mesh import apply_remainder
+
+            feed = apply_remainder(
+                feed, mesh.mesh.shape.get("data", 1), remainder)
+            if feed is None:  # "drop" left nothing: skip the batch
+                return None
+        feed = mesh.shard_batch(feed)
+    return examples, feed
+
+
+class SynchronousFeeds:
+    """The non-overlapped baseline: conversion + placement inline on the
+    consumer thread, with the same FeedBatch/close contract as
+    :class:`DevicePrefetcher` so the trainer has one code path."""
+
+    def __init__(self, reader: Callable, feeder=None, mesh=None,
+                 remainder: str = "error"):
+        self._it = iter(reader())
+        self._feeder = feeder
+        self._mesh = mesh
+        self._remainder = remainder
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> FeedBatch:
+        t0 = time.perf_counter()
+        while True:
+            batch = next(self._it)  # StopIteration ends the pass
+            item = _convert(batch, self._feeder, self._mesh, self._remainder)
+            if item is not None:
+                examples, feed = item
+                return FeedBatch(
+                    examples, feed, (time.perf_counter() - t0) * 1e3)
+
+    def close(self) -> None:
+        self._it = iter(())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class DevicePrefetcher:
+    """Stage up to ``depth`` converted, device-resident feeds ahead of the
+    step loop (see module docstring for the full contract).
+
+    :param reader: zero-arg callable returning an iterator of batches
+        (the ``paddle.batch(...)`` output ``SGD.train`` consumes).
+    :param feeder: optional ``DataFeeder`` (or any batch -> feed callable)
+        run on the worker thread.
+    :param mesh: optional ``MeshContext``; when given, each feed is placed
+        with ``shard_batch`` (async device_put) before being queued.
+    :param depth: bounded queue size — feeds staged ahead of the consumer.
+    :param remainder: "error" (strict divisibility, the default), "drop"
+        (trim the batch to the largest mesh multiple) or "pad" (repeat the
+        last sample up to the next multiple; see ``mesh.apply_remainder``).
+    """
+
+    def __init__(self, reader: Callable, feeder=None, mesh=None,
+                 depth: int = 2, remainder: str = "error"):
+        enforce(depth >= 1, f"prefetch depth must be >= 1, got {depth}")
+        self._reader = reader
+        self._feeder = feeder
+        self._mesh = mesh
+        self._remainder = remainder
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, name="paddle-tpu-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- producer (worker thread) ---------------------------------------------
+    def _produce(self) -> None:
+        try:
+            for batch in self._reader():
+                if self._stop.is_set():
+                    return
+                item = _convert(batch, self._feeder, self._mesh,
+                                self._remainder)
+                if item is None:
+                    continue
+                if not _guarded_put(self._q, item, self._stop):
+                    return
+        except BaseException as e:  # propagate to the consumer, not stderr
+            _guarded_put(self._q, _ProducerError(e), self._stop)
+        finally:
+            _guarded_put(self._q, _END, self._stop)
+
+    # -- consumer ---------------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> FeedBatch:
+        if self._done:
+            raise StopIteration
+        t0 = time.perf_counter()
+        while True:
+            try:
+                # timed wait: stays SIGTERM-interruptible on the main
+                # thread and lets us detect a dead producer
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    self._done = True
+                    raise RuntimeError(
+                        "prefetch producer died without signaling "
+                        "end-of-stream") from None
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        if item is _END:
+            self._done = True
+            self._thread.join(timeout=5.0)
+            raise StopIteration
+        if isinstance(item, _ProducerError):
+            self._done = True
+            self._thread.join(timeout=5.0)
+            raise item.exc
+        examples, feed = item
+        return FeedBatch(examples, feed, wait_ms)
+
+    # -- shutdown ---------------------------------------------------------------
+    def close(self) -> None:
+        """Stop the producer and drain staged feeds.  Idempotent; called by
+        the trainer on preemption and on every pass-loop exit so a consumer
+        that abandons the stream early never strands the worker in
+        ``Queue.put``."""
+        self._done = True
+        _drain_and_join(self._q, [self._thread], self._stop, deadline_s=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -26,7 +26,11 @@ import threading
 import time
 from typing import Any
 
-SCHEMA = "paddle_tpu.metrics/1"
+# /2 added the input-pipeline fields: per-step input_wait_ms (host time
+# the step loop blocked waiting for a feed) and host_stall_ms (amortized
+# device-fence wait per step under deferred fencing) — see
+# reader/prefetch.py and SGD.train(sync_period=)
+SCHEMA = "paddle_tpu.metrics/2"
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)

@@ -101,6 +101,8 @@ class StepTelemetry:
                     pass_id: int | None = None, batch_id: int | None = None,
                     metrics: dict | None = None, step: int | None = None,
                     comm: dict | None = None,
+                    input_wait_ms: float | None = None,
+                    host_stall_ms: float | None = None,
                     extra: dict | None = None) -> dict:
         """Assemble, aggregate, emit and flight-record one step record.
 
@@ -108,6 +110,13 @@ class StepTelemetry:
         ({"op/axis": bytes}, from :meth:`cost_for`); when None, the
         registry's CUMULATIVE comm counters stand in (clearly weaker —
         they sum over every traced program).
+
+        ``input_wait_ms``: host time the step loop spent blocked waiting
+        for this batch's feed (0 when the prefetcher kept up — the
+        host-starvation signal).  ``host_stall_ms``: amortized per-step
+        device-fence wait (the ``sync_period`` readback backlog divided
+        across its window).  Both are schema/2 fields and also land as
+        pull-side gauges.
 
         Returns the stamped record.  Emission is skipped when the
         registry has no sinks; the flight recorder gets the record
@@ -140,6 +149,10 @@ class StepTelemetry:
             rec["flops"] = flops
         if bytes_accessed:
             rec["hbm_gbps"] = round(bytes_accessed / sec / 1e9, 2)
+        if input_wait_ms is not None:
+            rec["input_wait_ms"] = round(float(input_wait_ms), 4)
+        if host_stall_ms is not None:
+            rec["host_stall_ms"] = round(float(host_stall_ms), 4)
         if comm is None:
             comm = reg_mod.comm_snapshot(self.registry)
         if comm:
@@ -161,6 +174,14 @@ class StepTelemetry:
             r.counter("tokens", "tokens consumed").inc(
                 float(tokens), run=self.run)
         r.counter("steps", "optimizer steps taken").inc(1.0, run=self.run)
+        if input_wait_ms is not None:
+            r.gauge("input_wait_ms",
+                    "host ms the step loop waited for input").set(
+                float(input_wait_ms), run=self.run)
+        if host_stall_ms is not None:
+            r.gauge("host_stall_ms",
+                    "amortized device-fence ms per step").set(
+                float(host_stall_ms), run=self.run)
 
         if r.active:
             rec = r.emit(rec)

@@ -53,6 +53,19 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="accepted for v1 compat")
     p.add_argument("--saving_period", type=int, default=1,
                    help="save a pass checkpoint every N passes")
+    # input pipeline / overlap (see README "Input pipeline & overlap"):
+    # unlike the v2 API (whose flag defaults prefetch_depth=0 /
+    # sync_period=1 keep exact v2 semantics), the CLI defaults to the
+    # overlapped configuration — operators get the win out of the box, at
+    # the cost of burst-delivered EndIteration log lines.  Resolution
+    # order (cmd_train): explicit CLI arg > PADDLE_TPU_* flag override >
+    # CLI default (2 / 8).
+    p.add_argument("--prefetch", type=int, default=None,
+                   help="device feeds staged ahead of the step loop "
+                        "(0 = synchronous input; default 2)")
+    p.add_argument("--sync_period", type=int, default=None,
+                   help="fence device costs every N steps (1 = per-batch "
+                        "v2 event cadence; default 8)")
     p.add_argument("--seq_dim", type=int, default=8,
                    help="timesteps per synthetic sequence for --job=time/"
                         "checkgrad feeds (the reference RNN benchmark pads "
@@ -361,8 +374,19 @@ def cmd_train(args, parsed) -> int:
                     trainer.save_parameter_to_tar(f)
                 print(f"saved {path}")
 
+    from paddle_tpu.core import flags as _flags
+
+    def _resolve(arg_val, flag_name, cli_default):
+        if arg_val is not None:  # explicit CLI arg wins
+            return arg_val
+        if _flags.is_set(flag_name):  # then an operator's env/flag override
+            return _flags.get(flag_name)
+        return cli_default
+
     trainer.train(reader=reader, num_passes=args.num_passes,
-                  event_handler=on_event, feeding=feeding)
+                  event_handler=on_event, feeding=feeding,
+                  sync_period=_resolve(args.sync_period, "sync_period", 8),
+                  prefetch=_resolve(args.prefetch, "prefetch_depth", 2))
     return 0
 
 
@@ -579,14 +603,16 @@ def main(argv=None) -> int:
     if extra:
         from paddle_tpu.core import flags as _flags
 
-        before = _flags.all_flags()
+        before = _flags.snapshot_raw()
         leftover = _flags.parse_args(extra)
         # cli.main may be called in-process (demo runners, tests):
-        # restore exactly the flags THIS call changed, on every exit path
-        changed = {k: v for k, v in before.items() if _flags.get(k) != v}
+        # restore exactly the flags THIS call changed, on every exit
+        # path — as RAW override values, so restoring a default doesn't
+        # leave the flag marked explicitly-set (flags.is_set)
+        after = _flags.snapshot_raw()
+        changed = {k: before[k] for k in before if after[k] != before[k]}
         if leftover:
-            for k, v in changed.items():
-                _flags.set(k, v)
+            _flags.restore_raw(changed)
             build_argparser().error(
                 f"unrecognized arguments: {' '.join(leftover)}")
     from paddle_tpu.trainer.config_parser import parse_config
@@ -609,8 +635,7 @@ def main(argv=None) -> int:
         if changed:
             from paddle_tpu.core import flags as _flags
 
-            for k, v in changed.items():
-                _flags.set(k, v)
+            _flags.restore_raw(changed)
 
 
 if __name__ == "__main__":

@@ -160,9 +160,37 @@ class SGD:
               event_handler: Callable | None = None, feeding=None,
               checkpoint_dir: str | None = None, checkpoint_period: int = 1,
               resume: bool = True, checkpoint_async: bool = False,
-              metrics_registry=None):
+              metrics_registry=None, sync_period: int | None = None,
+              prefetch: int | None = None):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
+
+        Input overlap (``reader/prefetch.py``): with ``prefetch`` > 0
+        (default: the ``prefetch_depth`` flag, 0 — synchronous, matching
+        v2; the CLI defaults to ``--prefetch=2``) a worker thread runs
+        ``DataFeeder.feed`` + ``mesh.shard_batch`` ahead of the step loop,
+        keeping up to ``prefetch`` device-resident feeds staged; 0 keeps
+        everything on the consumer thread with no read-ahead (feed
+        conversion then happens when the batch is pulled, just before
+        that batch's ``BeginIteration``).  The training trajectory is
+        bit-identical either way (same batches, same RNG key order) — but
+        with ``prefetch`` > 0 the READER is consumed up to ``prefetch``
+        batches ahead on a worker thread, so a reader that must run in
+        lockstep with the event stream (e.g. curriculum state mutated by
+        the event handler) or is not thread-safe should stay at 0.
+        Host-fed workloads should opt in (``prefetch=2`` or
+        ``PADDLE_TPU_PREFETCH_DEPTH=2``) — it is the structural fix for
+        the device idling through every Python-side feed conversion.
+
+        ``sync_period`` (default: the ``sync_period`` flag, 1) defers the
+        per-step device fence: costs/metrics stay device arrays and are
+        fetched with ONE ``jax.device_get`` every N steps, so the host
+        keeps dispatching while the device computes.  ``EndIteration``
+        events still carry real floats but arrive in bursts of N (and a
+        batch's ``BeginIteration`` may precede the PREVIOUS batch's
+        ``EndIteration``); 1 keeps exact v2 per-batch event cadence.
+        Host-side evaluators / gradient taps force an effective period
+        of 1 — they fence every batch anyway.
 
         ``checkpoint_dir`` enables full crash-safe checkpoints (parameters +
         optimizer slots + states + pass cursor, uuid/sha manifest — see
@@ -185,6 +213,10 @@ class SGD:
         from paddle_tpu.distributed import multihost as mh
         from paddle_tpu.telemetry import StepTelemetry
 
+        if sync_period is None:
+            sync_period = flags.get("sync_period")
+        if prefetch is None:
+            prefetch = flags.get("prefetch_depth")
         if event_handler is None:
             event_handler = _default_event_handler
         metrics_mod.configure_from_flags(metrics_registry)
@@ -241,7 +273,8 @@ class SGD:
             self._train_loop(reader, num_passes, event_handler, feeder,
                              params, states, opt_state, checkpoint_dir,
                              checkpoint_period, resume, preempted,
-                             checkpoint_async=checkpoint_async)
+                             checkpoint_async=checkpoint_async,
+                             sync_period=sync_period, prefetch=prefetch)
         finally:
             jax.config.update("jax_debug_nans", prev_debug_nans)
             if prev["installed"] and prev["handler"] is not None:
@@ -250,7 +283,7 @@ class SGD:
     def _train_loop(self, reader, num_passes, event_handler, feeder,
                     params, states, opt_state, checkpoint_dir,
                     checkpoint_period, resume, preempted,
-                    checkpoint_async=False):
+                    checkpoint_async=False, sync_period=1, prefetch=0):
         from paddle_tpu.trainer import checkpoint as ckpt
 
         writer = ckpt.AsyncCheckpointer() if (
@@ -287,7 +320,8 @@ class SGD:
             self._run_passes(start_pass, num_passes, reader, event_handler,
                              feeder, params, states, opt_state,
                              checkpoint_dir, checkpoint_period, preempted,
-                             writer)
+                             writer, sync_period=sync_period,
+                             prefetch=prefetch)
         except BaseException as e:
             # post-mortem: the flight ring (last N step records +
             # heartbeats) goes to disk so pod hangs/desyncs are
@@ -317,89 +351,214 @@ class SGD:
 
     def _run_passes(self, start_pass, num_passes, reader, event_handler,
                     feeder, params, states, opt_state, checkpoint_dir,
-                    checkpoint_period, preempted, writer):
+                    checkpoint_period, preempted, writer,
+                    sync_period=1, prefetch=0):
+        from paddle_tpu.reader.prefetch import (
+            DevicePrefetcher,
+            SynchronousFeeds,
+        )
+        from paddle_tpu.telemetry import tokens_in_feed
         from paddle_tpu.trainer import checkpoint as ckpt
+
+        sync_period = max(int(sync_period or 1), 1)
+        prefetch = max(int(prefetch or 0), 0)
+        remainder = flags.get("batch_remainder")
+        # host-side evaluators / gradient taps read concrete layer values
+        # every batch, i.e. they fence anyway — deferring the cost fence
+        # around them would only reorder events for zero overlap
+        if sync_period > 1 and (self.declared_evaluators
+                                or self._tap_grads is not None):
+            log.info("sync_period=%d requested, but host-side evaluators/"
+                     "grad taps fence every batch; using sync_period=1",
+                     sync_period)
+            sync_period = 1
+        telem = self._telemetry
 
         for pass_id in range(start_pass, num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             batch_costs, batch_metrics = [], []
             if self.declared_evaluators:
                 self.declared_evaluators.start()
-            for batch_id, data_batch in enumerate(reader()):
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                with stat.timer("feed"):
-                    feed = feeder(data_batch)
-                    feed = self.mesh.shard_batch(feed)
-                sig = _feed_signature(feed)
-                if sig not in self._compiled_sigs:
-                    self._compiled_sigs.add(sig)
-                    if len(self._compiled_sigs) > 1:
-                        log.info("train step: compiling new feed signature %s", sig)
-                step_key = rng.next_key()
-                telem = self._telemetry
-                if telem is not None and telem.registry.active:
-                    # FLOPs/bytes/comm of THIS signature's program
-                    # (cached; lower() only traces — the live args are
-                    # not read)
-                    step_flops, step_bytes, step_comm = telem.cost_for(
-                        sig, lambda: self._train_step.lower(
-                            params, opt_state, states, feed, step_key))
-                else:
-                    step_flops, step_bytes, step_comm = 0.0, 0.0, {}
-                if self._tap_grads is not None:
-                    # same key as the step: the printed d(cost)/d(layer)
-                    # corresponds to the exact update being taken
-                    tap_grads = self._tap_grads(params, states, feed, step_key)
-                else:
-                    tap_grads = None
-                if telem is not None and telem.flight is not None:
-                    # pre-step heartbeat: a hang inside the step leaves
-                    # "begin_batch" as this host's last sign of life
-                    telem.flight.heartbeat("begin_batch",
-                                           step=telem.global_step)
-                t_step0 = _time.perf_counter()
-                with stat.timer("forwardBackward+update"):
-                    params, opt_state, states, cost, metrics = self._train_step(
-                        params, opt_state, states, feed, step_key
-                    )
-                cost_f = float(cost)  # device fence: step really finished
-                step_ms = (_time.perf_counter() - t_step0) * 1e3
-                if self.declared_evaluators:
-                    # layer values ride along in the metrics dict from the
-                    # SAME forward the update used (fetch_layers) — no
-                    # second pass
-                    layer_vals = {
-                        k[len("layer:"):]: v for k, v in metrics.items()
-                        if k.startswith("layer:")}
-                    self.declared_evaluators.eval_batch(
-                        layer_vals, grads=tap_grads, feed=feed)
-                metrics = {k: v for k, v in metrics.items()
-                           if not k.startswith("layer:")}
-                event_handler(v2_event.EndForwardBackward(pass_id, batch_id, self))
-                if not np.isfinite(cost_f) and flags.get("debug_nans"):
-                    # ≅ the reference's feenableexcept FP trapping
-                    # (TrainerMain.cpp:49): stop at the poisoned batch
-                    raise FloatingPointError(
-                        f"non-finite cost {cost_f} at pass {pass_id} "
-                        f"batch {batch_id} (flags.debug_nans)")
-                metrics_f = {k: float(v) for k, v in metrics.items()}
-                batch_costs.append(cost_f)
-                batch_metrics.append(metrics_f)
-                if telem is not None:
-                    from paddle_tpu.telemetry import tokens_in_feed
 
-                    telem.record_step(
-                        loss=cost_f, step_ms=step_ms,
-                        examples=len(data_batch),
-                        tokens=tokens_in_feed(feed),
-                        flops=step_flops, bytes_accessed=step_bytes,
-                        pass_id=pass_id, batch_id=batch_id,
-                        metrics=metrics_f, comm=step_comm)
-                event_handler(
-                    v2_event.EndIteration(pass_id, batch_id, cost_f, metrics_f, self)
-                )
-                if preempted["flag"]:
-                    break
+            # steps dispatched but not yet fenced (device arrays for
+            # cost/metrics); flushed every sync_period steps with ONE
+            # jax.device_get of the whole backlog
+            pending: list[dict] = []
+            window = {"t0": _time.perf_counter()}
+
+            def flush_pending():
+                if not pending:
+                    return
+                t_f0 = _time.perf_counter()
+                host_vals = jax.device_get(
+                    [(p["cost"], p["metrics"]) for p in pending])
+                t_f1 = _time.perf_counter()
+                stall_ms = (t_f1 - t_f0) * 1e3 / len(pending)
+                # per-step time: with per-step fencing, dispatch+fence —
+                # the seed's device-bounded step_ms.  Under deferred
+                # fencing the device time of ONE step is unobservable
+                # (that is the point), so step_ms becomes the amortized
+                # WALL time per step over the window (input wait
+                # included) — the honest throughput number; derived
+                # rates (ex/s, MFU%) then measure achieved throughput
+                # rather than an inflated dispatch-only figure
+                amort_ms = (t_f1 - window["t0"]) * 1e3 / len(pending)
+                for p, (cost_h, metrics_h) in zip(pending, host_vals):
+                    cost_f = float(cost_h)
+                    if not np.isfinite(cost_f) and flags.get("debug_nans"):
+                        # ≅ the reference's feenableexcept FP trapping
+                        # (TrainerMain.cpp:49): stop at the poisoned batch
+                        raise FloatingPointError(
+                            f"non-finite cost {cost_f} at pass "
+                            f"{p['pass_id']} batch {p['batch_id']} "
+                            f"(flags.debug_nans)")
+                    metrics_f = {k: float(v) for k, v in metrics_h.items()}
+                    batch_costs.append(cost_f)
+                    batch_metrics.append(metrics_f)
+                    if telem is not None:
+                        telem.record_step(
+                            loss=cost_f,
+                            step_ms=(p["dispatch_ms"] + stall_ms
+                                     if sync_period == 1 else amort_ms),
+                            examples=p["examples"], tokens=p["tokens"],
+                            flops=p["flops"], bytes_accessed=p["bytes"],
+                            pass_id=p["pass_id"], batch_id=p["batch_id"],
+                            metrics=metrics_f, comm=p["comm"],
+                            input_wait_ms=p["wait_ms"],
+                            host_stall_ms=stall_ms)
+                    event_handler(v2_event.EndIteration(
+                        p["pass_id"], p["batch_id"], cost_f, metrics_f,
+                        self))
+                pending.clear()
+                window["t0"] = _time.perf_counter()
+
+            # the unmodified v2 configuration (no prefetch, strict
+            # remainder) keeps the SEED's exact event order — batch pull,
+            # BeginIteration, THEN feed conversion, so a handler may still
+            # mutate feeder/curriculum state for the CURRENT batch; any
+            # opt-in overlap/remainder feature converts before the event
+            v2_order = prefetch == 0 and remainder == "error"
+            if prefetch > 0:
+                feeds = DevicePrefetcher(reader, feeder, self.mesh,
+                                         depth=prefetch,
+                                         remainder=remainder)
+            elif not v2_order:
+                feeds = SynchronousFeeds(reader, feeder, self.mesh,
+                                         remainder=remainder)
+            else:
+                feeds = None
+                raw_it = iter(reader())
+            try:
+                batch_id = 0
+                feed_it = iter(feeds) if feeds is not None else None
+                while True:
+                    if v2_order:
+                        # input_wait_ms covers the reader pull AND the
+                        # conversion — the same accounting as the feed
+                        # iterators, so the host-starvation signal doesn't
+                        # change meaning with the knobs
+                        t_feed0 = _time.perf_counter()
+                        try:
+                            data_batch = next(raw_it)
+                        except StopIteration:
+                            break
+                        event_handler(v2_event.BeginIteration(pass_id,
+                                                              batch_id))
+                        with stat.timer("feed"):
+                            feed = feeder(data_batch)
+                            feed = self.mesh.shard_batch(feed)
+                        wait_ms = (_time.perf_counter() - t_feed0) * 1e3
+                        examples = len(data_batch)
+                    else:
+                        with stat.timer("feed"):
+                            try:
+                                examples, feed, wait_ms = next(feed_it)
+                            except StopIteration:
+                                break
+                        event_handler(v2_event.BeginIteration(pass_id,
+                                                              batch_id))
+                    sig = _feed_signature(feed)
+                    if sig not in self._compiled_sigs:
+                        self._compiled_sigs.add(sig)
+                        if len(self._compiled_sigs) > 1:
+                            log.info("train step: compiling new feed "
+                                     "signature %s", sig)
+                    step_key = rng.next_key()
+                    if telem is not None and telem.registry.active:
+                        # FLOPs/bytes/comm of THIS signature's program
+                        # (cached; lower() only traces — the live args are
+                        # not read)
+                        step_flops, step_bytes, step_comm = telem.cost_for(
+                            sig, lambda: self._train_step.lower(
+                                params, opt_state, states, feed, step_key))
+                    else:
+                        step_flops, step_bytes, step_comm = 0.0, 0.0, {}
+                    if self._tap_grads is not None:
+                        # same key as the step: the printed d(cost)/d(layer)
+                        # corresponds to the exact update being taken
+                        tap_grads = self._tap_grads(params, states, feed,
+                                                    step_key)
+                    else:
+                        tap_grads = None
+                    if telem is not None and telem.flight is not None:
+                        # pre-step heartbeat: a hang inside the step leaves
+                        # "begin_batch" as this host's last sign of life.
+                        # pass/batch ids are stamped explicitly — under
+                        # deferred fencing global_step lags dispatch by up
+                        # to sync_period-1 steps (it advances at fence
+                        # time), so step alone would misattribute a hang
+                        telem.flight.heartbeat("begin_batch",
+                                               step=telem.global_step,
+                                               pass_id=pass_id,
+                                               batch_id=batch_id)
+                    t_step0 = _time.perf_counter()
+                    with stat.timer("forwardBackward+update"):
+                        params, opt_state, states, cost, metrics = \
+                            self._train_step(params, opt_state, states,
+                                             feed, step_key)
+                    if self.declared_evaluators or tap_grads is not None:
+                        # host-side evaluators read device values right
+                        # below, which would absorb the device wait
+                        # OUTSIDE both timers; fence here (a readback,
+                        # the only fence the tunnel honors) so step_ms
+                        # stays device-bounded exactly like the seed's
+                        # float(cost)
+                        jax.device_get(cost)
+                    dispatch_ms = (_time.perf_counter() - t_step0) * 1e3
+                    if self.declared_evaluators:
+                        # layer values ride along in the metrics dict from
+                        # the SAME forward the update used (fetch_layers) —
+                        # no second pass
+                        layer_vals = {
+                            k[len("layer:"):]: v for k, v in metrics.items()
+                            if k.startswith("layer:")}
+                        self.declared_evaluators.eval_batch(
+                            layer_vals, grads=tap_grads, feed=feed)
+                    metrics = {k: v for k, v in metrics.items()
+                               if not k.startswith("layer:")}
+                    event_handler(v2_event.EndForwardBackward(
+                        pass_id, batch_id, self))
+                    pending.append({
+                        "pass_id": pass_id, "batch_id": batch_id,
+                        "cost": cost, "metrics": metrics,
+                        "examples": examples,
+                        "tokens": tokens_in_feed(feed),
+                        "flops": step_flops, "bytes": step_bytes,
+                        "comm": step_comm, "wait_ms": wait_ms,
+                        "dispatch_ms": dispatch_ms,
+                    })
+                    if len(pending) >= sync_period or preempted["flag"]:
+                        flush_pending()
+                    if preempted["flag"]:
+                        break
+                    batch_id += 1
+                flush_pending()  # end-of-pass backlog
+            finally:
+                # preemption-drain / early exit: stop the prefetch worker
+                # and drop staged feeds, so the checkpoint below sits on a
+                # consistent batch boundary and no thread leaks
+                if feeds is not None:
+                    feeds.close()
             # write back for checkpoint/event access
             self.parameters.update_from(params)
             self.states = dict(states)
@@ -481,8 +640,15 @@ class SGD:
             self._tap_grads_eval = build_tap_grads(self.topology, taps,
                                                    is_train=False)
         tap_grads_eval = self._tap_grads_eval
-        for data_batch in reader():
-            feed = self.mesh.shard_batch(feeder(data_batch))
+        from paddle_tpu.reader.prefetch import SynchronousFeeds
+
+        # same partial-batch policy as training, so a non-divisible final
+        # eval batch doesn't kill a multi-device run ("drop" keeps metrics
+        # exact and skips fully-dropped batches; "pad" over-weights the
+        # last sample)
+        for _, feed, _ in SynchronousFeeds(
+                reader, feeder, self.mesh,
+                remainder=flags.get("batch_remainder")):
             values, cost, metrics = self._eval_step(params, states, feed)
             if self.declared_evaluators:
                 grads = None

@@ -49,12 +49,18 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
         shown = steps
     has_tok = any("tokens_per_sec" in r for r in shown)
     has_hbm = any("hbm_gbps" in r for r in shown)
+    has_wait = any("input_wait_ms" in r for r in shown)
+    has_stall = any("host_stall_ms" in r for r in shown)
     hdr = ["step", "pass", "loss", "step ms", "ex/s"]
     if has_tok:
         hdr.append("tok/s")
     hdr.append("MFU %")
     if has_hbm:
         hdr.append("HBM GB/s")
+    if has_wait:
+        hdr.append("in-wait ms")
+    if has_stall:
+        hdr.append("stall ms")
     print("| " + " | ".join(hdr) + " |")
     print("|" + "---|" * len(hdr))
     for r in shown:
@@ -66,6 +72,14 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
         row.append(_fmt(r.get("mfu_pct")))
         if has_hbm:
             row.append(_fmt(r.get("hbm_gbps")))
+        if has_wait:
+            # ⚠ = host-bound step: input wait exceeds 20% of step time,
+            # i.e. the device idled for the feed — raise prefetch depth
+            # or move preprocessing into the reader
+            row.append(_fmt(r.get("input_wait_ms"))
+                       + (" ⚠" if _host_bound(r) else ""))
+        if has_stall:
+            row.append(_fmt(r.get("host_stall_ms")))
         print("| " + " | ".join(row) + " |")
 
     n = len(steps)
@@ -80,6 +94,21 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
     if mfu:
         print(f" · mean MFU {_fmt(sum(mfu) / len(mfu))}%", end="")
     print()
+    bound = [r for r in steps if _host_bound(r)]
+    if bound:
+        waits = [r["input_wait_ms"] for r in bound]
+        ids = ", ".join(str(r.get("step", "?")) for r in bound[:12])
+        more = f" (+{len(bound) - 12} more)" if len(bound) > 12 else ""
+        print(f"\n**⚠ {len(bound)}/{n} steps host-bound** (input wait > "
+              f"20% of step time): steps {ids}{more} · worst wait "
+              f"{_fmt(max(waits))} ms — the input pipeline is starving "
+              f"the device; raise --prefetch or vectorize the reader.")
+
+
+def _host_bound(r: dict) -> bool:
+    """input wait exceeding 20% of step time = the device idled on input."""
+    wait, ms = r.get("input_wait_ms"), r.get("step_ms")
+    return bool(wait and ms and wait > 0.2 * ms)
 
 
 def comm_table(steps: list[dict]) -> None:
