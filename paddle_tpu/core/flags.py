@@ -161,3 +161,29 @@ define("sync_period", 1, "fence device costs every N steps; 1 = exact v2 "
 define("batch_remainder", "error", "partial-batch policy for mesh sharding: "
                                    "error | drop | pad (see mesh."
                                    "apply_remainder)")
+# fault tolerance (paddle_tpu/resilience/): the numeric guard, the run
+# supervisor's restart budget, mid-pass checkpoint cadence, the chaos
+# harness and the multihost heartbeat watchdog
+define("nan_policy", "none", "non-finite-loss policy: none (die, the v2 "
+                             "behavior) | skip (drop the poisoned update) | "
+                             "rollback (restore the last checkpoint + "
+                             "reduced-LR rescue window)")
+define("guard_max_consecutive", 8, "consecutive non-finite batches before "
+                                   "the guard gives up (FloatingPointError)")
+define("guard_rescue_batches", 8, "batches trained at reduced step size "
+                                  "after a rollback")
+define("guard_rescue_scale", 0.1, "step-size factor inside the rescue window")
+define("max_restarts", 0, "worker faults the trainer-CLI supervisor absorbs "
+                          "by restart-and-resume (0 = no supervisor)")
+define("checkpoint_batch_period", 0, "also checkpoint every N batches "
+                                     "mid-pass (0 = per-pass only); the "
+                                     "manifest cursor lets resume replay "
+                                     "from the exact batch boundary")
+define("chaos", "", "deterministic fault-injection schedule, e.g. "
+                    "'reader_error@3,nan@5,sigterm@7' (see "
+                    "resilience/chaos.py; TESTING ONLY)")
+define("chaos_seed", 0, "seed for the chaos schedule's injectors")
+define("heartbeat_stale_s", 0.0, "multihost watchdog: dump the flight ring "
+                                 "and fail fast when this host's train-loop "
+                                 "heartbeat goes stale for this many "
+                                 "seconds (0 = watchdog off)")

@@ -1,9 +1,12 @@
 """Dataset cache/helpers — successor of ``python/paddle/v2/dataset/common.py``
-(DATA_HOME cache dir, md5 check, cluster_files_split)."""
+(DATA_HOME cache dir, md5-verified ``download``, cluster_files_split)."""
 
 from __future__ import annotations
 
+import hashlib
 import os
+import shutil
+import uuid
 
 import numpy as np
 
@@ -16,6 +19,80 @@ def data_path(*parts: str) -> str:
 
 def have_file(*parts: str) -> bool:
     return os.path.exists(data_path(*parts))
+
+
+def md5file(path: str) -> str:
+    """md5 of a file's contents (streamed) — the reference's integrity
+    check for dataset archives (``v2/dataset/common.py:md5file``)."""
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None, retry=None,
+             timeout: float = 60.0) -> str:
+    """Fetch ``url`` into ``DATA_HOME/module_name/`` and return the local
+    path (≅ the reference's ``common.download(url, module_name, md5sum)``).
+
+    A cached file whose md5 matches is returned without touching the
+    network; a cached mismatch (torn earlier download) is discarded and
+    re-fetched.  The fetch runs under ``retry`` (default: a 3-attempt
+    deterministic-backoff :class:`~paddle_tpu.resilience.policy
+    .RetryPolicy` over OSError/URLError) and downloads to a ``.part``
+    file renamed into place only after the checksum verifies, so readers
+    via :func:`data_path` never observe a partial artifact.  A checksum
+    mismatch counts as a failed attempt (a torn transfer is its common
+    cause) and raises ``IOError`` once the attempts are spent.
+    ``timeout`` bounds each connect/read so a stalled server surfaces as
+    a retryable fault instead of hanging the policy forever.
+    """
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.core import logger as log
+    from paddle_tpu.resilience.policy import RetryPolicy
+
+    dirname = data_path(module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(
+        dirname, save_name if save_name else os.path.basename(
+            url.split("?", 1)[0]) or "download")
+    if os.path.exists(filename):
+        if md5sum is None or md5file(filename) == md5sum:
+            return filename
+        log.warning("cached %s fails its md5 check; re-downloading",
+                    filename)
+        os.remove(filename)
+    if retry is None:
+        retry = RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                            max_delay_s=5.0,
+                            retry_on=(OSError, urllib.error.URLError),
+                            scope="download")
+
+    def fetch():
+        # unique per attempt/process: concurrent downloaders of the same
+        # artifact must not interleave writes or delete each other's
+        # in-flight tmp (the winning os.replace is atomic either way)
+        tmp = f"{filename}.part-{uuid.uuid4().hex[:8]}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as out:
+                shutil.copyfileobj(r, out)
+            if md5sum is not None:
+                got = md5file(tmp)
+                if got != md5sum:
+                    raise IOError(f"md5 mismatch for {url}: expected "
+                                  f"{md5sum}, got {got}")
+            os.replace(tmp, filename)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return filename
+
+    return retry.call(fetch)
 
 
 def synthetic_rng(name: str, split: str) -> np.random.Generator:

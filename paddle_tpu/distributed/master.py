@@ -17,12 +17,80 @@ import time
 
 class MasterClient:
     """Blocking line-protocol client; one socket per client (trainers keep
-    one for their whole life — tasks re-dispatch on disconnect anyway)."""
+    one for their whole life — tasks re-dispatch on disconnect anyway).
 
-    def __init__(self, addr: tuple[str, int], timeout: float = 30.0):
-        self._sock = socket.create_connection(addr, timeout=timeout)
+    Transient socket faults no longer kill the trainer: every
+    request/response transaction runs under a bounded-backoff
+    :class:`~paddle_tpu.resilience.policy.RetryPolicy` that tears the
+    socket down and redials (≅ the reference Go client's redial loop in
+    ``go/master/client.go``).  This is what makes ``task_failed``
+    re-queues survive a master restart — the FAIL lands on the recovered
+    master (snapshot-restored queue) after reconnect, exactly like the
+    reference's re-queue-on-timeout semantics.  Requests are safe to
+    replay: GET re-dispatches (the half-delivered task re-queues via the
+    master's lease timeout), FIN/FAIL on an unknown task are rejected,
+    not double-counted.  SET is the exception — the master appends every
+    payload with a fresh task id, so replaying a SET whose OK was lost
+    would queue the whole dataset twice; ``set_dataset`` therefore
+    retries only the (re)connect, never the exchange itself.
+    """
+
+    def __init__(self, addr: tuple[str, int], timeout: float = 30.0,
+                 retry=None):
+        from paddle_tpu.resilience.policy import RetryPolicy
+
+        self._addr = (addr[0], addr[1])
+        self._timeout = timeout
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=5, base_delay_s=0.05, max_delay_s=1.0,
+            retry_on=(OSError,), scope="master")
+        self._sock: socket.socket | None = None
+        self._buf = b""
+        self._retry.call(self._connect_once)
+
+    # -- connection lifecycle --------------------------------------------------
+    def _connect_once(self) -> None:
+        self._teardown()
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b""
+
+    def _teardown(self) -> None:
+        sock, self._sock = self._sock, None
+        self._buf = b""
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _transact(self, exchange, replay: bool = True):
+        """Run one request/response ``exchange`` against a live socket,
+        reconnecting (with the policy's backoff) on any socket fault.  A
+        failed exchange tears the connection down so the retry starts
+        clean — a half-written request is never resumed mid-stream.
+        ``replay=False`` (non-idempotent requests: SET) still retries
+        the dial, but runs the exchange at most once — a fault after
+        bytes hit the wire propagates rather than risk double-apply."""
+        def attempt():
+            if self._sock is None:
+                self._connect_once()
+            try:
+                return exchange()
+            except OSError:
+                self._teardown()
+                raise
+
+        if replay:
+            return self._retry.call(attempt)
+        if self._sock is None:
+            self._retry.call(self._connect_once)
+        try:
+            return exchange()
+        except OSError:
+            self._teardown()
+            raise
 
     def _send(self, line: str) -> None:
         self._sock.sendall(line.encode() + b"\n")
@@ -37,8 +105,11 @@ class MasterClient:
         return line.decode()
 
     def _call(self, line: str) -> str:
-        self._send(line)
-        return self._recv_line()
+        def exchange():
+            self._send(line)
+            return self._recv_line()
+
+        return self._transact(exchange)
 
     def ping(self) -> bool:
         return self._call("PING") == "PONG"
@@ -49,12 +120,16 @@ class MasterClient:
         for p in payloads:
             if "\n" in p:
                 raise ValueError("task payloads must be single-line")
-        self._send(f"SET {len(payloads)}")
-        for p in payloads:
-            self._send(p)
-        resp = self._recv_line()
-        assert resp.startswith("OK"), resp
-        return int(resp.split()[1])
+
+        def exchange():
+            self._send(f"SET {len(payloads)}")
+            for p in payloads:
+                self._send(p)
+            resp = self._recv_line()
+            assert resp.startswith("OK"), resp
+            return int(resp.split()[1])
+
+        return self._transact(exchange, replay=False)
 
     def get_task(self) -> tuple[int, int, str] | None | str:
         """Returns (id, epoch, payload), "WAIT" (queue busy, retry), or
@@ -83,12 +158,15 @@ class MasterClient:
 
     def stop_server(self) -> None:
         try:
-            self._call("STOP")
-        except (ConnectionError, OSError):
+            # no retry: redialing a server we just told to die would only
+            # burn the backoff schedule on ConnectionRefused
+            self._send("STOP")
+            self._recv_line()
+        except (ConnectionError, OSError, AttributeError):
             pass
 
     def close(self) -> None:
-        self._sock.close()
+        self._teardown()
 
 
 class MasterServer:

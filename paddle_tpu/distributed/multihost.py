@@ -296,6 +296,151 @@ def flight_recorder() -> FlightRecorder:
     return _flight
 
 
+class HeartbeatWatchdog:
+    """Fail fast when this host's train loop stops heartbeating.
+
+    A desynced or hung worker on a pod doesn't crash — it parks inside a
+    collective while every healthy host blocks on the barrier with it,
+    burning the whole slice until an external timeout.  The watchdog
+    turns that into a diagnosable local failure: a daemon thread watches
+    the flight recorder's heartbeat stream (``SGD.train`` heartbeats
+    every batch, and marks checkpoint restore, reader fast-forward and
+    checkpoint-save phases so heavy non-stepping work is not mistaken
+    for a hang), and once the newest heartbeat is older than
+    ``stale_after_s`` it dumps the flight ring (reason
+    ``"heartbeat stale"``), bumps the ``heartbeat_stale`` counter, and
+    — unless a custom ``on_stale`` callback is given — interrupts the
+    main thread, so the process dies with a post-mortem instead of
+    hanging the barrier.  A main thread parked inside a native call
+    (the hung collective itself) never processes that interrupt, so
+    after ``hard_exit_after_s`` more seconds of silence the watchdog
+    ``os._exit``\\ s — fail-fast must not depend on the hang being
+    interruptible.  A last heartbeat tagged ``"compiling"`` stretches
+    the threshold to ``compile_grace_s``: first-signature XLA
+    compilation is minutes of legitimate silence.  Armed by
+    ``SGD.train`` when the ``heartbeat_stale_s`` flag is set; usable
+    standalone around any loop that heartbeats.
+
+    The baseline for "stale" before the first heartbeat is
+    :meth:`start` time, so a job that never reaches its first batch
+    (e.g. a peer lost during init) still trips the watchdog.
+    """
+
+    def __init__(self, recorder: FlightRecorder | None = None,
+                 stale_after_s: float = 60.0, poll_s: float | None = None,
+                 on_stale=None, dump_dir: str | None = None,
+                 hard_exit_after_s: float = 15.0,
+                 compile_grace_s: float = 600.0):
+        import threading
+
+        self.recorder = recorder if recorder is not None else flight_recorder()
+        self.stale_after_s = float(stale_after_s)
+        self.poll_s = poll_s if poll_s is not None else max(
+            self.stale_after_s / 4.0, 0.01)
+        self.on_stale = on_stale
+        self.dump_dir = dump_dir
+        self.hard_exit_after_s = float(hard_exit_after_s)
+        self.compile_grace_s = max(float(compile_grace_s),
+                                   self.stale_after_s)
+        self.fired = False
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._started_at: float | None = None
+
+    def _last_beat(self) -> tuple[float, str]:
+        beats = self.recorder.heartbeats
+        if not beats:
+            return self._started_at, ""
+        return beats[-1]["ts"], beats[-1].get("tag", "")
+
+    def _watch(self) -> None:
+        import time
+
+        while not self._stop.wait(self.poll_s):
+            ts, tag = self._last_beat()
+            age = time.time() - ts
+            threshold = (self.compile_grace_s if tag == "compiling"
+                         else self.stale_after_s)
+            if age < threshold:
+                continue
+            self.fired = True
+            from paddle_tpu.core import logger as log
+
+            path = self.recorder.dump(
+                reason=f"heartbeat stale {age:.1f}s "
+                       f"(threshold {threshold:.1f}s)",
+                dump_dir=self.dump_dir)
+            log.error("heartbeat watchdog: host %s silent for %.1fs; "
+                      "flight ring dumped to %s", host_str(), age, path)
+            from paddle_tpu.telemetry import safe_inc
+
+            safe_inc("heartbeat_stale",
+                     "watchdog-detected heartbeat stalls")
+            if self.on_stale is not None:
+                try:
+                    self.on_stale(age, path)
+                except Exception:
+                    log.exception("heartbeat watchdog on_stale callback "
+                                  "failed")
+            else:
+                import _thread
+
+                # KeyboardInterrupt in the main thread: unwinds the
+                # train loop (dumping again is a harmless no-op) and
+                # kills the process instead of hanging the pod barrier
+                _thread.interrupt_main()
+                # ... but a main thread parked inside a native call (the
+                # hung collective itself) never processes the interrupt;
+                # if nothing calls stop() within the grace window, the
+                # hang is real and unrecoverable — exit hard.  os._exit
+                # skips atexit/finally by design: those may themselves
+                # block on the dead collective
+                if not self._stop.wait(self.hard_exit_after_s):
+                    import os as _os
+
+                    log.error("heartbeat watchdog: interrupt not "
+                              "processed within %.1fs; hard-exiting",
+                              self.hard_exit_after_s)
+                    _os._exit(17)
+            return
+
+    def start(self) -> "HeartbeatWatchdog":
+        import threading
+        import time
+
+        if self._thread is not None:
+            return self
+        self._started_at = time.time()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, name="paddle-tpu-heartbeat-watchdog",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def host_str() -> str:
+    try:
+        from paddle_tpu.telemetry import host_index
+
+        return str(host_index())
+    except Exception:
+        return "?"
+
+
 def chain_signal(signum, frame, prev) -> None:
     """Invoke a signal's pre-install disposition after our handler ran:
     call a Python ``prev`` handler; keep SIG_IGN ignored; for SIG_DFL —

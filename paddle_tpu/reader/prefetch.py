@@ -70,6 +70,43 @@ class _EndOfStream:
 _END = _EndOfStream()
 
 
+def skip_feed_batches(reader, skip: int, replicas: int = 1,
+                      remainder: str = "error", heartbeat=None):
+    """Fast-forward a batch reader past its first ``skip`` *yieldable*
+    batches — the mid-pass-resume cursor replay (``SGD.train`` restores a
+    ``(pass, batch)`` checkpoint cursor and must re-enter the pass at the
+    exact batch boundary).
+
+    Skipped batches are counted the way the trainer counts them: a batch
+    that ``remainder="drop"`` would discard entirely (fewer samples than
+    the mesh's ``replicas``) never reached the step loop, so it does not
+    count against ``skip`` — the cursor stays aligned with the original
+    run no matter the partial-batch policy.  Skipping does no feed
+    conversion, no device placement and consumes no RNG keys; the cost of
+    a resume is one pull per already-applied batch.  ``heartbeat``
+    (optional, called with the skipped-batch index) keeps a staleness
+    watchdog fed through a long fast-forward over a slow reader.
+    """
+    if skip <= 0:
+        return reader
+    m = max(int(replicas), 1)
+
+    def skipped_reader():
+        remaining = skip
+        it = iter(reader())
+        for batch in it:
+            if remaining > 0:
+                n = len(batch) if hasattr(batch, "__len__") else 0
+                if remainder != "drop" or n >= m:
+                    remaining -= 1
+                if heartbeat is not None:
+                    heartbeat(skip - remaining)
+                continue
+            yield batch
+
+    return skipped_reader
+
+
 def _convert(batch, feeder, mesh, remainder: str):
     """batch -> (examples, sharded feed) | None (batch fully dropped)."""
     examples = len(batch) if hasattr(batch, "__len__") else 0

@@ -1,0 +1,31 @@
+"""Fault tolerance — the recovery *logic* the reference kept in its Go
+master/pserver (etcd leases, task re-queue on trainer death, periodic
+snapshot-and-recover, ``go/pserver/service.go`` / ``go/master``), rebuilt
+for the TPU-native trainer where the trainer process itself is the state
+holder:
+
+- :mod:`policy` — :class:`RetryPolicy`: bounded attempts, exponential
+  backoff with deterministic jitter, per-exception-class filters.  Shared
+  by dataset downloads, ``MasterClient`` reconnects and checkpoint I/O.
+- :mod:`guard` — :class:`NumericGuard`: non-finite loss handling inside
+  ``SGD.train`` (skip the poisoned batch, or roll back to the last
+  checkpoint with a reduced-LR rescue window).
+- :mod:`supervisor` — :class:`Supervisor`: restart-budgeted wrapper
+  around a train callable; restores the newest valid checkpoint (falling
+  back past corrupt ones) and resumes mid-pass bit-identically.
+- :mod:`chaos` — deterministic fault injectors (raise-at-step-k,
+  NaN-at-step-k, simulated SIGTERM, corrupt-checkpoint writer) driven by
+  a seeded schedule, so every recovery path is exercised in tests rather
+  than hoped about.
+"""
+
+from paddle_tpu.resilience.chaos import (  # noqa: F401
+    ChaosError,
+    ChaosSchedule,
+    corrupt_newest_checkpoint,
+    flaky,
+    nan_poison_batch,
+)
+from paddle_tpu.resilience.guard import NumericGuard  # noqa: F401
+from paddle_tpu.resilience.policy import RetryPolicy  # noqa: F401
+from paddle_tpu.resilience.supervisor import Supervisor  # noqa: F401

@@ -1,0 +1,216 @@
+"""Deterministic fault injection — chaos you can replay.
+
+The reference validated its fault tolerance by actually killing trainers
+and pservers in cluster tests; a unit suite needs the same coverage
+without the cluster, so every injector here is a pure function of a
+seeded schedule: the same spec + seed faults the same batch of the same
+pass every run, which is what lets ``tests/test_resilience.py`` assert
+*bit-identical* recovery trajectories.
+
+A :class:`ChaosSchedule` is parsed from a spec string (the trainer CLI's
+``--chaos`` flag uses the same syntax)::
+
+    reader_error@3          raise ChaosError pulling batch 3
+    nan@5                   poison every float of batch 5 with NaN
+    step_error@4            raise ChaosError at BeginIteration 4
+    step_error@4:always     ... on every restart, not just the first
+    sigterm@7               deliver SIGTERM to this process at step 7
+
+Batch/step indices are 0-based and cumulative over the schedule object's
+lifetime (they keep counting across passes), so a fault lands at one
+globally unique point.  Faults fire ONCE by default — a supervisor
+restart replays past the fault cleanly — unless marked ``:always``
+(restart-budget-exhaustion testing).  Every fired fault bumps the
+``faults_injected`` telemetry counter (labeled by kind) and tags the
+flight recorder, so an injected fault is distinguishable from a real one
+in the post-mortem.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+
+import numpy as np
+
+from paddle_tpu.core import logger as log
+
+
+class ChaosError(RuntimeError):
+    """The injected worker fault (distinguishable from real errors)."""
+
+
+class _Fault:
+    __slots__ = ("kind", "step", "always", "fired")
+
+    def __init__(self, kind: str, step: int, always: bool = False):
+        self.kind = kind
+        self.step = step
+        self.always = always
+        self.fired = False
+
+
+def nan_poison_batch(batch):
+    """Replace every float array/scalar of a batch's samples with NaN —
+    the poisoned feed yields a non-finite cost through the real forward
+    pass, exercising the NumericGuard path end to end."""
+    def poison_value(v):
+        if isinstance(v, np.ndarray) and np.issubdtype(v.dtype, np.floating):
+            return np.full_like(v, np.nan)
+        if isinstance(v, float):
+            return float("nan")
+        return v
+
+    out = []
+    for sample in batch:
+        if isinstance(sample, (tuple, list)):
+            out.append(type(sample)(poison_value(v) for v in sample))
+        else:
+            out.append(poison_value(sample))
+    return out
+
+
+class ChaosSchedule:
+    """Parsed fault schedule + the wrappers that arm it.
+
+    ``wrap_reader`` arms ``reader_error``/``nan`` faults on the batch
+    stream; ``wrap_event_handler`` arms ``step_error``/``sigterm`` on the
+    event stream (``BeginIteration`` marks the step about to run).  One
+    schedule object carries its fired-state across supervisor restarts —
+    reuse the SAME instance for every attempt so once-faults stay once.
+    """
+
+    KINDS = ("reader_error", "nan", "step_error", "sigterm")
+
+    def __init__(self, spec: str = "", seed: int = 0, registry=None,
+                 flight=None):
+        self.seed = seed
+        self._registry = registry
+        self._flight = flight
+        self._batches = 0   # batches pulled through wrap_reader, ever
+        self._steps = 0     # BeginIteration events seen, ever
+        self.faults: list[_Fault] = []
+        for part in (p.strip() for p in spec.split(",") if p.strip()):
+            always = part.endswith(":always")
+            if always:
+                part = part[: -len(":always")]
+            kind, _, at = part.partition("@")
+            if kind not in self.KINDS:
+                raise ValueError(
+                    f"unknown chaos fault {kind!r} (expected one of "
+                    f"{self.KINDS})")
+            self.faults.append(_Fault(kind, int(at), always))
+
+    def reset_counters(self) -> None:
+        """Re-base the batch/step indexes to 0 for a new supervisor
+        attempt WITHOUT clearing fired-state: once-faults stay fired
+        (replay passes them cleanly), while ``:always`` faults re-fire
+        at the same per-attempt position — call this at the top of each
+        attempt when testing restart-budget exhaustion."""
+        self._batches = 0
+        self._steps = 0
+
+    # -- internals -------------------------------------------------------------
+    def _due(self, kind: str, index: int) -> _Fault | None:
+        for f in self.faults:
+            if f.kind == kind and f.step == index and (f.always or
+                                                       not f.fired):
+                return f
+        return None
+
+    def _fire(self, fault: _Fault, where: str) -> None:
+        fault.fired = True
+        log.warning("chaos: injecting %s at %s", fault.kind, where)
+        from paddle_tpu.telemetry import safe_inc
+
+        safe_inc("faults_injected", "chaos faults fired",
+                 registry=self._registry, kind=fault.kind)
+        try:
+            flight = self._flight
+            if flight is None:
+                from paddle_tpu.distributed import multihost as mh
+
+                flight = mh.flight_recorder()
+            flight.heartbeat(f"chaos:{fault.kind}", **{"at": where})
+        except Exception:
+            pass  # accounting never blocks the injection itself
+
+    # -- wrappers --------------------------------------------------------------
+    def wrap_reader(self, reader):
+        """Arm reader_error/nan faults on a batch reader (the
+        ``paddle.batch(...)`` output ``SGD.train`` consumes)."""
+        def wrapped():
+            for batch in reader():
+                i = self._batches
+                self._batches += 1
+                f = self._due("reader_error", i)
+                if f is not None:
+                    self._fire(f, f"reader batch {i}")
+                    raise ChaosError(f"injected reader fault at batch {i}")
+                f = self._due("nan", i)
+                if f is not None:
+                    self._fire(f, f"reader batch {i}")
+                    batch = nan_poison_batch(batch)
+                yield batch
+
+        return wrapped
+
+    def wrap_event_handler(self, handler=None):
+        """Arm step_error/sigterm faults on the trainer event stream."""
+        from paddle_tpu.trainer import event as v2_event
+
+        def wrapped(e):
+            if isinstance(e, v2_event.BeginIteration):
+                i = self._steps
+                self._steps += 1
+                f = self._due("sigterm", i)
+                if f is not None:
+                    self._fire(f, f"step {i}")
+                    os.kill(os.getpid(), _signal.SIGTERM)
+                f = self._due("step_error", i)
+                if f is not None:
+                    self._fire(f, f"step {i}")
+                    raise ChaosError(f"injected worker fault at step {i}")
+            if handler is not None:
+                handler(e)
+
+        return wrapped
+
+
+def corrupt_newest_checkpoint(ckpt_dir: str, seed: int = 0,
+                              registry=None) -> str:
+    """Append seeded garbage to the newest checkpoint's payload so its
+    manifest sha256 no longer matches — the corrupt-checkpoint writer
+    recovery tests use to prove ``latest_checkpoint`` falls back past it.
+    Returns the corrupted payload path."""
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    entries = ckpt.checkpoint_entries(ckpt_dir)
+    if not entries:
+        raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    newest = entries[-1]
+    target = os.path.join(newest, "params.npz")
+    rnd = np.random.default_rng(seed)
+    with open(target, "ab") as f:
+        f.write(rnd.integers(0, 256, size=64, dtype=np.uint8).tobytes())
+    log.warning("chaos: corrupted checkpoint payload %s", target)
+    from paddle_tpu.telemetry import safe_inc
+
+    safe_inc("faults_injected", "chaos faults fired", registry=registry,
+             kind="corrupt_ckpt")
+    return target
+
+
+def flaky(fn, fail_times: int = 2, exc=ConnectionError):
+    """A callable that raises ``exc`` for its first ``fail_times`` calls,
+    then delegates to ``fn`` — the canonical transient fault for
+    RetryPolicy tests and flaky-download simulation."""
+    state = {"n": 0}
+
+    def wrapped(*args, **kwargs):
+        if state["n"] < fail_times:
+            state["n"] += 1
+            raise exc(f"injected transient fault {state['n']}/{fail_times}")
+        return fn(*args, **kwargs)
+
+    return wrapped

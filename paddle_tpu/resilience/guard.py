@@ -1,0 +1,156 @@
+"""Numeric-health guard for the train loop.
+
+The reference trapped FP faults process-wide (``feenableexcept``,
+``TrainerMain.cpp:49``) — detection with no recovery: the run died.  The
+guard turns a non-finite loss into a *policy*:
+
+- ``nan_policy="skip"``: discard the poisoned update (the pre-step
+  parameter/optimizer/state snapshot is restored), count it, tag the
+  flight recorder and keep training.  The batch's RNG key stays
+  consumed, so a later kill-and-resume replays the same trajectory.
+- ``nan_policy="rollback"``: restore the newest valid checkpoint
+  (parameters, optimizer slots, layer states AND the RNG stream), then
+  train a rescue window of ``rescue_batches`` batches at
+  ``rescue_scale``x the effective step size before returning to full
+  speed.  Falls back to skip when no checkpoint exists yet.
+
+Escalation: ``max_consecutive`` non-finite batches in a row raise
+``FloatingPointError`` — a model whose every batch is NaN is dead, and
+skipping forever would hide it.
+
+The guard needs the PRE-step state to undo an update (the jitted step
+donates its input buffers), so ``SGD.train`` keeps one device-side copy
+of (params, opt_state, states) per batch while a policy is active, and
+forces ``sync_period=1`` — the non-finite check must fence every batch
+or later steps would be dispatched on poisoned parameters.  Both costs
+are the price of the safety net and only paid when it's armed.
+
+The rescue window scales the applied delta, not the optimizer's
+internal ``lr`` constant: ``p' = p_prev + scale * (p_new - p_prev)``.
+For every optimizer here the update delta is linear in the learning
+rate while slot updates (momentum, Adam moments) are lr-independent, so
+delta scaling IS learning-rate scaling — without recompiling the step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import logger as log
+
+POLICIES = ("none", "skip", "rollback")
+
+
+class NumericGuard:
+    """Per-run non-finite-loss state machine driven by ``SGD.train``.
+
+    The trainer calls, per batch: :meth:`snapshot` before the step,
+    then either :meth:`handle_nonfinite` (restoring the returned state)
+    or :meth:`after_finite_step` (which applies the rescue-window
+    scaling and resets the consecutive-fault counter).
+    """
+
+    def __init__(self, policy: str = "skip", max_consecutive: int = 8,
+                 rescue_batches: int = 8, rescue_scale: float = 0.1,
+                 registry=None, flight=None, run: str = "train"):
+        if policy not in ("skip", "rollback"):
+            raise ValueError(
+                f"nan_policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.max_consecutive = max(int(max_consecutive), 1)
+        self.rescue_batches = max(int(rescue_batches), 0)
+        self.rescue_scale = float(rescue_scale)
+        self.run = run
+        self._flight = flight
+        if registry is None:
+            from paddle_tpu.telemetry import get_default_registry
+
+            registry = get_default_registry()
+        self.registry = registry
+        self._consecutive = 0
+        self._rescue_left = 0
+        self._blend = jax.jit(
+            lambda old, new, s: jax.tree.map(
+                lambda o, n: o + s * (n - o), old, new))
+
+    # -- trainer hooks ---------------------------------------------------------
+    def snapshot(self, params, opt_state, states):
+        """Device-side copies of the step inputs, taken BEFORE dispatch —
+        the donating step deletes the originals, so these copies are the
+        only way back."""
+        copy = lambda t: jax.tree.map(jnp.copy, t)  # noqa: E731
+        return copy(params), copy(opt_state), copy(states)
+
+    def handle_nonfinite(self, cost: float, pass_id: int, batch_id: int,
+                         prev, restore_fn=None):
+        """Apply the policy to one non-finite batch.  ``prev`` is the
+        :meth:`snapshot` tuple; ``restore_fn`` (rollback only) loads the
+        newest valid checkpoint and returns (params, opt_state, states)
+        or None.  Returns the state tuple to continue training from."""
+        self._consecutive += 1
+        if self._consecutive >= self.max_consecutive:
+            raise FloatingPointError(
+                f"non-finite cost {cost} for {self._consecutive} "
+                f"consecutive batches (pass {pass_id} batch {batch_id}) — "
+                f"nan_policy={self.policy!r} gave up")
+        restored = None
+        action = self.policy
+        if self.policy == "rollback" and restore_fn is not None:
+            restored = restore_fn()
+        if restored is None:
+            # no checkpoint yet (or skip policy): undo just this update
+            if action == "rollback":
+                log.warning("nan_policy=rollback: no valid checkpoint to "
+                            "restore; skipping the batch instead")
+                action = "skip"
+            restored = prev
+        if action == "rollback" and self.rescue_batches:
+            self._rescue_left = self.rescue_batches
+        self._count(action, cost, pass_id, batch_id)
+        return restored
+
+    def after_finite_step(self, prev_params, new_params):
+        """Called after every finite batch: applies the rescue-window
+        step-size reduction (while active) and resets the consecutive-
+        fault counter.  Returns the params to carry forward."""
+        self._consecutive = 0
+        if self._rescue_left <= 0:
+            return new_params
+        self._rescue_left -= 1
+        return self._blend(prev_params, new_params,
+                           jnp.float32(self.rescue_scale))
+
+    @property
+    def in_rescue(self) -> bool:
+        return self._rescue_left > 0
+
+    # -- accounting ------------------------------------------------------------
+    def _count(self, action: str, cost: float, pass_id: int,
+               batch_id: int) -> None:
+        r = self.registry
+        if action == "skip":
+            r.counter("batches_skipped",
+                      "non-finite batches skipped by the guard").inc(
+                1.0, run=self.run)
+        else:
+            r.counter("rollbacks",
+                      "checkpoint rollbacks taken by the guard").inc(
+                1.0, run=self.run)
+        log.warning("numeric guard: non-finite cost %s at pass %d batch %d "
+                    "-> %s", cost, pass_id, batch_id, action)
+        if r.active:
+            r.emit({"kind": "fault", "run": self.run,
+                    "fault": f"nan_{action}", "pass_id": pass_id,
+                    "batch_id": batch_id, "loss": float(cost)})
+        flight = self._flight
+        if flight is None:
+            try:
+                from paddle_tpu.distributed import multihost as mh
+
+                flight = mh.flight_recorder()
+            except Exception:
+                flight = None
+        if flight is not None:
+            flight.heartbeat(f"nan_{action}", pass_id=pass_id,
+                             batch_id=batch_id)

@@ -1,0 +1,110 @@
+"""Bounded-retry policy with exponential backoff and deterministic jitter.
+
+The reference retried transient cluster faults ad hoc in three places
+(Go master client redial loop, pserver etcd lease re-acquire, dataset
+``download`` loop in ``python/paddle/v2/dataset/common.py``); this is the
+one reusable policy all of those call sites share here — dataset
+downloads (:func:`paddle_tpu.dataset.common.download`), ``MasterClient``
+reconnects (:mod:`paddle_tpu.distributed.master`) and checkpoint I/O
+(:class:`paddle_tpu.trainer.checkpoint.AsyncCheckpointer`).
+
+Jitter is *deterministic*: the delay sequence is a pure function of
+``(seed, scope)``, so a replayed run waits the same milliseconds and a
+fault-injection test can assert the exact schedule.  Each retry bumps the
+``retries`` telemetry counter (labeled by scope) so recoverable flakiness
+is visible, not silent.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable
+
+from paddle_tpu.core import logger as log
+
+
+class RetryPolicy:
+    """Retry ``fn`` up to ``max_attempts`` times on the listed exception
+    classes, sleeping an exponentially growing, deterministically
+    jittered delay between attempts.
+
+    :param max_attempts: total attempts (1 = no retries).
+    :param base_delay_s: delay before the first retry.
+    :param max_delay_s: backoff ceiling (pre-jitter).
+    :param multiplier: exponential growth factor.
+    :param jitter: +- fraction applied to each delay (0 disables).
+    :param seed: jitter seed; same (seed, scope) -> same delay sequence.
+    :param retry_on: exception classes that are retried; anything else
+        propagates immediately (per-exception-class filter).
+    :param scope: label for logs/telemetry ("download", "master", ...).
+    :param sleep: injection point for tests (default ``time.sleep``).
+    """
+
+    def __init__(self, max_attempts: int = 3, base_delay_s: float = 0.1,
+                 max_delay_s: float = 30.0, multiplier: float = 2.0,
+                 jitter: float = 0.25, seed: int = 0,
+                 retry_on: tuple = (OSError, ConnectionError, TimeoutError),
+                 scope: str = "", sleep: Callable[[float], None] | None = None,
+                 registry=None):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.seed = seed
+        self.retry_on = tuple(retry_on)
+        self.scope = scope
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._registry = registry
+
+    # -- the deterministic schedule -------------------------------------------
+    def delays(self) -> list[float]:
+        """The exact sleep sequence a full retry cycle would use — a pure
+        function of the policy's parameters, recomputed fresh per call so
+        every ``call()`` waits the same schedule."""
+        rnd = random.Random(f"{self.seed}/{self.scope}")
+        out, d = [], self.base_delay_s
+        for _ in range(self.max_attempts - 1):
+            j = 1.0 + self.jitter * (2.0 * rnd.random() - 1.0)
+            out.append(max(min(d, self.max_delay_s) * j, 0.0))
+            d *= self.multiplier
+        return out
+
+    def should_retry(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retry_on)
+
+    # -- execution -------------------------------------------------------------
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying per the policy.  The final
+        attempt's exception propagates unwrapped."""
+        delays = self.delays()
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:
+                if attempt >= self.max_attempts - 1 or not self.should_retry(e):
+                    raise
+                self._count_retry()
+                log.warning("%s: attempt %d/%d failed (%s: %s); retrying "
+                            "in %.2fs", self.scope or "retry", attempt + 1,
+                            self.max_attempts, type(e).__name__, e,
+                            delays[attempt])
+                self._sleep(delays[attempt])
+        raise AssertionError("unreachable")
+
+    def wrap(self, fn: Callable) -> Callable:
+        """Decorator form of :meth:`call`."""
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+    def _count_retry(self) -> None:
+        from paddle_tpu.telemetry import safe_inc
+
+        safe_inc("retries", "retried transient faults",
+                 registry=self._registry, scope=self.scope or "unscoped")

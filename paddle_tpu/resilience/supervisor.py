@@ -1,0 +1,134 @@
+"""Restart-budgeted run supervisor.
+
+The reference's Go master re-queued a dead trainer's task and its
+pserver recovered from the newest snapshot; with no parameter server,
+the TPU-native equivalent is a supervisor AROUND the train loop: run the
+training callable, and when it dies of a worker fault, run it again —
+each attempt's ``SGD.train(resume=True)`` restores the newest VALID
+checkpoint (``latest_checkpoint`` already falls back past corrupt ones)
+and resumes from the manifest's exact ``(pass, batch)`` cursor, so the
+retried run replays a bit-identical trajectory.
+
+The budget is the safety valve: ``max_restarts`` faults are absorbed;
+the one after that re-raises the original error (a run that cannot hold
+a trajectory is a bug, not bad luck).  ``fatal`` exception classes are
+never retried — user interrupts and deliberate shutdowns must win
+immediately.
+
+Telemetry (schema /3): ``restarts`` / ``faults_recovered`` counters, a
+``recovery_ms`` gauge (fault-to-retraining wall time) and one
+``kind="recovery"`` record per restart through the registry sinks, so
+``tools/metrics_to_md.py`` can flag any run that did not fly clean.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from paddle_tpu.core import logger as log
+from paddle_tpu.resilience.policy import RetryPolicy
+
+
+class Supervisor:
+    """Run a training callable under a restart budget.
+
+    :param max_restarts: faults absorbed before giving up (0 = none).
+    :param retry_on: exception classes that count as recoverable worker
+        faults.
+    :param fatal: never-retried classes (checked first; BaseExceptions
+        outside ``retry_on`` — KeyboardInterrupt, SystemExit — always
+        propagate).
+    :param backoff: delay policy between restarts (default: short
+        deterministic exponential backoff; its attempt bound is not
+        used — ``max_restarts`` is the budget).
+    :param run: telemetry label.
+
+    ``run(train_fn)`` calls ``train_fn(attempt)`` (or ``train_fn()``
+    when it takes no arguments) until it returns or the budget is
+    spent.  ``train_fn`` must rebuild whatever the fault poisoned —
+    typically: construct a fresh trainer and call ``train(...,
+    checkpoint_dir=..., resume=True)``.
+    """
+
+    def __init__(self, max_restarts: int = 3, retry_on: tuple = (Exception,),
+                 fatal: tuple = (), backoff: RetryPolicy | None = None,
+                 registry=None, run: str = "train"):
+        self.max_restarts = max(int(max_restarts), 0)
+        self.retry_on = tuple(retry_on)
+        self.fatal = tuple(fatal)
+        self.backoff = backoff if backoff is not None else RetryPolicy(
+            max_attempts=self.max_restarts + 1, base_delay_s=0.05,
+            max_delay_s=5.0, scope="supervisor")
+        if registry is None:
+            from paddle_tpu.telemetry import get_default_registry
+
+            registry = get_default_registry()
+        self.registry = registry
+        self.run_label = run
+        self.restarts = 0
+
+    def _retryable(self, exc: BaseException) -> bool:
+        if isinstance(exc, self.fatal):
+            return False
+        return isinstance(exc, self.retry_on)
+
+    def run(self, train_fn: Callable):
+        """Execute ``train_fn`` under the restart budget; returns its
+        result.  After budget exhaustion the ORIGINAL (final) error
+        re-raises unwrapped."""
+        import inspect
+
+        try:
+            takes_attempt = any(
+                p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                           p.VAR_POSITIONAL)
+                for p in inspect.signature(train_fn).parameters.values())
+        except (TypeError, ValueError):
+            takes_attempt = False
+        delays = self.backoff.delays()
+        attempt = 0
+        while True:
+            try:
+                result = train_fn(attempt) if takes_attempt else train_fn()
+            except BaseException as e:
+                if not self._retryable(e) or self.restarts >= self.max_restarts:
+                    if self._retryable(e):
+                        log.error(
+                            "supervisor: restart budget exhausted after %d "
+                            "restarts; re-raising %s", self.restarts,
+                            type(e).__name__)
+                    raise
+                self.restarts += 1
+                t0 = time.perf_counter()
+                delay = delays[min(self.restarts - 1, len(delays) - 1)] \
+                    if delays else 0.0
+                log.warning(
+                    "supervisor: worker fault (%s: %s); restart %d/%d in "
+                    "%.2fs", type(e).__name__, e, self.restarts,
+                    self.max_restarts, delay)
+                self.backoff._sleep(delay)
+                # fault-to-retraining supervisor overhead; the restore
+                # itself is timed by the trainer (checkpoint_restore_ms)
+                recovery_ms = (time.perf_counter() - t0) * 1e3
+                r = self.registry
+                r.counter("restarts", "supervisor restarts taken").inc(
+                    1.0, run=self.run_label)
+                r.gauge("recovery_ms",
+                        "wall ms from fault to retraining").set(
+                    recovery_ms, run=self.run_label)
+                if r.active:
+                    r.emit({"kind": "recovery", "run": self.run_label,
+                            "restart": self.restarts,
+                            "error": f"{type(e).__name__}: {e}"[:200],
+                            "recovery_ms": round(recovery_ms, 2)})
+                attempt += 1
+                continue
+            if self.restarts:
+                self.registry.counter(
+                    "faults_recovered",
+                    "worker faults absorbed by the supervisor").inc(
+                    float(self.restarts), run=self.run_label)
+                log.info("supervisor: run completed after %d restart(s)",
+                         self.restarts)
+            return result

@@ -21,6 +21,7 @@ from paddle_tpu.telemetry.registry import (  # noqa: F401
     get_default_registry,
     host_index,
     record_comm,
+    safe_inc,
 )
 from paddle_tpu.telemetry.sinks import (  # noqa: F401
     JsonlSink,

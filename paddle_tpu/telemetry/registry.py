@@ -29,8 +29,14 @@ from typing import Any
 # /2 added the input-pipeline fields: per-step input_wait_ms (host time
 # the step loop blocked waiting for a feed) and host_stall_ms (amortized
 # device-fence wait per step under deferred fencing) — see
-# reader/prefetch.py and SGD.train(sync_period=)
-SCHEMA = "paddle_tpu.metrics/2"
+# reader/prefetch.py and SGD.train(sync_period=).
+# /3 added the fault-tolerance stream (paddle_tpu/resilience/): counters
+# faults_injected{kind} / faults_recovered / batches_skipped / rollbacks
+# / restarts / retries{scope} / checkpoint_write_failures /
+# heartbeat_stale, gauges recovery_ms / checkpoint_restore_ms, and two
+# record kinds — "fault" (the numeric guard's nan_skip/nan_rollback
+# events) and "recovery" (one per supervisor restart)
+SCHEMA = "paddle_tpu.metrics/3"
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)
@@ -290,6 +296,17 @@ _default = MetricsRegistry()
 
 def get_default_registry() -> MetricsRegistry:
     return _default
+
+
+def safe_inc(name: str, help: str = "", amount: float = 1.0,
+             registry: MetricsRegistry | None = None, **labels) -> None:
+    """Best-effort counter increment for fault/recovery paths: accounting
+    must never break the operation it observes (a retry, an injected
+    fault, a failing checkpoint write), so every failure is swallowed."""
+    try:
+        (registry or _default).counter(name, help).inc(amount, **labels)
+    except Exception:
+        pass
 
 
 # -- comm accounting (called by parallel/collective.py at trace time) ---------

@@ -21,6 +21,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import shutil
 import time
 import uuid as uuid_mod
@@ -32,6 +33,38 @@ from paddle_tpu.core import logger as log
 from paddle_tpu.core.enforce import enforce
 
 MANIFEST = "checkpoint.json"
+
+# end-of-pass checkpoints are "pass-00003"; mid-pass cursor checkpoints
+# (preemption / --checkpoint_batch_period) are "pass-00003-batch-000005",
+# batch = batches COMPLETED in that pass (= the batch index resume
+# replays from)
+_DIR_RE = re.compile(r"^pass-(\d+)(?:-batch-(\d+))?$")
+
+
+def _cursor_key(dirname: str) -> tuple[int, int] | None:
+    """Chronological sort key = the manifest cursor encoded in the name:
+    end-of-pass P resumes at (P+1, 0); mid-pass P after B batches resumes
+    at (P, B) — so mid-pass snapshots of pass P order BEFORE pass P's
+    end-of-pass snapshot and AFTER pass P-1's, regardless of the
+    lexicographic accident that 'pass-00001' < 'pass-00001-batch-...'."""
+    m = _DIR_RE.match(dirname)
+    if not m:
+        return None
+    pass_id = int(m.group(1))
+    if m.group(2) is None:
+        return (pass_id + 1, 0)
+    return (pass_id, int(m.group(2)))
+
+
+def checkpoint_entries(ckpt_dir: str) -> list[str]:
+    """All checkpoint dirs under ``ckpt_dir``, oldest..newest by cursor
+    (not validated — callers needing integrity go through
+    :func:`latest_checkpoint`)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    named = [(k, d) for d in os.listdir(ckpt_dir)
+             if (k := _cursor_key(d)) is not None]
+    return [os.path.join(ckpt_dir, d) for _, d in sorted(named)]
 
 
 def _npz_safe(arr: np.ndarray) -> np.ndarray:
@@ -119,14 +152,27 @@ def _sha256(path: str) -> str:
 
 def save_checkpoint(ckpt_dir: str, pass_id: int, params: dict,
                     opt_state=None, states: dict | None = None,
-                    meta: dict | None = None, keep_last: int = 3) -> str:
+                    meta: dict | None = None, keep_last: int = 3,
+                    batch_id: int | None = None) -> str:
     """Write ``{ckpt_dir}/pass-{pass_id:05d}/`` atomically; returns the path.
+
+    ``batch_id`` (mid-pass cursor checkpoints: preemption saves and
+    ``checkpoint_batch_period``) is the number of batches COMPLETED in
+    ``pass_id``; the directory becomes ``pass-P-batch-B`` and the
+    manifest ``cursor`` tells resume to replay pass P from batch B.
+    Without it the cursor is the following pass's first batch.
 
     Files: ``params.npz`` (name -> array), ``opt_state.npz`` (key-path ->
     array), ``states.npz``, ``checkpoint.json`` manifest with uuid + sha256
     per payload file (written LAST, so a manifest implies complete payload).
     """
-    final = os.path.join(ckpt_dir, f"pass-{pass_id:05d}")
+    if batch_id is None:
+        final = os.path.join(ckpt_dir, f"pass-{pass_id:05d}")
+        cursor = {"pass_id": pass_id + 1, "batch_id": 0}
+    else:
+        final = os.path.join(
+            ckpt_dir, f"pass-{pass_id:05d}-batch-{batch_id:06d}")
+        cursor = {"pass_id": pass_id, "batch_id": batch_id}
     tmp = final + ".tmp-" + uuid_mod.uuid4().hex[:8]
     os.makedirs(tmp, exist_ok=True)
     try:
@@ -142,6 +188,12 @@ def save_checkpoint(ckpt_dir: str, pass_id: int, params: dict,
         manifest = {
             "uuid": uuid_mod.uuid4().hex,
             "pass_id": pass_id,
+            # where resume continues: the first (pass, batch) NOT yet
+            # applied.  Mid-pass cursors let a preempted/killed run
+            # replay from the exact batch boundary (trainer resume
+            # fast-forwards the reader and restores the manifest's RNG
+            # stream, so the trajectory is bit-identical).
+            "cursor": cursor,
             "created": time.time(),
             "files": {
                 f: _sha256(os.path.join(tmp, f))
@@ -173,10 +225,8 @@ def save_checkpoint(ckpt_dir: str, pass_id: int, params: dict,
 def _gc_old(ckpt_dir: str, keep_last: int) -> None:
     if keep_last <= 0:
         return
-    entries = sorted(d for d in os.listdir(ckpt_dir)
-                     if d.startswith("pass-") and ".tmp-" not in d)
-    for d in entries[:-keep_last]:
-        shutil.rmtree(os.path.join(ckpt_dir, d), ignore_errors=True)
+    for path in checkpoint_entries(ckpt_dir)[:-keep_last]:
+        shutil.rmtree(path, ignore_errors=True)
 
 
 def _validate(path: str) -> dict | None:
@@ -192,21 +242,17 @@ def _validate(path: str) -> dict | None:
                 log.warning("checkpoint %s: %s hash mismatch", path, fname)
                 return None
         return manifest
-    except (OSError, ValueError, KeyError) as e:
+    except (OSError, ValueError, KeyError, TypeError, AttributeError) as e:
         log.warning("checkpoint %s unreadable: %s", path, e)
         return None
 
 
 def latest_checkpoint(ckpt_dir: str) -> tuple[str, dict] | None:
-    """Newest VALID checkpoint (corrupt/partial ones are skipped — the Go
-    pserver recovery rule)."""
-    if not os.path.isdir(ckpt_dir):
-        return None
-    entries = sorted((d for d in os.listdir(ckpt_dir)
-                      if d.startswith("pass-") and ".tmp-" not in d),
-                     reverse=True)
-    for d in entries:
-        path = os.path.join(ckpt_dir, d)
+    """Newest VALID checkpoint by cursor order (corrupt/partial ones —
+    manifest missing, unreadable, or any payload sha256 mismatch — are
+    skipped, falling back to the previous one: the Go pserver recovery
+    rule)."""
+    for path in reversed(checkpoint_entries(ckpt_dir)):
         manifest = _validate(path)
         if manifest is not None:
             return path, manifest
@@ -246,18 +292,29 @@ class AsyncCheckpointer:
     write to a single daemon worker; at most one write is in flight — a
     new ``save()`` first joins the previous one, and a failed write
     re-raises from the next ``save()``/``wait()`` so errors are never
-    silently dropped.  Writes stay atomic (tmp dir + rename in
-    ``save_checkpoint``), so a crash mid-write never corrupts the newest
-    valid checkpoint.
+    silently dropped (the failure is also counted in telemetry —
+    ``checkpoint_write_failures`` — the moment it happens, so a run
+    whose next save is far away still shows it).  Transient I/O errors
+    are retried on the worker per ``retry`` (default: a short
+    deterministic :class:`~paddle_tpu.resilience.policy.RetryPolicy`
+    over OSError — a flaky NFS write should not cost the snapshot).
+    Writes stay atomic (tmp dir + rename in ``save_checkpoint``), so a
+    crash mid-write never corrupts the newest valid checkpoint.
     """
 
-    def __init__(self):
+    def __init__(self, retry=None):
+        from paddle_tpu.resilience.policy import RetryPolicy
+
         self._thread = None
         self._err = None
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+            retry_on=(OSError,), scope="checkpoint")
 
     def save(self, ckpt_dir: str, pass_id: int, params: dict,
              opt_state=None, states: dict | None = None,
-             meta: dict | None = None, keep_last: int = 3) -> None:
+             meta: dict | None = None, keep_last: int = 3,
+             batch_id: int | None = None) -> None:
         import threading
 
         self.wait()
@@ -269,11 +326,19 @@ class AsyncCheckpointer:
 
         def run():
             try:
-                save_checkpoint(ckpt_dir, pass_id, params_h, opt_state=opt_h,
-                                states=states_h, meta=meta,
-                                keep_last=keep_last)
+                self._retry.call(
+                    save_checkpoint, ckpt_dir, pass_id, params_h,
+                    opt_state=opt_h, states=states_h, meta=meta,
+                    keep_last=keep_last, batch_id=batch_id)
             except BaseException as e:  # surfaced on next save()/wait()
                 self._err = e
+                from paddle_tpu.telemetry import safe_inc
+
+                safe_inc("checkpoint_write_failures",
+                         "async checkpoint writes that failed")
+                log.warning("async checkpoint write failed (%s: %s); the "
+                            "error re-raises at the next save()/wait()",
+                            type(e).__name__, e)
 
         self._thread = threading.Thread(
             target=run, name=f"ckpt-pass-{pass_id}", daemon=True)

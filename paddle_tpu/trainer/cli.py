@@ -66,6 +66,31 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--sync_period", type=int, default=None,
                    help="fence device costs every N steps (1 = per-batch "
                         "v2 event cadence; default 8)")
+    # fault tolerance (README "Fault tolerance & recovery"): crash-safe
+    # cursor checkpoints, the numeric guard, the restart-budget
+    # supervisor and the deterministic chaos harness
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="crash-safe checkpoint directory (params + "
+                        "optimizer + states + (pass,batch) cursor); "
+                        "resume is automatic")
+    p.add_argument("--checkpoint_period", type=int, default=1,
+                   help="full checkpoint every N passes")
+    p.add_argument("--checkpoint_batch_period", type=int, default=None,
+                   help="also checkpoint every N batches mid-pass "
+                        "(0 = per-pass only)")
+    p.add_argument("--nan_policy", default=None,
+                   choices=["none", "skip", "rollback"],
+                   help="non-finite-loss policy: none (die) | skip "
+                        "(drop the poisoned update) | rollback (restore "
+                        "the last checkpoint + reduced-LR rescue window)")
+    p.add_argument("--max_restarts", type=int, default=None,
+                   help="worker faults absorbed by restart-and-resume "
+                        "(0 = die on the first fault); needs "
+                        "--checkpoint_dir to resume rather than rewind")
+    p.add_argument("--chaos", default=None,
+                   help="deterministic fault-injection schedule, e.g. "
+                        "'reader_error@3,nan@5,sigterm@7' — TESTING ONLY "
+                        "(see resilience/chaos.py)")
     p.add_argument("--seq_dim", type=int, default=8,
                    help="timesteps per synthetic sequence for --job=time/"
                         "checkgrad feeds (the reference RNN benchmark pads "
@@ -383,10 +408,45 @@ def cmd_train(args, parsed) -> int:
             return _flags.get(flag_name)
         return cli_default
 
-    trainer.train(reader=reader, num_passes=args.num_passes,
-                  event_handler=on_event, feeding=feeding,
-                  sync_period=_resolve(args.sync_period, "sync_period", 8),
-                  prefetch=_resolve(args.prefetch, "prefetch_depth", 2))
+    # deterministic chaos harness (TESTING ONLY): one schedule object for
+    # the whole run, so once-faults stay fired across supervisor restarts
+    chaos_spec = _resolve(args.chaos, "chaos", "")
+    handler, train_reader, schedule = on_event, reader, None
+    if chaos_spec:
+        from paddle_tpu.resilience.chaos import ChaosSchedule
+
+        schedule = ChaosSchedule(chaos_spec,
+                                 seed=_flags.get("chaos_seed"))
+        handler = schedule.wrap_event_handler(on_event)
+        train_reader = schedule.wrap_reader(reader)
+
+    def run_train():
+        if schedule is not None:
+            # per-attempt index re-base: fault positions stay aligned
+            # with the attempt's own batch/step stream across restarts
+            # (fired-state persists, so once-faults still fire once;
+            # ':always' faults re-fire at the same per-attempt spot)
+            schedule.reset_counters()
+        trainer.train(
+            reader=train_reader, num_passes=args.num_passes,
+            event_handler=handler, feeding=feeding,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_period=args.checkpoint_period,
+            checkpoint_batch_period=_resolve(
+                args.checkpoint_batch_period, "checkpoint_batch_period", 0),
+            nan_policy=_resolve(args.nan_policy, "nan_policy", "none"),
+            sync_period=_resolve(args.sync_period, "sync_period", 8),
+            prefetch=_resolve(args.prefetch, "prefetch_depth", 2))
+
+    max_restarts = _resolve(args.max_restarts, "max_restarts", 0)
+    if max_restarts > 0:
+        # the run supervisor: worker faults restart the loop; each retry
+        # resumes from the newest valid checkpoint's (pass, batch) cursor
+        from paddle_tpu.resilience.supervisor import Supervisor
+
+        Supervisor(max_restarts=max_restarts).run(run_train)
+    else:
+        run_train()
     return 0
 
 

@@ -161,7 +161,8 @@ class SGD:
               checkpoint_dir: str | None = None, checkpoint_period: int = 1,
               resume: bool = True, checkpoint_async: bool = False,
               metrics_registry=None, sync_period: int | None = None,
-              prefetch: int | None = None):
+              prefetch: int | None = None, nan_policy: str | None = None,
+              checkpoint_batch_period: int | None = None):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
 
@@ -193,13 +194,31 @@ class SGD:
         of 1 — they fence every batch anyway.
 
         ``checkpoint_dir`` enables full crash-safe checkpoints (parameters +
-        optimizer slots + states + pass cursor, uuid/sha manifest — see
-        ``trainer/checkpoint.py``); with ``resume`` the newest valid one is
-        loaded and training continues from the following pass.
+        optimizer slots + states + a ``(pass, batch)`` cursor + the RNG
+        stream, uuid/sha manifest — see ``trainer/checkpoint.py``); with
+        ``resume`` the newest VALID one is loaded (corrupt ones are
+        skipped) and training continues from the cursor — for a mid-pass
+        cursor the reader is fast-forwarded to the exact batch boundary
+        and the restored RNG stream makes the replayed trajectory
+        bit-identical to an uninterrupted run.
+        ``checkpoint_batch_period`` (default: the flag, 0 = off)
+        additionally checkpoints every N batches mid-pass, bounding lost
+        work to N batches instead of a whole pass.
         ``checkpoint_async`` moves the disk write off the step loop
         (``AsyncCheckpointer``: host snapshot taken synchronously, npz +
         manifest written by a worker thread; the preemption save stays
         synchronous).
+
+        ``nan_policy`` (default: the ``nan_policy`` flag, "none") arms
+        the numeric guard (``resilience/guard.py``): "skip" discards a
+        non-finite batch's update and keeps training; "rollback"
+        restores the newest valid checkpoint and re-enters at a reduced
+        step size for a rescue window.  Either policy fences every batch
+        (effective ``sync_period=1``) and keeps a one-batch device-side
+        state snapshot while armed.  With the ``heartbeat_stale_s`` flag
+        set, a watchdog thread dumps the flight ring and fails fast when
+        this host's train-loop heartbeat goes stale — a hung collective
+        becomes a diagnosable crash instead of a silent barrier wait.
 
         Telemetry (see ``paddle_tpu/metrics.py``): one structured record
         per step — {step, loss, step_ms, examples_per_sec, tokens_per_sec,
@@ -217,6 +236,10 @@ class SGD:
             sync_period = flags.get("sync_period")
         if prefetch is None:
             prefetch = flags.get("prefetch_depth")
+        if nan_policy is None:
+            nan_policy = flags.get("nan_policy")
+        if checkpoint_batch_period is None:
+            checkpoint_batch_period = flags.get("checkpoint_batch_period")
         if event_handler is None:
             event_handler = _default_event_handler
         metrics_mod.configure_from_flags(metrics_registry)
@@ -269,59 +292,115 @@ class SGD:
         except ValueError:  # non-main thread: no handler, no preemption
             pass
 
+        # heartbeat-staleness watchdog (multihost hang -> fail-fast dump):
+        # the train loop heartbeats every batch; a stall past the flag's
+        # threshold dumps the flight ring and interrupts the main thread
+        watchdog = None
+        stale_s = float(flags.get("heartbeat_stale_s") or 0.0)
+        if stale_s > 0:
+            watchdog = mh.HeartbeatWatchdog(recorder=mh.flight_recorder(),
+                                            stale_after_s=stale_s)
+            watchdog.start()
+
         try:
             self._train_loop(reader, num_passes, event_handler, feeder,
                              params, states, opt_state, checkpoint_dir,
                              checkpoint_period, resume, preempted,
                              checkpoint_async=checkpoint_async,
-                             sync_period=sync_period, prefetch=prefetch)
+                             sync_period=sync_period, prefetch=prefetch,
+                             nan_policy=nan_policy,
+                             checkpoint_batch_period=checkpoint_batch_period)
         finally:
             jax.config.update("jax_debug_nans", prev_debug_nans)
+            if watchdog is not None:
+                watchdog.stop()
             if prev["installed"] and prev["handler"] is not None:
                 signal.signal(signal.SIGTERM, prev["handler"])
+
+    def _restore_checkpoint_state(self, found, opt_state_template,
+                                  states_fallback):
+        """(path, manifest) -> (params, opt_state, states) replicated,
+        with ``self.parameters`` updated and the RNG stream restored to
+        the manifest's — shared by startup resume and the numeric
+        guard's rollback path.  The restore wall time lands in the
+        ``checkpoint_restore_ms`` gauge (the recovery-time observable)."""
+        from paddle_tpu.distributed import multihost as mh
+        from paddle_tpu.trainer.checkpoint import load_checkpoint
+
+        path, manifest = found
+        t0 = _time.perf_counter()
+        # heartbeat-free phases look like hangs to the staleness
+        # watchdog; mark the restore so a slow load stays a sign of life
+        mh.flight_recorder().heartbeat("restore", path=path)
+        cp, copt, cstates, _ = load_checkpoint(
+            path, opt_state_template=opt_state_template)
+        for name, arr in cp.items():
+            if name in self.parameters:
+                self.parameters[name] = arr
+        params = self.mesh.replicate(self._params_dict())
+        opt_state = (self.mesh.replicate(copt) if copt is not None
+                     else opt_state_template)
+        if cstates:
+            # restore each state at its template dtype (bf16/f8
+            # states were stored f32 by the npz layer)
+            tmpl = self.states
+            states = self.mesh.replicate({
+                k: jax.numpy.asarray(
+                    v, dtype=getattr(tmpl.get(k), "dtype", None))
+                for k, v in cstates.items()})
+        else:
+            states = states_fallback
+        if manifest.get("meta", {}).get("rng") is not None:
+            rng.set_state(np.asarray(manifest["meta"]["rng"],
+                                     dtype=np.uint32))
+        mh.flight_recorder().heartbeat("restored", path=path)
+        if self._telemetry is not None:
+            self._telemetry.registry.gauge(
+                "checkpoint_restore_ms",
+                "wall ms to restore the newest checkpoint").set(
+                (_time.perf_counter() - t0) * 1e3)
+        return params, opt_state, states
 
     def _train_loop(self, reader, num_passes, event_handler, feeder,
                     params, states, opt_state, checkpoint_dir,
                     checkpoint_period, resume, preempted,
-                    checkpoint_async=False, sync_period=1, prefetch=0):
+                    checkpoint_async=False, sync_period=1, prefetch=0,
+                    nan_policy="none", checkpoint_batch_period=0):
         from paddle_tpu.trainer import checkpoint as ckpt
 
         writer = ckpt.AsyncCheckpointer() if (
             checkpoint_async and checkpoint_dir) else None
 
         start_pass = flags.get("start_pass")
+        start_batch = 0
         if checkpoint_dir and resume:
             found = ckpt.latest_checkpoint(checkpoint_dir)
             if found is not None:
                 path, manifest = found
-                cp, copt, cstates, _ = ckpt.load_checkpoint(
-                    path, opt_state_template=opt_state)
-                for name, arr in cp.items():
-                    if name in self.parameters:
-                        self.parameters[name] = arr
-                params = self.mesh.replicate(self._params_dict())
-                if copt is not None:
-                    opt_state = self.mesh.replicate(copt)
-                if cstates:
-                    # restore each state at its template dtype (bf16/f8
-                    # states were stored f32 by the npz layer)
-                    tmpl = self.states
-                    states = self.mesh.replicate({
-                        k: jax.numpy.asarray(
-                            v, dtype=getattr(tmpl.get(k), "dtype", None))
-                        for k, v in cstates.items()})
-                if manifest.get("meta", {}).get("rng") is not None:
-                    rng.set_state(np.asarray(manifest["meta"]["rng"],
-                                             dtype=np.uint32))
-                start_pass = max(start_pass, manifest["pass_id"] + 1)
-                log.info("resumed from %s (pass %d)", path,
-                         manifest["pass_id"])
+                params, opt_state, states = self._restore_checkpoint_state(
+                    found, opt_state, states)
+                cursor = manifest.get("cursor")
+                if cursor is not None:
+                    # resume at the exact batch boundary the manifest
+                    # recorded; an explicitly higher --start_pass wins
+                    # (and starts that pass from its first batch)
+                    if cursor["pass_id"] > start_pass:
+                        start_pass = cursor["pass_id"]
+                        start_batch = int(cursor.get("batch_id", 0))
+                    elif cursor["pass_id"] == start_pass:
+                        start_batch = int(cursor.get("batch_id", 0))
+                else:  # pre-cursor manifests: continue with the next pass
+                    start_pass = max(start_pass, manifest["pass_id"] + 1)
+                log.info("resumed from %s (pass %d, next batch %d)", path,
+                         start_pass, start_batch)
         try:
             self._run_passes(start_pass, num_passes, reader, event_handler,
                              feeder, params, states, opt_state,
                              checkpoint_dir, checkpoint_period, preempted,
                              writer, sync_period=sync_period,
-                             prefetch=prefetch)
+                             prefetch=prefetch, start_batch=start_batch,
+                             nan_policy=nan_policy,
+                             checkpoint_batch_period=checkpoint_batch_period)
         except BaseException as e:
             # post-mortem: the flight ring (last N step records +
             # heartbeats) goes to disk so pod hangs/desyncs are
@@ -352,16 +431,19 @@ class SGD:
     def _run_passes(self, start_pass, num_passes, reader, event_handler,
                     feeder, params, states, opt_state, checkpoint_dir,
                     checkpoint_period, preempted, writer,
-                    sync_period=1, prefetch=0):
+                    sync_period=1, prefetch=0, start_batch=0,
+                    nan_policy="none", checkpoint_batch_period=0):
         from paddle_tpu.reader.prefetch import (
             DevicePrefetcher,
             SynchronousFeeds,
+            skip_feed_batches,
         )
         from paddle_tpu.telemetry import tokens_in_feed
         from paddle_tpu.trainer import checkpoint as ckpt
 
         sync_period = max(int(sync_period or 1), 1)
         prefetch = max(int(prefetch or 0), 0)
+        checkpoint_batch_period = max(int(checkpoint_batch_period or 0), 0)
         remainder = flags.get("batch_remainder")
         # host-side evaluators / gradient taps read concrete layer values
         # every batch, i.e. they fence anyway — deferring the cost fence
@@ -373,6 +455,66 @@ class SGD:
                      sync_period)
             sync_period = 1
         telem = self._telemetry
+        # the staleness watchdog reads the global flight ring, so the
+        # loop must heartbeat even with telemetry inactive (a ring
+        # append — cheap enough to pay unconditionally)
+        from paddle_tpu.distributed import multihost as mh
+
+        flight = telem.flight if (telem is not None and
+                                  telem.flight is not None) \
+            else mh.flight_recorder()
+
+        guard = None
+        if nan_policy and nan_policy != "none":
+            from paddle_tpu.resilience.guard import NumericGuard
+
+            guard = NumericGuard(
+                policy=nan_policy,
+                max_consecutive=flags.get("guard_max_consecutive"),
+                rescue_batches=flags.get("guard_rescue_batches"),
+                rescue_scale=flags.get("guard_rescue_scale"),
+                registry=telem.registry if telem is not None else None,
+                flight=telem.flight if telem is not None else None)
+            if sync_period > 1:
+                # the non-finite check must observe each cost before the
+                # NEXT step is dispatched, or poisoned parameters spread
+                # through the whole deferred window
+                log.info("nan_policy=%r fences every batch; using "
+                         "sync_period=1", nan_policy)
+                sync_period = 1
+
+        def restore_fn_for(opt_template, states_now):
+            """Rollback loader for the guard: newest valid checkpoint ->
+            replicated state tuple, or None when none exists yet."""
+            def restore():
+                found = ckpt.latest_checkpoint(checkpoint_dir)
+                if found is None:
+                    return None
+                return self._restore_checkpoint_state(
+                    found, opt_template, states_now)
+
+            return restore if checkpoint_dir else (lambda: None)
+
+        def cursor_meta(batches_done, extra=None):
+            """Manifest meta for a mid-pass cursor checkpoint: the RNG
+            stream (bit-identical replay) + the reader/prefetch cursor
+            state resume needs to fast-forward to the same boundary."""
+            meta = {
+                "completed_pass": False,
+                "rng": rng.get_state().tolist(),
+                "reader_cursor": {
+                    "batches_consumed": batches_done,
+                    "shard_index": jax.process_index(),
+                    "shard_count": jax.process_count(),
+                },
+                # staged prefetch feeds are read-ahead only — they are
+                # discarded on death and re-derived from the reader on
+                # resume, so "drained" is the only state to record
+                "prefetch": {"depth": prefetch,
+                             "staged_discarded_on_resume": True},
+            }
+            meta.update(extra or {})
+            return meta
 
         for pass_id in range(start_pass, num_passes):
             event_handler(v2_event.BeginPass(pass_id))
@@ -432,6 +574,21 @@ class SGD:
                 pending.clear()
                 window["t0"] = _time.perf_counter()
 
+            # mid-pass resume: fast-forward the reader past the batches
+            # the checkpoint already applied (no feed conversion, no
+            # device placement, no RNG keys consumed — the manifest's
+            # restored stream stays aligned with the replayed batches)
+            skip = start_batch if pass_id == start_pass else 0
+            if skip:
+                log.info("pass %d: fast-forwarding the reader past %d "
+                         "already-applied batches", pass_id, skip)
+                pass_reader = skip_feed_batches(
+                    reader, skip, replicas=self.mesh.num_replicas,
+                    remainder=remainder,
+                    heartbeat=lambda i: flight.heartbeat(
+                        "fast_forward", pass_id=pass_id, batch_id=i))
+            else:
+                pass_reader = reader
             # the unmodified v2 configuration (no prefetch, strict
             # remainder) keeps the SEED's exact event order — batch pull,
             # BeginIteration, THEN feed conversion, so a handler may still
@@ -439,17 +596,41 @@ class SGD:
             # opt-in overlap/remainder feature converts before the event
             v2_order = prefetch == 0 and remainder == "error"
             if prefetch > 0:
-                feeds = DevicePrefetcher(reader, feeder, self.mesh,
+                feeds = DevicePrefetcher(pass_reader, feeder, self.mesh,
                                          depth=prefetch,
                                          remainder=remainder)
             elif not v2_order:
-                feeds = SynchronousFeeds(reader, feeder, self.mesh,
+                feeds = SynchronousFeeds(pass_reader, feeder, self.mesh,
                                          remainder=remainder)
             else:
                 feeds = None
-                raw_it = iter(reader())
+                raw_it = iter(pass_reader())
+            pass_complete = False
+
+            def maybe_cursor_checkpoint():
+                # mid-pass cursor checkpoint: bounds lost work to
+                # checkpoint_batch_period batches; resume replays from
+                # this exact boundary.  The carried arrays already
+                # include every dispatched step, so no fence beyond the
+                # save's own host copy is needed.  Called on BOTH the
+                # finite path and the guard's skip path — a NaN landing
+                # on a period boundary must not stretch the bound to 2N
+                if not (checkpoint_dir and checkpoint_batch_period
+                        and batch_id > skip
+                        and batch_id % checkpoint_batch_period == 0):
+                    return
+                flight.heartbeat("checkpoint", pass_id=pass_id,
+                                 batch_id=batch_id)
+                save = (ckpt.save_checkpoint if writer is None
+                        else writer.save)
+                save(checkpoint_dir, pass_id,
+                     {n: np.asarray(params[n]) for n in params},
+                     opt_state=opt_state, states=dict(states),
+                     batch_id=batch_id,
+                     meta=cursor_meta(batch_id))
+
             try:
-                batch_id = 0
+                batch_id = skip
                 feed_it = iter(feeds) if feeds is not None else None
                 while True:
                     if v2_order:
@@ -461,6 +642,7 @@ class SGD:
                         try:
                             data_batch = next(raw_it)
                         except StopIteration:
+                            pass_complete = True
                             break
                         event_handler(v2_event.BeginIteration(pass_id,
                                                               batch_id))
@@ -474,11 +656,13 @@ class SGD:
                             try:
                                 examples, feed, wait_ms = next(feed_it)
                             except StopIteration:
+                                pass_complete = True
                                 break
                         event_handler(v2_event.BeginIteration(pass_id,
                                                               batch_id))
                     sig = _feed_signature(feed)
-                    if sig not in self._compiled_sigs:
+                    new_sig = sig not in self._compiled_sigs
+                    if new_sig:
                         self._compiled_sigs.add(sig)
                         if len(self._compiled_sigs) > 1:
                             log.info("train step: compiling new feed "
@@ -500,22 +684,54 @@ class SGD:
                                                     step_key)
                     else:
                         tap_grads = None
-                    if telem is not None and telem.flight is not None:
-                        # pre-step heartbeat: a hang inside the step leaves
-                        # "begin_batch" as this host's last sign of life.
-                        # pass/batch ids are stamped explicitly — under
-                        # deferred fencing global_step lags dispatch by up
-                        # to sync_period-1 steps (it advances at fence
-                        # time), so step alone would misattribute a hang
-                        telem.flight.heartbeat("begin_batch",
-                                               step=telem.global_step,
-                                               pass_id=pass_id,
-                                               batch_id=batch_id)
+                    # pre-step heartbeat: a hang inside the step leaves
+                    # "begin_batch" as this host's last sign of life.
+                    # pass/batch ids are stamped explicitly — under
+                    # deferred fencing global_step lags dispatch by up
+                    # to sync_period-1 steps (it advances at fence
+                    # time), so step alone would misattribute a hang
+                    flight.heartbeat(
+                        "begin_batch",
+                        step=telem.global_step if telem is not None else -1,
+                        pass_id=pass_id, batch_id=batch_id)
+                    if guard is not None:
+                        # the jitted step donates its inputs; these
+                        # copies are the only way to undo the update
+                        prev_snap = guard.snapshot(params, opt_state,
+                                                   states)
+                    if new_sig:
+                        # must be the NEWEST beat when the step call
+                        # below triggers XLA compilation: the staleness
+                        # watchdog grants a "compiling" tag its own
+                        # (long) grace window — compiles are minutes of
+                        # legitimate heartbeat silence
+                        flight.heartbeat("compiling", pass_id=pass_id,
+                                         batch_id=batch_id)
                     t_step0 = _time.perf_counter()
                     with stat.timer("forwardBackward+update"):
                         params, opt_state, states, cost, metrics = \
                             self._train_step(params, opt_state, states,
                                              feed, step_key)
+                    if guard is not None:
+                        cost_now = float(jax.device_get(cost))
+                        if not np.isfinite(cost_now):
+                            params, opt_state, states = \
+                                guard.handle_nonfinite(
+                                    cost_now, pass_id, batch_id, prev_snap,
+                                    restore_fn_for(prev_snap[1],
+                                                   prev_snap[2]))
+                            # the poisoned update never happened: no
+                            # events, no step record — but the batch and
+                            # its RNG key stay consumed, so a later
+                            # kill-and-resume replays this exact skip
+                            batch_id += 1
+                            if preempted["flag"]:
+                                flush_pending()
+                                break
+                            maybe_cursor_checkpoint()
+                            continue
+                        params = guard.after_finite_step(prev_snap[0],
+                                                         params)
                     if self.declared_evaluators or tap_grads is not None:
                         # host-side evaluators read device values right
                         # below, which would absorb the device wait
@@ -547,11 +763,12 @@ class SGD:
                         "comm": step_comm, "wait_ms": wait_ms,
                         "dispatch_ms": dispatch_ms,
                     })
+                    batch_id += 1
                     if len(pending) >= sync_period or preempted["flag"]:
                         flush_pending()
                     if preempted["flag"]:
                         break
-                    batch_id += 1
+                    maybe_cursor_checkpoint()
                 flush_pending()  # end-of-pass backlog
             finally:
                 # preemption-drain / early exit: stop the prefetch worker
@@ -563,13 +780,13 @@ class SGD:
             self.parameters.update_from(params)
             self.states = dict(states)
             self._opt_state = opt_state
-            if preempted["flag"]:
-                # mid-pass eviction: checkpoint the partial pass under ITS
-                # OWN pass number (never clobbering the genuine end-of-
-                # previous-pass snapshot); resume continues with the next
-                # pass, keeping the partial progress — no batch is applied
-                # twice.  No EndPass fires for a partial pass, and the save
-                # ignores checkpoint_period.
+            if preempted["flag"] and not pass_complete:
+                # mid-pass eviction: checkpoint the partial pass with its
+                # (pass, batch) cursor — no EndPass fires, the save
+                # ignores checkpoint_period, and resume replays THIS pass
+                # from the exact batch boundary (bit-identically: the
+                # manifest carries the RNG stream and the reader is
+                # fast-forwarded past the applied batches).
                 if checkpoint_dir:
                     if writer is not None:
                         # eviction save must be durable AND must not be
@@ -580,17 +797,18 @@ class SGD:
                             log.warning("async checkpoint write had "
                                         "failed (%s); writing eviction "
                                         "checkpoint synchronously", e)
+                    flight.heartbeat("checkpoint", pass_id=pass_id,
+                                     batch_id=batch_id)
                     ckpt.save_checkpoint(
                         checkpoint_dir, pass_id,
                         {n: np.asarray(params[n]) for n in params},
                         opt_state=opt_state, states=dict(states),
-                        meta={"preempted": True,
-                              "completed_pass": False,
-                              "rng": rng.get_state().tolist()},
+                        batch_id=batch_id,
+                        meta=cursor_meta(batch_id, {"preempted": True}),
                     )
-                    log.info("preempted in pass %d: partial-pass checkpoint "
-                             "written; resume continues at pass %d",
-                             pass_id, pass_id + 1)
+                    log.info("preempted in pass %d: cursor checkpoint "
+                             "written; resume replays pass %d from "
+                             "batch %d", pass_id, pass_id, batch_id)
                 break
             avg_metrics = _mean_dicts(batch_metrics)
             if self.declared_evaluators:
@@ -601,7 +819,9 @@ class SGD:
                 self.save_parameter_to_tar_path(
                     os.path.join(save_dir, f"pass-{pass_id:05d}.tar")
                 )
-            if checkpoint_dir and (pass_id % max(checkpoint_period, 1) == 0):
+            if checkpoint_dir and (pass_id % max(checkpoint_period, 1) == 0
+                                   or preempted["flag"]):
+                flight.heartbeat("checkpoint", pass_id=pass_id)
                 save = ckpt.save_checkpoint if writer is None else writer.save
                 save(
                     checkpoint_dir, pass_id,
@@ -611,6 +831,10 @@ class SGD:
                           "rng": rng.get_state().tolist()},
                 )
             stat.global_stat.print_all_status()
+            if preempted["flag"]:
+                # SIGTERM landed exactly as the pass finished: the normal
+                # end-of-pass checkpoint above is the resume point
+                break
 
     def test(self, reader, feeding=None) -> v2_event.TestResult:
         """≅ SGD.test: forward-only over a reader of batches.  When the

@@ -113,8 +113,9 @@ def test_trainer_checkpoint_and_resume(tmp_path):
 
 
 def test_sigterm_preemption_checkpoints_and_resumes(tmp_path):
-    """SIGTERM mid-training -> checkpoint at the batch boundary -> a fresh
-    trainer resumes from the saved pass (SURVEY §5 preemption handling)."""
+    """SIGTERM mid-training -> cursor checkpoint at the batch boundary ->
+    a fresh trainer resumes the SAME pass from the next batch (SURVEY §5
+    preemption handling + the resilience mid-pass replay cursor)."""
     import os
     import signal
     import numpy as np
@@ -150,8 +151,12 @@ def test_sigterm_preemption_checkpoints_and_resumes(tmp_path):
     assert found is not None
     saved_pass = found[1]["pass_id"]
     assert saved_pass < 49  # preempted long before the end
+    # mid-pass preemption records a replay cursor into the SAME pass
+    cursor = found[1]["cursor"]
+    assert cursor["pass_id"] == saved_pass and cursor["batch_id"] >= 1
+    assert found[1]["meta"]["preempted"] is True
 
-    # resume continues after the saved pass
+    # resume re-enters the preempted pass at the cursor batch
     passes = []
     trainer2 = build()
     trainer2.train(
@@ -161,7 +166,7 @@ def test_sigterm_preemption_checkpoints_and_resumes(tmp_path):
         num_passes=saved_pass + 3, checkpoint_dir=ckdir,
         event_handler=lambda e: passes.append(e.pass_id)
         if isinstance(e, paddle.event.BeginPass) else None)
-    assert passes and passes[0] == saved_pass + 1
+    assert passes and passes[0] == saved_pass
 
 
 def test_async_checkpointer_writes_and_raises(tmp_path):

@@ -1,0 +1,614 @@
+"""Fault tolerance — every recovery path exercised under deterministic
+chaos injection (resilience/chaos.py), mirroring how the reference proved
+its Go master/pserver recovery (kill-and-restart in client_test.go /
+service_internal_test.go) but without needing a cluster:
+
+- RetryPolicy: bounded attempts, deterministic jitter, class filters;
+- chaos-injected reader fault -> supervisor restart -> bit-identical;
+- NaN-at-step-k: skip policy and rollback policy (reduced-LR rescue);
+- kill (worker fault / SIGTERM) + resume mid-pass == unfaulted run,
+  asserted bit-identically on the final parameters;
+- corrupt-newest-checkpoint fallback;
+- restart-budget exhaustion re-raises the original error;
+- heartbeat-staleness watchdog dumps the flight ring.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags, rng
+from paddle_tpu.layers import api as layer, base, data_type
+from paddle_tpu.metrics import MetricsRegistry
+from paddle_tpu.resilience import (
+    ChaosError,
+    ChaosSchedule,
+    NumericGuard,
+    RetryPolicy,
+    Supervisor,
+    corrupt_newest_checkpoint,
+    flaky,
+)
+from paddle_tpu.trainer import checkpoint as ckpt
+
+
+# -- shared tiny trainer ------------------------------------------------------
+
+def _build():
+    """Deterministic tiny regression trainer (rebuildable mid-test: the
+    supervisor constructs a fresh one per attempt)."""
+    base.reset_name_counters()
+    rng.seed(7)
+    x = layer.data(name="x", type=data_type.dense_vector(4))
+    y = layer.data(name="y", type=data_type.dense_vector(1))
+    fc = layer.fc(input=x, size=1, act=paddle.activation.LinearActivation(),
+                  name="out")
+    cost = layer.mse_cost(input=fc, label=y)
+    params = paddle.parameters.create(paddle.topology.Topology(cost))
+    return paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=0.05))
+
+
+def _reader(n_samples=32):
+    def r():
+        rs = np.random.RandomState(0)
+        w = np.array([1.0, -2.0, 0.5, 3.0])
+        for _ in range(n_samples):
+            x = rs.randn(4).astype(np.float32)
+            yield x, np.array([x @ w], np.float32)
+    return paddle.reader.batch(r, batch_size=8)  # 4 batches per pass
+
+
+def _final_w(trainer):
+    return np.asarray(trainer.parameters["_out.w0"]).copy()
+
+
+@pytest.fixture(scope="module")
+def baseline_w():
+    """Final weights of an unfaulted 2-pass run — the bit-identical
+    target every recovery test compares against."""
+    tr = _build()
+    tr.train(reader=_reader(), num_passes=2)
+    return _final_w(tr)
+
+
+# -- RetryPolicy --------------------------------------------------------------
+
+def test_retry_policy_bounded_attempts_and_filters():
+    slept = []
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.01, sleep=slept.append,
+                    retry_on=(ConnectionError,))
+    assert p.call(flaky(lambda: 7, fail_times=2, exc=ConnectionError)) == 7
+    assert len(slept) == 2  # two retries, bounded
+
+    # attempts exhausted -> the last error propagates unwrapped
+    with pytest.raises(ConnectionError):
+        p.call(flaky(lambda: 7, fail_times=5, exc=ConnectionError))
+
+    # per-exception-class filter: unlisted classes never retry
+    calls = {"n": 0}
+
+    def wrong_class():
+        calls["n"] += 1
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        p.call(wrong_class)
+    assert calls["n"] == 1
+
+
+def test_retry_policy_jitter_is_deterministic():
+    a = RetryPolicy(max_attempts=5, seed=3, scope="x", jitter=0.5)
+    b = RetryPolicy(max_attempts=5, seed=3, scope="x", jitter=0.5)
+    assert a.delays() == b.delays()
+    assert a.delays() == a.delays()  # stable per call, not consumed
+    c = RetryPolicy(max_attempts=5, seed=4, scope="x", jitter=0.5)
+    assert a.delays() != c.delays()  # seed actually reaches the jitter
+    # backoff grows and respects the ceiling
+    d = RetryPolicy(max_attempts=6, base_delay_s=1.0, max_delay_s=4.0,
+                    jitter=0.0).delays()
+    assert d == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+# -- dataset download (satellite) ---------------------------------------------
+
+def test_download_md5_verification(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "cache"))
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"paddle_tpu dataset payload")
+    url = "file://" + str(src)
+    good = common.md5file(str(src))
+
+    got = common.download(url, "unit", md5sum=good)
+    assert got == common.data_path("unit", "blob.bin")
+    assert common.md5file(got) == good
+
+    # cached-and-valid short-circuits (the source may even disappear)
+    src.unlink()
+    assert common.download(url, "unit", md5sum=good) == got
+
+    # a torn cached file is discarded and re-fetched; with the source
+    # gone the re-fetch fails through the (fast) retry policy
+    with open(got, "ab") as f:
+        f.write(b"garbage")
+    fast = RetryPolicy(max_attempts=2, base_delay_s=0.0, sleep=lambda s: 0)
+    with pytest.raises(OSError):
+        common.download(url, "unit", md5sum=good, retry=fast)
+
+
+def test_download_retries_transient_fetch_errors(tmp_path, monkeypatch):
+    import urllib.request
+
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path / "cache"))
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"retry me")
+    url = "file://" + str(src)
+    real = urllib.request.urlopen
+    monkeypatch.setattr(urllib.request, "urlopen",
+                        flaky(real, fail_times=2, exc=ConnectionError))
+    fast = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: 0,
+                       retry_on=(OSError,))
+    got = common.download(url, "unit", md5sum=common.md5file(str(src)),
+                          retry=fast)
+    assert common.md5file(got) == common.md5file(str(src))
+
+
+# -- chaos harness ------------------------------------------------------------
+
+def test_chaos_schedule_parses_and_fires_once():
+    reg = MetricsRegistry()
+    sched = ChaosSchedule("reader_error@1,nan@2", registry=reg)
+
+    def reader():
+        for i in range(4):
+            yield [(np.ones(2, np.float32), 0)]
+
+    wrapped = sched.wrap_reader(reader)
+    out = []
+    with pytest.raises(ChaosError):
+        for b in wrapped():
+            out.append(b)
+    assert len(out) == 1  # batch 0 delivered, batch 1 exploded
+    # second pull-through: the once-fault stays fired; nan@2 (global
+    # index) poisons the next stream's position 2
+    batches = list(wrapped())
+    assert len(batches) == 4
+    assert np.isnan(batches[0][0][0]).all()  # global batch 2 == index 0 here
+    assert not any(np.isnan(b[0][0]).any() for b in batches[1:])
+    assert reg.counter("faults_injected", "").value(kind="reader_error") == 1
+    assert reg.counter("faults_injected", "").value(kind="nan") == 1
+
+    with pytest.raises(ValueError):
+        ChaosSchedule("meteor@3")
+
+
+def test_skip_feed_batches_counts_like_the_trainer():
+    from paddle_tpu.reader.prefetch import skip_feed_batches
+
+    def reader():
+        yield [1] * 8
+        yield [2] * 3   # dropped entirely under remainder="drop", m=8
+        yield [3] * 8
+        yield [4] * 8
+
+    # error-mode: every batch counts
+    got = [b[0] for b in skip_feed_batches(reader, 2)()]
+    assert got == [3, 4]
+    # drop-mode: the undersized batch never reached the step loop, so it
+    # must not count against the cursor
+    got = [b[0] for b in skip_feed_batches(reader, 2, replicas=8,
+                                           remainder="drop")()]
+    assert got == [4]
+    assert skip_feed_batches(reader, 0) is reader
+
+
+# -- numeric guard ------------------------------------------------------------
+
+def test_nan_skip_policy_drops_the_poisoned_update():
+    from paddle_tpu.distributed import multihost as mh
+
+    reg = MetricsRegistry()
+    sched = ChaosSchedule("nan@2", registry=reg)
+    seen = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            seen.append((e.pass_id, e.batch_id))
+
+    tr = _build()
+    tr.train(reader=sched.wrap_reader(_reader()), num_passes=1,
+             nan_policy="skip", event_handler=handler,
+             metrics_registry=reg)
+    w = _final_w(tr)
+    assert np.isfinite(w).all()
+    assert reg.counter("batches_skipped", "").value(run="train") == 1
+    # the skipped batch emitted no EndIteration — it never happened
+    assert (0, 2) not in seen and (0, 3) in seen
+    # flight recorder carries the heartbeat tag for the post-mortem
+    assert any(h["tag"] == "nan_skip" for h in mh.flight_recorder().heartbeats)
+
+
+def test_nan_rollback_restores_checkpoint_with_rescue_window(tmp_path):
+    reg = MetricsRegistry()
+    sched = ChaosSchedule("nan@5", registry=reg)  # pass 1, batch 1
+    d = str(tmp_path / "ck")
+    tr = _build()
+    tr.train(reader=sched.wrap_reader(_reader()), num_passes=2,
+             nan_policy="rollback", checkpoint_dir=d, metrics_registry=reg)
+    assert np.isfinite(_final_w(tr)).all()
+    assert reg.counter("rollbacks", "").value(run="train") == 1
+    assert reg.counter("batches_skipped", "").value(run="train") == 0
+    # rollback without any checkpoint degrades to skip (and says so)
+    reg2 = MetricsRegistry()
+    sched2 = ChaosSchedule("nan@1", registry=reg2)
+    tr2 = _build()
+    tr2.train(reader=sched2.wrap_reader(_reader()), num_passes=1,
+              nan_policy="rollback", metrics_registry=reg2)
+    assert reg2.counter("batches_skipped", "").value(run="train") == 1
+
+
+def test_guard_gives_up_after_max_consecutive():
+    prev = flags.snapshot_raw()
+    flags.set("guard_max_consecutive", 3)
+    try:
+        # every batch is poisoned: skipping forever would hide a dead run
+        sched = ChaosSchedule(
+            ",".join(f"nan@{i}:always" for i in range(8)))
+        tr = _build()
+        with pytest.raises(FloatingPointError):
+            tr.train(reader=sched.wrap_reader(_reader()), num_passes=1,
+                     nan_policy="skip", metrics_registry=MetricsRegistry())
+    finally:
+        flags.restore_raw(prev)
+
+
+def test_guard_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        NumericGuard(policy="pray")
+
+
+# -- supervisor + kill-and-resume ---------------------------------------------
+
+def test_supervisor_worker_fault_resumes_bit_identical(tmp_path, baseline_w):
+    """A worker fault at an arbitrary mid-pass step, restarted by the
+    supervisor from a mid-pass cursor checkpoint, must produce the exact
+    final trajectory of an unfaulted run (same batches, same RNG keys)."""
+    reg = MetricsRegistry()
+    d = str(tmp_path / "ck")
+    sched = ChaosSchedule("step_error@6", registry=reg)  # pass 1, batch 2
+
+    def attempt(i):
+        tr = _build()
+        tr.train(reader=sched.wrap_reader(_reader()), num_passes=2,
+                 checkpoint_dir=d, checkpoint_batch_period=2,
+                 event_handler=sched.wrap_event_handler(None),
+                 metrics_registry=reg)
+        return tr
+
+    sup = Supervisor(max_restarts=2, registry=reg)
+    tr = sup.run(attempt)
+    assert sup.restarts == 1
+    assert reg.counter("restarts", "").value(run="train") == 1
+    assert reg.counter("faults_recovered", "").value(run="train") == 1
+    np.testing.assert_array_equal(_final_w(tr), baseline_w)
+
+
+def test_supervisor_reader_fault_resumes_bit_identical(tmp_path, baseline_w):
+    """Chaos-injected reader IOError mid-pass: the pass dies, the
+    supervisor restarts, resume replays from the cursor checkpoint."""
+    d = str(tmp_path / "ck")
+    sched = ChaosSchedule("reader_error@2")
+
+    def attempt():
+        tr = _build()
+        tr.train(reader=sched.wrap_reader(_reader()), num_passes=2,
+                 checkpoint_dir=d, checkpoint_batch_period=1,
+                 metrics_registry=MetricsRegistry())
+        return tr
+
+    sup = Supervisor(max_restarts=2, retry_on=(ChaosError,))
+    tr = sup.run(attempt)
+    assert sup.restarts == 1
+    np.testing.assert_array_equal(_final_w(tr), baseline_w)
+
+
+def test_sigterm_preemption_resumes_bit_identical(tmp_path, baseline_w):
+    """Simulated pod eviction (chaos sigterm@k): the trainer writes a
+    mid-pass cursor checkpoint and exits cleanly; a fresh trainer resumes
+    the same pass at the next batch — final weights bit-identical."""
+    d = str(tmp_path / "ck")
+    sched = ChaosSchedule("sigterm@5")
+    tr = _build()
+    tr.train(reader=sched.wrap_reader(_reader()), num_passes=2,
+             checkpoint_dir=d,
+             event_handler=sched.wrap_event_handler(None),
+             metrics_registry=MetricsRegistry())
+    found = ckpt.latest_checkpoint(d)
+    assert found[1]["meta"]["preempted"] is True
+    cursor = found[1]["cursor"]
+    assert cursor == {"pass_id": 1, "batch_id": 2}  # batches 0,1 applied
+    assert found[1]["meta"]["reader_cursor"]["batches_consumed"] == 2
+
+    tr2 = _build()
+    tr2.train(reader=_reader(), num_passes=2, checkpoint_dir=d,
+              metrics_registry=MetricsRegistry())
+    np.testing.assert_array_equal(_final_w(tr2), baseline_w)
+
+
+def test_corrupt_newest_checkpoint_falls_back(tmp_path, baseline_w):
+    """The corrupt-checkpoint writer: resume skips the damaged newest
+    snapshot, restores the previous valid one, and replays to the same
+    final trajectory."""
+    d = str(tmp_path / "ck")
+    tr = _build()
+    tr.train(reader=_reader(), num_passes=2, checkpoint_dir=d,
+             checkpoint_batch_period=2, metrics_registry=MetricsRegistry())
+    entries_before = ckpt.checkpoint_entries(d)
+    corrupt_newest_checkpoint(d, seed=1)
+    path, manifest = ckpt.latest_checkpoint(d)
+    assert path != entries_before[-1]  # fell back past the corrupt one
+
+    tr2 = _build()
+    tr2.train(reader=_reader(), num_passes=2, checkpoint_dir=d,
+              metrics_registry=MetricsRegistry())
+    np.testing.assert_array_equal(_final_w(tr2), baseline_w)
+
+
+def test_supervisor_budget_exhaustion_raises_original_error(tmp_path):
+    d = str(tmp_path / "ck")
+    sched = ChaosSchedule("step_error@0:always")
+    reg = MetricsRegistry()
+
+    def attempt():
+        sched.reset_counters()  # the :always fault re-fires per attempt
+        tr = _build()
+        tr.train(reader=_reader(), num_passes=1, checkpoint_dir=d,
+                 event_handler=sched.wrap_event_handler(None),
+                 metrics_registry=reg)
+
+    sup = Supervisor(max_restarts=2, registry=reg,
+                     backoff=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                         sleep=lambda s: 0))
+    with pytest.raises(ChaosError):
+        sup.run(attempt)
+    assert sup.restarts == 2  # budget spent, then the fault re-raised
+    assert reg.counter("restarts", "").value(run="train") == 2
+    assert reg.counter("faults_recovered", "").value(run="train") == 0
+
+
+def test_supervisor_never_retries_fatal():
+    calls = {"n": 0}
+
+    def attempt():
+        calls["n"] += 1
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        Supervisor(max_restarts=3).run(attempt)
+    assert calls["n"] == 1
+
+
+# -- checkpoint cursor machinery ----------------------------------------------
+
+def test_checkpoint_cursor_ordering_and_gc(tmp_path):
+    """Mid-pass cursors order chronologically against end-of-pass
+    snapshots (pass-1-batch-2 < pass-1 < pass-2-batch-1), not
+    lexicographically."""
+    d = str(tmp_path)
+    w = {"w": np.zeros(1, np.float32)}
+    ckpt.save_checkpoint(d, 0, w, keep_last=10)
+    ckpt.save_checkpoint(d, 1, w, batch_id=2, keep_last=10)
+    ckpt.save_checkpoint(d, 1, w, keep_last=10)
+    ckpt.save_checkpoint(d, 2, w, batch_id=1, keep_last=10)
+    names = [os.path.basename(p) for p in ckpt.checkpoint_entries(d)]
+    assert names == ["pass-00000", "pass-00001-batch-000002", "pass-00001",
+                     "pass-00002-batch-000001"]
+    path, manifest = ckpt.latest_checkpoint(d)
+    assert manifest["cursor"] == {"pass_id": 2, "batch_id": 1}
+
+    # gc keeps the newest N by cursor order
+    ckpt.save_checkpoint(d, 2, w, batch_id=3, keep_last=2)
+    names = [os.path.basename(p) for p in ckpt.checkpoint_entries(d)]
+    assert names == ["pass-00002-batch-000001", "pass-00002-batch-000003"]
+
+
+def test_async_checkpointer_failure_counted_and_raised(tmp_path):
+    from paddle_tpu.telemetry import get_default_registry
+
+    reg = get_default_registry()
+    before = reg.counter("checkpoint_write_failures", "").value()
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    w = ckpt.AsyncCheckpointer(
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                          sleep=lambda s: 0, retry_on=(OSError,)))
+    w.save(str(blocker / "denied"), 0, {"w": np.zeros(1, np.float32)})
+    with pytest.raises(OSError):
+        w.wait()
+    assert reg.counter("checkpoint_write_failures", "").value() == before + 1
+
+
+# -- heartbeat watchdog -------------------------------------------------------
+
+def test_heartbeat_watchdog_dumps_and_reports(tmp_path):
+    import time
+
+    from paddle_tpu.distributed.multihost import (
+        FlightRecorder,
+        HeartbeatWatchdog,
+    )
+
+    rec = FlightRecorder(capacity=8)
+    rec.heartbeat("alive", step=1)
+    fired = []
+    wd = HeartbeatWatchdog(recorder=rec, stale_after_s=0.15, poll_s=0.03,
+                           on_stale=lambda age, path: fired.append(
+                               (age, path)),
+                           dump_dir=str(tmp_path))
+    with wd:
+        deadline = time.time() + 3.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.02)
+    assert wd.fired and fired
+    age, path = fired[0]
+    assert age >= 0.15
+    with open(path) as f:
+        dump = json.load(f)
+    assert "heartbeat stale" in dump["reason"]
+    assert dump["heartbeats"][-1]["tag"] == "alive"
+
+    # fresh heartbeats keep it quiet
+    rec2 = FlightRecorder(capacity=8)
+    quiet = HeartbeatWatchdog(recorder=rec2, stale_after_s=10.0,
+                              poll_s=0.02, on_stale=lambda *a: None,
+                              dump_dir=str(tmp_path))
+    with quiet:
+        rec2.heartbeat("alive")
+        time.sleep(0.1)
+    assert not quiet.fired
+
+
+# -- master reconnect (satellite) ---------------------------------------------
+
+def test_master_client_survives_master_restart(tmp_path):
+    """Socket fault mid-conversation: the client redials with bounded
+    backoff; a FAIL sent to the snapshot-recovered master re-queues the
+    task (the reference Go master's re-queue-on-timeout semantics)."""
+    from paddle_tpu.distributed import MasterClient, MasterServer
+
+    snap = str(tmp_path / "master.snapshot")
+    try:
+        s = MasterServer(timeout_ms=60000, snapshot_path=snap)
+    except Exception as e:  # native binary unavailable in this env
+        pytest.skip(f"master binary unavailable: {e}")
+    import time
+
+    c = s.client()
+    c.set_dataset([f"t{i}" for i in range(3)])
+    tid, epoch, _ = c.get_task()
+    time.sleep(0.4)  # snapshot flush throttle
+    port = s.port
+    s.kill()  # crash — the client's socket is now dead
+
+    s2 = MasterServer(timeout_ms=60000, snapshot_path=snap, port=port)
+    try:
+        # the SAME client object: task_failed redials and the re-queue
+        # lands on the recovered queue
+        assert c.task_failed(tid, epoch) in (True, False)
+        st = c.stat()
+        assert st["todo"] + st["pending"] == 3  # nothing lost
+        got = c.get_task()
+        assert got not in (None,)  # tasks are dispatchable again
+    finally:
+        c.close()
+        s2.shutdown()
+
+
+# -- tooling ------------------------------------------------------------------
+
+def test_metrics_to_md_renders_fault_and_recovery(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_to_md", os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "metrics_to_md.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    stream = tmp_path / "m.jsonl"
+    records = [
+        {"kind": "step", "run": "train", "step": 0, "loss": 1.0,
+         "step_ms": 2.0, "examples_per_sec": 10.0, "mfu_pct": 0.0},
+        {"kind": "fault", "fault": "nan_skip", "pass_id": 0, "batch_id": 2,
+         "loss": float("nan")},
+        {"kind": "recovery", "restart": 1, "error": "ChaosError: boom",
+         "recovery_ms": 52.1},
+    ]
+    stream.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    assert mod.main([str(stream)]) == 0
+    out = capsys.readouterr().out
+    assert "Faults & recovery" in out
+    assert "run restarted 1 time(s)" in out  # restarts > 0 is flagged
+    assert "nan_skip" in out and "ChaosError: boom" in out
+
+
+# -- whole-process kill-and-resume (chaos marker: filtered from tier-1) -------
+
+_PROC_SCRIPT = r"""
+import os, sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.core import rng
+from paddle_tpu.layers import api as layer, base, data_type
+
+mode, ckdir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+base.reset_name_counters(); rng.seed(7)
+x = layer.data(name="x", type=data_type.dense_vector(4))
+y = layer.data(name="y", type=data_type.dense_vector(1))
+fc = layer.fc(input=x, size=1, act=paddle.activation.LinearActivation(), name="out")
+cost = layer.mse_cost(input=fc, label=y)
+params = paddle.parameters.create(paddle.topology.Topology(cost))
+tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                        update_equation=paddle.optimizer.Momentum(
+                            momentum=0.9, learning_rate=0.05))
+
+def r():
+    rs = np.random.RandomState(0)
+    w = np.array([1.0, -2.0, 0.5, 3.0])
+    for _ in range(32):
+        xs = rs.randn(4).astype(np.float32)
+        yield xs, np.array([xs @ w], np.float32)
+reader = paddle.reader.batch(r, batch_size=8)
+
+def killer(e):
+    if mode == "kill" and isinstance(e, paddle.event.BeginIteration) \
+            and (e.pass_id, e.batch_id) == (1, 3):
+        os.kill(os.getpid(), 9)  # SIGKILL: no handlers, no cleanup
+
+tr.train(reader=reader, num_passes=2, event_handler=killer,
+         checkpoint_dir=(ckdir or None), checkpoint_batch_period=2)
+np.save(out, np.asarray(tr.parameters["_out.w0"]))
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_process_sigkill_and_resume_bit_identical(tmp_path):
+    """The real thing: SIGKILL the training process mid-pass (no Python
+    cleanup at all), run it again, and the resumed process finishes with
+    weights bit-identical to a never-killed run."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "train_proc.py"
+    script.write_text(_PROC_SCRIPT)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + env.get("PYTHONPATH", "").split(os.pathsep))
+
+    def run(mode, ckdir, out):
+        return subprocess.run(
+            [sys.executable, str(script), mode, ckdir, out],
+            env=env, capture_output=True, text=True, timeout=300)
+
+    ref = str(tmp_path / "ref.npy")
+    assert run("clean", "", ref).returncode == 0
+
+    ckdir = str(tmp_path / "ck")
+    out = str(tmp_path / "resumed.npy")
+    first = run("kill", ckdir, out)
+    assert first.returncode == -signal.SIGKILL
+    second = run("clean", ckdir, out)
+    assert second.returncode == 0, second.stderr[-2000:]
+    np.testing.assert_array_equal(np.load(out), np.load(ref))

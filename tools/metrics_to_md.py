@@ -126,6 +126,30 @@ def comm_table(steps: list[dict]) -> None:
         print(f"| {key} | {v:,.0f} |")
 
 
+def recovery_table(faults: list[dict], recoveries: list[dict]) -> None:
+    """Render the schema /3 fault-tolerance stream: one row per injected/
+    handled fault and per supervisor restart, with a loud flag on any
+    run that needed a restart — a dirty run must not read as clean."""
+    if not faults and not recoveries:
+        return
+    print("\n## Faults & recovery\n")
+    if recoveries:
+        worst = max(r.get("recovery_ms", 0) or 0 for r in recoveries)
+        print(f"**⚠ run restarted {len(recoveries)} time(s)** (worst "
+              f"supervisor recovery {_fmt(float(worst))} ms) — the "
+              f"trajectory is checkpoint-replayed, but investigate the "
+              f"faults below.\n")
+    print("| event | detail | pass | batch | loss / recovery ms |")
+    print("|---|---|---|---|---|")
+    for r in faults:
+        print(f"| fault | {r.get('fault', '-')} | {r.get('pass_id', '-')} "
+              f"| {r.get('batch_id', '-')} | {_fmt(r.get('loss'), 5)} |")
+    for r in recoveries:
+        print(f"| restart #{r.get('restart', '?')} "
+              f"| {r.get('error', '-')} | - | - "
+              f"| {_fmt(r.get('recovery_ms'))} |")
+
+
 def bench_table(rows: list[dict]) -> None:
     if not rows:
         return
@@ -150,6 +174,8 @@ def main(argv: list[str]) -> int:
         argv = argv[:i] + argv[i + 2:]
     records = load(argv[0])
     steps = [r for r in records if r.get("kind") == "step"]
+    faults = [r for r in records if r.get("kind") == "fault"]
+    recoveries = [r for r in records if r.get("kind") == "recovery"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -162,9 +188,10 @@ def main(argv: list[str]) -> int:
             print(f"## Steps — run `{run}`\n")
             step_table(rs, last=last)
         comm_table(steps)
+    recovery_table(faults, recoveries)
     bench_table(bench)
-    if not steps and not bench:
-        print("_no step or bench records found_")
+    if not steps and not bench and not faults and not recoveries:
+        print("_no step, fault or bench records found_")
     return 0
 
 
