@@ -495,6 +495,39 @@ def bench_input_pipeline(records):
     })
 
 
+def bench_zero(records):
+    """ZeRO weight-update-sharding ablation (tools/bench_zero.py):
+    replicated vs zero1 vs zero2 on a forced-8-device host mesh, in a
+    SUBPROCESS so the virtual mesh never touches this process's backend.
+    Rows carry opt-state bytes/device and grad-reduce bytes/device
+    alongside steps/s — the sharded-aggregation memory and traffic
+    story (1/n under zero>=1 / zero=2)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_zero.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=8"])
+    out = subprocess.run([sys.executable, script], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench_zero subprocess failed: "
+                           f"{out.stderr[-400:]}")
+    for line in out.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        r = json.loads(line)
+        r.pop("schema", None), r.pop("ts", None), r.pop("host", None)
+        r.pop("kind", None)
+        records.append(r)
+
+
 def bench_transformer(records):
     """124M GPT-2-shape LM, bs 8x1024, mixed precision, flash attention,
     dots-remat — the modern-workload flagship row."""
@@ -585,7 +618,7 @@ def main() -> None:
     failures = []
     rows = (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
             bench_nmt, bench_ctr, bench_crnn, bench_saturation,
-            bench_input_pipeline, bench_transformer)
+            bench_input_pipeline, bench_transformer, bench_zero)
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything.  --prefetch=0|N
     # sets the input-pipeline ablation depth (0 = sync row only).

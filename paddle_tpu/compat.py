@@ -40,6 +40,17 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
                           out_specs=out_specs, **kwargs)
 
 
+def tpu_compiler_params(**kwargs):
+    """Version-portable pallas-TPU compiler params: new jax spells the
+    class ``pltpu.CompilerParams``, older jax ``TPUCompilerParams`` (same
+    fields).  Every pallas kernel in this package goes through this one
+    constructor so the ops import (and run) on both."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def axis_size(axis_name) -> int:
     """Static size of a mapped mesh axis (``lax.axis_size`` where it
     exists; 0.4.x exposes it as ``core.axis_frame(name)`` — an int, so

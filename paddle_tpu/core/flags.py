@@ -119,6 +119,10 @@ define("trainer_count", 1, "data-parallel replicas on this host (mesh batch axis
 define("trainer_id", 0, "distinct id of this trainer process")
 define("num_hosts", 1, "number of participating hosts (was: num_gradient_servers)")
 define("mesh_shape", "", "device mesh as 'dp,tp' or 'dp,tp,pp' (empty = all-dp)")
+define("zero", 0, "weight-update sharding over the mesh data axis (the "
+                  "pserver's sharded aggregation, in-mesh): 0 = replicated "
+                  "update | 1 = 1/n-sharded optimizer state | 2 = "
+                  "reduce-scatter grads + sharded update + all-gather params")
 define("seed", 1, "global RNG seed (0 = nondeterministic)")
 define("log_period", 100, "log every N batches")
 define("test_period", 0, "test every N batches (0 = every pass)")

@@ -1,0 +1,237 @@
+"""``python -m paddle_tpu.distributed.launch`` — the trainer-fleet
+launcher.
+
+Reference parity: ``paddle/scripts/cluster_train/paddle.py`` (the SSH
+fan-out that started N trainers + pservers with ``--trainer_id``/
+``--num_gradient_servers`` set) — rebuilt for the multi-controller SPMD
+runtime, where every process runs the SAME program and rendezvouses
+through ``jax.distributed`` (``multihost.initialize``).
+
+Local mode spawns ``--nproc`` processes on THIS host with the rank
+environment set, tees each rank's output to a log file (and rank 0's
+through to the console), and propagates the FIRST failure: remaining
+ranks are terminated and the launcher exits with the failing rank's
+code — a hung collective on rank 1 must not leave ranks 0..n zombied
+behind a green shell.
+
+Pod mode (``--emit_hosts``) does not spawn: it prints the per-host
+command lines an operator (or a fleet controller) runs on each host —
+one process per host, coordinator on host 0.
+
+Command templating: ``{rank}``, ``{nproc}`` and ``{port}`` inside the
+command argv are substituted per process.  Each child additionally gets
+
+- ``PADDLE_TPU_TRAINER_ID``    — its rank (the reference's trainer_id);
+- ``PADDLE_TPU_NPROC``         — the world size;
+- ``PADDLE_TPU_COORDINATOR``   — ``host:port`` of rank 0's coordinator
+  (read by ``multihost.initialize`` via COORDINATOR_ADDRESS-style vars
+  when the program passes nothing explicit).
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch --nproc 2 -- \
+        python train.py --trainer_id {rank}
+
+    python -m paddle_tpu.distributed.launch --emit_hosts h0,h1,h2,h3 -- \
+        python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+
+def _substitute(cmd: list[str], rank: int, nproc: int, port: int) -> list[str]:
+    return [a.replace("{rank}", str(rank))
+             .replace("{nproc}", str(nproc))
+             .replace("{port}", str(port)) for a in cmd]
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def rank_env(rank: int, nproc: int, port: int,
+             host: str = "127.0.0.1", base_env=None) -> dict:
+    """Child environment for one rank (the reference's gflags
+    ``--trainer_id``/``--num_gradient_servers``, env-var spelling)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env["PADDLE_TPU_TRAINER_ID"] = str(rank)
+    env["PADDLE_TPU_NPROC"] = str(nproc)
+    env["PADDLE_TPU_COORDINATOR"] = f"{host}:{port}"
+    return env
+
+
+class _Tee(threading.Thread):
+    """Pump one child's combined output to a log file (+ console when
+    asked), line-buffered so interleaved ranks stay readable."""
+
+    def __init__(self, rank: int, stream, log_path: str | None,
+                 echo: bool):
+        super().__init__(name=f"launch-tee-{rank}", daemon=True)
+        self.rank, self.stream, self.echo = rank, stream, echo
+        self.log = open(log_path, "wb") if log_path else None
+        self.tail: list[bytes] = []  # last lines for the failure report
+
+    def run(self):
+        try:
+            for line in iter(self.stream.readline, b""):
+                if self.log:
+                    self.log.write(line)
+                    self.log.flush()
+                self.tail.append(line)
+                if len(self.tail) > 50:
+                    self.tail.pop(0)
+                if self.echo:
+                    sys.stderr.buffer.write(
+                        f"[rank {self.rank}] ".encode() + line)
+                    sys.stderr.buffer.flush()
+        finally:
+            if self.log:
+                self.log.close()
+
+    def tail_text(self) -> str:
+        return b"".join(self.tail).decode(errors="replace")
+
+
+def launch_local(cmd: list[str], nproc: int, *, env=None,
+                 log_dir: str | None = None, port: int | None = None,
+                 echo_rank0: bool = True, timeout: float | None = None,
+                 poll_s: float = 0.1) -> int:
+    """Spawn ``nproc`` local ranks of ``cmd``; returns the exit code.
+
+    First failure wins: as soon as any rank exits nonzero, the others
+    are SIGTERMed (then killed) and that rank's code is returned, with
+    its output tail on stderr.  0 only when every rank exited 0.
+    ``timeout`` (seconds) kills the fleet and returns 124, the
+    ``timeout(1)`` convention."""
+    port = port or _free_port()
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    procs, tees = [], []
+    for rank in range(nproc):
+        argv = _substitute(list(cmd), rank, nproc, port)
+        p = subprocess.Popen(
+            argv, env=rank_env(rank, nproc, port, base_env=env),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        tee = _Tee(rank, p.stdout,
+                   os.path.join(log_dir, f"rank{rank}.log")
+                   if log_dir else None,
+                   echo=echo_rank0 and rank == 0)
+        tee.start()
+        procs.append(p)
+        tees.append(tee)
+
+    def reap_rest(skip: int | None):
+        for i, q in enumerate(procs):
+            if i == skip or q.poll() is not None:
+                continue
+            try:
+                q.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 5.0
+        for i, q in enumerate(procs):
+            if i == skip:
+                continue
+            while q.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if q.poll() is None:
+                q.kill()
+                q.wait()
+
+    t0 = time.monotonic()
+    rc = 0
+    try:
+        while True:
+            done = [p.poll() for p in procs]
+            for rank, code in enumerate(done):
+                if code is not None and code != 0:
+                    reap_rest(rank)
+                    # drain the failing rank's pipe before reporting, or
+                    # a fast crash races its traceback out of the tail
+                    tees[rank].join(timeout=2.0)
+                    sys.stderr.write(
+                        f"launch: rank {rank} failed (exit {code}); "
+                        f"terminated the remaining ranks.  Last "
+                        f"output:\n{tees[rank].tail_text()[-3000:]}\n")
+                    return code
+            if all(c == 0 for c in done):
+                return 0
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                sys.stderr.write(
+                    f"launch: timed out after {timeout:.0f}s; killing "
+                    f"{sum(c is None for c in done)} live rank(s)\n")
+                reap_rest(None)
+                return 124
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        rc = 130
+        reap_rest(None)
+        return rc
+    finally:
+        for t in tees:
+            t.join(timeout=2.0)
+
+
+def emit_pod_commands(hosts: list[str], cmd: list[str],
+                      port: int = 8476) -> list[str]:
+    """Per-host command lines for a pod run (one process per host,
+    coordinator on hosts[0]) — the modern spelling of the reference SSH
+    launcher's remote command assembly."""
+    nproc = len(hosts)
+    lines = []
+    for rank, host in enumerate(hosts):
+        argv = _substitute(list(cmd), rank, nproc, port)
+        envs = (f"PADDLE_TPU_TRAINER_ID={rank} "
+                f"PADDLE_TPU_NPROC={nproc} "
+                f"PADDLE_TPU_COORDINATOR={hosts[0]}:{port}")
+        lines.append(f"# on {host}:\n{envs} {' '.join(argv)}")
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="spawn N local ranks / emit per-host pod commands")
+    p.add_argument("--nproc", type=int, default=1,
+                   help="local processes to spawn")
+    p.add_argument("--log_dir", default=None,
+                   help="tee each rank's output to <log_dir>/rank<k>.log")
+    p.add_argument("--port", type=int, default=None,
+                   help="coordinator port (default: an ephemeral one)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="kill the fleet after this many seconds (rc 124)")
+    p.add_argument("--emit_hosts", default=None,
+                   help="comma-separated host list: print per-host pod "
+                        "commands instead of spawning")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to run (prefix with --); {rank}/{nproc}/"
+                        "{port} are substituted per process")
+    args = p.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (append: -- python train.py ...)")
+    if args.emit_hosts:
+        hosts = [h for h in args.emit_hosts.split(",") if h]
+        print("\n".join(emit_pod_commands(hosts, cmd,
+                                          port=args.port or 8476)))
+        return 0
+    return launch_local(cmd, args.nproc, log_dir=args.log_dir,
+                        port=args.port, timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,17 @@ def initialize(coordinator_address: str | None = None,
     dist_state = getattr(jax.distributed, "is_initialized", None)
     if dist_state is not None and jax.distributed.is_initialized():
         return
+    if coordinator_address is None:
+        # the fleet launcher's rendezvous env (distributed/launch.py):
+        # rank/world/coordinator set per spawned process
+        coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+        if coord and int(os.environ.get("PADDLE_TPU_NPROC", "1")) > 1:
+            coordinator_address = coord
+            if num_processes is None:
+                num_processes = int(os.environ["PADDLE_TPU_NPROC"])
+            if process_id is None:
+                process_id = int(os.environ.get("PADDLE_TPU_TRAINER_ID",
+                                                "0"))
     explicit = coordinator_address is not None
     if not explicit and not any(os.environ.get(k) for k in _CLUSTER_ENV_VARS):
         return  # single-process run

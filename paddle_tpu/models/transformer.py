@@ -331,7 +331,7 @@ def loss_fn(cfg: TransformerConfig, params: dict, ids: jax.Array,
 
 
 def build_train_step(cfg: TransformerConfig, optimizer, mesh=None,
-                     compute_dtype=None, zero1=False):
+                     compute_dtype=None, zero1=False, zero=None):
     """(params, opt_state, ids) -> (params, opt_state, loss), jitted.
     With a mesh: batch sharded ("data","seq" on time), params per TP layout;
     GSPMD inserts every collective.
@@ -340,27 +340,80 @@ def build_train_step(cfg: TransformerConfig, optimizer, mesh=None,
     master params (and Adam moments) stay f32; the forward/backward run on
     a bf16 cast, and the cast's cotangent upcasts grads back to f32.
 
-    ``zero1=True`` (needs a mesh with a ``data`` axis) pins the optimizer
-    slots sharded over data-parallel ranks (parallel/zero.py — the
-    pserver's sharded-optimizer-state property, in-mesh); pair with
-    ``zero.shard_opt_state`` for the initial placement."""
+    ``zero`` = 0|1|2 selects weight-update sharding over the ``data``
+    axis (parallel/zero.py — the pserver's sharded-aggregation property,
+    in-mesh): 1 pins the optimizer slots 1/n-sharded; 2 additionally
+    replaces the gradient all-reduce with reduce-scatter + sharded
+    update + parameter all-gather.  ``zero1=True`` is the original
+    spelling of ``zero=1``.  Pair with ``zero.shard_opt_state`` for the
+    initial state placement.
+
+    On a pure-data mesh the zero=2 gradient flow is lowered explicitly
+    (shard_map + ``collective.reduce_scatter``/``all_gather`` — the
+    telemetry census sees the real payloads); with live TP/seq/expert
+    axes the GSPMD constraint lowering is used (composes with the TP
+    layout and the MoE expert axis)."""
+    from paddle_tpu.parallel import zero as zero_mod
+
+    zero = int(zero) if zero is not None else (1 if zero1 else 0)
+    dp = mesh.shape.get("data", 1) if mesh is not None else 1
+    zero_on = zero >= 1 and mesh is not None and dp > 1
+    explicit = (zero_on and zero >= 2
+                and zero_mod.explicit_lowering_ok(mesh))
+    pspecs = param_shardings(cfg)
 
     def step(params, opt_state, ids):
-        def lf(p):
+        def lf(p, ids, inner_mesh):
             if compute_dtype is not None:
                 from paddle_tpu.trainer.step import _cast_floats
                 p = _cast_floats(p, compute_dtype)
-            return loss_fn(cfg, p, ids, mesh=mesh)
+            return loss_fn(cfg, p, ids, mesh=inner_mesh)
 
-        loss, grads = jax.value_and_grad(lf)(params)
+        gspecs = (zero_mod.grad_specs(params, mesh, param_specs=pspecs)
+                  if zero_on else None)
+        if explicit:
+            from jax.sharding import PartitionSpec as P
+
+            from paddle_tpu import compat
+
+            def local_step(p, ids):
+                # per-shard forward/backward: the data axis is manual
+                # here, so inner batch constraints are skipped
+                # (mesh=None) — on a pure-data mesh they were only
+                # batch-dim hints
+                loss, grads = jax.value_and_grad(lf)(p, ids, None)
+                # loss_fn is a MEAN over the batch: the global value is
+                # the pmean of equal-sized shard means, and the global
+                # gradient is the 1/n-scaled psum of shard gradients —
+                # scale before the (sum-)reduce-scatter
+                loss = jax.lax.pmean(loss, "data")
+                grads = jax.tree.map(lambda g: g / dp, grads)
+                grads = zero_mod.sync_grads(grads, gspecs)
+                return loss, grads
+
+            region = compat.shard_map(
+                local_step, mesh=mesh,
+                in_specs=(jax.tree.map(lambda _: P(), params),
+                          P("data", None)),
+                out_specs=(P(), gspecs),
+                check_vma=False)
+            loss, grads = region(params, ids)
+        else:
+            loss, grads = jax.value_and_grad(lf)(params, ids, mesh)
+            if zero_on and zero >= 2:
+                grads = zero_mod.constrain_grads(grads, gspecs, mesh)
         new_params, new_opt = optimizer.apply_tree(grads, params, opt_state)
-        if zero1:
-            from paddle_tpu.parallel.zero import (
-                constrain_opt_state, zero1_specs)
-
-            specs = zero1_specs(new_opt, params, mesh,
-                                param_specs=param_shardings(cfg))
-            new_opt = constrain_opt_state(new_opt, specs, mesh)
+        if zero_on:
+            sspecs = zero_mod.state_specs(new_opt, params, mesh,
+                                          param_specs=pspecs)
+            new_opt = zero_mod.constrain_opt_state(new_opt, sspecs, mesh)
+            if explicit:
+                new_params = zero_mod.gather_params(new_params, gspecs,
+                                                    mesh)
+            elif zero >= 2:
+                new_params = zero_mod.constrain_params(
+                    new_params, mesh, param_specs=pspecs,
+                    zero_specs=gspecs)
         return new_params, new_opt, loss
 
     return jax.jit(step, donate_argnums=(0, 1))

@@ -28,6 +28,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.compat import tpu_compiler_params
 from paddle_tpu.ops.pallas import NEG_INF, round_up as _round_up
 
 
@@ -262,7 +263,7 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
                 jax.ShapeDtypeStruct((bh, tqp, dpad), q.dtype),
                 jax.ShapeDtypeStruct((bh, tqp, 1), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel",),
             ),
             interpret=interpret,
@@ -294,7 +295,7 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -361,7 +362,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
                 jax.ShapeDtypeStruct((bh, tkp, dpad), kp.dtype),
                 jax.ShapeDtypeStruct((bh, tkp, dpad), vp.dtype),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel",),
             ),
             interpret=interpret,
@@ -387,7 +388,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, tqp, dpad), qp.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dpad), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -411,7 +412,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
             pltpu.VMEM((block_k, dpad), jnp.float32),
             pltpu.VMEM((block_k, dpad), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

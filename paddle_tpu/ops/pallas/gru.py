@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.compat import tpu_compiler_params
 from paddle_tpu.ops.pallas import (mxu_precision as _prec,
                                    time_major_mask as _mask3)
 
@@ -131,7 +132,7 @@ def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret):
             jax.ShapeDtypeStruct((b, d), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((b, d), w_h.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
@@ -167,7 +168,7 @@ def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, reverse,
             jax.ShapeDtypeStruct((b, d), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.compat import tpu_compiler_params
 from paddle_tpu.ops.pallas import (mxu_precision as _prec,
                                    time_major_mask as _mask3)
 
@@ -191,7 +192,7 @@ def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret):
             pltpu.VMEM((b, d), w_h.dtype),     # h carry (matmul dtype)
             pltpu.VMEM((b, d), jnp.float32),   # c carry
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             # w_h residency at D=1280 needs ~18 MB with the IO slabs;
             # v5e VMEM is 128 MB — raise the conservative 16 MB default
@@ -241,7 +242,7 @@ def _bwd_call(mask, w_h, peep, gates, cs_prev, cs, dhs, dhT, dcT,
             pltpu.VMEM((b, d), jnp.float32),   # dc carry
             pltpu.VMEM((3, d), jnp.float32),   # dpeep accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             # w_h residency at D=1280 needs ~18 MB with the IO slabs;
             # v5e VMEM is 128 MB — raise the conservative 16 MB default

@@ -25,6 +25,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.compat import tpu_compiler_params
 from paddle_tpu.ops.pallas import NEG_INF, round_up as _round_up
 
 
@@ -78,7 +79,7 @@ def _lse(logits, block_rows, block_v, interpret):
         out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_rows, 128), jnp.float32),
                         pltpu.VMEM((block_rows, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(logits)
@@ -123,7 +124,7 @@ def _bwd(block_rows, block_v, interpret, res, g):
                   rspec, rspec, rspec],
         out_specs=pl.BlockSpec((block_rows, block_v), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((np_, vp), logits.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(logits, lse[:, None], targets.astype(jnp.int32)[:, None],

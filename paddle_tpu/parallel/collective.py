@@ -35,9 +35,11 @@ from paddle_tpu import compat
 from paddle_tpu.compat import shard_map
 
 
-def _comm_record(op: str, axis_name, x) -> None:
+def _comm_record(op: str, axis_name, x, divide: int = 1) -> None:
     """Account one traced collective call site (never raises — telemetry
-    must not break compilation)."""
+    must not break compilation).  ``divide`` scales the recorded payload
+    (reduce_scatter records the per-device OUTPUT shard, i.e. input
+    bytes / axis size — the bytes each rank materializes and applies)."""
     try:
         from paddle_tpu.telemetry import record_comm
 
@@ -53,7 +55,7 @@ def _comm_record(op: str, axis_name, x) -> None:
             nbytes += n * jnp.dtype(dtype).itemsize
         axis = "+".join(axis_name) if isinstance(axis_name, (tuple, list)) \
             else str(axis_name)
-        record_comm(op, axis, nbytes)
+        record_comm(op, axis, nbytes // max(int(divide), 1))
     except Exception:
         pass
 
@@ -88,8 +90,17 @@ def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
 
 
 def reduce_scatter(x, axis_name: str, axis: int = 0):
-    """Sum-reduce then scatter shards — the ZeRO/“sharded grads” primitive."""
-    _comm_record("reduce_scatter", axis_name, x)
+    """Sum-reduce then scatter shards — the ZeRO/“sharded grads” primitive.
+
+    Census accounting records the per-device OUTPUT shard bytes (input /
+    axis size): the reduce result a rank materializes is 1/n of what the
+    equivalent all_reduce would hand it, which is exactly the ZeRO-2
+    grad-reduce saving the census is meant to show."""
+    try:
+        n = compat.axis_size(axis_name)
+    except Exception:
+        n = 1
+    _comm_record("reduce_scatter", axis_name, x, divide=n)
     with _scope("reduce_scatter", axis_name):
         return lax.psum_scatter(x, axis_name, scatter_dimension=axis,
                                 tiled=True)

@@ -17,6 +17,7 @@ from paddle_tpu.telemetry.registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
     capture_comm,
+    census_by_kind,
     comm_snapshot,
     get_default_registry,
     host_index,

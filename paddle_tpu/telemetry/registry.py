@@ -368,3 +368,25 @@ def comm_snapshot(registry=None) -> dict[str, float]:
     if c is None:
         return {}
     return {f"{s['op']}/{s['axis']}": s["value"] for s in c.snapshot()}
+
+
+def census_by_kind(comm: dict[str, float]) -> dict[str, dict]:
+    """Roll a {"op/axis": bytes} comm map (a step record's per-program
+    payload, or :func:`comm_snapshot`'s cumulative counters) up to
+    {kind: {"bytes", "sites", "axes"}} — the collective census.
+
+    Under ZeRO-2 this is the table that PROVES the collective swap: the
+    gradient flow's ``all_reduce`` bytes drop to (near) zero, replaced by
+    ``reduce_scatter`` + ``all_gather`` whose per-device payloads are 1/n
+    of the replicated run's all-reduce.  ``sites`` counts distinct
+    op/axis call sites, not per-step executions (a collective in a scan
+    body is traced once)."""
+    out: dict[str, dict] = {}
+    for key, nbytes in (comm or {}).items():
+        kind, _, axis = key.partition("/")
+        row = out.setdefault(kind, {"bytes": 0.0, "sites": 0, "axes": []})
+        row["bytes"] += float(nbytes)
+        row["sites"] += 1
+        if axis and axis not in row["axes"]:
+            row["axes"].append(axis)
+    return out

@@ -66,6 +66,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--sync_period", type=int, default=None,
                    help="fence device costs every N steps (1 = per-batch "
                         "v2 event cadence; default 8)")
+    # weight-update sharding (README "Weight-update sharding (ZeRO-1/2)"):
+    # the pserver's sharded aggregation re-expressed in-mesh
+    p.add_argument("--zero", type=int, default=None, choices=[0, 1, 2],
+                   help="ZeRO weight-update sharding over the mesh data "
+                        "axis: 0 = replicated update (default) | 1 = "
+                        "1/n-sharded optimizer state | 2 = reduce-scatter "
+                        "grads + sharded update + all-gather params")
     # fault tolerance (README "Fault tolerance & recovery"): crash-safe
     # cursor checkpoints, the numeric guard, the restart-budget
     # supervisor and the deterministic chaos harness
@@ -372,10 +379,14 @@ def cmd_train(args, parsed) -> int:
         with open(args.init_model_path, "rb") as f:
             params = paddle.parameters.Parameters.from_tar(f)
 
+    from paddle_tpu.core import flags as _zflags
+
     trainer = paddle.trainer.SGD(
         cost=topo.outputs, parameters=params, update_equation=opt,
         extra_layers=topo.extra_layers,
-        declared_evaluators=getattr(parsed, "evaluators", None))
+        declared_evaluators=getattr(parsed, "evaluators", None),
+        zero=(args.zero if args.zero is not None
+              else _zflags.get("zero")))
 
     def on_event(event):
         if isinstance(event, paddle.event.EndIteration):

@@ -7,7 +7,13 @@ backward (``jax.grad``), gradient all-reduce over the mesh ``data`` axis
 ``MultiGradientMachine``'s software ring and the pserver round-trip of
 ``RemoteParameterUpdater``), optimizer update, and metric computation.  The
 reference pipelines per-parameter updates with backward via UpdateCallback
-(``TrainerInternal.cpp:99-111``); XLA's scheduler provides that overlap."""
+(``TrainerInternal.cpp:99-111``); XLA's scheduler provides that overlap.
+
+``zero`` lowers the weight update to the pserver's sharded-aggregation
+form in-mesh (``parallel/zero.py``): 1 shards the optimizer state 1/n
+over data-parallel ranks; 2 additionally replaces the gradient
+all-reduce with reduce-scatter + sharded update + parameter all-gather
+(ZeRO-2 / Xu et al.'s automatic weight-update sharding)."""
 
 from __future__ import annotations
 
@@ -16,13 +22,20 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
+from paddle_tpu import compat
 from paddle_tpu.config.topology import Topology
 from paddle_tpu.layers.base import is_sequence, raw
 from paddle_tpu.parallel.mesh import MeshContext
 
 
-def _compute_metrics(metric_specs, values) -> dict[str, jax.Array]:
+def _metric_parts(metric_specs, values) -> dict[str, tuple]:
+    """Per-metric (numerator, denominator) pairs.  Splitting the ratio
+    lets the ZeRO shard_map region psum both sides over the data axis —
+    the sharded run's metrics are then EXACT, not a mean of per-shard
+    means (which would mis-weight sequence masks)."""
     out = {}
     for kind, pred_name, label_name, tag in metric_specs:
         pred, label = values[pred_name], values[label_name]
@@ -32,15 +45,23 @@ def _compute_metrics(metric_specs, values) -> dict[str, jax.Array]:
                 mask = pred.mask()
                 ids = jnp.argmax(p, axis=-1)
                 err = (ids != raw(label)).astype(jnp.float32) * mask
-                out["classification_error_evaluator"] = jnp.sum(err) / jnp.maximum(
-                    jnp.sum(mask), 1.0
-                )
+                out["classification_error_evaluator"] = (
+                    jnp.sum(err), jnp.sum(mask))
             else:
                 ids = jnp.argmax(p, axis=-1)
-                out["classification_error_evaluator"] = jnp.mean(
-                    (ids != l.reshape(ids.shape)).astype(jnp.float32)
-                )
+                err = (ids != l.reshape(ids.shape)).astype(jnp.float32)
+                out["classification_error_evaluator"] = (
+                    jnp.sum(err), jnp.asarray(float(err.size), jnp.float32))
     return out
+
+
+def _finalize_metrics(parts: dict[str, tuple]) -> dict[str, jax.Array]:
+    return {k: num / jnp.maximum(den, 1.0)
+            for k, (num, den) in parts.items()}
+
+
+def _compute_metrics(metric_specs, values) -> dict[str, jax.Array]:
+    return _finalize_metrics(_metric_parts(metric_specs, values))
 
 
 def _cast_floats(tree, dtype):
@@ -59,9 +80,18 @@ def _cast_like(tree, ref):
     )
 
 
+def _batch_spec(x) -> P:
+    """Batch-dim sharding spec of one feed leaf (mirrors
+    ``MeshContext.data_sharding``)."""
+    if hasattr(x, "ndim") and x.ndim >= 1:
+        return P("data", *([None] * (x.ndim - 1)))
+    return P()
+
+
 def build_train_step(topology: Topology, optimizer,
                      mesh: MeshContext | None = None,
-                     compute_dtype=None, fetch_layers=None):
+                     compute_dtype=None, fetch_layers=None,
+                     zero: int | None = None):
     """Returns jitted fn: (params, opt_state, states, feed, key)
     -> (params, opt_state, states, cost, metrics).
 
@@ -72,12 +102,94 @@ def build_train_step(topology: Topology, optimizer,
     ``fetch_layers`` names layers whose batch values should ride along in
     the metrics dict (key ``"layer:<name>"``) — the declared-evaluator feed,
     computed by the SAME forward the update uses (same dropout draw, no
-    extra pass)."""
+    extra pass).
+
+    ``zero`` selects the weight-update sharding over the mesh ``data``
+    axis (``parallel/zero.py``; None/0 = the replicated update):
+
+    - ``1``: optimizer slots live 1/n-sharded (state memory /n); the
+      gradient sync stays an all-reduce and updated parameters are
+      all-gathered from the sharded deltas.
+    - ``2``: the gradient all-reduce is REPLACED by reduce-scatter —
+      each rank receives its 1/n gradient shard, applies the optimizer
+      on its state shard, and updated parameters are all-gathered.
+
+    On a pure-data mesh the zero>=2 gradient flow is lowered explicitly:
+    forward/backward run per-shard inside ``shard_map`` and the sync goes
+    through ``collective.reduce_scatter``/``all_gather``, so the
+    telemetry census carries the real per-device payloads and the
+    compiled program contains literal reduce-scatter ops on every
+    backend.  On meshes with live TP/MoE axes the GSPMD lowering
+    (sharding constraints, Xu et al.) is used instead — same math,
+    partitioner-chosen collectives.  Dropout note: the explicit lowering
+    folds the data-axis index into the step key (independent per-replica
+    draws, like the reference's per-thread streams), so a stochastic
+    model's trajectory differs from the replicated run's by the draw —
+    deterministic models match to reduction-order tolerance."""
     specs = {s.name: s for s in topology.param_specs()}
     trainable = {n for n, s in specs.items() if not s.is_static}
     metric_specs = topology.metrics()
     out_names = [o.name for o in topology.outputs]
     fetch_layers = list(fetch_layers or [])
+    zero = int(zero or 0)
+    # P() (not None) for unannotated params: a None entry is an empty
+    # pytree to jax and would misalign spec lists in parallel/zero.py
+    base_specs = {
+        n: (P(*s.sharding) if getattr(s, "sharding", None) else P())
+        for n, s in specs.items()}
+
+    from paddle_tpu.parallel import zero as zero_mod
+
+    dp = mesh.mesh.shape.get("data", 1) if mesh is not None else 1
+    zero_on = zero >= 1 and mesh is not None and dp > 1
+    explicit = (zero_on and zero >= 2
+                and zero_mod.explicit_lowering_ok(mesh.mesh))
+
+    def run_forward(tp, static_c, states, feed_c, key):
+        """(cost, new_states, metric parts, fetch values, grads) on the
+        batch visible to this trace (global under jit, the local shard
+        under shard_map)."""
+        def loss_fn(tp):
+            if compute_dtype is not None:
+                tp = _cast_floats(tp, compute_dtype)
+            allp = {**static_c, **tp}
+            values, new_states = topology.forward(
+                allp, states, feed_c, True, key)
+            cost = functools.reduce(
+                lambda a, b: a + b,
+                [jnp.sum(values[n], dtype=jnp.float32) for n in out_names]
+            )
+            parts = _metric_parts(metric_specs, values)
+            fetch = {f"layer:{n}": jax.lax.stop_gradient(values[n])
+                     for n in fetch_layers if n in values}
+            return cost, (new_states, parts, fetch)
+
+        # grads arrive f32 already (cotangent of the bf16 cast upcasts)
+        (cost, (new_states, parts, fetch)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(tp)
+        return cost, new_states, parts, fetch, grads
+
+    def apply_update(grads, train_p, opt_state, gspecs):
+        """Optimizer update (+ ZeRO constraints); returns
+        (new_train, new_opt) with new_train back at its base layout."""
+        new_train, new_opt = optimizer.apply(grads, train_p, opt_state,
+                                             specs)
+        if zero_on:
+            sspecs = zero_mod.state_specs(
+                new_opt, {**train_p}, mesh.mesh,
+                param_specs={n: base_specs[n] for n in train_p})
+            new_opt = zero_mod.constrain_opt_state(new_opt, sspecs,
+                                                   mesh.mesh)
+            if explicit:
+                new_train = zero_mod.gather_params(new_train, gspecs,
+                                                   mesh.mesh)
+            else:
+                new_train = zero_mod.constrain_params(
+                    new_train, mesh.mesh,
+                    param_specs={n: base_specs[n] for n in train_p},
+                    zero_specs=gspecs if zero >= 2 else None)
+        return new_train, new_opt
 
     def step(params, opt_state, states, feed, key):
         train_p = {k: v for k, v in params.items() if k in trainable}
@@ -90,29 +202,73 @@ def build_train_step(topology: Topology, optimizer,
         # persistent states (BN running stats) stay f32: batch_norm upcasts
         # internally, and a bf16 EMA accumulator would re-quantize each step
 
-        def loss_fn(tp):
-            if compute_dtype is not None:
-                tp = _cast_floats(tp, compute_dtype)
-            allp = {**static_c, **tp}
-            values, new_states = topology.forward(
-                allp, states, feed_c, True, key)
-            cost = functools.reduce(
-                lambda a, b: a + b,
-                [jnp.sum(values[n], dtype=jnp.float32) for n in out_names]
-            )
-            metrics = _compute_metrics(metric_specs, values)
-            for n in fetch_layers:
-                if n in values:
-                    metrics[f"layer:{n}"] = jax.lax.stop_gradient(values[n])
-            return cost, (new_states, metrics)
+        gspecs = (zero_mod.grad_specs(
+            train_p, mesh.mesh,
+            param_specs={n: base_specs[n] for n in train_p})
+            if zero_on else None)
 
-        # grads arrive f32 already (cotangent of the bf16 cast upcasts)
-        (cost, (new_states, metrics)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(train_p)
+        if explicit:
+            def local_step(tp, static_c, states, feed_c, key):
+                # independent per-replica RNG stream (the reference's
+                # per-thread dropout draws, MultiGradientMachine)
+                key = jax.random.fold_in(key, lax.axis_index("data"))
+                cost, new_states, parts, fetch, grads = run_forward(
+                    tp, static_c, states, feed_c, key)
+                # cost layers reduce to batch-MEAN scalars (layers/api
+                # _mean_over_batch), so the global cost is the pmean of
+                # equal-shard local means and the global gradient the
+                # 1/n-scaled sum — exact for dense costs; a masked
+                # sequence cost weights each replica equally instead of
+                # each timestep (the reference's multi-trainer
+                # averaging did the same).  Metric num/den parts are
+                # psummed separately, so METRICS stay exact either way.
+                # Scalar reductions use raw lax — accounting noise kept
+                # out of the census; the census IS the gradient flow.
+                cost = lax.pmean(cost, "data")
+                parts = jax.tree.map(lambda x: lax.psum(x, "data"), parts)
+                new_states = jax.tree.map(lambda x: lax.pmean(x, "data"),
+                                          new_states)
+                grads = jax.tree.map(lambda g: g / dp, grads)
+                grads = zero_mod.sync_grads(grads, gspecs)
+                return cost, new_states, parts, fetch, grads
+
+            # output STRUCTURE (metric keys, fetch leaves, state shapes)
+            # comes from an abstract eval of the collective-free forward
+            out_sh = jax.eval_shape(run_forward, train_p, static_c,
+                                    states, feed_c, key)
+            out_specs = (
+                P(),                                        # cost
+                jax.tree.map(lambda _: P(), out_sh[1]),     # new_states
+                jax.tree.map(lambda _: P(), out_sh[2]),     # metric parts
+                jax.tree.map(_batch_spec, out_sh[3]),       # fetch values
+                gspecs,                                     # synced grads
+            )
+            region = compat.shard_map(
+                local_step, mesh=mesh.mesh,
+                in_specs=(
+                    jax.tree.map(lambda _: P(), train_p),
+                    jax.tree.map(lambda _: P(), static_c),
+                    jax.tree.map(lambda _: P(), states),
+                    jax.tree.map(_batch_spec, feed_c),
+                    P(),
+                ),
+                out_specs=out_specs,
+                check_vma=False)
+            cost, new_states, parts, fetch, grads = region(
+                train_p, static_c, states, feed_c, key)
+            metrics = _finalize_metrics(parts)
+            metrics.update(fetch)
+        else:
+            cost, new_states, parts, fetch, grads = run_forward(
+                train_p, static_c, states, feed_c, key)
+            metrics = _finalize_metrics(parts)
+            metrics.update(fetch)
+            if zero_on and zero >= 2:
+                grads = zero_mod.constrain_grads(grads, gspecs, mesh.mesh)
+
         if compute_dtype is not None:
             new_states = _cast_like(new_states, states)
-        new_train, new_opt = optimizer.apply(grads, train_p, opt_state, specs)
+        new_train, new_opt = apply_update(grads, train_p, opt_state, gspecs)
         new_params = {**static_p, **new_train}
         return new_params, new_opt, new_states, cost, metrics
 

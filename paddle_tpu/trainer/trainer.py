@@ -55,8 +55,15 @@ class SGD:
     def __init__(self, cost, parameters: Parameters, update_equation,
                  extra_layers=None, is_local: bool = True, pserver_spec=None,
                  use_etcd: bool = False, mesh: MeshContext | None = None,
-                 compute_dtype=None, declared_evaluators=None):
+                 compute_dtype=None, declared_evaluators=None,
+                 zero: int | None = None):
         self.compute_dtype = compute_dtype  # e.g. jnp.bfloat16 for the MXU
+        # weight-update sharding over the mesh data axis (parallel/zero.py
+        # — the pserver's sharded aggregation, in-mesh): 0 = replicated
+        # update (the v2 behavior), 1 = 1/n-sharded optimizer state,
+        # 2 = reduce-scatter grads + sharded update + all-gather params.
+        # Default: the --zero flag (PADDLE_TPU_ZERO).
+        self.zero = flags.get("zero") if zero is None else int(zero)
         # v1 *_evaluator declarations (EvaluatorSpecs or a prebuilt
         # DeclaredEvaluators) executed host-side per batch, like
         # GradientMachine::eval driving Evaluator.cpp
@@ -117,6 +124,30 @@ class SGD:
     def _params_dict(self):
         return {n: jax.numpy.asarray(self.parameters[n]) for n in self.parameters.names()}
 
+    def _zero_active(self) -> bool:
+        return (self.zero >= 1
+                and self.mesh.mesh.shape.get("data", 1) > 1)
+
+    def _place_opt_state(self, opt_state):
+        """Device placement for the optimizer state: ZeRO runs shard the
+        slots 1/n over the data axis (parallel/zero.py), the replicated
+        update keeps full copies everywhere — ONE placement point shared
+        by train() init, checkpoint resume and the guard's rollback, so
+        every path agrees on the layout the jitted step expects."""
+        if not self._zero_active():
+            return self.mesh.replicate(opt_state)
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.parallel.zero import shard_opt_state
+
+        params = {n: jax.numpy.asarray(self.parameters[n])
+                  for n in self._trainable}
+        base = {
+            n: (P(*s.sharding) if getattr(s, "sharding", None) else P())
+            for n, s in self._specs.items() if n in self._trainable}
+        return shard_opt_state(opt_state, params, self.mesh.mesh,
+                               param_specs=base)
+
     def _ensure_built(self):
         if self._train_step is None:
             node_names = {n.name for n in self.topology.nodes}
@@ -133,7 +164,8 @@ class SGD:
             fetch = sorted(wanted & node_names)
             self._train_step = build_train_step(
                 self.topology, self.optimizer, self.mesh,
-                compute_dtype=self.compute_dtype, fetch_layers=fetch)
+                compute_dtype=self.compute_dtype, fetch_layers=fetch,
+                zero=self.zero)
             self._eval_step = build_eval_step(self.topology, self.mesh)
             taps = (self.declared_evaluators.grad_tap_layers()
                     if self.declared_evaluators else [])
@@ -263,7 +295,7 @@ class SGD:
             opt_state = self.optimizer.init(
                 {k: params[k] for k in self._trainable}, self._specs
             )
-            opt_state = self.mesh.replicate(opt_state)
+            opt_state = self._place_opt_state(opt_state)
         else:
             opt_state = self._opt_state
 
@@ -338,7 +370,7 @@ class SGD:
             if name in self.parameters:
                 self.parameters[name] = arr
         params = self.mesh.replicate(self._params_dict())
-        opt_state = (self.mesh.replicate(copt) if copt is not None
+        opt_state = (self._place_opt_state(copt) if copt is not None
                      else opt_state_template)
         if cstates:
             # restore each state at its template dtype (bf16/f8

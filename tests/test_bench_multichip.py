@@ -22,7 +22,8 @@ def test_grid_runs_with_collective_census():
     rows = bm.run_grid(steps=2, layers=1, embed=16, seq_len=16,
                        batch_per_replica=1)
     by_name = {r["config"]: r for r in rows}
-    assert {"dp8", "dp4_tp2", "dp2_sp2_tp2", "tp8", "pp4"} <= set(by_name)
+    assert {"dp8", "dp4_tp2", "dp2_sp2_tp2", "tp8", "pp4",
+            "dp8_zero1", "dp8_zero2"} <= set(by_name)
     for r in rows:
         assert np.isfinite(r["loss"]), r
         assert r["wall_ms_per_step"] > 0
@@ -36,6 +37,11 @@ def test_grid_runs_with_collective_census():
         "collective-permute", 0) >= 1
     assert by_name["pp4"]["collectives_hlo"].get(
         "collective-permute", 0) >= 1
+    # the zero2 row's compiled program carries the ZeRO-2 collective
+    # swap: literal reduce-scatter + all-gather ops for the grad flow
+    z2 = by_name["dp8_zero2"]["collectives_hlo"]
+    assert z2.get("reduce-scatter", 0) >= 1, z2
+    assert z2.get("all-gather", 0) >= 1, z2
 
 
 def test_grid_for_scales_down():
@@ -45,4 +51,5 @@ def test_grid_for_scales_down():
     names2 = [c["name"] for c in bm.grid_for(2)]
     assert "dp2" in names2 and "pp2" in names2
     names8 = [c["name"] for c in bm.grid_for(8)]
-    assert len(names8) == 5
+    assert len(names8) == 7
+    assert "dp8_zero2" in names8

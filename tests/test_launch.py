@@ -1,0 +1,91 @@
+"""The trainer-fleet launcher (paddle_tpu.distributed.launch): rank
+env/argv templating, per-rank log tee, first-failure propagation, pod
+command emission — the SSH cluster launcher of the reference
+(``paddle/scripts/cluster_train/paddle.py``) rebuilt for SPMD."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from paddle_tpu.distributed.launch import (
+    emit_pod_commands,
+    launch_local,
+    main,
+    rank_env,
+)
+
+_PY = sys.executable
+
+
+def test_all_ranks_succeed_and_logs_teed(tmp_path):
+    rc = launch_local(
+        [_PY, "-c",
+         "import os, sys; print('rank', os.environ['PADDLE_TPU_TRAINER_ID'],"
+         " 'of', os.environ['PADDLE_TPU_NPROC'], 'arg {rank}')"],
+        nproc=3, log_dir=str(tmp_path), echo_rank0=False, timeout=60)
+    assert rc == 0
+    for i in range(3):
+        text = (tmp_path / f"rank{i}.log").read_text()
+        # env AND {rank} substitution agree
+        assert f"rank {i} of 3 arg {i}" in text
+
+
+def test_first_failure_propagates_and_kills_stragglers(tmp_path):
+    import time
+
+    t0 = time.monotonic()
+    rc = launch_local(
+        [_PY, "-c",
+         "import os, sys, time\n"
+         "r = int(os.environ['PADDLE_TPU_TRAINER_ID'])\n"
+         "sys.exit(7) if r == 1 else time.sleep(120)"],
+        nproc=3, log_dir=str(tmp_path), echo_rank0=False, timeout=90)
+    # rank 1's code comes back, and the 120 s sleepers were reaped
+    assert rc == 7
+    assert time.monotonic() - t0 < 60
+
+
+def test_coordinator_env_is_shared(tmp_path):
+    rc = launch_local(
+        [_PY, "-c",
+         "import os; print('coord', os.environ['PADDLE_TPU_COORDINATOR'],"
+         " 'port {port}')"],
+        nproc=2, log_dir=str(tmp_path), echo_rank0=False, timeout=60)
+    assert rc == 0
+    texts = [(tmp_path / f"rank{i}.log").read_text() for i in range(2)]
+    coord0 = texts[0].split("coord ")[1].split()[0]
+    coord1 = texts[1].split("coord ")[1].split()[0]
+    assert coord0 == coord1  # every rank sees the same rendezvous point
+    assert coord0.split(":")[1] in texts[0]  # {port} matches the env
+
+
+def test_timeout_kills_fleet(tmp_path):
+    rc = launch_local([_PY, "-c", "import time; time.sleep(60)"],
+                      nproc=2, log_dir=str(tmp_path), echo_rank0=False,
+                      timeout=1.0, poll_s=0.05)
+    assert rc == 124  # the timeout(1) convention
+
+
+def test_emit_pod_commands():
+    lines = emit_pod_commands(["h0", "h1"], ["python", "train.py",
+                                             "--trainer_id", "{rank}"])
+    assert len(lines) == 2
+    assert "PADDLE_TPU_TRAINER_ID=0" in lines[0]
+    assert "PADDLE_TPU_COORDINATOR=h0:8476" in lines[1]  # host 0 leads
+    assert "--trainer_id 1" in lines[1]
+
+
+def test_cli_emit_mode(capsys):
+    rc = main(["--emit_hosts", "a,b", "--", "python", "w.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "# on a:" in out and "# on b:" in out
+
+
+def test_rank_env_isolated_base():
+    env = rank_env(2, 4, 1234, base_env={"KEEP": "1"})
+    assert env["PADDLE_TPU_TRAINER_ID"] == "2"
+    assert env["PADDLE_TPU_NPROC"] == "4"
+    assert env["KEEP"] == "1"
+    assert "PATH" not in env or os.environ.get("PATH") != env  # no leak

@@ -3,11 +3,15 @@ synthetic bar-code labels (the reference's scene-text CRNN + WarpCTC path,
 tested like its test_TrainerOnePass convergence checks)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.models.ocr_crnn import crnn_ctc_cost, synthetic_ocr_reader
 
 
+# ~2.5 min on CPU: the GRU runs the fused pallas kernel in interpret
+# mode for a full convergence loop
+@pytest.mark.slow
 def test_crnn_ctc_learns_and_decodes():
     cost, probs, feed_order = crnn_ctc_cost(num_classes=8, rnn_size=32)
     parameters = paddle.parameters.create(

@@ -63,6 +63,14 @@ def grid_for(n: int) -> list[dict]:
                      "mesh": {"data": n // 4, "seq": 2, "model": 2}})
         cfgs.append({"name": "tp%d" % n, "kind": "transformer",
                      "mesh": {"model": n}})
+        # ZeRO weight-update sharding rows: same dp mesh, sharded
+        # optimizer state (zero1) / reduce-scattered grad flow (zero2) —
+        # the census should show all-reduce replaced by reduce-scatter +
+        # all-gather on the zero2 row
+        cfgs.append({"name": "dp%d_zero1" % n, "kind": "transformer",
+                     "mesh": {"data": n}, "zero": 1})
+        cfgs.append({"name": "dp%d_zero2" % n, "kind": "transformer",
+                     "mesh": {"data": n}, "zero": 2})
     if n >= 2:
         cfgs.append({"name": "pp%d" % min(4, n), "kind": "pipeline",
                      "stages": min(4, n)})
@@ -70,7 +78,8 @@ def grid_for(n: int) -> list[dict]:
 
 
 def _build_transformer_step(mesh_axes: dict, layers: int, embed: int,
-                            seq_len: int, batch_per_replica: int):
+                            seq_len: int, batch_per_replica: int,
+                            zero: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -91,7 +100,12 @@ def _build_transformer_step(mesh_axes: dict, layers: int, embed: int,
     params = T.place_params(T.init_params(cfg, jax.random.key(0)), mesh, cfg)
     opt = Adam(learning_rate=1e-4)
     state = opt.init_tree(params)
-    step = T.build_train_step(cfg, opt, mesh=mesh)
+    if zero >= 1:
+        from paddle_tpu.parallel import zero as zero_mod
+
+        state = zero_mod.shard_opt_state(
+            state, params, mesh, param_specs=T.param_shardings(cfg))
+    step = T.build_train_step(cfg, opt, mesh=mesh, zero=zero)
     b = batch_per_replica * mesh.shape.get("data", 1)
     ids = np.random.default_rng(0).integers(0, 256, (b, seq_len + 1))
     spec = P("data", None) if "data" in mesh.shape else P(None, None)
@@ -224,7 +238,8 @@ def bench_config(cfg: dict, steps: int, layers: int, embed: int,
             cfg["stages"], width=embed, batch=8 * cfg["stages"])
     else:
         run_once, mesh, hlo_text = _build_transformer_step(
-            cfg["mesh"], layers, embed, seq_len, batch_per_replica)
+            cfg["mesh"], layers, embed, seq_len, batch_per_replica,
+            zero=cfg.get("zero", 0))
 
     loss = run_once()  # compile
     float(np.asarray(loss).reshape(-1)[0])

@@ -1,0 +1,121 @@
+"""ZeRO weight-update-sharding ablation on a forced-8-device host mesh.
+
+Runs the SAME small transformer LM three ways — replicated update
+(zero=0), state-sharded (zero=1), reduce-scatter/sharded-update/
+all-gather (zero=2) — and emits one JSONL row per mode with
+
+- ``steps_per_sec`` (wall, post-compile),
+- ``opt_state_bytes_per_device`` (addressable slot residency — the
+  ZeRO-1 headline: 1/n under zero>=1),
+- ``grad_reduce_bytes_per_device`` (the traced gradient-sync payload a
+  device materializes: the full all-reduce copy when replicated, the 1/n
+  reduce-scatter shard under zero=2 — the ZeRO-2 headline),
+- the per-kind collective census of the step program.
+
+Standalone: ``python tools/bench_zero.py`` (forces JAX_PLATFORMS=cpu +
+8 host devices when run on a 1-device box, so the ablation is about the
+lowering, not the hardware).  ``bench.py`` shells out to this script so
+the rows ride the normal bench stream on any machine.  On a real pod the
+same rows measure actual ICI traffic shifts.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+if __name__ == "__main__":  # force the virtual mesh BEFORE jax imports
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _repo not in sys.path:
+        sys.path.insert(0, _repo)
+
+import numpy as np
+
+
+def run_ablation(steps: int = 8, layers: int = 2, embed: int = 64,
+                 seq_len: int = 64, batch_per_replica: int = 2) -> list[dict]:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.optimizer import Adam
+    from paddle_tpu.parallel import zero as Z
+    from paddle_tpu.telemetry import capture_comm, census_by_kind
+
+    n = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()).reshape(n), ("data",))
+    cfg = T.TransformerConfig(
+        vocab_size=256, num_layers=layers, num_heads=4, embed_dim=embed,
+        mlp_dim=embed * 4, max_seq_len=seq_len, remat=False)
+    b = batch_per_replica * n
+    ids_np = np.random.default_rng(0).integers(0, 256, (b, seq_len + 1))
+    grad_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(
+        T.init_params(cfg, jax.random.key(0))))
+
+    rows = []
+    for zero in (0, 1, 2):
+        opt = Adam(learning_rate=1e-4)
+        params = T.place_params(T.init_params(cfg, jax.random.key(0)),
+                                mesh, cfg)
+        state = opt.init_tree(params)
+        if zero >= 1:
+            state = Z.shard_opt_state(state, params, mesh,
+                                      param_specs=T.param_shardings(cfg))
+        else:
+            state = jax.device_put(state, NamedSharding(mesh, P()))
+        step = T.build_train_step(cfg, opt, mesh=mesh, zero=zero)
+        ids = jax.device_put(jnp.asarray(ids_np),
+                             NamedSharding(mesh, P("data", None)))
+        with capture_comm() as comm:
+            step.lower(params, state, ids)
+        params, state, loss = step(params, state, ids)  # compile
+        float(loss)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            params, state, loss = step(params, state, ids)
+        float(loss)
+        wall = time.monotonic() - t0
+        # grad-reduce bytes a device materializes per step: zero=2 has
+        # the traced reduce_scatter shards (+ all_reduce of indivisible
+        # leaves); replicated/zero1 all-reduce a full gradient copy
+        # (implicit GSPMD — statically the whole param payload)
+        rs = comm.get("reduce_scatter/data", 0.0)
+        ar = comm.get("all_reduce/data", 0.0)
+        grad_reduce = (rs + ar) if zero >= 2 else float(grad_bytes)
+        rows.append({
+            "metric": f"zero{zero}_train",
+            "value": round(steps / wall, 2), "unit": "steps/s",
+            "steps_per_sec": round(steps / wall, 2),
+            "opt_state_bytes_per_device": int(
+                Z.state_bytes_per_device(state)),
+            "grad_reduce_bytes_per_device": int(grad_reduce),
+            "param_bytes_total": int(grad_bytes),
+            "collective_census": census_by_kind(comm),
+            "config": f"{layers}L/{embed}d transformer LM, dp{n}, "
+                      f"bs {b}x{seq_len}, zero={zero}",
+            "vs_baseline": 0,
+        })
+    return rows
+
+
+def main() -> int:
+    rows = run_ablation()
+    from paddle_tpu.telemetry import JsonlSink, MetricsRegistry
+
+    reg = MetricsRegistry("bench_zero")
+    reg.add_sink(JsonlSink(sys.stdout))
+    for r in rows:
+        reg.emit(r, kind="bench")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,21 @@ def _host_bound(r: dict) -> bool:
     return bool(wait and ms and wait > 0.2 * ms)
 
 
+def _census_by_kind(comm: dict) -> dict:
+    """Per-kind rollup of an {"op/axis": bytes} map (standalone twin of
+    ``paddle_tpu.telemetry.census_by_kind`` — this tool must run on a
+    bare checkout without importing the package)."""
+    out: dict = {}
+    for key, nbytes in comm.items():
+        kind, _, axis = key.partition("/")
+        row = out.setdefault(kind, {"bytes": 0.0, "sites": 0, "axes": []})
+        row["bytes"] += float(nbytes)
+        row["sites"] += 1
+        if axis and axis not in row["axes"]:
+            row["axes"].append(axis)
+    return out
+
+
 def comm_table(steps: list[dict]) -> None:
     comm = None
     for r in reversed(steps):  # counters are cumulative: latest wins
@@ -124,6 +139,24 @@ def comm_table(steps: list[dict]) -> None:
     print("|---|---|")
     for key, v in sorted(comm.items(), key=lambda kv: -kv[1]):
         print(f"| {key} | {v:,.0f} |")
+    # the per-kind census: under ZeRO-2 the gradient flow's all_reduce
+    # row drops to (near) zero, replaced by reduce_scatter + all_gather
+    # at 1/n per-device payload — the collective swap, visible at a
+    # glance
+    census = _census_by_kind(comm)
+    total = sum(r["bytes"] for r in census.values()) or 1.0
+    print("\n## Collective census (per kind)\n")
+    print("| kind | bytes/step/device | share | call sites | axes |")
+    print("|---|---|---|---|---|")
+    for kind, row in sorted(census.items(), key=lambda kv: -kv[1]["bytes"]):
+        print(f"| {kind} | {row['bytes']:,.0f} "
+              f"| {100.0 * row['bytes'] / total:.1f}% "
+              f"| {row['sites']} | {', '.join(row['axes'])} |")
+    if "reduce_scatter" in census and \
+            census.get("all_reduce", {}).get("bytes", 0.0) \
+            < 0.01 * census["reduce_scatter"]["bytes"]:
+        print("\n_reduce-scatter carries the gradient flow (all-reduce "
+              "≈ 0): the weight update is ZeRO-sharded._")
 
 
 def recovery_table(faults: list[dict], recoveries: list[dict]) -> None:
