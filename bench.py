@@ -528,6 +528,35 @@ def bench_zero(records):
         records.append(r)
 
 
+def bench_serving(records):
+    """Serving ablation (tools/bench_serving.py in a subprocess, CPU-safe):
+    continuous batching vs naive static batching on the same synthetic
+    Poisson arrival trace — tokens/sec + p99 TTFT per mode and the
+    speedup row (the continuous engine refills retired slots every step
+    instead of draining whole batches)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_serving.py")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run([sys.executable, script], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench_serving subprocess failed: "
+                           f"{out.stderr[-400:]}")
+    for line in out.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        r = json.loads(line)
+        for k in ("schema", "ts", "host", "kind"):
+            r.pop(k, None)
+        records.append(r)
+
+
 def bench_transformer(records):
     """124M GPT-2-shape LM, bs 8x1024, mixed precision, flash attention,
     dots-remat — the modern-workload flagship row."""
@@ -618,7 +647,8 @@ def main() -> None:
     failures = []
     rows = (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
             bench_nmt, bench_ctr, bench_crnn, bench_saturation,
-            bench_input_pipeline, bench_transformer, bench_zero)
+            bench_input_pipeline, bench_transformer, bench_zero,
+            bench_serving)
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything.  --prefetch=0|N
     # sets the input-pipeline ablation depth (0 = sync row only).

@@ -100,6 +100,13 @@ class Parameters:
             return
         self._specs[spec.name] = spec
 
+    def uninitialized_names(self) -> list[str]:
+        """Specs with no materialized value yet — what ``init_missing``
+        would fill with fresh random weights.  Serving paths check this
+        BEFORE init_missing: an incomplete checkpoint must raise, not
+        silently serve random weights (``Inference(strict=True)``)."""
+        return [n for n in self._specs if n not in self._values]
+
     def init_missing(self, key=None) -> None:
         """Materialize values for all specs that don't have one yet."""
         missing = [n for n in self._specs if n not in self._values]

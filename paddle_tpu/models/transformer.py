@@ -309,6 +309,108 @@ def forward_with_aux(cfg: TransformerConfig, params: dict, ids: jax.Array,
     return x @ params["embed"].T, jnp.mean(auxes)
 
 
+# -- incremental inference (the serving path) ---------------------------------
+#
+# Training runs the whole context through `forward` every step; serving
+# can't — decode is one token per sequence per step over a ragged,
+# continuously re-batched population.  The two entry points below split
+# the forward into the standard prefill/decode pair over the paged
+# KV-cache of ops/pallas/paged_attention.py (layout and page-table
+# semantics documented there; paddle_tpu/serving/ owns allocation and
+# scheduling).  Both reuse this module's block math verbatim, so
+# incremental decode is token-for-token equal to repeated full-context
+# `forward` argmax (asserted in tests/test_serving.py).
+
+
+def _block_kv(cfg: TransformerConfig, mesh, x, layer):
+    """One decoder block that also returns its K/V — the prefill body.
+    Identical math to ``_block`` (dense FFN path; no remat — inference
+    holds no backward), with the attention inputs captured for the cache."""
+    b, t, e = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+    q = (h @ layer["wq"]).reshape(b, t, nh, hd)
+    k = (h @ layer["wk"]).reshape(b, t, nh, hd)
+    v = (h @ layer["wv"]).reshape(b, t, nh, hd)
+    a = _attention(cfg, q, k, v, mesh)
+    x = x + a.reshape(b, t, nh * hd) @ layer["wo"]
+    h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+    h = jax.nn.gelu(h @ layer["w_in"] + layer["b_in"])
+    return x + h @ layer["w_out"] + layer["b_out"], (k, v)
+
+
+def forward_prefill(cfg: TransformerConfig, params: dict, ids: jax.Array,
+                    seq_lens: jax.Array, mesh=None):
+    """Prompt pass: ids [B, T] right-padded, seq_lens [B] valid lengths.
+
+    Returns (last-token logits [B, V], k [L, B, T, H, Dh], v likewise) —
+    the K/V stacks are scattered into the paged cache by the caller
+    (``paged_attention.write_prefill_kv``).  Causal masking means padded
+    positions are never attended by valid queries, so plain right-padding
+    is exact; rows with ``seq_lens == 0`` (slack in a fixed-size prefill
+    batch) produce garbage logits the caller discards."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "serving prefill/decode cover the dense-FFN transformer; "
+            "quantized/MoE decode is future work")
+    b, t = ids.shape
+    x = params["embed"][ids] + params["pos_embed"][:t][None]
+    x, (ks, vs) = lax.scan(
+        functools.partial(_block_kv, cfg, mesh), x, params["blocks"])
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    last = jnp.take_along_axis(
+        x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    return last @ params["embed"].T, ks, vs
+
+
+def forward_decode(cfg: TransformerConfig, params: dict, ids: jax.Array,
+                   positions: jax.Array, seq_lens: jax.Array,
+                   page_table: jax.Array, k_cache, v_cache,
+                   attn_impl: str = "auto", mesh=None):
+    """One incremental decode step over the paged KV-cache.
+
+    ids [B] current tokens, positions [B] their absolute indices,
+    seq_lens [B] = positions + 1 on live rows and 0 on idle rows,
+    page_table [B, max_pages], k_cache/v_cache [L, H, P, page_size, Dh]
+    (``paged_attention.init_kv_pages``).  Each block writes the new
+    token's K/V into its pages, then runs ragged paged attention over
+    the whole resident context.  Returns (logits [B, V], k_cache',
+    v_cache'); idle rows write the null page and read zeros.
+
+    ``attn_impl`` is the paged-attention implementation ("auto" =
+    Pallas kernel on TPU, jnp reference elsewhere) — deliberately
+    separate from ``cfg.attn_impl``, which describes TRAINING attention
+    over contiguous sequences."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "serving prefill/decode cover the dense-FFN transformer; "
+            "quantized/MoE decode is future work")
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    b = ids.shape[0]
+    nh, hd = cfg.num_heads, cfg.head_dim
+    x = params["embed"][ids] + params["pos_embed"][positions]
+
+    def block(x, layer_kv):
+        layer, kc, vc = layer_kv
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = (h @ layer["wq"]).reshape(b, nh, hd)
+        k = (h @ layer["wk"]).reshape(b, nh, hd)
+        v = (h @ layer["wv"]).reshape(b, nh, hd)
+        kc, vc = pa.write_decode_kv(kc, vc, k, v, page_table, positions)
+        a = pa.ragged_paged_attention(q, kc, vc, page_table, seq_lens,
+                                      impl=attn_impl)
+        x = x + a.reshape(b, nh * hd) @ layer["wo"]
+        h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+        h = jax.nn.gelu(h @ layer["w_in"] + layer["b_in"])
+        return x + h @ layer["w_out"] + layer["b_out"], (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(
+        block, x, (params["blocks"], k_cache, v_cache))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    return x @ params["embed"].T, k_cache, v_cache
+
+
 def loss_fn(cfg: TransformerConfig, params: dict, ids: jax.Array,
             mesh=None) -> jax.Array:
     """Next-token mean cross-entropy (targets = ids shifted left).

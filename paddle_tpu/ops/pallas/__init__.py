@@ -27,8 +27,12 @@ def round_up(x: int, m: int) -> int:
 
 
 from paddle_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from paddle_tpu.ops.pallas.paged_attention import (  # noqa: E402
+    ragged_paged_attention,
+)
 
-__all__ = ["flash_attention", "default_interpret", "NEG_INF", "round_up"]
+__all__ = ["flash_attention", "ragged_paged_attention", "default_interpret",
+           "NEG_INF", "round_up"]
 
 
 def mxu_precision(ref):

@@ -1,0 +1,43 @@
+"""paddle_tpu.serving — the online inference engine (TPU-native serving).
+
+The reference framework served models through the C gradient-machine API
+(``paddle/capi/gradient_machine.h``; MIGRATION.md maps it).  This package
+is its production-scale successor: continuous batching over a paged
+KV-cache for the transformer LM, plus a micro-batching dense path for the
+CTR/recommender models.
+
+- ``kv_cache``   — PageAllocator (free-list, null page 0) + PagedKVCache
+  (device page pools + host page tables);
+- ``scheduler``  — continuous-batching request scheduler: admission
+  control by free pages / concurrent-token budget, prefill/decode
+  interleave, per-step join/retire; deterministic given seed + arrival
+  order;
+- ``engine``     — ServingEngine: thread-safe submit()/results() over a
+  background step loop (or synchronous ``run_until_idle`` for CLIs and
+  tests), jitted prefill/decode closures, per-request telemetry
+  (queue wait, TTFT, TPOT) through the MetricsRegistry;
+- ``sampling``   — greedy + temperature sampling under explicit PRNG keys;
+- ``export``     — checkpoint -> servable artifact (sha256 manifest, the
+  trainer checkpoint format's serving twin);
+- ``dense``      — DenseBatcher: micro-batching front-end for the batch
+  v2 ``Inference`` path (CTR / recommender scoring);
+- ``__main__``   — ``python -m paddle_tpu.serving`` stdin CLI loop.
+
+Attention kernel: ``ops/pallas/paged_attention.py`` (ragged paged
+attention; Pallas on TPU, pure-jnp reference elsewhere).
+"""
+
+from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
+from paddle_tpu.serving.export import (  # noqa: F401
+    checkpoint_to_servable,
+    export_servable,
+    load_servable,
+)
+from paddle_tpu.serving.kv_cache import PageAllocator, PagedKVCache  # noqa: F401
+from paddle_tpu.serving.scheduler import (  # noqa: F401
+    Request,
+    RequestResult,
+    Scheduler,
+    ServingConfig,
+)
+from paddle_tpu.serving.sampling import sample_tokens  # noqa: F401

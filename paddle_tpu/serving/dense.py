@@ -1,0 +1,151 @@
+"""DenseBatcher — the micro-batching serving front-end for the dense
+(non-autoregressive) models: CTR, recommender, image scorers.
+
+These models need no KV-cache — one forward scores a request — but
+serving them a row at a time wastes the MXU.  The batcher coalesces
+concurrent ``submit()`` rows into one forward (up to ``max_batch`` rows
+or ``max_wait_ms``, whichever first) and fans results back out, the
+standard online-batching pattern the reference's capi serving loop left
+to the caller.
+
+The predict function is any rows -> row-aligned-outputs callable;
+``from_inference`` builds one from the v2 ``Inference`` path with
+``strict=True`` (an incomplete checkpoint raises at build time instead of
+silently serving random weights — see ``trainer/inference.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+
+class _Pending:
+    """One submitted row: a tiny future (event + value/error)."""
+
+    __slots__ = ("row", "_event", "_value", "_error")
+
+    def __init__(self, row):
+        self.row = row
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        enforce(self._event.wait(timeout), "DenseBatcher result timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class DenseBatcher:
+    def __init__(self, predict_fn, max_batch: int = 64,
+                 max_wait_ms: float = 2.0, registry=None):
+        from paddle_tpu import metrics as metrics_mod
+
+        enforce(max_batch >= 1, "max_batch must be >= 1")
+        self._predict = predict_fn
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1e3
+        self._registry = registry or metrics_mod.get_registry()
+        self._queue: list[_Pending] = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="dense-batcher", daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def from_inference(cls, output_layer, parameters, feeding=None,
+                       max_batch: int = 64, max_wait_ms: float = 2.0,
+                       registry=None, strict: bool = True):
+        """Batcher over ``Inference.infer`` (the v2 topology path);
+        ``strict`` (serving default) refuses incomplete parameters."""
+        from paddle_tpu.trainer.inference import Inference
+
+        inf = Inference(output_layer, parameters, strict=strict)
+
+        def predict(rows):
+            return inf.infer(rows, feeding=feeding)
+
+        return cls(predict, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                   registry=registry)
+
+    def submit(self, row) -> _Pending:
+        """Queue one input row; returns a pending handle
+        (``.result(timeout)`` blocks for this row's output)."""
+        p = _Pending(row)
+        with self._cv:
+            enforce(not self._stop, "DenseBatcher is closed")
+            self._queue.append(p)
+            self._cv.notify()
+        return p
+
+    def __call__(self, row, timeout: float | None = 30.0):
+        return self.submit(row).result(timeout)
+
+    def close(self) -> None:
+        """Drain the queue, then stop the worker."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join()
+
+    # -- worker ---------------------------------------------------------------
+    def _take_batch(self) -> list[_Pending] | None:
+        with self._cv:
+            while not self._queue and not self._stop:
+                self._cv.wait()
+            if not self._queue:
+                return None  # stopped and drained
+            # first row opens the batch; linger up to max_wait for more
+            deadline = time.monotonic() + self._max_wait_s
+            while (len(self._queue) < self._max_batch and not self._stop):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    break
+            batch, self._queue[:] = (self._queue[:self._max_batch],
+                                     self._queue[self._max_batch:])
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                outs = self._predict([p.row for p in batch])
+                outs = np.asarray(outs)
+                enforce(outs.shape[0] == len(batch),
+                        f"predict_fn returned {outs.shape[0]} rows for a "
+                        f"batch of {len(batch)}")
+                for i, p in enumerate(batch):
+                    p._value = outs[i]
+            except Exception as e:  # fan the failure out, keep serving
+                for p in batch:
+                    p._error = e
+            except BaseException as e:  # KeyboardInterrupt/SystemExit:
+                for p in batch:  # unblock waiters, then let it kill the
+                    p._error = e  # worker (finally still sets the events)
+                raise
+            finally:
+                ms = (time.perf_counter() - t0) * 1e3
+                reg = self._registry
+                reg.histogram("serve_dense_batch",
+                              "coalesced rows per dense forward",
+                              buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)
+                              ).observe(len(batch))
+                reg.histogram("serve_dense_ms",
+                              "dense batch forward wall ms").observe(ms)
+                reg.counter("serve_dense_requests",
+                            "rows served by the dense path").inc(len(batch))
+                for p in batch:
+                    p._event.set()

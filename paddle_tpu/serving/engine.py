@@ -1,0 +1,345 @@
+"""ServingEngine — the online inference front-end.
+
+Owns the jitted prefill/decode closures over the paged KV-cache, drives
+the continuous-batching :class:`~paddle_tpu.serving.scheduler.Scheduler`,
+and exposes a thread-safe ``submit()/results()`` API:
+
+    eng = ServingEngine(cfg, params, ServingConfig(max_slots=8))
+    eng.start()                       # background step loop; or skip and
+    rid = eng.submit([5, 17, 3], max_new_tokens=32, temperature=0.7)
+    res = eng.results(n=1)[0]         # blocks until a request completes
+    eng.stop()
+
+Synchronous callers (CLIs, tests, benches) skip the thread:
+``eng.generate(prompts)`` or ``submit(...)`` + ``run_until_idle()``.
+
+Telemetry rides the shared :class:`MetricsRegistry`: histograms
+``serve_queue_wait_ms`` / ``serve_prefill_ms`` / ``serve_decode_step_ms``
+/ ``serve_ttft_ms`` / ``serve_tpot_ms``, counters ``serve_requests`` /
+``serve_tokens``, gauges ``serve_active_slots`` / ``serve_free_pages``,
+one ``kind="serve"`` record per completed request and a
+``kind="serve_summary"`` record (TTFT/TPOT p50/p99) from
+:meth:`emit_summary` — rendered by ``tools/metrics_to_md.py``'s
+"Serving latency" table.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.serving.kv_cache import PagedKVCache
+from paddle_tpu.serving.scheduler import (
+    Request,
+    RequestResult,
+    Scheduler,
+    ServingConfig,
+)
+
+_LAT_HISTS = ("serve_queue_wait_ms", "serve_prefill_ms",
+              "serve_decode_step_ms", "serve_ttft_ms", "serve_tpot_ms")
+
+
+class ServingEngine:
+    def __init__(self, cfg, params, serving: ServingConfig | None = None,
+                 registry=None):
+        """``cfg``: TransformerConfig; ``params``: the matching pytree
+        (e.g. from ``serving.export.load_servable``); ``serving``:
+        engine knobs."""
+        import jax
+
+        from paddle_tpu import metrics as metrics_mod
+
+        self.cfg = cfg
+        self.serving = serving or ServingConfig()
+        s = self.serving
+        enforce(s.max_prompt_len <= cfg.max_seq_len
+                and s.max_prompt_len + s.max_new_tokens <= cfg.max_seq_len,
+                "max_prompt_len + max_new_tokens exceeds cfg.max_seq_len")
+        # liveness: the largest admissible request must fit an EMPTY
+        # engine, or a queue head could block forever (admission is FIFO)
+        enforce(s.num_pages - 1 >= s.max_pages_per_seq,
+                f"num_pages {s.num_pages} (1 reserved for the null page) "
+                f"cannot hold one max-size request "
+                f"({s.max_pages_per_seq} pages)")
+        enforce(not s.max_concurrent_tokens or s.max_concurrent_tokens
+                >= s.max_prompt_len + s.max_new_tokens,
+                "max_concurrent_tokens is below one max-size request's "
+                "reservation — nothing could ever be admitted")
+        self.params = params
+        self.registry = registry or metrics_mod.get_registry()
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_heads, cfg.head_dim, s.num_pages,
+            s.page_size, s.max_slots, s.max_pages_per_seq, dtype=cfg.dtype)
+        self.scheduler = Scheduler(s, self.cache)
+        self._base_key = jax.random.key(s.seed)
+        self._lock = threading.Lock()
+        self._incoming: collections.deque[Request] = collections.deque()
+        self._completed: queue.Queue[RequestResult] = queue.Queue()
+        self._next_id = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._build_fns()
+
+    # -- jitted compute -------------------------------------------------------
+    def _build_fns(self) -> None:
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.ops.pallas import paged_attention as pa
+        from paddle_tpu.serving import sampling
+
+        cfg, attn_impl = self.cfg, self.serving.attn_impl
+        # prefill runs cfg.attn_impl — but a TRAINING config may name a
+        # mesh-dependent impl (ring/ulysses) or a Pallas kernel the
+        # serving host can't run fast (flash off-TPU, where interpret
+        # mode is a Python loop); degrade those to exact attention,
+        # which is numerically equivalent at serving shapes
+        if cfg.attn_impl in ("ring", "ulysses") or (
+                cfg.attn_impl == "flash"
+                and jax.default_backend() != "tpu"):
+            cfg = dataclasses.replace(cfg, attn_impl="exact")
+        # donating the cache lets XLA update pages in place; CPU has no
+        # donation and would warn every call
+        donate = (2, 3) if jax.default_backend() == "tpu" else ()
+
+        def prefill(params, base_key, kc, vc, ids, lens, table, rids,
+                    temps):
+            logits, ks, vs = T.forward_prefill(cfg, params, ids, lens)
+            kc, vc = pa.write_prefill_kv(kc, vc, ks, vs, table, lens)
+            keys = sampling.request_keys(
+                base_key, rids, jnp.zeros_like(rids))
+            return sampling.sample_tokens(logits, keys, temps), kc, vc
+
+        def decode(params, base_key, kc, vc, ids, positions, lens, table,
+                   rids, gens, temps):
+            logits, kc, vc = T.forward_decode(
+                cfg, params, ids, positions, lens, table, kc, vc,
+                attn_impl=attn_impl)
+            keys = sampling.request_keys(base_key, rids, gens)
+            return sampling.sample_tokens(logits, keys, temps), kc, vc
+
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        self._decode = jax.jit(decode, donate_argnums=donate)
+
+    # -- public API -----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int | None = None,
+               temperature: float = 0.0) -> int:
+        """Queue one request (thread-safe); returns its request id.
+        Prompt/limit validation errors raise here, not in the loop."""
+        s = self.serving
+        prompt = [int(t) for t in prompt]
+        n = s.max_new_tokens if max_new_tokens is None else max_new_tokens
+        enforce(1 <= n <= s.max_new_tokens,
+                f"max_new_tokens must be in [1, {s.max_new_tokens}], "
+                f"got {n}")
+        enforce(1 <= len(prompt) <= s.max_prompt_len,
+                f"prompt length must be in [1, {s.max_prompt_len}], "
+                f"got {len(prompt)}")
+        v = self.cfg.vocab_size
+        bad = [t for t in prompt if not 0 <= t < v]
+        enforce(not bad, f"prompt ids {bad[:8]} outside [0, {v}) — jnp "
+                "gather would clamp them silently")
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._incoming.append(Request(
+                id=rid, prompt=prompt, max_new_tokens=n,
+                temperature=float(temperature), arrival=time.perf_counter()))
+        return rid
+
+    def results(self, n: int | None = None,
+                timeout: float | None = None) -> list[RequestResult]:
+        """Pop up to ``n`` completed results (all currently available if
+        None), blocking up to ``timeout`` for the first."""
+        out: list[RequestResult] = []
+        if n is None:
+            # drain mode: optionally wait up to timeout for the first,
+            # then take whatever else is already there
+            try:
+                out.append(self._completed.get(block=timeout is not None,
+                                               timeout=timeout))
+            except queue.Empty:
+                return out
+            while True:
+                try:
+                    out.append(self._completed.get(block=False))
+                except queue.Empty:
+                    return out
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while len(out) < n:
+            try:
+                remaining = (None if deadline is None
+                             else max(deadline - time.monotonic(), 0.0))
+                out.append(self._completed.get(block=True,
+                                               timeout=remaining))
+            except queue.Empty:
+                break
+        return out
+
+    def generate(self, prompts, max_new_tokens: int | None = None,
+                 temperature: float = 0.0) -> list[RequestResult]:
+        """Synchronous convenience: submit every prompt, run the loop to
+        idle, return results ordered by submission."""
+        ids = [self.submit(p, max_new_tokens, temperature) for p in prompts]
+        self.run_until_idle()
+        got: dict[int, RequestResult] = {}
+        mine = set(ids)
+        for r in self.results():
+            if r.id in mine:
+                got[r.id] = r
+            else:  # a concurrent submit()-er's result: leave it queued
+                self._completed.put(r)
+        return [got[i] for i in ids]
+
+    def start(self) -> None:
+        """Run the step loop on a background thread."""
+        enforce(self._thread is None, "engine already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        self.emit_summary()
+
+    def run_until_idle(self) -> None:
+        """Drive the loop on the calling thread until no work remains."""
+        while self.step():
+            pass
+
+    # -- the step loop --------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.step():
+                time.sleep(1e-3)
+
+    def step(self) -> bool:
+        """One scheduler iteration: drain submissions, retire, admit +
+        prefill, decode.  Returns False when fully idle."""
+        sched, reg = self.scheduler, self.registry
+        now = time.perf_counter()
+        worked = False
+
+        with self._lock:
+            while self._incoming:
+                sched.enqueue(self._incoming.popleft())
+                worked = True
+
+        for a in sched.retire_finished():
+            self._finish(a)
+            worked = True
+
+        admitted = sched.admit(now=now)
+        if admitted:
+            t0 = time.perf_counter()
+            batch = sched.prefill_batch(admitted)
+            toks, self.cache.k, self.cache.v = self._prefill(
+                self.params, self._base_key, self.cache.k, self.cache.v,
+                *_dev(batch, "ids", "seq_lens", "page_table", "rids",
+                      "temps"))
+            toks = np.asarray(toks)
+            t1 = time.perf_counter()
+            hist = reg.histogram("serve_prefill_ms",
+                                 "prefill pass wall ms (per admitted batch)")
+            hist.observe((t1 - t0) * 1e3)
+            # the first generated token of each request is sampled here
+            reg.counter("serve_tokens", "tokens generated").inc(
+                len(admitted))
+            for j, a in enumerate(admitted):
+                reg.histogram(
+                    "serve_queue_wait_ms",
+                    "request wait between arrival and admission").observe(
+                        (a.t_admit - a.request.arrival) * 1e3)
+                a.t_first = t1
+                reg.histogram(
+                    "serve_ttft_ms", "time to first token").observe(
+                        (t1 - a.request.arrival) * 1e3)
+                sched.append_token(a, int(toks[j]))
+            worked = True
+
+        batch = sched.decode_batch()
+        if batch is not None:
+            live = batch.pop("live")
+            t0 = time.perf_counter()
+            toks, self.cache.k, self.cache.v = self._decode(
+                self.params, self._base_key, self.cache.k, self.cache.v,
+                *_dev(batch, "ids", "positions", "seq_lens", "page_table",
+                      "rids", "gens", "temps"))
+            toks = np.asarray(toks)
+            reg.histogram(
+                "serve_decode_step_ms",
+                "one continuous-batching decode step, wall ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+            reg.counter("serve_tokens", "tokens generated").inc(len(live))
+            for a in live:
+                sched.append_token(a, int(toks[a.slot]))
+            worked = True
+
+        reg.gauge("serve_active_slots",
+                  "sequences resident in the decode batch").set(
+                      len(sched.active))
+        reg.gauge("serve_free_pages", "KV-cache pages on the free list").set(
+            self.cache.allocator.free_pages)
+        return worked
+
+    def _finish(self, a) -> None:
+        now = time.perf_counter()
+        n = len(a.generated)
+        ttft_ms = (a.t_first - a.request.arrival) * 1e3
+        tpot_ms = ((now - a.t_first) / max(n - 1, 1)) * 1e3
+        total_ms = (now - a.request.arrival) * 1e3
+        self.registry.histogram(
+            "serve_tpot_ms", "mean per-token decode latency").observe(
+                tpot_ms)
+        self.registry.counter(
+            "serve_requests", "completed requests").inc(
+                1.0, reason=a.finished)
+        rec = {
+            "request": a.request.id, "prompt_tokens": a.prompt_len,
+            "new_tokens": n, "finish": a.finished,
+            "queue_wait_ms": round((a.t_admit - a.request.arrival) * 1e3, 3),
+            "ttft_ms": round(ttft_ms, 3), "tpot_ms": round(tpot_ms, 3),
+            "total_ms": round(total_ms, 3),
+        }
+        if self.registry.active:
+            self.registry.emit(rec, kind="serve")
+        self._completed.put(RequestResult(
+            id=a.request.id, prompt=list(a.request.prompt),
+            tokens=list(a.generated), finish_reason=a.finished,
+            metrics=rec))
+
+    def emit_summary(self) -> None:
+        """One ``serve_summary`` record with the latency histograms'
+        count/p50/p99/max — the SLO rollup operators read."""
+        if not self.registry.active:
+            return
+        summary: dict = {}
+        for name in _LAT_HISTS:
+            h = self.registry.get(name)
+            s = h.summary() if h is not None else None
+            if s:
+                summary[name] = {k: s[k] for k in
+                                 ("count", "p50", "p99", "max")}
+        self.registry.emit(
+            {"summary": summary,
+             "rejected_admissions": self.scheduler.rejected_admissions},
+            kind="serve_summary")
+
+
+def _dev(batch: dict, *names):
+    import jax.numpy as jnp
+
+    return [jnp.asarray(batch[n]) for n in names]

@@ -1,0 +1,156 @@
+"""Checkpoint -> servable export.
+
+A *servable* is the frozen serving artifact: ``params.npz`` plus a
+``servable.json`` manifest carrying the model config and a sha256 per
+payload file — the same uuid + content-hash + atomic tmp/rename
+convention as ``trainer/checkpoint.py`` (the Go pserver's recovery rule),
+so a torn or tampered export is detected at load, never served.
+
+Flows::
+
+    export_servable(dir, cfg, params)               # from live params
+    checkpoint_to_servable(ckpt_dir, out_dir, cfg)  # newest VALID ckpt
+    cfg, params = load_servable(dir)                # engine input
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+import uuid as uuid_mod
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+MANIFEST = "servable.json"
+SCHEMA = "paddle_tpu.servable/1"
+
+
+def _sha256(path: str) -> str:
+    # deferred: trainer.checkpoint imports jax at module scope, and this
+    # package keeps jax out of import time
+    from paddle_tpu.trainer.checkpoint import _sha256 as impl
+
+    return impl(path)
+
+
+def _cfg_to_json(cfg) -> dict:
+    """TransformerConfig -> plain-json dict (dtype stored by name)."""
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = np.dtype(cfg.dtype).name
+    return d
+
+
+def _cfg_from_json(d: dict):
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import TransformerConfig
+
+    d = dict(d)
+    d["dtype"] = jnp.dtype(d["dtype"])
+    return TransformerConfig(**d)
+
+
+def _flatten(params: dict, prefix="") -> dict[str, np.ndarray]:
+    flat = {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key + "/"))
+        else:
+            flat[key] = np.asarray(v)
+    return flat
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> dict:
+    out: dict = {}
+    for key, v in flat.items():
+        node, parts = out, key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def export_servable(out_dir: str, cfg, params: dict,
+                    meta: dict | None = None) -> str:
+    """Write ``out_dir`` atomically (tmp + rename); returns the path."""
+    tmp = out_dir.rstrip("/") + ".tmp-" + uuid_mod.uuid4().hex[:8]
+    os.makedirs(tmp, exist_ok=True)
+    try:
+        np.savez(os.path.join(tmp, "params.npz"), **_flatten(params))
+        manifest = {
+            "schema": SCHEMA,
+            "uuid": uuid_mod.uuid4().hex,
+            "created": time.time(),
+            "config": _cfg_to_json(cfg),
+            "files": {f: _sha256(os.path.join(tmp, f))
+                      for f in sorted(os.listdir(tmp))},
+            "meta": meta or {},
+        }
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+        # refresh-over-live: move the old artifact ASIDE first so the
+        # no-servable window is two renames, not a whole rmtree — a
+        # reader never sees a half-deleted directory
+        old = None
+        if os.path.exists(out_dir):
+            old = out_dir.rstrip("/") + ".old-" + uuid_mod.uuid4().hex[:8]
+            os.rename(out_dir, old)
+        try:
+            os.rename(tmp, out_dir)
+        except BaseException:
+            if old is not None:  # put the previous good artifact back
+                os.rename(old, out_dir)
+            raise
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return out_dir
+
+
+def load_servable(path: str):
+    """Validate hashes and return (TransformerConfig, params pytree)."""
+    import jax.numpy as jnp
+
+    mpath = os.path.join(path, MANIFEST)
+    enforce(os.path.exists(mpath), f"no servable manifest at {mpath}")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    for fname, digest in manifest["files"].items():
+        enforce(_sha256(os.path.join(path, fname)) == digest,
+                f"servable {path}: {fname} hash mismatch — refusing to "
+                "serve a corrupt/tampered artifact")
+    cfg = _cfg_from_json(manifest["config"])
+    with np.load(os.path.join(path, "params.npz")) as z:
+        flat = {k: z[k] for k in z.files}
+    # float payloads come back at the config's compute dtype (npz stores
+    # extension dtypes upcast, the checkpoint convention)
+    params = {k: jnp.asarray(v, dtype=cfg.dtype if v.dtype.kind == "f"
+                             else None)
+              for k, v in flat.items()}
+    return cfg, _unflatten(params)
+
+
+def checkpoint_to_servable(ckpt_dir: str, out_dir: str, cfg,
+                           meta: dict | None = None) -> str:
+    """Export the newest VALID trainer checkpoint under ``ckpt_dir`` as a
+    servable.  Parameter names must match ``transformer.init_params``'s
+    flat layout (the trainer saves ``params.npz`` keyed by name)."""
+    from paddle_tpu.trainer.checkpoint import latest_checkpoint, load_checkpoint
+
+    found = latest_checkpoint(ckpt_dir)
+    enforce(found is not None, f"no valid checkpoint under {ckpt_dir}")
+    path, manifest = found
+    params, _, _, _ = load_checkpoint(path)
+    nested = _unflatten(params)
+    return export_servable(
+        out_dir, cfg, nested,
+        meta={**(meta or {}), "checkpoint": path,
+              "checkpoint_uuid": manifest.get("uuid")})

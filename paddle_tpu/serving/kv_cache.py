@@ -1,0 +1,120 @@
+"""Paged KV-cache state: the free-list page allocator (host) and the
+device-resident page pools + page tables it manages.
+
+Design (PAPERS "Ragged Paged Attention", arxiv 2604.15464; layout details
+in ``ops/pallas/paged_attention.py``): the cache is a fixed pool of
+``num_pages`` pages of ``page_size`` token slots each, shared by every
+resident sequence.  A sequence owns a list of pages named by its row of
+the page table; on retirement the pages return to the free list and are
+reused verbatim (no zeroing needed — ``seq_lens`` masking means stale
+contents are never read).  Page 0 is reserved as the null/scratch page:
+never allocated, it absorbs idle-row writes and backs unused table
+entries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+
+class OutOfPages(RuntimeError):
+    """Raised by :meth:`PageAllocator.alloc` when the pool can't cover a
+    request — admission control catches this (or checks ``can_alloc``)
+    and leaves the request queued."""
+
+
+class PageAllocator:
+    """Free-list allocator over page ids ``1..num_pages-1`` (0 = null).
+
+    LIFO reuse (retired pages are handed out first): the hottest pages
+    stay resident in whatever cache hierarchy sits under the pool, and
+    tests can assert reuse deterministically."""
+
+    def __init__(self, num_pages: int):
+        enforce(num_pages >= 2, "need at least 2 pages (page 0 is null)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self._owned: set[int] = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` pages off the free list; raises :class:`OutOfPages`
+        without side effects if fewer are free."""
+        if n > len(self._free):
+            raise OutOfPages(
+                f"requested {n} pages, {len(self._free)} free "
+                f"(pool {self.num_pages})")
+        pages = [self._free.pop() for _ in range(n)]
+        self._owned.update(pages)
+        return pages
+
+    def free(self, pages) -> None:
+        """Return pages to the free list; double-free and freeing the
+        null page are hard errors (they would alias live sequences)."""
+        for p in pages:
+            enforce(p != 0, "page 0 (null) is never allocated or freed")
+            enforce(p in self._owned, f"double free of page {p}")
+            self._owned.remove(p)
+            self._free.append(p)
+
+
+class PagedKVCache:
+    """Device page pools for every layer + the host-side page table.
+
+    ``k``/``v``: [L, H, P, page_size, D] jax arrays (functional — the
+    jitted decode step returns replacements); ``page_table``: host
+    int32 [max_slots, max_pages_per_seq], row ``s`` owned by batch slot
+    ``s``.  The allocator spans the whole pool; slot bookkeeping
+    (assign/release) keeps table rows and the free list consistent."""
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_pages: int, page_size: int, max_slots: int,
+                 max_pages_per_seq: int, dtype=None):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.paged_attention import init_kv_pages
+
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.k, self.v = init_kv_pages(
+            num_layers, num_heads, num_pages, page_size, head_dim,
+            dtype=dtype or jnp.float32)
+        self.allocator = PageAllocator(num_pages)
+        self.page_table = np.zeros((max_slots, max_pages_per_seq), np.int32)
+        self._slot_pages: dict[int, list[int]] = {}
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    def assign(self, slot: int, tokens: int) -> list[int]:
+        """Allocate pages covering ``tokens`` positions to ``slot`` and
+        write its table row.  Raises :class:`OutOfPages` (no partial
+        state) when the pool can't cover it."""
+        enforce(slot not in self._slot_pages, f"slot {slot} already assigned")
+        n = self.pages_needed(tokens)
+        enforce(n <= self.max_pages_per_seq,
+                f"{tokens} tokens need {n} pages > max_pages_per_seq "
+                f"{self.max_pages_per_seq}")
+        pages = self.allocator.alloc(n)
+        self._slot_pages[slot] = pages
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :n] = pages
+        return pages
+
+    def release(self, slot: int) -> None:
+        """Retire a sequence: free its pages, zero its table row."""
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self.allocator.free(pages)
+        self.page_table[slot, :] = 0
+
+    def slot_pages(self, slot: int) -> list[int]:
+        return list(self._slot_pages.get(slot, ()))

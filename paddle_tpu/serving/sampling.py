@@ -1,0 +1,34 @@
+"""Token sampling for the decode loop — greedy + temperature, all under
+explicit PRNG keys so a serving trace is reproducible given (seed,
+arrival order): request ``r``'s ``n``-th sampled token always uses
+``fold_in(fold_in(base_key, r), n)`` regardless of which batch slot or
+step it lands in."""
+
+from __future__ import annotations
+
+
+def request_keys(base_key, request_ids, token_indices):
+    """Per-row sampling keys: fold the request id then the per-request
+    token index into ``base_key`` (both [B] int32)."""
+    import jax  # deferred: the package imports this module eagerly
+
+    def one(rid, n):
+        return jax.random.fold_in(jax.random.fold_in(base_key, rid), n)
+
+    return jax.vmap(one)(request_ids, token_indices)
+
+
+def sample_tokens(logits, keys, temperatures):
+    """logits [B, V], keys [B] PRNG keys, temperatures [B] -> tokens [B].
+
+    Rows with ``temperature <= 0`` are greedy (argmax); others draw from
+    softmax(logits / temperature) with that row's key."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temps = jnp.maximum(temperatures, 1e-6)[:, None]
+    drawn = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l)
+    )(keys, logits.astype(jnp.float32) / temps).astype(jnp.int32)
+    return jnp.where(temperatures > 0, drawn, greedy)

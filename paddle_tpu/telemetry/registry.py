@@ -36,7 +36,15 @@ from typing import Any
 # heartbeat_stale, gauges recovery_ms / checkpoint_restore_ms, and two
 # record kinds — "fault" (the numeric guard's nan_skip/nan_rollback
 # events) and "recovery" (one per supervisor restart)
-SCHEMA = "paddle_tpu.metrics/3"
+# /4 added the serving stream (paddle_tpu/serving/): record kinds
+# "serve" (one per completed request: queue_wait_ms/ttft_ms/tpot_ms/
+# total_ms) and "serve_summary" (latency histogram rollup), histograms
+# serve_queue_wait_ms / serve_prefill_ms / serve_decode_step_ms /
+# serve_ttft_ms / serve_tpot_ms / serve_dense_batch / serve_dense_ms,
+# counters serve_requests{reason} / serve_tokens / serve_dense_requests,
+# gauges serve_active_slots / serve_free_pages; histogram summaries grew
+# interpolated percentile fields (p50/p90/p99)
+SCHEMA = "paddle_tpu.metrics/4"
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)
@@ -130,13 +138,53 @@ class Histogram(_Metric):
             else:
                 h.buckets[-1] += 1
 
+    def _percentile_of(self, h: _Hist, q: float) -> float:
+        """Linear-interpolated q-th percentile from the bucket counts.
+
+        Within the bucket containing the target rank, values are assumed
+        uniform between the bucket's bounds (first bucket's lower bound =
+        observed min; overflow bucket's upper bound = observed max), so
+        the estimate is exact at bucket edges and clamped to [min, max]
+        — good enough to assert SLOs against (tests) and render (the
+        metrics_to_md "Serving latency" table)."""
+        rank = (q / 100.0) * h.count
+        cum = 0
+        lower = h.min
+        for i, cnt in enumerate(h.buckets):
+            upper = (self.bucket_edges[i] if i < len(self.bucket_edges)
+                     else h.max)
+            if cnt:
+                cum += cnt
+                if cum >= rank:
+                    lo = max(lower, h.min)
+                    hi = min(upper, h.max)
+                    frac = (rank - (cum - cnt)) / cnt
+                    return float(min(max(lo + (hi - lo) * frac, h.min),
+                                     h.max))
+            lower = upper
+        return float(h.max)
+
+    def percentile(self, q: float, **labels) -> float | None:
+        """Estimated q-th percentile (0..100) for a label set, or None
+        with no observations — lets tests/SLO checks assert e.g.
+        ``hist.percentile(99) < 250``."""
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        with self._lock():
+            h = self._series.get(_label_key(labels))
+            if h is None or not h.count:
+                return None
+            return self._percentile_of(h, q)
+
     def summary(self, **labels) -> dict | None:
         h = self._series.get(_label_key(labels))
         if h is None:
             return None
+        pct = ({f"p{q}": self._percentile_of(h, q) for q in (50, 90, 99)}
+               if h.count else {"p50": 0.0, "p90": 0.0, "p99": 0.0})
         return {"count": h.count, "sum": h.total,
                 "avg": h.total / h.count if h.count else 0.0,
-                "min": h.min, "max": h.max,
+                "min": h.min, "max": h.max, **pct,
                 "buckets": dict(zip([str(e) for e in self.bucket_edges]
                                     + ["+Inf"], h.buckets))}
 

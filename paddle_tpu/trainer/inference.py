@@ -16,13 +16,28 @@ from paddle_tpu.trainer.step import build_forward
 
 
 class Inference:
-    def __init__(self, output_layer, parameters: Parameters):
+    def __init__(self, output_layer, parameters: Parameters,
+                 strict: bool = False):
+        """``strict=True`` (the serving default — ``serving/dense.py``)
+        refuses to run when any topology parameter has no loaded value:
+        the legacy behaviour silently ``init_missing``-ed fresh random
+        weights, so serving from an incomplete checkpoint produced
+        plausible-looking garbage.  Offline/experimental callers keep
+        ``strict=False`` (a fresh ``parameters.create`` topology is fully
+        initialized anyway)."""
         if isinstance(output_layer, LayerOutput):
             output_layer = [output_layer]
         self.topology = Topology(output_layer)
         self.parameters = parameters
         for spec in self.topology.param_specs():
             self.parameters.add(spec)
+        if strict:
+            missing = self.parameters.uninitialized_names()
+            if missing:
+                raise ValueError(
+                    "Inference(strict=True): parameters have no value for "
+                    f"{sorted(missing)} — the checkpoint/tar is incomplete "
+                    "for this topology; refusing to serve random weights")
         self.parameters.init_missing()
         self.output_names = [o.name for o in output_layer]
         self._fwd = build_forward(self.topology, self.output_names)

@@ -1,0 +1,110 @@
+"""Paged KV-cache allocator + cache bookkeeping (serving/kv_cache.py):
+free-list reuse after retirement, out-of-pages admission rejection, and
+no cross-sequence page aliasing under a seeded alloc/free fuzz loop."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving.kv_cache import OutOfPages, PageAllocator, PagedKVCache
+
+
+class TestPageAllocator:
+    def test_null_page_never_allocated(self):
+        a = PageAllocator(8)
+        got = a.alloc(7)  # the whole pool
+        assert 0 not in got
+        assert sorted(got) == list(range(1, 8))
+
+    def test_out_of_pages_rejection_is_side_effect_free(self):
+        a = PageAllocator(4)
+        first = a.alloc(2)
+        with pytest.raises(OutOfPages):
+            a.alloc(2)  # only 1 free
+        assert a.free_pages == 1  # nothing leaked by the failed alloc
+        a.free(first)
+        assert a.free_pages == 3
+
+    def test_reuse_after_retirement(self):
+        a = PageAllocator(4)
+        s1 = a.alloc(3)
+        a.free(s1)
+        s2 = a.alloc(3)
+        # retired pages are reused (LIFO: the same set comes back)
+        assert set(s2) == set(s1)
+
+    def test_double_free_and_null_free_raise(self):
+        a = PageAllocator(4)
+        pages = a.alloc(1)
+        a.free(pages)
+        with pytest.raises(Exception):
+            a.free(pages)
+        with pytest.raises(Exception):
+            a.free([0])
+
+    def test_fuzz_no_cross_sequence_aliasing(self):
+        """Randomized (seeded) alloc/free churn: live allocations must
+        stay disjoint, never contain page 0, and conservation must hold
+        (free + live == pool)."""
+        rng = np.random.default_rng(7)
+        a = PageAllocator(33)  # 32 usable pages
+        live: dict[int, list[int]] = {}
+        next_id = 0
+        for _ in range(500):
+            if live and rng.random() < 0.45:
+                sid = list(live)[int(rng.integers(len(live)))]
+                a.free(live.pop(sid))
+            else:
+                n = int(rng.integers(1, 6))
+                if a.can_alloc(n):
+                    live[next_id] = a.alloc(n)
+                    next_id += 1
+                else:
+                    with pytest.raises(OutOfPages):
+                        a.alloc(n)
+            allocated = [p for pages in live.values() for p in pages]
+            assert 0 not in allocated
+            assert len(allocated) == len(set(allocated)), "page aliasing!"
+            assert a.free_pages + len(allocated) == 32
+        assert next_id > 50  # the loop actually exercised allocation
+
+
+class TestPagedKVCache:
+    def _cache(self, num_pages=16, max_slots=4):
+        return PagedKVCache(num_layers=2, num_heads=2, head_dim=8,
+                            num_pages=num_pages, page_size=4,
+                            max_slots=max_slots, max_pages_per_seq=8)
+
+    def test_assign_writes_table_row_and_release_clears_it(self):
+        c = self._cache()
+        pages = c.assign(1, tokens=10)  # 3 pages of 4
+        assert len(pages) == 3
+        assert list(c.page_table[1, :3]) == pages
+        assert all(c.page_table[1, 3:] == 0)
+        free_before = c.allocator.free_pages
+        c.release(1)
+        assert all(c.page_table[1] == 0)
+        assert c.allocator.free_pages == free_before + 3
+
+    def test_assign_rejects_when_pool_exhausted(self):
+        c = self._cache(num_pages=5)  # 4 usable
+        c.assign(0, tokens=12)  # 3 pages
+        with pytest.raises(OutOfPages):
+            c.assign(1, tokens=8)  # needs 2, only 1 free
+        # the failed assign left no partial state
+        assert all(c.page_table[1] == 0)
+        assert c.allocator.free_pages == 1
+
+    def test_rows_stay_disjoint_across_slots(self):
+        c = self._cache()
+        p0 = c.assign(0, tokens=8)
+        p1 = c.assign(2, tokens=8)
+        assert not set(p0) & set(p1)
+        c.release(0)
+        p2 = c.assign(3, tokens=8)
+        assert not set(p2) & set(p1)
+
+    def test_pages_needed_rounds_up(self):
+        c = self._cache()
+        assert c.pages_needed(1) == 1
+        assert c.pages_needed(4) == 1
+        assert c.pages_needed(5) == 2

@@ -183,6 +183,57 @@ def recovery_table(faults: list[dict], recoveries: list[dict]) -> None:
               f"| {_fmt(r.get('recovery_ms'))} |")
 
 
+def _pctl(vals: list[float], q: float) -> float:
+    """Nearest-rank-with-interpolation percentile over raw values (the
+    per-request serve records carry exact latencies, so no bucket
+    estimate is needed here)."""
+    vs = sorted(vals)
+    if len(vs) == 1:
+        return vs[0]
+    rank = (q / 100.0) * (len(vs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (rank - lo)
+
+
+def serving_table(serves: list[dict], summaries: list[dict]) -> None:
+    """Render the schema /4 serving stream: per-request latency
+    percentiles (TTFT / TPOT / queue wait / total) from the
+    ``kind="serve"`` records, plus the engine's own histogram rollup
+    (``serve_summary``) when present."""
+    if not serves and not summaries:
+        return
+    print("\n## Serving latency\n")
+    if serves:
+        toks = sum(r.get("new_tokens", 0) for r in serves)
+        print(f"**{len(serves)} requests** · {toks} generated tokens\n")
+        print("| metric | count | p50 ms | p99 ms | max ms |")
+        print("|---|---|---|---|---|")
+        for field, label in (("ttft_ms", "TTFT"), ("tpot_ms", "TPOT"),
+                             ("queue_wait_ms", "queue wait"),
+                             ("total_ms", "total")):
+            vals = [float(r[field]) for r in serves if field in r]
+            if not vals:
+                continue
+            print(f"| {label} | {len(vals)} | {_pctl(vals, 50):,.2f} "
+                  f"| {_pctl(vals, 99):,.2f} | {max(vals):,.2f} |")
+    for s in summaries[-1:]:  # the newest rollup wins
+        rows = s.get("summary") or {}
+        if rows:
+            print("\n_engine histogram rollup (bucket-interpolated):_\n")
+            print("| histogram | count | p50 ms | p99 ms | max ms |")
+            print("|---|---|---|---|---|")
+            for name, h in rows.items():
+                print(f"| {name} | {h.get('count', '-')} "
+                      f"| {_fmt(h.get('p50'))} | {_fmt(h.get('p99'))} "
+                      f"| {_fmt(h.get('max'))} |")
+        if s.get("rejected_admissions"):
+            print(f"\n_⚠ {s['rejected_admissions']} admission attempts "
+                  "blocked on pages/budget — requests queued while the "
+                  "cache was full; grow num_pages or max_concurrent_"
+                  "tokens if TTFT p99 matters more than memory._")
+
+
 def bench_table(rows: list[dict]) -> None:
     if not rows:
         return
@@ -209,6 +260,9 @@ def main(argv: list[str]) -> int:
     steps = [r for r in records if r.get("kind") == "step"]
     faults = [r for r in records if r.get("kind") == "fault"]
     recoveries = [r for r in records if r.get("kind") == "recovery"]
+    serves = [r for r in records if r.get("kind") == "serve"]
+    serve_summaries = [r for r in records
+                       if r.get("kind") == "serve_summary"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -222,9 +276,11 @@ def main(argv: list[str]) -> int:
             step_table(rs, last=last)
         comm_table(steps)
     recovery_table(faults, recoveries)
+    serving_table(serves, serve_summaries)
     bench_table(bench)
-    if not steps and not bench and not faults and not recoveries:
-        print("_no step, fault or bench records found_")
+    if not steps and not bench and not faults and not recoveries \
+            and not serves and not serve_summaries:
+        print("_no step, fault, serve or bench records found_")
     return 0
 
 
