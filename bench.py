@@ -313,6 +313,63 @@ def bench_ctr(records, bs=1024, saturated=False):
     })
 
 
+def _fused_ablation_row(records, metric, cost_fn, feed_fn, optimizer_fn,
+                        per_unit, unit_scale=1.0, n2=10, steps=4):
+    """Fused-vs-unfused TPP-kernel ablation: the SAME model + feed through
+    the trainer step with ``fused_kernels`` off vs on, reporting ms/step
+    both ways, the speedup, and the trajectory check.  Contract: on CPU
+    the fused routing resolves to the jnp reference (identical op
+    sequence) so the trajectories are bit-identical; on TPU the Pallas
+    kernels run and the match is tolerance-bounded (kernel accumulation
+    order; bound documented in BENCHMARKS.md).  A divergence beyond the
+    bound raises — a broken fused path must not report a speedup."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.core import rng as prng
+
+    # ONE feed for both modes: a feed_fn over an advancing shared rng
+    # (bench_crnn's) would hand each mode different batches and trip the
+    # divergence guard on data, not numerics
+    feed = feed_fn()
+    snap = flags.snapshot_raw()
+    res = {}
+    try:
+        for mode in ("off", "on"):
+            flags.set("fused_kernels", mode)
+            prng.seed(7)
+            step = _topology_step(cost_fn, lambda: feed,
+                                  optimizer=optimizer_fn())
+            losses = [float(np.asarray(step()).reshape(-1)[0])
+                      for _ in range(steps)]
+            ms = _two_point(step, n2=n2)
+            if ms <= 0:  # empty profiler trace (some CPU testbeds)
+                ms = _wall_two_point(step, n1=3, n2=max(n2, 6))
+            res[mode] = (ms, losses)
+    finally:
+        flags.restore_raw(snap)
+    (ms_off, l_off), (ms_on, l_on) = res["off"], res["on"]
+    l_off, l_on = np.asarray(l_off), np.asarray(l_on)
+    identical = bool(np.array_equal(l_off, l_on))
+    max_rel = float(np.max(np.abs(l_off - l_on)
+                           / np.maximum(np.abs(l_off), 1e-9)))
+    if not identical and max_rel > 5e-3:
+        raise RuntimeError(
+            f"{metric}: fused trajectory diverged from unfused "
+            f"(max rel diff {max_rel:.2e} over {steps} steps)")
+    records.append({
+        "metric": metric,
+        "value": round(ms_off / max(ms_on, 1e-9), 2), "unit": "x",
+        "unfused_ms": round(ms_off, 3), "fused_ms": round(ms_on, 3),
+        "unfused_" + per_unit: round(unit_scale * 1000.0
+                                     / max(ms_off, 1e-9), 1),
+        "fused_" + per_unit: round(unit_scale * 1000.0
+                                   / max(ms_on, 1e-9), 1),
+        "trajectory_identical": identical,
+        "trajectory_max_rel_diff": max_rel,
+        "vs_baseline": 0,
+    })
+    return ms_on
+
+
 def bench_crnn(records, bs=64, saturated=False):
     import jax
     import jax.numpy as jnp
@@ -344,10 +401,20 @@ def bench_crnn(records, bs=64, saturated=False):
         "metric": "ocr_crnn_ctc_train_samples_per_sec"
                   + (f"_bs{bs}_saturated" if saturated else ""),
         "value": round(bs / ms * 1000.0, 0), "unit": "samples/s",
-        "config": f"32x96 conv+BiLSTM+CTC, bs {bs}, bf16 mixed precision, bf16 Adam moments",
+        "config": f"32x96 conv+BN+ReLU(+BiLSTM+CTC), bs {bs}, bf16 mixed precision, bf16 Adam moments",
         "vs_baseline": 0,
         **_utilization(step),
     })
+    if not saturated:
+        # OCR step-time row of the TPP fused-kernel ablation (the CRNN
+        # conv stack rides layer.img_conv_bn -> ops/nn.conv2d_bn_relu)
+        _fused_ablation_row(
+            records, "ocr_crnn_fused_ablation_speedup",
+            lambda: crnn_ctc_cost(image_height=h, image_width=w,
+                                  num_classes=classes)[0],
+            feed_fn,
+            lambda: Adam(learning_rate=1e-3, moment_dtype=jnp.bfloat16),
+            per_unit="steps_per_sec", n2=10)
 
 
 def bench_saturation(records):
@@ -608,6 +675,23 @@ def bench_transformer(records):
 
 def bench_resnet(records):
     from paddle_tpu.models import image as M
+    from paddle_tpu.optimizer import Momentum
+
+    # fused-vs-unfused TPP ablation sub-row (bs 64): conv+BN+ReLU blocks
+    # + the ZeRO-less momentum update, trajectory asserted against the
+    # unfused XLA path (bit-identical on CPU, tolerance-bounded on TPU)
+    try:
+        _fused_ablation_row(
+            records, "resnet50_fused_ablation_speedup",
+            lambda: M.resnet_cost(depth=50)[0],
+            _image_feed(64, 224 * 224 * 3),
+            lambda: Momentum(momentum=0.9, learning_rate=0.1 / 64),
+            per_unit="img_per_sec", unit_scale=64, n2=8, steps=3)
+    except Exception as e:
+        records.append({
+            "metric": "resnet50_fused_ablation_speedup", "value": 0,
+            "unit": "x", "error": f"{type(e).__name__}: {e}"[:200],
+            "vs_baseline": 0})
 
     best = None
     for bs in (64, 128, 256):

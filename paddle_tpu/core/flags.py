@@ -191,3 +191,12 @@ define("heartbeat_stale_s", 0.0, "multihost watchdog: dump the flight ring "
                                  "and fail fast when this host's train-loop "
                                  "heartbeat goes stale for this many "
                                  "seconds (0 = watchdog off)")
+# TPP-style fused microkernels (ops/pallas/tpp): conv+BN+ReLU forward,
+# direct-conv BRGEMM, single-pass BN stats, and the fused optimizer-shard
+# update.  "auto" routes through the kernels on TPU only — the CPU path
+# keeps the reference XLA composition (bit-identical to the unfused
+# program), which the bench ablation relies on.
+define("fused_kernels", "auto", "route conv/BN/optimizer hot paths through "
+                                "the TPP fused Pallas microkernels "
+                                "(ops/pallas/tpp): auto = on-TPU only | "
+                                "on | off")

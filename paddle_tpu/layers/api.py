@@ -567,6 +567,93 @@ def batch_norm(
 batch_norm_layer = batch_norm
 
 
+def img_conv_bn(
+    input: LayerOutput,
+    filter_size,
+    num_filters: int,
+    num_channels: int | None = None,
+    stride=1,
+    padding=0,
+    act=None,
+    param_attr: ParamAttr | None = None,
+    bn_param_attr: ParamAttr | None = None,
+    bn_bias_attr=None,
+    epsilon: float = 1e-5,
+    moving_average_fraction: float = 0.9,
+    use_global_stats: bool | None = None,
+    layer_attr: ExtraAttr | None = None,
+    name: str | None = None,
+) -> LayerOutput:
+    """Fused conv (no bias) + batch-norm + activation as ONE layer node,
+    lowering to ``ops/nn.conv2d_bn_relu`` (the TPP fused kernel when the
+    ``fused_kernels`` flag enables it; the exact img_conv -> batch_norm
+    composition otherwise).
+
+    Parameter/state naming mirrors the two-layer form the model zoo used
+    before (conv under ``<name>_conv``, BN under ``<name>_bn`` with the
+    reference's ``.w1``/``.w2`` moving-stat slots), so checkpoints and
+    param counts are unchanged."""
+    name = name or gen_name("conv_bn")
+    kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    c_in = num_channels or input.depth
+    h_in, w_in = input.height, input.width
+    if not (h_in and w_in):
+        side = int(_pymath.sqrt(input.size // c_in))
+        h_in = w_in = side
+    h_out = _conv_out(h_in, kh, sh, ph)
+    w_out = _conv_out(w_in, kw, sw, pw)
+    wspec = _wspec(param_attr, name + "_conv", "w0",
+                   (kh, kw, c_in, num_filters), I.msra())
+    gamma = _wspec(bn_param_attr, name + "_bn", "w0", (num_filters,),
+                   I.constant(1.0))
+    beta = _wspec(
+        bn_bias_attr if isinstance(bn_bias_attr, ParamAttr) else None,
+        name + "_bn", "wbias", (num_filters,), I.constant(0.0))
+    mean_s = StateSpec(f"_{name}_bn.w1", (num_filters,), 0.0)
+    var_s = StateSpec(f"_{name}_bn.w2", (num_filters,), 1.0)
+    activation = act_mod.get(act) if act is not None else act_mod.ReluActivation()
+
+    def fwd(ctx, params, states, x):
+        xr = _to_nhwc(raw(x), c_in, h_in, w_in)
+        training = (ctx.is_train if use_global_stats is None
+                    else (not use_global_stats))
+        y, nm, nv = nn_ops.conv2d_bn_relu(
+            xr, params[wspec.name], params[gamma.name], params[beta.name],
+            states[mean_s.name], states[var_s.name], is_train=training,
+            momentum=moving_average_fraction, eps=epsilon,
+            stride=(sh, sw), padding=(ph, pw),
+            act="relu" if activation.name == "relu" else "")
+        if activation.name not in ("relu", ""):
+            y = activation(y)
+        return y, {mean_s.name: nm, var_s.name: nv}
+
+    return _maybe_dropout(
+        LayerOutput(
+            name=name,
+            layer_type="conv_bn",
+            size=num_filters * h_out * w_out,
+            parents=(input,),
+            param_specs=(wspec, gamma, beta),
+            state_specs=(mean_s, var_s),
+            fn=fwd,
+            height=h_out,
+            width=w_out,
+            depth=num_filters,
+            attrs={
+                "filter_size": [kh, kw], "stride": [sh, sw],
+                "padding": [ph, pw], "num_filters": num_filters,
+                "channels": c_in, "epsilon": epsilon,
+                "moving_average_fraction": moving_average_fraction,
+                "active_type": activation.name,
+                "stat_param_names": (mean_s.name, var_s.name),
+            },
+        ),
+        layer_attr,
+    )
+
+
 def img_cmrnorm(
     input: LayerOutput, size: int = 5, scale: float = 0.0128, power: float = 0.75,
     num_channels: int | None = None, name: str | None = None,

@@ -109,13 +109,14 @@ def vgg(img=None, class_num: int = 1000, depth: int = 19,
 # ----------------------------------------------------------------- ResNet ----
 def _conv_bn(name, input, filter_size, num_filters, stride, padding,
              channels=None, active_type=None):
-    tmp = layer.img_conv(
-        name=name + "_conv", input=input, filter_size=filter_size,
+    """One fused conv+BN+act node (layer.img_conv_bn -> the TPP fused
+    kernel when ``fused_kernels`` enables it).  Parameter/state names
+    match the previous img_conv(name_conv) + batch_norm(name_bn) pair,
+    so checkpoints and the 161-param ResNet-50 census are unchanged."""
+    return layer.img_conv_bn(
+        name=name, input=input, filter_size=filter_size,
         num_channels=channels, num_filters=num_filters, stride=stride,
-        padding=padding, act=act.LinearActivation(), bias_attr=False,
-    )
-    return layer.batch_norm(
-        name=name + "_bn", input=tmp,
+        padding=padding,
         act=active_type if active_type is not None else act.ReluActivation(),
     )
 

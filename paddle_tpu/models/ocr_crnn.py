@@ -44,12 +44,16 @@ def crnn_ctc_cost(image_height: int = 32, image_width: int = 96,
         type=data_type.dense_vector(num_channels * image_height * image_width),
         height=image_height, width=image_width,
     )
-    conv1 = layer.img_conv(input=img, filter_size=3, num_filters=16,
-                           num_channels=num_channels, padding=1,
-                           act=act.ReluActivation())
+    # conv stack on the fused conv+BN+ReLU entry point (layer.img_conv_bn
+    # -> ops/nn.conv2d_bn_relu -> the TPP kernel when fused_kernels is
+    # on); BN replaces the conv bias — the standard CRNN extractor form
+    conv1 = layer.img_conv_bn(name="crnn_conv1", input=img, filter_size=3,
+                              num_filters=16, num_channels=num_channels,
+                              padding=1, act=act.ReluActivation())
     pool1 = layer.img_pool(input=conv1, pool_size=2, stride=2)
-    conv2 = layer.img_conv(input=pool1, filter_size=3, num_filters=32,
-                           padding=1, act=act.ReluActivation())
+    conv2 = layer.img_conv_bn(name="crnn_conv2", input=pool1, filter_size=3,
+                              num_filters=32, padding=1,
+                              act=act.ReluActivation())
     pool2 = layer.img_pool(input=conv2, pool_size=2, stride=2)
     seq_w = pool2.width  # pool layers use ceil-mode output sizes
 

@@ -21,6 +21,27 @@ def _pair(v):
     return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
 
 
+def _tpp():
+    """Late import of the fused-microkernel layer (ops/pallas/tpp) — the
+    tpp references call back into this module, so neither side imports
+    the other at module load."""
+    from paddle_tpu.ops.pallas import tpp
+
+    return tpp
+
+
+def _tpp_kernels_on() -> bool:
+    """True when conv/BN should route through the TPP Pallas kernels:
+    the ``fused_kernels`` flag says on AND a real TPU backend is present.
+    With the flag forced on over CPU, the tpp entry points still resolve
+    to their jnp references — the identical op sequence to this module —
+    so CPU trajectories stay bit-equal either way (the bench ablation's
+    ``trajectory_identical`` contract)."""
+    import jax as _jax
+
+    return _tpp().fused_enabled() and _jax.default_backend() == "tpu"
+
+
 def conv2d(
     x: jax.Array,  # [N, H, W, Cin]
     w: jax.Array,  # [KH, KW, Cin // groups, Cout]
@@ -29,7 +50,31 @@ def conv2d(
     dilation=1,
     groups: int = 1,
 ) -> jax.Array:
-    """2-D convolution, NHWC (≅ ExpandConvLayer/CudnnConvLayer via GemmConv)."""
+    """2-D convolution, NHWC (≅ ExpandConvLayer/CudnnConvLayer via GemmConv).
+
+    Routes through the TPP direct-conv kernel (``ops/pallas/tpp/conv``,
+    BRGEMM over shifted input patches) when the ``fused_kernels`` flag
+    enables it and the config is the kernel's shape class (groups=1,
+    dilation=1, numeric padding); everything else takes the XLA lowering
+    below."""
+    if (groups == 1 and _pair(dilation) == (1, 1)
+            and not isinstance(padding, str) and x.ndim == 4
+            and _tpp_kernels_on()):
+        return _tpp().conv2d_direct(x, w, stride=stride, padding=padding)
+    return conv2d_xla(x, w, stride=stride, padding=padding,
+                      dilation=dilation, groups=groups)
+
+
+def conv2d_xla(
+    x: jax.Array,
+    w: jax.Array,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups: int = 1,
+) -> jax.Array:
+    """The XLA ``lax.conv_general_dilated`` lowering — the reference
+    numerics every fused path is measured against."""
     stride, dilation = _pair(stride), _pair(dilation)
     if isinstance(padding, str):
         pad = padding
@@ -144,6 +189,7 @@ def batch_norm(
     is_train: bool,
     momentum: float = 0.9,
     eps: float = 1e-5,
+    use_fused_stats: bool | None = None,
 ):
     """Batch normalization over all but the last (channel) axis.
 
@@ -151,6 +197,11 @@ def batch_norm(
     moving stats as extra parameter buffers updated in the layer
     (``BatchNormBaseLayer``); here they are explicit state in/out so the
     train step stays pure.
+
+    ``use_fused_stats`` (None = auto from the ``fused_kernels`` flag)
+    computes the train-mode moments through the TPP single-pass
+    sum/sum-of-squares kernel — one read of ``x`` instead of two
+    reduction passes.
     """
     if is_train:
         # single-pass stats (E[x], E[x²]) accumulated in f32 from the native
@@ -158,10 +209,18 @@ def batch_norm(
         # (bf16 under the mixed-precision policy), halving the HBM traffic of
         # the f32-upcast formulation.  ResNet-class training on TPU is
         # bandwidth-bound in BN, not FLOP-bound (see BENCHMARKS.md roofline).
-        axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-        m2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes)
-        var = jnp.maximum(m2 - lax.square(mean), 0.0)
+        if use_fused_stats is None:
+            use_fused_stats = _tpp_kernels_on()
+        if use_fused_stats:
+            s, ss = _tpp().channel_stats(x)
+            count = x.size // x.shape[-1]
+            mean = s / count
+            var = jnp.maximum(ss / count - lax.square(mean), 0.0)
+        else:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            m2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes)
+            var = jnp.maximum(m2 - lax.square(mean), 0.0)
         new_mean = momentum * running_mean + (1 - momentum) * mean
         new_var = momentum * running_var + (1 - momentum) * var
     else:
@@ -171,6 +230,45 @@ def batch_norm(
     shift = bias - mean * inv
     y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
     return y, new_mean, new_var
+
+
+def conv2d_bn_relu(
+    x: jax.Array,          # [N, H, W, Cin]
+    w: jax.Array,          # [KH, KW, Cin, Cout]
+    scale: jax.Array,      # [Cout] BN gamma
+    bias: jax.Array,       # [Cout] BN beta
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    is_train: bool,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+    stride=1,
+    padding=0,
+    act: str = "relu",
+):
+    """Fused conv + batch-norm + activation (the ResNet/CRNN block entry
+    point, ``act`` "relu" or "" for linear).  Returns
+    ``(y, new_running_mean, new_running_var)``.
+
+    With the ``fused_kernels`` flag on, lowers to the TPP fused kernel
+    (``ops/pallas/tpp/conv.conv2d_bn_act``): training fuses the BN
+    statistics into the conv epilogue, inference folds the whole affine
+    + ReLU into it.  Otherwise (and always on CPU) it is exactly the
+    ``conv2d`` -> ``batch_norm`` -> relu composition."""
+    if _tpp().fused_enabled():
+        # impl="auto": kernel on TPU, jnp reference (== this composition)
+        # elsewhere — the flag only chooses routing, never numerics class
+        return _tpp().conv2d_bn_act(
+            x, w, scale, bias, running_mean, running_var, is_train,
+            momentum=momentum, eps=eps, stride=stride, padding=padding,
+            act=act or None)
+    y = conv2d_xla(x, w, stride=stride, padding=padding)
+    y, nm, nv = batch_norm(y, scale, bias, running_mean, running_var,
+                           is_train=is_train, momentum=momentum, eps=eps,
+                           use_fused_stats=False)
+    if act == "relu":
+        y = jax.nn.relu(y)
+    return y, nm, nv
 
 
 def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5):

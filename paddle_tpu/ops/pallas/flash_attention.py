@@ -426,3 +426,20 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_reference(q, k, v, causal=False, scale=None):
+    """Pure-jnp oracle of :func:`flash_attention`: exact masked softmax
+    attention on [B, T, H, D], f32 accumulation (the two-implementations
+    test contract — see ``tools/check_kernel_parity.py``)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        ok = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(ok[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -225,3 +225,25 @@ def _gru_seq_bwd(reverse, interpret, res, cts):
 
 
 gru_seq.defvjp(_gru_seq_fwd, _gru_seq_bwd)
+
+
+def gru_seq_reference(xw, mask, w_h, w_hc, h0, reverse=False):
+    """Pure-jnp oracle of :func:`gru_seq`: the same cell and freeze-mask
+    semantics as an explicit f32 scan.  Returns (hs [B, T, D], h_T)."""
+    d = w_hc.shape[0]
+    xw_t = jnp.swapaxes(xw, 0, 1).astype(jnp.float32)
+    m_t = jnp.swapaxes(mask, 0, 1)[:, :, None].astype(jnp.float32)
+
+    def step(h, inp):
+        x, m = inp
+        ur = x[:, :2 * d] + h @ w_h.astype(jnp.float32)
+        u = jax.nn.sigmoid(ur[:, :d])
+        r = jax.nn.sigmoid(ur[:, d:])
+        c = jnp.tanh(x[:, 2 * d:] + (r * h) @ w_hc.astype(jnp.float32))
+        h_new = u * h + (1.0 - u) * c
+        h_new = m * h_new + (1.0 - m) * h
+        return h_new, h_new
+
+    hT, hs = jax.lax.scan(step, h0.astype(jnp.float32), (xw_t, m_t),
+                          reverse=reverse)
+    return jnp.swapaxes(hs, 0, 1).astype(xw.dtype), hT

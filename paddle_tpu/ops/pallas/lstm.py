@@ -316,3 +316,32 @@ def _lstm_seq_bwd(reverse, interpret, res, cts):
 
 
 lstm_seq.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)
+
+
+def lstm_seq_reference(xw, mask, w_h, peephole, h0, c0, reverse=False):
+    """Pure-jnp oracle of :func:`lstm_seq`: the same [i, f, g, o] cell,
+    peephole taps, and freeze-mask semantics as an explicit f32 scan.
+    Returns (hs [B, T, D], (h_T, c_T))."""
+    d = w_h.shape[0]
+    xw_t = jnp.swapaxes(xw, 0, 1).astype(jnp.float32)
+    m_t = jnp.swapaxes(mask, 0, 1)[:, :, None].astype(jnp.float32)
+    peep = peephole.astype(jnp.float32)
+
+    def step(carry, inp):
+        h, c = carry
+        x, m = inp
+        pre = x + h @ w_h.astype(jnp.float32)
+        i = jax.nn.sigmoid(pre[:, 0 * d:1 * d] + peep[0] * c)
+        f = jax.nn.sigmoid(pre[:, 1 * d:2 * d] + peep[1] * c)
+        g = jnp.tanh(pre[:, 2 * d:3 * d])
+        c_new = f * c + i * g
+        o = jax.nn.sigmoid(pre[:, 3 * d:4 * d] + peep[2] * c_new)
+        h_new = o * jnp.tanh(c_new)
+        h_new = m * h_new + (1.0 - m) * h
+        c_new = m * c_new + (1.0 - m) * c
+        return (h_new, c_new), h_new
+
+    (hT, cT), hs = jax.lax.scan(
+        step, (h0.astype(jnp.float32), c0.astype(jnp.float32)),
+        (xw_t, m_t), reverse=reverse)
+    return jnp.swapaxes(hs, 0, 1).astype(xw.dtype), (hT, cT)

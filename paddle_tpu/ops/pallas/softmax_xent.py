@@ -133,3 +133,12 @@ def _bwd(block_rows, block_v, interpret, res, g):
 
 
 softmax_xent.defvjp(_fwd, _bwd)
+
+
+def softmax_xent_reference(logits, targets):
+    """Pure-jnp oracle of :func:`softmax_xent`: the unfused
+    ``logsumexp - picked-logit`` formulation in f32 (per-row NLL)."""
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    picked = jnp.take_along_axis(lf, targets[:, None], axis=-1)[:, 0]
+    return lse - picked

@@ -44,6 +44,14 @@ already uses (TP composes), then lay ``data`` on the largest remaining
 dimension it divides; leaves with no divisible free dim stay replicated
 (scalars, tiny biases — their memory is noise, and their gradient sync
 stays an all-reduce).
+
+The OPTIMIZER step on the shard these specs describe has a fused
+lowering: under the explicit ZeRO-2 path, ``trainer/step.py`` routes
+eligible SGD/momentum updates through
+``ops/pallas/tpp/update.fused_shard_apply`` — one read-modify-write
+kernel pass per leaf inside a ``shard_map`` region over ``data``,
+p/velocity donated in place (gated by the ``fused_kernels`` flag;
+bit-identical to ``optimizer.apply``, asserted in tests/test_tpp.py).
 """
 
 from __future__ import annotations

@@ -44,7 +44,11 @@ from typing import Any
 # counters serve_requests{reason} / serve_tokens / serve_dense_requests,
 # gauges serve_active_slots / serve_free_pages; histogram summaries grew
 # interpolated percentile fields (p50/p90/p99)
-SCHEMA = "paddle_tpu.metrics/4"
+# /5: step records carry a ``fused_kernels`` bool — whether the step's
+# program routed the conv/BN/optimizer hot paths through the TPP fused
+# Pallas kernels (ops/pallas/tpp), so bench streams and flight
+# recordings identify which path produced a trajectory
+SCHEMA = "paddle_tpu.metrics/5"
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)

@@ -44,6 +44,14 @@ class StepTelemetry:
         self._cost_cache = cost_cache if cost_cache is not None else {}
         self._peak_flops: float | None = None
         self.global_step = 0
+        # schema/5: stamp which kernel path produced this run's records
+        # (resolved once — routing is a build-time decision per step fn)
+        try:
+            from paddle_tpu.ops.pallas import tpp
+
+            self.fused_kernels = bool(tpp.fused_enabled())
+        except Exception:
+            self.fused_kernels = False
 
     # -- hardware / program constants -----------------------------------------
     def peak_flops(self) -> float:
@@ -133,6 +141,7 @@ class StepTelemetry:
             "step": step,
             "loss": float(loss),
             "step_ms": round(float(step_ms), 4),
+            "fused_kernels": self.fused_kernels,
         }
         if pass_id is not None:
             rec["pass_id"] = pass_id

@@ -144,6 +144,13 @@ def build_train_step(topology: Topology, optimizer,
     zero_on = zero >= 1 and mesh is not None and dp > 1
     explicit = (zero_on and zero >= 2
                 and zero_mod.explicit_lowering_ok(mesh.mesh))
+    # TPP fused shard update (ops/pallas/tpp/update): under the explicit
+    # ZeRO-2 lowering with the fused_kernels flag on, the SGD/momentum
+    # update runs as one read-modify-write pass inside a shard_map region
+    # on exactly the 1/n gradient shard the reduce-scatter produced
+    from paddle_tpu.ops.pallas import tpp as tpp_mod
+
+    fused_update = explicit and tpp_mod.fused_enabled()
 
     def run_forward(tp, static_c, states, feed_c, key):
         """(cost, new_states, metric parts, fetch values, grads) on the
@@ -173,8 +180,16 @@ def build_train_step(topology: Topology, optimizer,
     def apply_update(grads, train_p, opt_state, gspecs):
         """Optimizer update (+ ZeRO constraints); returns
         (new_train, new_opt) with new_train back at its base layout."""
-        new_train, new_opt = optimizer.apply(grads, train_p, opt_state,
-                                             specs)
+        fused = None
+        if fused_update:
+            fused = tpp_mod.fused_shard_apply(
+                optimizer, grads, train_p, opt_state, specs, mesh.mesh,
+                gspecs)
+        if fused is not None:
+            new_train, new_opt = fused
+        else:
+            new_train, new_opt = optimizer.apply(grads, train_p, opt_state,
+                                                 specs)
         if zero_on:
             sspecs = zero_mod.state_specs(
                 new_opt, {**train_p}, mesh.mesh,

@@ -1,0 +1,31 @@
+"""Tier-1 wiring of tools/check_kernel_parity.py: every Pallas kernel
+module must expose a jnp ``*_reference`` oracle and have an
+interpret-mode parity test — one-off kernels without an oracle can't
+land (the Compare2Function discipline, FunctionTest.h)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_every_kernel_has_reference_and_parity_test():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_kernel_parity as ckp
+    finally:
+        sys.path.pop(0)
+    violations = ckp.audit()
+    assert not violations, "\n".join(violations)
+
+
+def test_cli_entrypoint_passes():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_kernel_parity.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout

@@ -1,0 +1,117 @@
+"""Direct unit coverage for the ops/nn.py conv/pool family that until now
+only had end-to-end model coverage: depthwise_conv2d, conv2d_transpose
+and avg_pool2d(exclude_pad=...) against independent
+``lax.conv_general_dilated`` / ``lax.reduce_window`` oracles across
+stride/padding/dtype combinations."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from paddle_tpu.ops import nn
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else \
+        dict(rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+@pytest.mark.parametrize("padding", [0, 1])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_depthwise_conv2d_matches_per_channel_oracle(rng_np, stride,
+                                                     padding, dtype):
+    """Each channel must be an INDEPENDENT single-channel convolution —
+    the oracle runs C separate lax convs and stacks them."""
+    c = 3
+    x = jnp.asarray(rng_np.normal(size=(2, 9, 10, c)).astype(np.float32)
+                    ).astype(dtype)
+    w = jnp.asarray(rng_np.normal(size=(3, 3, 1, c)).astype(np.float32)
+                    ).astype(dtype)
+    got = nn.depthwise_conv2d(x, w, stride=stride, padding=padding)
+    per = [
+        lax.conv_general_dilated(
+            x[..., ci:ci + 1], w[:, :, :, ci:ci + 1],
+            window_strides=(stride, stride),
+            padding=[(padding, padding)] * 2,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=lax.Precision.HIGHEST)
+        for ci in range(c)
+    ]
+    oracle = jnp.concatenate(per, axis=-1)
+    assert got.shape == oracle.shape
+    assert got.dtype == dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(oracle, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("stride", [1, 2, 3])
+@pytest.mark.parametrize("padding", [0, 1])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_conv2d_transpose_matches_dilated_conv_oracle(rng_np, stride,
+                                                      padding, dtype):
+    """Fractionally-strided oracle: zero-dilate the input by ``stride``,
+    convolve with the spatially flipped kernel (in/out swapped) at
+    padding k-1-p — out = (in-1)*s + k - 2p."""
+    k, cin, cout = 3, 4, 5
+    x = jnp.asarray(rng_np.normal(size=(2, 6, 7, cin)).astype(np.float32)
+                    ).astype(dtype)
+    w = jnp.asarray(rng_np.normal(size=(k, k, cout, cin)).astype(np.float32)
+                    ).astype(dtype)
+    got = nn.conv2d_transpose(x, w, stride=stride, padding=padding)
+    # rhs HWIO with I = x's channels: flip taps, swap (cout, cin)
+    rhs = jnp.flip(w, (0, 1)).transpose(0, 1, 3, 2)
+    oracle = lax.conv_general_dilated(
+        x, rhs, window_strides=(1, 1),
+        padding=[(k - 1 - padding, k - 1 - padding)] * 2,
+        lhs_dilation=(stride, stride),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=lax.Precision.HIGHEST)
+    expect_h = (x.shape[1] - 1) * stride + k - 2 * padding
+    assert got.shape == (2, expect_h, (x.shape[2] - 1) * stride + k
+                         - 2 * padding, cout)
+    assert got.shape == oracle.shape
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(oracle, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("ksize,stride,padding", [
+    (2, 2, 0), (3, 2, 1), (3, 1, 1), ((2, 3), (1, 2), (1, 0)),
+])
+@pytest.mark.parametrize("exclude_pad", [True, False])
+def test_avg_pool2d_matches_reduce_window_oracle(rng_np, ksize, stride,
+                                                 padding, exclude_pad):
+    x = jnp.asarray(rng_np.normal(size=(2, 8, 9, 3)).astype(np.float32))
+    got = nn.avg_pool2d(x, ksize, stride, padding, exclude_pad=exclude_pad)
+    kh, kw = (ksize, ksize) if isinstance(ksize, int) else ksize
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    win = dict(window_dimensions=(1, kh, kw, 1),
+               window_strides=(1, sh, sw, 1),
+               padding=((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    summed = lax.reduce_window(x, 0.0, lax.add, **win)
+    if exclude_pad and (ph or pw):
+        # EXCLUDE_PADDING: divide by the number of REAL elements under
+        # each window (border windows see fewer)
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, **win)
+        oracle = summed / counts
+    else:
+        oracle = summed / (kh * kw)
+    assert got.shape == oracle.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_avg_pool2d_exclude_pad_border_value():
+    """Hand-computed border check: a constant input avg-pooled with
+    exclude_pad=True must stay constant (counts divide exactly), while
+    include-pad shrinks border values by the zero ring."""
+    x = jnp.ones((1, 4, 4, 1))
+    ex = nn.avg_pool2d(x, 3, 1, 1, exclude_pad=True)
+    np.testing.assert_allclose(np.asarray(ex), 1.0, atol=1e-6)
+    inc = nn.avg_pool2d(x, 3, 1, 1, exclude_pad=False)
+    np.testing.assert_allclose(float(inc[0, 0, 0, 0]), 4.0 / 9.0, atol=1e-6)
+    np.testing.assert_allclose(float(inc[0, 1, 1, 0]), 1.0, atol=1e-6)

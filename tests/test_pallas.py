@@ -112,3 +112,44 @@ def test_softmax_xent_matches_xla():
         jax.nn.logsumexp(l, axis=-1)
         - jnp.take_along_axis(l, tgt[:, None], axis=-1)[:, 0]))(logits)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+
+def test_flash_matches_in_module_reference(rng_np):
+    """flash_attention vs flash_attention_reference (the in-module oracle
+    the check_kernel_parity tool audits), fwd + grad, causal and not."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        flash_attention_reference,
+    )
+
+    q, k, v = _qkv(rng_np, b=1, t=48, h=2, d=16)
+    for causal in (False, True):
+        ref = flash_attention_reference(q, k, v, causal)
+        out = flash_attention(q, k, v, causal, None, 32, 32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g_r = jax.grad(lambda *a: jnp.sum(
+            flash_attention_reference(*a, causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_k = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, causal, None, 32, 32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_k, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_softmax_xent_matches_in_module_reference():
+    from paddle_tpu.ops.pallas.softmax_xent import (
+        softmax_xent,
+        softmax_xent_reference,
+    )
+
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(40, 170)).astype(np.float32) * 3)
+    tgt = jnp.asarray(rng.integers(0, 170, size=(40,)))
+    np.testing.assert_allclose(
+        np.asarray(softmax_xent(logits, tgt, 32, 128)),
+        np.asarray(softmax_xent_reference(logits, tgt)), atol=1e-4)
+    g1 = jax.grad(lambda l: jnp.mean(softmax_xent(l, tgt, 32, 128)))(logits)
+    g2 = jax.grad(lambda l: jnp.mean(softmax_xent_reference(l, tgt)))(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)

@@ -209,3 +209,84 @@ def test_gru_group_fused_fast_path_matches_cell_scan(rng_np):
     last, ys = rnn._masked_scan(step, xw, jnp.zeros((3, 16)))
     np.testing.assert_allclose(np.asarray(got.data), np.asarray(ys),
                                rtol=2e-5, atol=2e-5)
+
+
+# -- fast kernel-vs-in-module-reference parity (the check_kernel_parity
+# contract: small shapes, interpret mode, forward + vjp — kernel coverage
+# no longer rides the slow CRNN convergence test) ----------------------------
+
+
+def test_lstm_seq_matches_reference_fwd_and_vjp(rng_np):
+    from paddle_tpu.ops.pallas.lstm import lstm_seq, lstm_seq_reference
+
+    B, T, D = 2, 4, 8
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 4 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 4 * D)).astype(np.float32) * .3)
+    peep = jnp.asarray(rng_np.normal(size=(3, D)).astype(np.float32) * .2)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([4, 2])[:, None]).astype(np.float32))
+    h0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+    c0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+
+    for reverse in (False, True):
+        def k_loss(xw, wh, peep, h0, c0):
+            hs, (hT, cT) = lstm_seq(xw, mask, wh, peep, h0, c0, reverse,
+                                    True)
+            return (jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+                    + 0.5 * jnp.sum(cT))
+
+        def r_loss(xw, wh, peep, h0, c0):
+            hs, (hT, cT) = lstm_seq_reference(xw, mask, wh, peep, h0, c0,
+                                              reverse)
+            return (jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+                    + 0.5 * jnp.sum(cT))
+
+        hs_k, (hT_k, cT_k) = lstm_seq(xw, mask, wh, peep, h0, c0, reverse,
+                                      True)
+        hs_r, (hT_r, cT_r) = lstm_seq_reference(xw, mask, wh, peep, h0, c0,
+                                                reverse)
+        np.testing.assert_allclose(np.asarray(hs_k), np.asarray(hs_r),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(hT_k), np.asarray(hT_r),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(cT_k), np.asarray(cT_r),
+                                   rtol=2e-5, atol=2e-5)
+        gk = jax.grad(k_loss, argnums=(0, 1, 2, 3, 4))(xw, wh, peep, h0, c0)
+        gr = jax.grad(r_loss, argnums=(0, 1, 2, 3, 4))(xw, wh, peep, h0, c0)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_gru_seq_matches_reference_fwd_and_vjp(rng_np):
+    from paddle_tpu.ops.pallas.gru import gru_seq, gru_seq_reference
+
+    B, T, D = 2, 4, 8
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 3 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 2 * D)).astype(np.float32) * .3)
+    whc = jnp.asarray(rng_np.normal(size=(D, D)).astype(np.float32) * .3)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([3, 4])[:, None]).astype(np.float32))
+    h0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+
+    for reverse in (False, True):
+        hs_k, hT_k = gru_seq(xw, mask, wh, whc, h0, reverse, True)
+        hs_r, hT_r = gru_seq_reference(xw, mask, wh, whc, h0, reverse)
+        np.testing.assert_allclose(np.asarray(hs_k), np.asarray(hs_r),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(hT_k), np.asarray(hT_r),
+                                   rtol=2e-5, atol=2e-5)
+
+        def k_loss(xw, wh, whc, h0):
+            hs, hT = gru_seq(xw, mask, wh, whc, h0, reverse, True)
+            return jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+
+        def r_loss(xw, wh, whc, h0):
+            hs, hT = gru_seq_reference(xw, mask, wh, whc, h0, reverse)
+            return jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+
+        gk = jax.grad(k_loss, argnums=(0, 1, 2, 3))(xw, wh, whc, h0)
+        gr = jax.grad(r_loss, argnums=(0, 1, 2, 3))(xw, wh, whc, h0)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)

@@ -234,17 +234,47 @@ def serving_table(serves: list[dict], summaries: list[dict]) -> None:
                   "tokens if TTFT p99 matters more than memory._")
 
 
+MFU_TARGET_PCT = 50.0  # the ROADMAP north-star floor
+
+
 def bench_table(rows: list[dict]) -> None:
     if not rows:
         return
     print("\n## Bench rows\n")
     print("| metric | value | MFU % |")
     print("|---|---|---|")
+    below = []
     for r in rows:
         if "metric" not in r:
             continue
         val = f"{r.get('value', '-')} {r.get('unit', '')}".strip()
-        print(f"| {r['metric']} | **{val}** | {r.get('mfu_pct', '-')} |")
+        mfu = r.get("mfu_pct", "-")
+        cell = str(mfu)
+        if isinstance(mfu, (int, float)) and mfu < MFU_TARGET_PCT:
+            cell += " ⚠"
+            below.append((r["metric"], mfu))
+        print(f"| {r['metric']} | **{val}** | {cell} |")
+    # the TPP fused-kernel ablation sub-rows: speedup + which path is
+    # trusted (bit-identical trajectory vs tolerance-bounded)
+    abl = [r for r in rows
+           if str(r.get("metric", "")).endswith("fused_ablation_speedup")
+           and "unfused_ms" in r]
+    if abl:
+        print("\n### Fused-kernel ablation (TPP)\n")
+        print("| workload | unfused ms | fused ms | speedup | trajectory |")
+        print("|---|---|---|---|---|")
+        for r in abl:
+            traj = ("bit-identical" if r.get("trajectory_identical")
+                    else f"≤{r.get('trajectory_max_rel_diff', 0):.1e} rel")
+            print(f"| {r['metric'].replace('_fused_ablation_speedup', '')} "
+                  f"| {_fmt(r.get('unfused_ms'))} "
+                  f"| {_fmt(r.get('fused_ms'))} "
+                  f"| **{_fmt(r.get('value'))}x** | {traj} |")
+    if below:
+        names = ", ".join(f"{m} ({v}%)" for m, v in below)
+        print(f"\n**⚠ {len(below)} row(s) below the {MFU_TARGET_PCT:.0f}% "
+              f"MFU target:** {names} — candidates for the next fused-"
+              f"kernel/batching pass.")
 
 
 def main(argv: list[str]) -> int:
