@@ -191,6 +191,17 @@ define("heartbeat_stale_s", 0.0, "multihost watchdog: dump the flight ring "
                                  "and fail fast when this host's train-loop "
                                  "heartbeat goes stale for this many "
                                  "seconds (0 = watchdog off)")
+# elastic fleet (resilience/elastic.py): live mesh resharding at batch
+# boundaries when membership changes — host loss reshards down from the
+# surviving ZeRO shards (cursor-checkpoint fallback when a shard is
+# unrecoverable), a scale-up notice reshards up; no process restarts
+define("elastic", False, "arm live resharding on host-loss/scale events "
+                         "(ElasticCoordinator consumed at batch "
+                         "boundaries)")
+define("elastic_membership", "", "membership file to watch for elastic "
+                                 "events (written by distributed.launch "
+                                 "--elastic; empty = the launcher's "
+                                 "PADDLE_TPU_MEMBERSHIP env, if set)")
 # TPP-style fused microkernels (ops/pallas/tpp): conv+BN+ReLU forward,
 # direct-conv BRGEMM, single-pass BN stats, and the fused optimizer-shard
 # update.  "auto" routes through the kernels on TPU only — the CPU path

@@ -18,6 +18,15 @@ Pod mode (``--emit_hosts``) does not spawn: it prints the per-host
 command lines an operator (or a fleet controller) runs on each host —
 one process per host, coordinator on host 0.
 
+Operator signals are forwarded, never swallowed: SIGTERM/SIGINT to the
+launcher re-delivers to every rank and reaps them (grace, then KILL);
+``--drain`` arms SIGUSR1 as a graceful-drain notice (ranks get SIGTERM —
+the trainer checkpoint-and-exit path — and are awaited, not killed);
+``--elastic`` turns rank death into a membership event (epoch-bumped
+``membership.json`` rewrite + SIGUSR1 to survivors) that an
+``ElasticCoordinator`` on each survivor consumes as a live reshard —
+the Go master's task-re-queue survivability, without restarting anyone.
+
 Command templating: ``{rank}``, ``{nproc}`` and ``{port}`` inside the
 command argv are substituted per process.  Each child additionally gets
 
@@ -62,13 +71,17 @@ def _free_port() -> int:
 
 
 def rank_env(rank: int, nproc: int, port: int,
-             host: str = "127.0.0.1", base_env=None) -> dict:
+             host: str = "127.0.0.1", base_env=None,
+             epoch: int = 0) -> dict:
     """Child environment for one rank (the reference's gflags
-    ``--trainer_id``/``--num_gradient_servers``, env-var spelling)."""
+    ``--trainer_id``/``--num_gradient_servers``, env-var spelling).
+    ``epoch`` is the membership rendezvous epoch the rank joins under
+    (0 for a static fleet; ``--elastic`` stamps the current one)."""
     env = dict(base_env if base_env is not None else os.environ)
     env["PADDLE_TPU_TRAINER_ID"] = str(rank)
     env["PADDLE_TPU_NPROC"] = str(nproc)
     env["PADDLE_TPU_COORDINATOR"] = f"{host}:{port}"
+    env["PADDLE_TPU_RENDEZVOUS_EPOCH"] = str(epoch)
     return env
 
 
@@ -107,40 +120,102 @@ class _Tee(threading.Thread):
 def launch_local(cmd: list[str], nproc: int, *, env=None,
                  log_dir: str | None = None, port: int | None = None,
                  echo_rank0: bool = True, timeout: float | None = None,
-                 poll_s: float = 0.1) -> int:
+                 poll_s: float = 0.1, elastic: bool = False,
+                 membership_path: str | None = None,
+                 drain_signal: int | None = None,
+                 grace_s: float = 5.0) -> int:
     """Spawn ``nproc`` local ranks of ``cmd``; returns the exit code.
 
-    First failure wins: as soon as any rank exits nonzero, the others
-    are SIGTERMed (then killed) and that rank's code is returned, with
-    its output tail on stderr.  0 only when every rank exited 0.
-    ``timeout`` (seconds) kills the fleet and returns 124, the
-    ``timeout(1)`` convention."""
+    Default (static fleet): first failure wins — as soon as any rank
+    exits nonzero, the others are SIGTERMed (then killed) and that
+    rank's code is returned, with its output tail on stderr.  0 only
+    when every rank exited 0.  ``timeout`` (seconds) kills the fleet and
+    returns 124, the ``timeout(1)`` convention.
+
+    Operator signals are FORWARDED, not swallowed: SIGTERM/SIGINT to
+    the launcher is re-delivered to every live rank, the ranks are
+    reaped (``grace_s`` of grace, then SIGKILL) and the launcher exits
+    ``128+signum`` — a Ctrl-C can no longer orphan ranks behind a dead
+    launcher.  ``drain_signal`` (the ``--drain`` path; SIGUSR1 from
+    ``main``) is gentler: live ranks get SIGTERM — the trainer's
+    preemption path checkpoints and exits cleanly — and the launcher
+    WAITS for them instead of killing, returning their worst exit code.
+
+    ``elastic`` switches rank death from fleet-fatal to a membership
+    event: the dead rank is removed from the :class:`~paddle_tpu.
+    distributed.multihost.Membership` file (``membership_path``,
+    default ``<log_dir>/membership.json``; epoch bumped, atomic
+    rewrite) and survivors are notified with SIGUSR1 — the
+    ``ElasticCoordinator`` on each survivor re-reads the file and
+    reshards live.  The launcher keeps running until every rank has
+    exited and returns 0 when the SURVIVORS all exited 0 (lost ranks
+    are the event, not the verdict)."""
     port = port or _free_port()
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-    procs, tees = [], []
-    for rank in range(nproc):
-        argv = _substitute(list(cmd), rank, nproc, port)
-        p = subprocess.Popen(
-            argv, env=rank_env(rank, nproc, port, base_env=env),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        tee = _Tee(rank, p.stdout,
-                   os.path.join(log_dir, f"rank{rank}.log")
-                   if log_dir else None,
-                   echo=echo_rank0 and rank == 0)
-        tee.start()
-        procs.append(p)
-        tees.append(tee)
+    membership = None
+    if elastic:
+        from paddle_tpu.distributed.multihost import Membership
 
-    def reap_rest(skip: int | None):
+        if membership_path is None:
+            if log_dir is None:
+                raise ValueError(
+                    "--elastic needs membership_path or log_dir for the "
+                    "membership file")
+            membership_path = os.path.join(log_dir, "membership.json")
+        membership = Membership(ranks=range(nproc), epoch=0)
+        membership.write(membership_path)
+    procs, tees = [], []
+    # elastic children must start with SIGUSR1 IGNORED: the membership
+    # notice has to be harmless until a rank arms
+    # ElasticCoordinator.arm_signal — the default disposition would
+    # KILL a survivor that is still importing when a sibling dies,
+    # cascading the whole fleet.  Ignored dispositions are inherited
+    # through exec, so ignoring it in the launcher FOR THE SPAWN WINDOW
+    # is enough (restored below; the launcher's own drain handler, if
+    # any, is installed after the window).  Best-effort: off the main
+    # thread the disposition can't change — children then inherit the
+    # caller's.
+    spawn_ignore = None
+    if elastic:
+        try:
+            spawn_ignore = signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+        except ValueError:
+            spawn_ignore = None
+    try:
+        for rank in range(nproc):
+            argv = _substitute(list(cmd), rank, nproc, port)
+            child_env = rank_env(
+                rank, nproc, port, base_env=env,
+                epoch=membership.epoch if membership else 0)
+            if membership_path:
+                child_env["PADDLE_TPU_MEMBERSHIP"] = membership_path
+            p = subprocess.Popen(
+                argv, env=child_env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            tee = _Tee(rank, p.stdout,
+                       os.path.join(log_dir, f"rank{rank}.log")
+                       if log_dir else None,
+                       echo=echo_rank0 and rank == 0)
+            tee.start()
+            procs.append(p)
+            tees.append(tee)
+    finally:
+        if spawn_ignore is not None:
+            signal.signal(signal.SIGUSR1, spawn_ignore)
+
+    def signal_live(sig, skip: int | None = None):
         for i, q in enumerate(procs):
             if i == skip or q.poll() is not None:
                 continue
             try:
-                q.send_signal(signal.SIGTERM)
+                q.send_signal(sig)
             except OSError:
                 pass
-        deadline = time.monotonic() + 5.0
+
+    def reap_rest(skip: int | None, sig=signal.SIGTERM):
+        signal_live(sig, skip)
+        deadline = time.monotonic() + grace_s
         for i, q in enumerate(procs):
             if i == skip:
                 continue
@@ -150,24 +225,91 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
                 q.kill()
                 q.wait()
 
+    # operator-signal forwarding: the handlers only set a flag — the
+    # poll loop does the forwarding/reaping, so the handler never races
+    # the subprocess bookkeeping.  Install fails off the main thread
+    # (tests drive launch_local from workers); forwarding is then the
+    # caller's job.
+    received = {"sig": None, "drain": False}
+    prev_handlers = {}
+
+    def _on_signal(sig, frame):
+        received["sig"] = sig
+
+    def _on_drain(sig, frame):
+        received["drain"] = True
+
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev_handlers[s] = signal.signal(s, _on_signal)
+        if drain_signal is not None:
+            prev_handlers[drain_signal] = signal.signal(drain_signal,
+                                                        _on_drain)
+    except ValueError:
+        prev_handlers = {}
+
     t0 = time.monotonic()
-    rc = 0
+    draining = False
+    lost: set[int] = set()
     try:
         while True:
             done = [p.poll() for p in procs]
+            if received["sig"] is not None:
+                sig = received["sig"]
+                sys.stderr.write(
+                    f"launch: received signal {sig}; forwarding to "
+                    f"{sum(c is None for c in done)} live rank(s) and "
+                    f"reaping\n")
+                reap_rest(None, sig=sig)
+                return 128 + int(sig)
+            if received["drain"] and not draining:
+                draining = True
+                sys.stderr.write(
+                    "launch: drain requested; delivering SIGTERM to "
+                    "live ranks and waiting for graceful exit\n")
+                signal_live(signal.SIGTERM)
             for rank, code in enumerate(done):
-                if code is not None and code != 0:
-                    reap_rest(rank)
-                    # drain the failing rank's pipe before reporting, or
-                    # a fast crash races its traceback out of the tail
+                if code is None or code == 0 or rank in lost:
+                    continue
+                if elastic:
+                    # membership event, not fleet death: drop the rank,
+                    # bump the epoch, notify survivors
+                    lost.add(rank)
+                    membership.remove(rank)
+                    membership.write(membership_path)
                     tees[rank].join(timeout=2.0)
                     sys.stderr.write(
-                        f"launch: rank {rank} failed (exit {code}); "
-                        f"terminated the remaining ranks.  Last "
-                        f"output:\n{tees[rank].tail_text()[-3000:]}\n")
-                    return code
-            if all(c == 0 for c in done):
-                return 0
+                        f"launch: rank {rank} lost (exit {code}); "
+                        f"membership epoch {membership.epoch}, "
+                        f"survivors {membership.ranks}.  Last output:\n"
+                        f"{tees[rank].tail_text()[-1500:]}\n")
+                    signal_live(signal.SIGUSR1)
+                    continue
+                if draining:
+                    continue  # judged collectively once all exit
+                reap_rest(rank)
+                # drain the failing rank's pipe before reporting, or
+                # a fast crash races its traceback out of the tail
+                tees[rank].join(timeout=2.0)
+                sys.stderr.write(
+                    f"launch: rank {rank} failed (exit {code}); "
+                    f"terminated the remaining ranks.  Last "
+                    f"output:\n{tees[rank].tail_text()[-3000:]}\n")
+                return code
+            if all(c is not None for c in done):
+                # elastic: survivors' verdict; drain: first failure
+                # (signal deaths report as 128+N, the shell convention)
+                codes = [c for rank, c in enumerate(done)
+                         if rank not in lost]
+                if not codes:
+                    # every rank was "lost" — a fleet that died entirely
+                    # is a failed job, not an elastic event
+                    sys.stderr.write(
+                        "launch: all ranks lost under --elastic; "
+                        "reporting the first failure\n")
+                    codes = [done[min(lost)]]
+                bad = [c if c > 0 else 128 - c for c in codes if c != 0]
+                return bad[0] if bad else 0
             if timeout is not None and time.monotonic() - t0 > timeout:
                 sys.stderr.write(
                     f"launch: timed out after {timeout:.0f}s; killing "
@@ -176,10 +318,16 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
                 return 124
             time.sleep(poll_s)
     except KeyboardInterrupt:
-        rc = 130
-        reap_rest(None)
-        return rc
+        # SIGINT that bypassed the handler (non-main-thread install
+        # failure): forward it and reap, same contract
+        reap_rest(None, sig=signal.SIGINT)
+        return 130
     finally:
+        for s, h in prev_handlers.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
         for t in tees:
             t.join(timeout=2.0)
 
@@ -215,6 +363,21 @@ def main(argv=None) -> int:
     p.add_argument("--emit_hosts", default=None,
                    help="comma-separated host list: print per-host pod "
                         "commands instead of spawning")
+    p.add_argument("--elastic", action="store_true",
+                   help="rank death becomes a membership event (file "
+                        "rewrite + SIGUSR1 to survivors) instead of "
+                        "killing the fleet")
+    p.add_argument("--membership", default=None,
+                   help="membership file path for --elastic (default: "
+                        "<log_dir>/membership.json)")
+    p.add_argument("--drain", action="store_true",
+                   help="arm the drain path: SIGUSR1 to the launcher "
+                        "delivers SIGTERM to every rank (graceful "
+                        "checkpoint-and-exit) and waits instead of "
+                        "killing")
+    p.add_argument("--grace", type=float, default=5.0,
+                   help="seconds between forwarded SIGTERM and SIGKILL "
+                        "when reaping")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --); {rank}/{nproc}/"
                         "{port} are substituted per process")
@@ -230,7 +393,12 @@ def main(argv=None) -> int:
                                           port=args.port or 8476)))
         return 0
     return launch_local(cmd, args.nproc, log_dir=args.log_dir,
-                        port=args.port, timeout=args.timeout)
+                        port=args.port, timeout=args.timeout,
+                        elastic=args.elastic,
+                        membership_path=args.membership,
+                        drain_signal=signal.SIGUSR1 if args.drain
+                        else None,
+                        grace_s=args.grace)
 
 
 if __name__ == "__main__":

@@ -77,6 +77,15 @@ def initialize(coordinator_address: str | None = None,
     jax.distributed.initialize(**kwargs)
 
 
+def rendezvous_epoch() -> int:
+    """The membership epoch this process rendezvoused at
+    (``PADDLE_TPU_RENDEZVOUS_EPOCH``, stamped by ``distributed.launch``;
+    0 for a static fleet).  A re-admitted or late-joining rank carries
+    the epoch it joined under, so peers can reject a stale joiner whose
+    view predates a membership change."""
+    return int(os.environ.get("PADDLE_TPU_RENDEZVOUS_EPOCH", "0"))
+
+
 def process_index() -> int:
     return jax.process_index()
 
@@ -181,6 +190,109 @@ def shard_reader(reader, index: int | None = None,
                 round_buf = []
 
     return sharded
+
+
+# -- fleet membership ---------------------------------------------------------
+
+
+class Membership:
+    """The fleet's membership view: alive ranks, per-rank heartbeats and
+    a monotonically increasing **rendezvous epoch** — the membership
+    protocol behind elastic resharding (``resilience/elastic.py``).
+
+    The reference's Go master kept this in etcd (trainer leases expired,
+    tasks re-queued); here it is a small value object every participant
+    can hold, diff and serialize.  ``distributed.launch --elastic``
+    maintains the authoritative copy in a JSON file next to the rank
+    logs (atomic tmp+rename writes) and bumps the epoch on every change;
+    survivors re-read it on the SIGUSR1 notice or by polling
+    (``ElasticCoordinator.watch_membership``).
+
+    Rank re-numbering: global rank ids are STABLE (a rank keeps its id
+    for the life of the job, like the reference's trainer_id), while
+    :meth:`renumbering` maps them to the dense 0..n-1 indices the
+    rebuilt mesh uses — so host k dying renumbers k+1..n-1 down by one
+    without reshuffling the survivors' relative order.
+    """
+
+    def __init__(self, ranks=None, epoch: int = 0):
+        self.ranks: list[int] = sorted(int(r) for r in (ranks or []))
+        self.epoch = int(epoch)
+        self._beats: dict[int, float] = {}
+
+    # -- heartbeats ------------------------------------------------------------
+    def heartbeat(self, rank: int, ts: float | None = None) -> None:
+        import time
+
+        self._beats[int(rank)] = time.time() if ts is None else float(ts)
+
+    def stale_ranks(self, stale_after_s: float,
+                    now: float | None = None) -> list[int]:
+        """Members whose newest heartbeat is older than the threshold
+        (a rank that never beat counts from epoch start — i.e. never —
+        so callers seed ``heartbeat`` at join time)."""
+        import time
+
+        now = time.time() if now is None else now
+        return [r for r in self.ranks
+                if r in self._beats
+                and now - self._beats[r] > stale_after_s]
+
+    # -- membership changes ----------------------------------------------------
+    def remove(self, *ranks: int) -> dict[int, int]:
+        """Drop ranks (host loss); bumps the epoch and returns the new
+        dense renumbering.  Removing an absent rank is a no-op that
+        does NOT bump the epoch (idempotent under duplicate notices)."""
+        before = list(self.ranks)
+        gone = {int(r) for r in ranks}
+        self.ranks = [r for r in self.ranks if r not in gone]
+        for r in gone:
+            self._beats.pop(r, None)
+        if self.ranks != before:
+            self.epoch += 1
+        return self.renumbering()
+
+    def add(self, *ranks: int) -> dict[int, int]:
+        """Admit ranks (scale-up); bumps the epoch for any actual
+        addition and returns the new dense renumbering."""
+        before = list(self.ranks)
+        self.ranks = sorted(set(self.ranks) | {int(r) for r in ranks})
+        if self.ranks != before:
+            self.epoch += 1
+        return self.renumbering()
+
+    def renumbering(self) -> dict[int, int]:
+        """{stable global rank: dense mesh index} for the current
+        members, order-preserving."""
+        return {r: i for i, r in enumerate(self.ranks)}
+
+    # -- serialization ---------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"schema": "paddle_tpu.membership/1",
+                "epoch": self.epoch, "ranks": list(self.ranks)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Membership":
+        return cls(ranks=d.get("ranks", []), epoch=d.get("epoch", 0))
+
+    def write(self, path: str) -> str:
+        """Atomic write (tmp+rename), so a poller never reads a torn
+        view — the same discipline as the checkpoint manifests."""
+        import json
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def read(cls, path: str) -> "Membership":
+        import json
+
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
 
 
 # -- flight recorder ----------------------------------------------------------

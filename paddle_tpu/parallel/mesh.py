@@ -190,6 +190,41 @@ def apply_remainder(tree, multiple: int, policy: str):
     return jax.tree.map(_pad, tree)
 
 
+def resize_data_axis(ctx: MeshContext, new_dp: int,
+                     devices=None) -> MeshContext:
+    """A new MeshContext with the ``data`` axis resized to ``new_dp`` —
+    the elastic-resharding mesh rebuild (``resilience/elastic.py``).
+
+    Only pure data-parallel meshes resize live: a ``model``/``pipe``/
+    ``seq`` axis > 1 would need its parameter shards re-laid-out too,
+    which live resharding does not attempt.  ``devices`` selects the
+    member devices explicitly (host-loss survivors keep their relative
+    order); by default a shrink keeps the first ``new_dp`` of the
+    current mesh and a grow extends with unattached devices.
+    """
+    old = ctx.mesh
+    for a in old.axis_names:
+        enforce(a == "data" or old.shape[a] == 1,
+                f"resize_data_axis needs a pure data mesh; axis {a!r} "
+                f"has size {old.shape[a]}")
+    enforce(new_dp >= 1, f"new data degree must be >= 1, got {new_dp}")
+    if devices is None:
+        current = list(old.devices.flat)
+        if new_dp <= len(current):
+            devices = current[:new_dp]
+        else:
+            pool = current + [d for d in jax.devices()
+                              if d not in current]
+            enforce(len(pool) >= new_dp,
+                    f"resize to data={new_dp} needs {new_dp} devices; "
+                    f"only {len(pool)} attached")
+            devices = pool[:new_dp]
+    enforce(len(devices) == new_dp,
+            f"{len(devices)} devices given for data={new_dp}")
+    return MeshContext(mesh=make_mesh({"data": new_dp},
+                                      devices=list(devices)))
+
+
 def get_mesh(shape: dict[str, int] | None = None) -> MeshContext:
     global _current
     if _current is None or shape is not None:

@@ -356,6 +356,53 @@ def explicit_lowering_ok(mesh, axis: str = "data") -> bool:
     return all(mesh.shape[a] == 1 for a in mesh.axis_names if a != axis)
 
 
+def respec_report(opt_state, old_mesh, new_mesh, axis: str = "data",
+                  ) -> dict:
+    """How the ZeRO state layout changes when the ``axis`` degree
+    changes — the elastic-resharding accounting (``resilience/
+    elastic.py`` attaches it to every ``elastic_event`` record).
+
+    Per optimizer-slot leaf the report counts: ``resharded`` (sharded
+    at both degrees — its shard merely resizes), ``to_replicated``
+    (divisible at the old degree only: the new degree can't split it,
+    so it costs full residency again), ``to_sharded`` (the reverse) and
+    ``replicated`` (never sharded), plus the resulting slot
+    bytes/device at each degree.  Shapes only — no device data is
+    touched, so it is safe to run on a mesh that is about to die.
+    """
+    old_n = int(dict(old_mesh.shape).get(axis, 1))
+    new_n = int(dict(new_mesh.shape).get(axis, 1))
+    report = {"axis": axis, "old_degree": old_n, "new_degree": new_n,
+              "resharded": 0, "to_replicated": 0, "to_sharded": 0,
+              "replicated": 0, "old_bytes_per_device": 0,
+              "new_bytes_per_device": 0}
+    slots = (opt_state.get("slots", opt_state)
+             if isinstance(opt_state, dict) else opt_state)
+    for leaf in jax.tree.leaves(slots):
+        shape = tuple(getattr(leaf, "shape", ()))
+        nbytes = 1
+        for d in shape:
+            nbytes *= int(d)
+        nbytes *= int(getattr(getattr(leaf, "dtype", None), "itemsize",
+                              4) or 4)
+
+        def sharded_at(n):
+            return (n > 1 and
+                    data_dim(_leaf_spec(shape, n, axis, None),
+                             axis) is not None)
+
+        old_s, new_s = sharded_at(old_n), sharded_at(new_n)
+        key = ("resharded" if old_s and new_s else
+               "to_replicated" if old_s else
+               "to_sharded" if new_s else "replicated")
+        report[key] += 1
+        report["old_bytes_per_device"] += nbytes // (old_n if old_s
+                                                     else 1)
+        report["new_bytes_per_device"] += nbytes // (new_n if new_s
+                                                     else 1)
+    return report
+
+
 def state_bytes_per_device(opt_state) -> int:
     """Addressable bytes of one device's shard of the slot buffers."""
     total = 0

@@ -108,7 +108,11 @@ def skip_feed_batches(reader, skip: int, replicas: int = 1,
 
 
 def _convert(batch, feeder, mesh, remainder: str):
-    """batch -> (examples, sharded feed) | None (batch fully dropped)."""
+    """batch -> (examples, sharded feed, mesh used) | None (batch fully
+    dropped).  The mesh rides along so a consumer whose mesh changed
+    between staging and use (elastic resharding — ``rebind_mesh``) can
+    detect and re-place a stale feed instead of handing the step arrays
+    committed to dead devices."""
     examples = len(batch) if hasattr(batch, "__len__") else 0
     feed = feeder(batch) if feeder is not None else batch
     if mesh is not None:
@@ -120,7 +124,32 @@ def _convert(batch, feeder, mesh, remainder: str):
             if feed is None:  # "drop" left nothing: skip the batch
                 return None
         feed = mesh.shard_batch(feed)
-    return examples, feed
+    return examples, feed, mesh
+
+
+def _replace_feed(feed, mesh, remainder: str):
+    """Re-place a staged feed onto a different mesh: device_get the old
+    placement and shard onto the new one, re-applying the remainder
+    policy in case the new degree no longer divides the staged batch.
+
+    The device_get reads the OLD mesh's devices — fine on a simulated
+    loss (every device stays attached) and on scale-up, but after a
+    REAL host loss a batch-sharded feed's slice on the dead host is
+    gone.  That is unrecoverable here (the reader already advanced past
+    this batch), so it raises a clear error instead of silently
+    skipping data; the checkpoint-fallback / supervisor ladder is the
+    recovery path then."""
+    import jax
+
+    try:
+        host = jax.device_get(feed)
+    except Exception as e:
+        raise RuntimeError(
+            "elastic rebind: a staged feed's shard is unreachable (its "
+            "device died before the feed was consumed); the batch "
+            "cannot be reconstructed — recover via the cursor "
+            "checkpoint") from e
+    return mesh.shard_batch(host, remainder=remainder)
 
 
 class SynchronousFeeds:
@@ -144,9 +173,14 @@ class SynchronousFeeds:
             batch = next(self._it)  # StopIteration ends the pass
             item = _convert(batch, self._feeder, self._mesh, self._remainder)
             if item is not None:
-                examples, feed = item
+                examples, feed, _ = item
                 return FeedBatch(
                     examples, feed, (time.perf_counter() - t0) * 1e3)
+
+    def rebind_mesh(self, mesh) -> None:
+        """Adopt a rebuilt mesh (elastic resharding): nothing is staged
+        here, so the next conversion simply places onto it."""
+        self._mesh = mesh
 
     def close(self) -> None:
         self._it = iter(())
@@ -235,8 +269,23 @@ class DevicePrefetcher:
             self._done = True
             self._thread.join(timeout=5.0)
             raise item.exc
-        examples, feed = item
+        examples, feed, used_mesh = item
+        mesh_now = self._mesh
+        if mesh_now is not None and used_mesh is not mesh_now:
+            # staged under a mesh that has since been rebuilt (elastic
+            # resharding): re-place on the consumer thread rather than
+            # dropping — the reader already advanced past this batch,
+            # so dropping would silently skip data
+            feed = _replace_feed(feed, mesh_now, self._remainder)
         return FeedBatch(examples, feed, wait_ms)
+
+    def rebind_mesh(self, mesh) -> None:
+        """Adopt a rebuilt mesh (elastic resharding).  The producer
+        picks it up for every batch it converts from now on; feeds
+        already staged (or mid-conversion) under the old mesh are
+        detected by their mesh tag at ``__next__`` and re-placed, so
+        the stream stays gapless and in order."""
+        self._mesh = mesh
 
     # -- shutdown ---------------------------------------------------------------
     def close(self) -> None:

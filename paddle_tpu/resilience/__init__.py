@@ -14,9 +14,13 @@ holder:
   around a train callable; restores the newest valid checkpoint (falling
   back past corrupt ones) and resumes mid-pass bit-identically.
 - :mod:`chaos` — deterministic fault injectors (raise-at-step-k,
-  NaN-at-step-k, simulated SIGTERM, corrupt-checkpoint writer) driven by
-  a seeded schedule, so every recovery path is exercised in tests rather
-  than hoped about.
+  NaN-at-step-k, simulated SIGTERM, corrupt-checkpoint writer, and the
+  host-loss/scale-up elastic events) driven by a seeded schedule, so
+  every recovery path is exercised in tests rather than hoped about.
+- :mod:`elastic` — :class:`ElasticCoordinator`: live mesh resharding on
+  membership change (host loss / scale-up) at a train-loop drain point;
+  re-places params/opt-state from the surviving ZeRO shards, falling
+  back to the newest cursor checkpoint — no process restart.
 """
 
 from paddle_tpu.resilience.chaos import (  # noqa: F401
@@ -25,6 +29,11 @@ from paddle_tpu.resilience.chaos import (  # noqa: F401
     corrupt_newest_checkpoint,
     flaky,
     nan_poison_batch,
+)
+from paddle_tpu.resilience.elastic import (  # noqa: F401
+    ElasticCoordinator,
+    ElasticError,
+    ElasticEvent,
 )
 from paddle_tpu.resilience.guard import NumericGuard  # noqa: F401
 from paddle_tpu.resilience.policy import RetryPolicy  # noqa: F401

@@ -15,6 +15,15 @@ A :class:`ChaosSchedule` is parsed from a spec string (the trainer CLI's
     step_error@4            raise ChaosError at BeginIteration 4
     step_error@4:always     ... on every restart, not just the first
     sigterm@7               deliver SIGTERM to this process at step 7
+    host_loss@5:dp=4        post a host-loss elastic event at step 5
+                            (mesh reshards to data=4 at the boundary)
+    host_loss@5:dp=4:source=checkpoint
+                            ... with the live shards declared
+                            unrecoverable (checkpoint-fallback path)
+    scale_up@8:dp=8         post a scale-up elastic event at step 8
+
+The elastic kinds need a coordinator: call :meth:`ChaosSchedule.
+bind_elastic` with the run's ``ElasticCoordinator`` before training.
 
 Batch/step indices are 0-based and cumulative over the schedule object's
 lifetime (they keep counting across passes), so a fault lands at one
@@ -41,13 +50,15 @@ class ChaosError(RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("kind", "step", "always", "fired")
+    __slots__ = ("kind", "step", "always", "fired", "params")
 
-    def __init__(self, kind: str, step: int, always: bool = False):
+    def __init__(self, kind: str, step: int, always: bool = False,
+                 params: dict | None = None):
         self.kind = kind
         self.step = step
         self.always = always
         self.fired = False
+        self.params = params or {}
 
 
 def nan_poison_batch(batch):
@@ -80,26 +91,53 @@ class ChaosSchedule:
     reuse the SAME instance for every attempt so once-faults stay once.
     """
 
-    KINDS = ("reader_error", "nan", "step_error", "sigterm")
+    KINDS = ("reader_error", "nan", "step_error", "sigterm",
+             "host_loss", "scale_up")
 
     def __init__(self, spec: str = "", seed: int = 0, registry=None,
                  flight=None):
         self.seed = seed
         self._registry = registry
         self._flight = flight
+        self._elastic = None  # ElasticCoordinator, via bind_elastic
         self._batches = 0   # batches pulled through wrap_reader, ever
         self._steps = 0     # BeginIteration events seen, ever
         self.faults: list[_Fault] = []
         for part in (p.strip() for p in spec.split(",") if p.strip()):
-            always = part.endswith(":always")
-            if always:
-                part = part[: -len(":always")]
             kind, _, at = part.partition("@")
             if kind not in self.KINDS:
                 raise ValueError(
                     f"unknown chaos fault {kind!r} (expected one of "
                     f"{self.KINDS})")
-            self.faults.append(_Fault(kind, int(at), always))
+            # "5", "5:always", "5:dp=4:source=checkpoint", ...
+            at, *extras = at.split(":")
+            always, params = False, {}
+            for ex in extras:
+                if ex == "always":
+                    always = True
+                elif ex.startswith("dp="):
+                    params["dp"] = int(ex[len("dp="):])
+                elif ex.startswith("source="):
+                    src = ex[len("source="):]
+                    if src not in ("live", "checkpoint"):
+                        raise ValueError(
+                            f"chaos {kind}: source must be live|"
+                            f"checkpoint, got {src!r}")
+                    params["source"] = src
+                else:
+                    raise ValueError(
+                        f"unknown chaos fault option {ex!r} in {part!r}")
+            if kind in ("host_loss", "scale_up") and "dp" not in params:
+                raise ValueError(
+                    f"chaos {kind} needs a :dp=<degree> target "
+                    f"(got {part!r})")
+            self.faults.append(_Fault(kind, int(at), always, params))
+
+    def bind_elastic(self, coordinator) -> "ChaosSchedule":
+        """Give host_loss/scale_up faults their target: the run's
+        :class:`~paddle_tpu.resilience.elastic.ElasticCoordinator`."""
+        self._elastic = coordinator
+        return self
 
     def reset_counters(self) -> None:
         """Re-base the batch/step indexes to 0 for a new supervisor
@@ -167,6 +205,28 @@ class ChaosSchedule:
                 if f is not None:
                     self._fire(f, f"step {i}")
                     os.kill(os.getpid(), _signal.SIGTERM)
+                for kind in ("host_loss", "scale_up"):
+                    f = self._due(kind, i)
+                    if f is None:
+                        continue
+                    if self._elastic is None:
+                        raise ValueError(
+                            f"chaos {kind} fault armed but no "
+                            "ElasticCoordinator bound — call "
+                            "schedule.bind_elastic(coordinator)")
+                    self._fire(f, f"step {i}")
+                    # posted here, consumed by the trainer at the NEXT
+                    # batch boundary (after this step completes) — the
+                    # drain point elastic resharding is defined at
+                    if kind == "host_loss":
+                        self._elastic.post_host_loss(
+                            new_data_parallel=f.params["dp"],
+                            shard_source=f.params.get("source", "live"),
+                            reason=f"chaos host_loss@{i}")
+                    else:
+                        self._elastic.post_scale_up(
+                            new_data_parallel=f.params["dp"],
+                            reason=f"chaos scale_up@{i}")
                 f = self._due("step_error", i)
                 if f is not None:
                     self._fire(f, f"step {i}")

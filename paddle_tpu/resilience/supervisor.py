@@ -49,11 +49,20 @@ class Supervisor:
     spent.  ``train_fn`` must rebuild whatever the fault poisoned —
     typically: construct a fresh trainer and call ``train(...,
     checkpoint_dir=..., resume=True)``.
+
+    Elastic integration: a live reshard that cannot complete raises
+    :class:`~paddle_tpu.resilience.elastic.ElasticError` — a plain
+    retryable worker fault here, so the restart budget is the fallback
+    OF the elastic fallback (live shards → cursor checkpoint → full
+    restart-and-resume).  Pass the run's coordinator as ``elastic=`` and
+    each retry first drops its queued events: the membership change that
+    killed the attempt is already reflected in the restored state, and
+    replaying it into the fresh run would reshard twice.
     """
 
     def __init__(self, max_restarts: int = 3, retry_on: tuple = (Exception,),
                  fatal: tuple = (), backoff: RetryPolicy | None = None,
-                 registry=None, run: str = "train"):
+                 registry=None, run: str = "train", elastic=None):
         self.max_restarts = max(int(max_restarts), 0)
         self.retry_on = tuple(retry_on)
         self.fatal = tuple(fatal)
@@ -66,6 +75,7 @@ class Supervisor:
             registry = get_default_registry()
         self.registry = registry
         self.run_label = run
+        self.elastic = elastic
         self.restarts = 0
 
     def _retryable(self, exc: BaseException) -> bool:
@@ -122,6 +132,11 @@ class Supervisor:
                             "restart": self.restarts,
                             "error": f"{type(e).__name__}: {e}"[:200],
                             "recovery_ms": round(recovery_ms, 2)})
+                if self.elastic is not None:
+                    # the restored checkpoint already reflects the
+                    # fleet the crash left behind; a queued pre-crash
+                    # event re-firing would reshard a second time
+                    self.elastic.reset_pending()
                 attempt += 1
                 continue
             if self.restarts:

@@ -16,7 +16,10 @@ Synchronous callers (CLIs, tests, benches) skip the thread:
 Telemetry rides the shared :class:`MetricsRegistry`: histograms
 ``serve_queue_wait_ms`` / ``serve_prefill_ms`` / ``serve_decode_step_ms``
 / ``serve_ttft_ms`` / ``serve_tpot_ms``, counters ``serve_requests`` /
-``serve_tokens``, gauges ``serve_active_slots`` / ``serve_free_pages``,
+``serve_tokens`` / ``serve_loop_crashes`` (background loops that died —
+pending ``results()`` callers get the loop's exception re-raised
+instead of blocking forever), gauges ``serve_active_slots`` /
+``serve_free_pages``,
 one ``kind="serve"`` record per completed request and a
 ``kind="serve_summary"`` record (TTFT/TPOT p50/p99) from
 :meth:`emit_summary` — rendered by ``tools/metrics_to_md.py``'s
@@ -32,6 +35,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.core import logger as log
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.serving.kv_cache import PagedKVCache
 from paddle_tpu.serving.scheduler import (
@@ -84,6 +88,7 @@ class ServingEngine:
         self._next_id = 0
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._loop_error: BaseException | None = None
         self._build_fns()
 
     # -- jitted compute -------------------------------------------------------
@@ -156,33 +161,63 @@ class ServingEngine:
                 temperature=float(temperature), arrival=time.perf_counter()))
         return rid
 
+    def _raise_loop_error(self) -> None:
+        raise RuntimeError(
+            "serving loop crashed; pending requests will never "
+            "complete") from self._loop_error
+
+    def _pop_completed(self, block: bool, deadline: float | None,
+                       raise_on_crash: bool):
+        """One completed result, or None on timeout/empty.  Waits in
+        short slices so a dying loop thread fails blocked callers with
+        its exception instead of parking them forever (already-queued
+        results are always handed out first)."""
+        while True:
+            try:
+                return self._completed.get(block=False)
+            except queue.Empty:
+                pass
+            if self._loop_error is not None and raise_on_crash:
+                self._raise_loop_error()
+            if not block:
+                return None
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return None
+            try:
+                return self._completed.get(
+                    timeout=0.05 if remaining is None
+                    else min(0.05, remaining))
+            except queue.Empty:
+                continue
+
     def results(self, n: int | None = None,
                 timeout: float | None = None) -> list[RequestResult]:
         """Pop up to ``n`` completed results (all currently available if
-        None), blocking up to ``timeout`` for the first."""
+        None), blocking up to ``timeout`` for the first.  If the
+        background loop has died, callers that would otherwise come
+        back empty-handed (or block forever) get the loop's exception
+        re-raised instead — a pending future must fail, not hang."""
         out: list[RequestResult] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
         if n is None:
             # drain mode: optionally wait up to timeout for the first,
             # then take whatever else is already there
-            try:
-                out.append(self._completed.get(block=timeout is not None,
-                                               timeout=timeout))
-            except queue.Empty:
-                return out
-            while True:
-                try:
-                    out.append(self._completed.get(block=False))
-                except queue.Empty:
-                    return out
-        deadline = None if timeout is None else time.monotonic() + timeout
+            r = self._pop_completed(block=timeout is not None,
+                                    deadline=deadline,
+                                    raise_on_crash=True)
+            while r is not None:
+                out.append(r)
+                r = self._pop_completed(block=False, deadline=None,
+                                        raise_on_crash=False)
+            return out
         while len(out) < n:
-            try:
-                remaining = (None if deadline is None
-                             else max(deadline - time.monotonic(), 0.0))
-                out.append(self._completed.get(block=True,
-                                               timeout=remaining))
-            except queue.Empty:
+            r = self._pop_completed(block=True, deadline=deadline,
+                                    raise_on_crash=not out)
+            if r is None:
                 break
+            out.append(r)
         return out
 
     def generate(self, prompts, max_new_tokens: int | None = None,
@@ -203,6 +238,7 @@ class ServingEngine:
     def start(self) -> None:
         """Run the step loop on a background thread."""
         enforce(self._thread is None, "engine already started")
+        self._loop_error = None  # a restart forgives the previous crash
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True)
@@ -222,9 +258,22 @@ class ServingEngine:
 
     # -- the step loop --------------------------------------------------------
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            if not self.step():
-                time.sleep(1e-3)
+        try:
+            while not self._stop.is_set():
+                if not self.step():
+                    time.sleep(1e-3)
+        except BaseException as e:
+            # a dead loop must not strand waiters: record the cause —
+            # results() re-raises it to every pending caller — and
+            # count it, so a crashed engine can't masquerade as idle
+            self._loop_error = e
+            from paddle_tpu.telemetry import safe_inc
+
+            safe_inc("serve_loop_crashes",
+                     "serving background loops that died",
+                     registry=self.registry)
+            log.error("serving loop crashed (%s: %s); failing pending "
+                      "requests", type(e).__name__, e)
 
     def step(self) -> bool:
         """One scheduler iteration: drain submissions, retire, admit +

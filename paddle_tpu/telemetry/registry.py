@@ -48,7 +48,15 @@ from typing import Any
 # program routed the conv/BN/optimizer hot paths through the TPP fused
 # Pallas kernels (ops/pallas/tpp), so bench streams and flight
 # recordings identify which path produced a trajectory
-SCHEMA = "paddle_tpu.metrics/5"
+# /6 added the elastic-fleet stream (resilience/elastic.py): record kind
+# "elastic_event" — one per live mesh rebuild, carrying event
+# (host_loss|scale_up), old_dp/new_dp, recovery_ms (drain→resume wall
+# time), shard_source (live|checkpoint), the drain cursor and the ZeRO
+# respec report — plus the elastic_events{kind} counter, the shared
+# recovery_ms gauge labeled run="elastic", and the serving engine's
+# serve_loop_crashes counter (background loop deaths that failed
+# pending requests)
+SCHEMA = "paddle_tpu.metrics/6"
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)

@@ -59,11 +59,16 @@ def _cursor_key(dirname: str) -> tuple[int, int] | None:
 def checkpoint_entries(ckpt_dir: str) -> list[str]:
     """All checkpoint dirs under ``ckpt_dir``, oldest..newest by cursor
     (not validated — callers needing integrity go through
-    :func:`latest_checkpoint`)."""
+    :func:`latest_checkpoint`, which also skips the debris a concurrent
+    writer can expose: manifest missing/torn, payloads not yet written).
+    Non-directories and the writer's ``.tmp-*`` staging dirs never
+    qualify — a stray file named like a checkpoint must not reach the
+    manifest probe."""
     if not os.path.isdir(ckpt_dir):
         return []
     named = [(k, d) for d in os.listdir(ckpt_dir)
-             if (k := _cursor_key(d)) is not None]
+             if (k := _cursor_key(d)) is not None
+             and os.path.isdir(os.path.join(ckpt_dir, d))]
     return [os.path.join(ckpt_dir, d) for _, d in sorted(named)]
 
 

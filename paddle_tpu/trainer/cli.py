@@ -96,8 +96,18 @@ def build_argparser() -> argparse.ArgumentParser:
                         "--checkpoint_dir to resume rather than rewind")
     p.add_argument("--chaos", default=None,
                    help="deterministic fault-injection schedule, e.g. "
-                        "'reader_error@3,nan@5,sigterm@7' — TESTING ONLY "
-                        "(see resilience/chaos.py)")
+                        "'reader_error@3,nan@5,sigterm@7,host_loss@9:dp=4'"
+                        " — TESTING ONLY (see resilience/chaos.py)")
+    p.add_argument("--elastic", action="store_true", default=None,
+                   help="arm live resharding on host-loss/scale events: "
+                        "membership changes rebuild the mesh at the new "
+                        "data-parallel degree at a batch boundary "
+                        "instead of killing the run (resilience/"
+                        "elastic.py)")
+    p.add_argument("--elastic_membership", default=None,
+                   help="membership file to watch for elastic events "
+                        "(default: the launcher's PADDLE_TPU_MEMBERSHIP "
+                        "env when --elastic is set)")
     p.add_argument("--seq_dim", type=int, default=8,
                    help="timesteps per synthetic sequence for --job=time/"
                         "checkgrad feeds (the reference RNN benchmark pads "
@@ -431,6 +441,30 @@ def cmd_train(args, parsed) -> int:
         handler = schedule.wrap_event_handler(on_event)
         train_reader = schedule.wrap_reader(reader)
 
+    # elastic fleet: membership events rebuild the mesh live at batch
+    # boundaries (resilience/elastic.py); host-loss/scale-up chaos
+    # faults and the launcher's membership file both feed the queue
+    elastic = None
+    if _resolve(args.elastic, "elastic", False):
+        from paddle_tpu.resilience.elastic import ElasticCoordinator
+
+        elastic = ElasticCoordinator(checkpoint_dir=args.checkpoint_dir)
+        membership = _resolve(args.elastic_membership,
+                              "elastic_membership",
+                              os.environ.get("PADDLE_TPU_MEMBERSHIP", ""))
+        if membership:
+            # baseline = the fleet this rank JOINED: a peer that died
+            # before our first file read must still read as a loss
+            from paddle_tpu.distributed import multihost as _mh
+
+            elastic.seed_membership(
+                _mh.rendezvous_epoch(),
+                int(os.environ.get("PADDLE_TPU_NPROC", "1")))
+            elastic.watch_membership(membership)
+            elastic.arm_signal(membership)
+        if schedule is not None:
+            schedule.bind_elastic(elastic)
+
     def run_train():
         if schedule is not None:
             # per-attempt index re-base: fault positions stay aligned
@@ -447,17 +481,25 @@ def cmd_train(args, parsed) -> int:
                 args.checkpoint_batch_period, "checkpoint_batch_period", 0),
             nan_policy=_resolve(args.nan_policy, "nan_policy", "none"),
             sync_period=_resolve(args.sync_period, "sync_period", 8),
-            prefetch=_resolve(args.prefetch, "prefetch_depth", 2))
+            prefetch=_resolve(args.prefetch, "prefetch_depth", 2),
+            elastic=elastic)
 
     max_restarts = _resolve(args.max_restarts, "max_restarts", 0)
-    if max_restarts > 0:
-        # the run supervisor: worker faults restart the loop; each retry
-        # resumes from the newest valid checkpoint's (pass, batch) cursor
-        from paddle_tpu.resilience.supervisor import Supervisor
+    try:
+        if max_restarts > 0:
+            # the run supervisor: worker faults restart the loop; each
+            # retry resumes from the newest valid checkpoint's
+            # (pass, batch) cursor — and drops any queued elastic event
+            # the restored state already reflects
+            from paddle_tpu.resilience.supervisor import Supervisor
 
-        Supervisor(max_restarts=max_restarts).run(run_train)
-    else:
-        run_train()
+            Supervisor(max_restarts=max_restarts,
+                       elastic=elastic).run(run_train)
+        else:
+            run_train()
+    finally:
+        if elastic is not None:
+            elastic.stop()
     return 0
 
 

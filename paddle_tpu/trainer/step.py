@@ -125,7 +125,19 @@ def build_train_step(topology: Topology, optimizer,
     folds the data-axis index into the step key (independent per-replica
     draws, like the reference's per-thread streams), so a stochastic
     model's trajectory differs from the replicated run's by the draw —
-    deterministic models match to reduction-order tolerance."""
+    deterministic models match to reduction-order tolerance.
+
+    Mesh-mutability contract (elastic resharding): the returned step
+    CAPTURES ``mesh`` and its data degree at build time — the shard_map
+    region, the ZeRO specs, the 1/n gradient scale and the donated
+    layouts are all frozen into the trace.  A runtime mesh change
+    (``resilience/elastic.py``) must therefore discard the step and
+    rebuild through this function (``SGD._ensure_built`` after nulling
+    ``_train_step``), never re-invoke a stale one: jit would happily
+    re-lower the old program onto arrays whose shardings name dead
+    devices.  The per-signature cost analyses cached next to the step
+    (``SGD._telemetry_costs``) freeze the same mesh and are invalidated
+    together."""
     specs = {s.name: s for s in topology.param_specs()}
     trainable = {n for n, s in specs.items() if not s.is_static}
     metric_specs = topology.metrics()

@@ -30,6 +30,25 @@ from paddle_tpu.trainer import event as v2_event
 from paddle_tpu.trainer.step import build_eval_step, build_train_step
 
 
+class _ElasticReplay(Exception):
+    """Control flow, not an error: a checkpoint-fallback elastic rebuild
+    restored state behind the current position, so the pass loop must
+    re-enter at the restored cursor (reader fast-forward included) —
+    the in-process analog of a supervisor restart.  Carries the
+    re-placed state so ``_train_loop`` re-enters without another
+    restore."""
+
+    def __init__(self, pass_id: int, batch_id: int, params, opt_state,
+                 states):
+        super().__init__(f"elastic replay from pass {pass_id} "
+                         f"batch {batch_id}")
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.params = params
+        self.opt_state = opt_state
+        self.states = states
+
+
 def _feed_signature(feed: dict) -> tuple:
     sig = []
     for k in sorted(feed):
@@ -194,7 +213,7 @@ class SGD:
               resume: bool = True, checkpoint_async: bool = False,
               metrics_registry=None, sync_period: int | None = None,
               prefetch: int | None = None, nan_policy: str | None = None,
-              checkpoint_batch_period: int | None = None):
+              checkpoint_batch_period: int | None = None, elastic=None):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
 
@@ -259,7 +278,17 @@ class SGD:
         sink attachable via ``--metrics_jsonl``/``PADDLE_TPU_METRICS_JSONL``
         or ``metrics.configure``).  Every record also lands in the
         multihost flight recorder, whose ring buffer is dumped to disk on
-        exception or SIGTERM (``distributed/multihost.py``)."""
+        exception or SIGTERM (``distributed/multihost.py``).
+
+        ``elastic`` (an :class:`~paddle_tpu.resilience.elastic.
+        ElasticCoordinator`) arms live resharding: membership events
+        (host loss, scale-up) queued on the coordinator are consumed at
+        batch boundaries — the deferred-fence backlog is drained, a
+        cursor checkpoint marks the boundary, the mesh is rebuilt at the
+        new data-parallel degree, and params/optimizer state are
+        re-placed from the live shards (or restored from the newest
+        cursor checkpoint when a shard is unrecoverable, replaying from
+        its cursor) — all without leaving this call."""
         from paddle_tpu import metrics as metrics_mod
         from paddle_tpu.distributed import multihost as mh
         from paddle_tpu.telemetry import StepTelemetry
@@ -334,6 +363,8 @@ class SGD:
                                             stale_after_s=stale_s)
             watchdog.start()
 
+        if elastic is not None:
+            elastic.bind(self, checkpoint_dir)
         try:
             self._train_loop(reader, num_passes, event_handler, feeder,
                              params, states, opt_state, checkpoint_dir,
@@ -341,7 +372,8 @@ class SGD:
                              checkpoint_async=checkpoint_async,
                              sync_period=sync_period, prefetch=prefetch,
                              nan_policy=nan_policy,
-                             checkpoint_batch_period=checkpoint_batch_period)
+                             checkpoint_batch_period=checkpoint_batch_period,
+                             elastic=elastic)
         finally:
             jax.config.update("jax_debug_nans", prev_debug_nans)
             if watchdog is not None:
@@ -397,7 +429,8 @@ class SGD:
                     params, states, opt_state, checkpoint_dir,
                     checkpoint_period, resume, preempted,
                     checkpoint_async=False, sync_period=1, prefetch=0,
-                    nan_policy="none", checkpoint_batch_period=0):
+                    nan_policy="none", checkpoint_batch_period=0,
+                    elastic=None):
         from paddle_tpu.trainer import checkpoint as ckpt
 
         writer = ckpt.AsyncCheckpointer() if (
@@ -426,13 +459,29 @@ class SGD:
                 log.info("resumed from %s (pass %d, next batch %d)", path,
                          start_pass, start_batch)
         try:
-            self._run_passes(start_pass, num_passes, reader, event_handler,
-                             feeder, params, states, opt_state,
-                             checkpoint_dir, checkpoint_period, preempted,
-                             writer, sync_period=sync_period,
-                             prefetch=prefetch, start_batch=start_batch,
-                             nan_policy=nan_policy,
-                             checkpoint_batch_period=checkpoint_batch_period)
+            while True:
+                try:
+                    self._run_passes(
+                        start_pass, num_passes, reader, event_handler,
+                        feeder, params, states, opt_state,
+                        checkpoint_dir, checkpoint_period, preempted,
+                        writer, sync_period=sync_period,
+                        prefetch=prefetch, start_batch=start_batch,
+                        nan_policy=nan_policy,
+                        checkpoint_batch_period=checkpoint_batch_period,
+                        elastic=elastic)
+                    break
+                except _ElasticReplay as r:
+                    # checkpoint-fallback elastic rebuild: re-enter the
+                    # pass loop at the restored cursor with the re-placed
+                    # state — the same replay a supervisor restart would
+                    # do, minus the process restart
+                    params, opt_state, states = (r.params, r.opt_state,
+                                                 r.states)
+                    start_pass, start_batch = r.pass_id, r.batch_id
+                    log.info("elastic: replaying from pass %d batch %d "
+                             "at the new mesh degree", start_pass,
+                             start_batch)
         except BaseException as e:
             # post-mortem: the flight ring (last N step records +
             # heartbeats) goes to disk so pod hangs/desyncs are
@@ -464,7 +513,8 @@ class SGD:
                     feeder, params, states, opt_state, checkpoint_dir,
                     checkpoint_period, preempted, writer,
                     sync_period=1, prefetch=0, start_batch=0,
-                    nan_policy="none", checkpoint_batch_period=0):
+                    nan_policy="none", checkpoint_batch_period=0,
+                    elastic=None):
         from paddle_tpu.reader.prefetch import (
             DevicePrefetcher,
             SynchronousFeeds,
@@ -661,6 +711,59 @@ class SGD:
                      batch_id=batch_id,
                      meta=cursor_meta(batch_id))
 
+            def drain_checkpoint(host_params, host_opt, host_states):
+                # elastic drain boundary: persist the exact state the
+                # rebuild re-places, so (a) a crash mid-reshard resumes
+                # here and (b) a fresh run at the new degree resuming
+                # from this cursor replays the identical trajectory —
+                # the bit-identity anchor the elastic tests assert
+                if writer is not None:
+                    try:  # a stale deferred write error must not mask
+                        writer.wait()  # the drain save
+                    except Exception as e:
+                        log.warning("async checkpoint write had failed "
+                                    "(%s); writing the elastic drain "
+                                    "checkpoint synchronously", e)
+                flight.heartbeat("checkpoint", pass_id=pass_id,
+                                 batch_id=batch_id)
+                ckpt.save_checkpoint(
+                    checkpoint_dir, pass_id,
+                    {n: np.asarray(v) for n, v in host_params.items()},
+                    opt_state=host_opt, states=dict(host_states),
+                    batch_id=batch_id,
+                    meta=cursor_meta(batch_id, {"elastic_drain": True}))
+
+            def maybe_elastic():
+                # elastic drain point (once per batch boundary): consume
+                # pending membership events — flush the deferred-fence
+                # backlog first so every dispatched step retires on the
+                # old mesh, then rebuild and re-place.  The feed
+                # pipeline is re-bound to the new mesh (staged prefetch
+                # feeds are re-placed, not dropped: no reader batch is
+                # lost or replayed on the live path).
+                nonlocal params, opt_state, states
+                if elastic is None or not elastic.pending():
+                    return
+                flush_pending()
+                while elastic.pending():
+                    out = elastic.apply(
+                        self, params, opt_state, states, pass_id,
+                        batch_id,
+                        drain_checkpoint=(drain_checkpoint
+                                          if checkpoint_dir else None))
+                    if out is None:
+                        break
+                    params, opt_state, states = (out.params,
+                                                 out.opt_state,
+                                                 out.states)
+                    if feeds is not None:
+                        feeds.rebind_mesh(self.mesh)
+                    if out.replay_cursor is not None:
+                        raise _ElasticReplay(
+                            int(out.replay_cursor["pass_id"]),
+                            int(out.replay_cursor.get("batch_id", 0)),
+                            params, opt_state, states)
+
             try:
                 batch_id = skip
                 feed_it = iter(feeds) if feeds is not None else None
@@ -761,6 +864,7 @@ class SGD:
                                 flush_pending()
                                 break
                             maybe_cursor_checkpoint()
+                            maybe_elastic()
                             continue
                         params = guard.after_finite_step(prev_snap[0],
                                                          params)
@@ -801,6 +905,7 @@ class SGD:
                     if preempted["flag"]:
                         break
                     maybe_cursor_checkpoint()
+                    maybe_elastic()
                 flush_pending()  # end-of-pass backlog
             finally:
                 # preemption-drain / early exit: stop the prefetch worker

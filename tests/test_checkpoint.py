@@ -70,6 +70,61 @@ def test_corrupt_checkpoint_falls_back_to_previous(tmp_path):
     np.testing.assert_array_equal(p["w"], 0.0)
 
 
+def test_latest_checkpoint_skips_concurrent_writer_debris(tmp_path):
+    """latest_checkpoint racing a concurrent writer: visible pass-*/
+    batch-* dirs whose manifest is missing, torn (half-written JSON),
+    empty, or pointing at not-yet-written payload files must be SKIPPED
+    — selection falls back to the newest complete checkpoint instead of
+    crashing (the Go pserver newest-VALID recovery rule, extended to
+    mid-write states a non-atomic writer or lagging NFS can expose)."""
+    import json
+    d = str(tmp_path)
+    good = ckpt.save_checkpoint(d, 0, {"w": np.zeros(2, np.float32)},
+                                batch_id=3)
+
+    # 1) dir exists, manifest not yet written
+    os.makedirs(os.path.join(d, "pass-00000-batch-000005"))
+    # 2) manifest torn mid-write (truncated JSON)
+    torn = os.path.join(d, "pass-00000-batch-000007")
+    os.makedirs(torn)
+    with open(os.path.join(torn, ckpt.MANIFEST), "w") as f:
+        f.write('{"uuid": "abc", "pass_id": 0, "files": {"par')
+    # 3) manifest empty (open()'d but nothing flushed)
+    empty = os.path.join(d, "pass-00000-batch-000008")
+    os.makedirs(empty)
+    open(os.path.join(empty, ckpt.MANIFEST), "w").close()
+    # 4) manifest complete but a payload file it names is missing
+    missing = os.path.join(d, "pass-00000-batch-000009")
+    os.makedirs(missing)
+    with open(os.path.join(missing, ckpt.MANIFEST), "w") as f:
+        json.dump({"uuid": "x", "pass_id": 0,
+                   "cursor": {"pass_id": 0, "batch_id": 9},
+                   "files": {"params.npz": "0" * 64}, "meta": {}}, f)
+    # 5) a stray FILE named like a checkpoint dir
+    with open(os.path.join(d, "pass-00000-batch-000011"), "w") as f:
+        f.write("not a directory")
+    # 6) the writer's own tmp staging dir (never selectable)
+    os.makedirs(os.path.join(d, "pass-00000-batch-000012.tmp-deadbeef"))
+
+    found = ckpt.latest_checkpoint(d)
+    assert found is not None
+    path, manifest = found
+    assert path == good
+    assert manifest["cursor"] == {"pass_id": 0, "batch_id": 3}
+
+
+def test_latest_checkpoint_empty_and_debris_only_dir(tmp_path):
+    """No valid checkpoint at all -> None, not an exception."""
+    d = str(tmp_path)
+    assert ckpt.latest_checkpoint(d) is None  # dir doesn't even exist yet
+    os.makedirs(os.path.join(d, "pass-00000-batch-000001"))
+    torn = os.path.join(d, "pass-00002")
+    os.makedirs(torn)
+    with open(os.path.join(torn, ckpt.MANIFEST), "w") as f:
+        f.write("{")
+    assert ckpt.latest_checkpoint(d) is None
+
+
 def test_gc_keeps_last_n(tmp_path):
     d = str(tmp_path)
     for i in range(5):

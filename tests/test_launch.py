@@ -88,4 +88,156 @@ def test_rank_env_isolated_base():
     assert env["PADDLE_TPU_TRAINER_ID"] == "2"
     assert env["PADDLE_TPU_NPROC"] == "4"
     assert env["KEEP"] == "1"
+    assert env["PADDLE_TPU_RENDEZVOUS_EPOCH"] == "0"
     assert "PATH" not in env or os.environ.get("PATH") != env  # no leak
+
+
+# -- operator signals / drain / elastic membership ---------------------------
+
+_TRAP_CHILD = (
+    "import os, signal, sys, time\n"
+    "def bye(sig, frame):\n"
+    "    print('rank', os.environ['PADDLE_TPU_TRAINER_ID'],\n"
+    "          'draining', flush=True)\n"
+    "    sys.exit(0)\n"
+    "signal.signal(signal.SIGTERM, bye)\n"
+    "print('ready', flush=True)\n"
+    "time.sleep(120)\n"
+)
+
+
+def _spawn_launcher(tmp_path, extra_args, child_src, nproc=2):
+    import subprocess
+
+    return subprocess.Popen(
+        [_PY, "-m", "paddle_tpu.distributed.launch",
+         "--nproc", str(nproc), "--log_dir", str(tmp_path),
+         "--grace", "10", *extra_args, "--", _PY, "-c", child_src],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait_logs(tmp_path, nproc, marker, timeout=30.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        texts = []
+        for i in range(nproc):
+            p = tmp_path / f"rank{i}.log"
+            texts.append(p.read_text() if p.exists() else "")
+        if all(marker in t for t in texts):
+            return texts
+        time.sleep(0.1)
+    raise AssertionError(f"marker {marker!r} never appeared in all "
+                         f"rank logs: {texts}")
+
+
+def test_sigterm_forwarded_to_ranks_and_reaped(tmp_path):
+    """An operator SIGTERM to the launcher must reach every rank (their
+    graceful-shutdown handlers run) and reap them — not orphan sleepers
+    behind a dead launcher."""
+    import signal as sig
+
+    p = _spawn_launcher(tmp_path, [], _TRAP_CHILD)
+    try:
+        _wait_logs(tmp_path, 2, "ready")
+        p.send_signal(sig.SIGTERM)
+        rc = p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert rc == 128 + sig.SIGTERM  # 143: terminated, after forwarding
+    for i in range(2):
+        assert f"rank {i} draining" in (tmp_path / f"rank{i}.log"
+                                        ).read_text()
+
+
+def test_drain_signal_delivers_sigterm_and_waits(tmp_path):
+    """--drain: SIGUSR1 to the launcher SIGTERMs the ranks (the trainer
+    checkpoint-and-exit path) and WAITS for their graceful exit —
+    rc 0, nobody killed."""
+    import signal as sig
+
+    p = _spawn_launcher(tmp_path, ["--drain"], _TRAP_CHILD)
+    try:
+        _wait_logs(tmp_path, 2, "ready")
+        p.send_signal(sig.SIGUSR1)
+        rc = p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert rc == 0
+    for i in range(2):
+        assert f"rank {i} draining" in (tmp_path / f"rank{i}.log"
+                                        ).read_text()
+
+
+def test_elastic_rank_death_updates_membership_and_notifies(tmp_path):
+    """--elastic: a dying rank is a membership event, not fleet death —
+    the membership file is rewritten (epoch bump, rank removed) and the
+    survivors get SIGUSR1; the launcher returns the SURVIVORS' verdict."""
+    import json
+
+    child = (
+        "import json, os, signal, sys, time\n"
+        "r = int(os.environ['PADDLE_TPU_TRAINER_ID'])\n"
+        "path = os.environ['PADDLE_TPU_MEMBERSHIP']\n"
+        "assert os.environ['PADDLE_TPU_RENDEZVOUS_EPOCH'] == '0'\n"
+        "if r == 1:\n"
+        "    sys.exit(5)\n"
+        "hit = []\n"
+        "signal.signal(signal.SIGUSR1, lambda s, f: hit.append(s))\n"
+        "print('ready', flush=True)\n"
+        "deadline = time.monotonic() + 60\n"
+        "while not hit and time.monotonic() < deadline:\n"
+        "    time.sleep(0.05)\n"
+        "m = json.load(open(path))\n"
+        "print('notified epoch', m['epoch'], 'ranks', m['ranks'],\n"
+        "      flush=True)\n"
+        "sys.exit(0)\n"
+    )
+    p = _spawn_launcher(tmp_path, ["--elastic"], child)
+    try:
+        rc = p.wait(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert rc == 0  # survivor exited clean; the lost rank is the event
+    m = json.loads((tmp_path / "membership.json").read_text())
+    assert m["epoch"] == 1 and m["ranks"] == [0]
+    log0 = (tmp_path / "rank0.log").read_text()
+    assert "notified epoch 1 ranks [0]" in log0
+
+
+def test_elastic_all_ranks_dead_is_a_failure(tmp_path):
+    """--elastic must not launder a fully-failed fleet into rc 0: when
+    every rank dies, the first failure's code comes back."""
+    rc = launch_local(
+        [_PY, "-c", "import sys; sys.exit(9)"], nproc=2,
+        log_dir=str(tmp_path), echo_rank0=False, timeout=60,
+        elastic=True)
+    assert rc == 9
+
+
+def test_elastic_sigusr1_ignored_until_armed(tmp_path):
+    """Elastic children start with SIGUSR1 ignored (exec keeps ignored
+    dispositions), so the membership notice fired by a sibling's death
+    cannot kill a survivor that has not armed its handler yet."""
+    child = (
+        "import os, signal, sys, time\n"
+        "r = int(os.environ['PADDLE_TPU_TRAINER_ID'])\n"
+        "assert signal.getsignal(signal.SIGUSR1) is signal.SIG_IGN\n"
+        "if r == 1:\n"
+        "    sys.exit(5)\n"  # dies while rank 0 is still 'importing'
+        "time.sleep(1.0)\n"  # absorb the SIGUSR1 notice unarmed
+        "print('survived unarmed', flush=True)\n"
+    )
+    rc = launch_local([_PY, "-c", child], nproc=2,
+                      log_dir=str(tmp_path), echo_rank0=False,
+                      timeout=60, elastic=True)
+    assert rc == 0
+    assert "survived unarmed" in (tmp_path / "rank0.log").read_text()

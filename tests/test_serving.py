@@ -204,6 +204,37 @@ class TestSchedulerAndEngine:
         assert sorted(r.id for r in got) == sorted(ids)
         assert all(len(r.tokens) == 3 for r in got)
 
+    def test_loop_crash_fails_pending_results(self, rng_np):
+        """A dead background loop must FAIL blocked results() callers
+        with its exception (and count the crash), not park them forever
+        behind an engine that will never complete anything."""
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(1))
+        reg = MetricsRegistry("serve_crash")
+        eng = ServingEngine(cfg, params, ServingConfig(
+            max_slots=2, page_size=4, num_pages=32, max_prompt_len=8,
+            max_new_tokens=4, prefill_batch=2), registry=reg)
+        boom = RuntimeError("injected decode fault")
+
+        def bad_step():
+            raise boom
+
+        eng.step = bad_step
+        eng.start()
+        try:
+            eng.submit([1, 2, 3], max_new_tokens=3)
+            with pytest.raises(RuntimeError,
+                               match="serving loop crashed") as ei:
+                eng.results(n=1, timeout=30.0)
+            assert ei.value.__cause__ is boom
+            # the non-blocking drain reports the crash too, rather than
+            # returning an innocent-looking empty list
+            with pytest.raises(RuntimeError, match="serving loop crashed"):
+                eng.results()
+        finally:
+            eng.stop()
+        assert reg.counter("serve_loop_crashes", "").value() == 1.0
+
 
 class TestServeTelemetry:
     def test_per_request_records_and_percentiles(self, rng_np):
@@ -221,7 +252,7 @@ class TestServeTelemetry:
         serves = [r for r in sink.records if r.get("kind") == "serve"]
         assert len(serves) == 3
         for r in serves:
-            assert r["schema"] == "paddle_tpu.metrics/5"
+            assert r["schema"] == "paddle_tpu.metrics/6"
             for f in ("queue_wait_ms", "ttft_ms", "tpot_ms", "total_ms"):
                 assert r[f] >= 0.0
             assert r["new_tokens"] == 4

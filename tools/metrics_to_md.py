@@ -183,6 +183,45 @@ def recovery_table(faults: list[dict], recoveries: list[dict]) -> None:
               f"| {_fmt(r.get('recovery_ms'))} |")
 
 
+def elastic_table(events: list[dict]) -> None:
+    """Render the schema /6 elastic-fleet stream: one row per live mesh
+    rebuild (host loss / scale-up), with a loud flag on any recovery
+    that had to fall back to a cursor checkpoint — a fallback means the
+    lost host's shard was unrecoverable and progress was replayed, so
+    it must not read as a clean live reshard."""
+    if not events:
+        return
+    print("\n## Elastic events\n")
+    print("| event | dp degree | recovery ms | shard source "
+          "| pass | batch |")
+    print("|---|---|---|---|---|---|")
+    fallbacks = []
+    for r in events:
+        src = r.get("shard_source", "-")
+        if src == "checkpoint":
+            fallbacks.append(r)
+            src += " ⚠"
+        print(f"| {r.get('event', '-')} "
+              f"| {r.get('old_dp', '?')} → {r.get('new_dp', '?')} "
+              f"| {_fmt(r.get('recovery_ms'))} | {src} "
+              f"| {r.get('pass_id', '-')} | {r.get('batch_id', '-')} |")
+    worst = max((r.get("recovery_ms", 0) or 0 for r in events),
+                default=0)
+    print(f"\n**{len(events)} elastic rebuild(s)** · worst recovery "
+          f"{_fmt(float(worst))} ms — training continued in-process; "
+          f"no fleet restart.")
+    if fallbacks:
+        cursors = ", ".join(
+            f"pass {r.get('replay_cursor', {}).get('pass_id', '?')} "
+            f"batch {r.get('replay_cursor', {}).get('batch_id', '?')}"
+            for r in fallbacks)
+        print(f"\n**⚠ {len(fallbacks)} checkpoint-fallback "
+              f"recover{'y' if len(fallbacks) == 1 else 'ies'}** — live "
+              f"shards were unrecoverable and the trajectory replayed "
+              f"from {cursors}; work since those cursors was redone.  "
+              f"Shorten --checkpoint_batch_period if this recurs.")
+
+
 def _pctl(vals: list[float], q: float) -> float:
     """Nearest-rank-with-interpolation percentile over raw values (the
     per-request serve records carry exact latencies, so no bucket
@@ -293,6 +332,7 @@ def main(argv: list[str]) -> int:
     serves = [r for r in records if r.get("kind") == "serve"]
     serve_summaries = [r for r in records
                        if r.get("kind") == "serve_summary"]
+    elastics = [r for r in records if r.get("kind") == "elastic_event"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -306,10 +346,11 @@ def main(argv: list[str]) -> int:
             step_table(rs, last=last)
         comm_table(steps)
     recovery_table(faults, recoveries)
+    elastic_table(elastics)
     serving_table(serves, serve_summaries)
     bench_table(bench)
     if not steps and not bench and not faults and not recoveries \
-            and not serves and not serve_summaries:
+            and not serves and not serve_summaries and not elastics:
         print("_no step, fault, serve or bench records found_")
     return 0
 
