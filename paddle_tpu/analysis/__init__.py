@@ -1,0 +1,59 @@
+"""graftlint — the jaxpr/HLO preflight + codebase static-analysis suite.
+
+The reference framework front-loads correctness into static validation:
+``config_parser.py`` rejects a bad config before a kernel runs, and a
+Fluid ``ProgramDesc`` is a statically checkable program of ops.  This
+package is the TPU-era equivalent, following the graph-analysis framing
+of GDP (arxiv 1910.01578): analyze the dataflow program (and the repo
+that builds it), don't just run it and wait for the bench to regress.
+
+Two pass families share one finding/baseline machinery (:mod:`core`):
+
+- **Program passes** (:mod:`program`) run over the jaxpr / lowered HLO
+  of a built train or serve step: host-sync points inside the deferred-
+  fence window, per-signature recompilation hazards, large non-donated
+  update-step buffers, collective-sequence mismatch between the two
+  ZeRO lowerings (the multi-host deadlock class), silent f32 upcasts in
+  bf16 programs.  ``trainer --preflight`` drives them over the actual
+  configured step (:mod:`preflight`).
+- **Codebase passes** (:mod:`codebase`, :mod:`kernel_parity`) run over
+  the repo's own AST: thread-safety of the five threaded subsystems
+  (cross-thread attributes without the declared lock, lock-order
+  cycles), swallow-all ``except`` blocks, the kernel reference-twin
+  rule, telemetry record-kind drift vs SCHEMA, env-var reads without a
+  ``core/flags`` registration.
+
+Findings carry stable IDs (``RULE:path:anchor``) so the checked-in
+baseline (``baseline.json``) survives line drift; the repo-wide suite
+runs in tier-1 (``tests/test_analysis.py``) and must come up clean.
+
+CLI: ``python -m paddle_tpu.analysis`` (or ``tools/lint.py``, which
+adds ``--changed`` git-diff scoping).
+"""
+
+from paddle_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    repo_root,
+)
+from paddle_tpu.analysis.codebase import (  # noqa: F401
+    CODEBASE_PASSES,
+    lock_registry,
+    run_codebase,
+)
+from paddle_tpu.analysis.program import (  # noqa: F401
+    collective_sequence_from_hlo_text,
+    collective_sequence_from_jaxpr,
+    compare_collective_lowerings,
+    donation_pass,
+    f32_upcast_pass,
+    host_sync_pass,
+    recompile_hazard_pass,
+)
+from paddle_tpu.analysis.preflight import (  # noqa: F401
+    emit_preflight_record,
+    run_preflight,
+    trainer_preflight,
+)

@@ -1,0 +1,73 @@
+"""``python -m paddle_tpu.analysis`` — run the graftlint codebase suite
+repo-wide (exit 0 = clean: no unsuppressed findings).
+
+Options:
+  --files F [F ...]   restrict to these repo-relative files (the
+                      ``tools/lint.py --changed`` scoping; disables the
+                      stale-baseline check and the corpus-global kernel
+                      pass)
+  --passes P [P ...]  run only these passes (except thread lockorder
+                      env schema kernel)
+  --baseline PATH     alternate suppression file
+  --json              machine-readable output (one JSON object)
+  --locks             print the per-module lock registry and exit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from paddle_tpu.analysis.codebase import (
+    CODEBASE_PASSES,
+    lock_registry,
+    run_codebase,
+)
+from paddle_tpu.analysis.core import apply_baseline, load_baseline
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
+    p.add_argument("--files", nargs="*", default=None)
+    p.add_argument("--passes", nargs="*", default=None,
+                   choices=sorted(CODEBASE_PASSES))
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--locks", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.locks:
+        print(json.dumps(lock_registry(), indent=2))
+        return 0
+
+    findings = run_codebase(files=args.files, passes=args.passes)
+    full_run = args.files is None and args.passes is None
+    unsup, sup, stale = apply_baseline(
+        findings, load_baseline(args.baseline), full_run=full_run)
+
+    if args.json:
+        print(json.dumps({
+            "clean": not unsup,
+            "findings": [vars(f) | {"fid": f.fid} for f in unsup],
+            "suppressed": [f.fid for f in sup],
+            "stale_suppressions": stale,
+        }, indent=2))
+        return 1 if unsup else 0
+
+    for f in unsup:
+        print(f.render())
+    if sup:
+        print(f"({len(sup)} finding(s) suppressed by baseline)")
+    for fid in stale:
+        print(f"stale suppression (matches nothing): {fid}")
+    if unsup:
+        print(f"graftlint: {len(unsup)} unsuppressed finding(s)")
+        return 1
+    print("graftlint: OK — repo-wide suite clean"
+          if full_run else "graftlint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

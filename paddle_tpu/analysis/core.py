@@ -1,0 +1,95 @@
+"""Finding/baseline machinery shared by every graftlint pass.
+
+A :class:`Finding` is one violation.  Its identity (:attr:`Finding.fid`)
+is ``RULE:path:anchor[#ordinal]`` — the anchor is the enclosing
+qualified name (``Class.method``, a function, or ``<module>``), NOT a
+line number, so IDs survive unrelated edits and the checked-in baseline
+(``baseline.json`` next to this file) stays stable.  Multiple findings
+of one rule in one anchor get ``#2``, ``#3``… ordinals in source order.
+
+The baseline maps fid -> reason string.  A finding whose fid appears in
+the baseline is *suppressed* (reported separately, never a failure); a
+baseline entry matching nothing in a full run is *stale* and reported
+so dead suppressions get cleaned up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+@dataclasses.dataclass
+class Finding:
+    """One static-analysis violation."""
+
+    rule: str       # stable pass/rule id, e.g. "GL-EXCEPT"
+    path: str       # repo-relative path, or "<program:NAME>" for program passes
+    line: int       # 1-based line (0 for whole-file / program findings)
+    anchor: str     # enclosing qualified name ("Class.method", "<module>")
+    message: str
+    ordinal: int = 1  # disambiguates same rule+path+anchor; set by finalize
+
+    @property
+    def fid(self) -> str:
+        base = f"{self.rule}:{self.path}:{self.anchor}"
+        return base if self.ordinal == 1 else f"{base}#{self.ordinal}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{self.fid}\n    {loc} — {self.message}"
+
+
+def finalize(findings: list[Finding]) -> list[Finding]:
+    """Assign ordinals to findings sharing a (rule, path, anchor) so
+    every fid is unique; order (source order) is preserved."""
+    seen: dict[tuple, int] = {}
+    for f in findings:
+        key = (f.rule, f.path, f.anchor)
+        seen[key] = seen.get(key, 0) + 1
+        f.ordinal = seen[key]
+    return findings
+
+
+def repo_root() -> str:
+    """The repository root — the directory holding ``paddle_tpu/``."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> dict[str, str]:
+    """{fid: reason}.  A missing file is an empty baseline."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    sup = data.get("suppressions", data)
+    if not isinstance(sup, dict):
+        raise ValueError(f"baseline {path}: 'suppressions' must be a dict")
+    return {str(k): str(v) for k, v in sup.items()}
+
+
+def apply_baseline(findings: list[Finding], baseline: dict[str, str],
+                   full_run: bool = True,
+                   ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """(unsuppressed, suppressed, stale baseline fids).
+
+    ``full_run=False`` (``--changed`` scoping) skips the stale check —
+    a subset run cannot tell a stale entry from an out-of-scope one."""
+    unsup, sup = [], []
+    hit: set[str] = set()
+    for f in findings:
+        if f.fid in baseline:
+            hit.add(f.fid)
+            sup.append(f)
+        else:
+            unsup.append(f)
+    stale = sorted(set(baseline) - hit) if full_run else []
+    return unsup, sup, stale

@@ -1,0 +1,107 @@
+"""GL-KERNEL — the kernel reference-twin rule, as a graftlint pass.
+
+Every Pallas kernel module must ship a pure-jnp reference twin
+(``<entry>_reference``) and an interpret-mode parity test — the
+Compare2Function discipline the reference applied to its CUDA kernels
+(``paddle/function/FunctionTest.h``).  Concretely, for every module
+under ``paddle_tpu/ops/pallas/`` (recursively, ``__init__`` excluded)
+that calls ``pallas_call``:
+
+1. the module defines at least one public ``<entry>_reference`` function
+   whose base name ``<entry>`` is also defined in the module;
+2. for each such pair, some file under ``tests/`` mentions BOTH the
+   entry name and its reference name (the parity test — kernel vs
+   oracle in interpret mode).
+
+This absorbed ``tools/check_kernel_parity.py`` (PR 7); that script is
+now a thin shim over :func:`audit` / :func:`main` so the existing
+tier-1 wiring (``tests/test_kernel_parity.py``) is unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from paddle_tpu.analysis.core import Finding, repo_root
+
+
+def _kernel_modules(repo: str) -> list[str]:
+    pallas = os.path.join(repo, "paddle_tpu", "ops", "pallas")
+    out = []
+    for root, _dirs, files in os.walk(pallas):
+        for f in sorted(files):
+            if f.endswith(".py") and f != "__init__.py":
+                out.append(os.path.join(root, f))
+    return out
+
+
+def _module_defs(path: str) -> list[str]:
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return [n.name for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _uses_pallas(path: str) -> bool:
+    with open(path) as fh:
+        return "pallas_call" in fh.read()
+
+
+def _tests_corpus(repo: str) -> str:
+    tests = os.path.join(repo, "tests")
+    chunks = []
+    for f in sorted(os.listdir(tests)):
+        if f.endswith(".py"):
+            with open(os.path.join(tests, f)) as fh:
+                chunks.append(fh.read())
+    return "\n".join(chunks)
+
+
+def kernel_parity_findings(repo: str | None = None) -> list[Finding]:
+    repo = repo or repo_root()
+    corpus = _tests_corpus(repo)
+    findings = []
+    for path in _kernel_modules(repo):
+        rel = os.path.relpath(path, repo)
+        if not _uses_pallas(path):
+            continue
+        defs = _module_defs(path)
+        pairs = [(n[: -len("_reference")], n) for n in defs
+                 if n.endswith("_reference") and not n.startswith("_")]
+        pairs = [(base, ref) for base, ref in pairs if base in defs]
+        if not pairs:
+            findings.append(Finding(
+                "GL-KERNEL", rel, 0, "<module>",
+                "no public <entry>/<entry>_reference pair — every kernel "
+                "module needs a jnp oracle"))
+            continue
+        for base, ref in pairs:
+            if base not in corpus or ref not in corpus:
+                missing = [n for n in (base, ref) if n not in corpus]
+                findings.append(Finding(
+                    "GL-KERNEL", rel, 0, base,
+                    f"{base!r} has no interpret-mode parity test under "
+                    f"tests/ ({', '.join(missing)} never referenced)"))
+    return findings
+
+
+def audit(repo: str | None = None) -> list[str]:
+    """Violation strings (empty = pass) — the historical
+    ``check_kernel_parity.audit`` contract the tools shim re-exports."""
+    return [f"{f.path}: {f.message}" for f in kernel_parity_findings(repo)]
+
+
+def main(repo: str | None = None) -> int:
+    repo = repo or repo_root()
+    violations = audit(repo)
+    mods = [m for m in _kernel_modules(repo) if _uses_pallas(m)]
+    if violations:
+        print(f"check_kernel_parity: {len(violations)} violation(s) over "
+              f"{len(mods)} kernel modules:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print(f"check_kernel_parity: OK — {len(mods)} kernel modules, every "
+          f"entry has a jnp reference and a tests/ parity mention")
+    return 0

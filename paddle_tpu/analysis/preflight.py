@@ -1,0 +1,192 @@
+"""Preflight — run the program passes over the ACTUAL configured train
+step before a single optimizer step executes (``trainer --preflight``),
+the way the reference's ``config_parser.py`` rejected a bad config
+before any kernel ran.
+
+:func:`trainer_preflight` builds the same jitted step ``cmd_train``
+would train with (same topology/optimizer/mesh/zero mode/compute
+dtype), lowers it once, and runs:
+
+- ``GL-P-SYNC``    over the step's jaxpr (host callbacks compiled in);
+- ``GL-P-DONATE``  over the lowered StableHLO (un-donated update-size
+  buffers);
+- ``GL-P-UPCAST``  over the jaxpr when the run declared bf16 compute;
+- ``GL-P-COLL``    when ``zero >= 2`` on a multi-device pure-data mesh:
+  both ZeRO lowerings (explicit shard_map and GSPMD constraints) are
+  built and their collective sequences compared — the multi-host
+  deadlock class;
+- ``GL-P-RECOMPILE`` over the probe-signature set (the step's own feed
+  signature plus any caller-supplied set, e.g. a resumed run's
+  ``SGD._compiled_sigs``).
+
+``inject`` (the ``preflight_inject`` flag; TESTING ONLY) seeds a
+deterministic defect — ``host_sync`` wraps the step with a host
+callback, ``collective_mismatch`` perturbs the GSPMD sequence — so the
+regression tests can prove each check fires through the real CLI.
+
+One ``kind="preflight"`` telemetry record (schema /7) is emitted per
+run with the per-rule counts and unsuppressed finding ids.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis.core import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+)
+from paddle_tpu.analysis.core import finalize as finalize_build
+from paddle_tpu.analysis.program import (
+    collective_sequence_from_hlo_text,
+    collective_sequence_from_jaxpr,
+    compare_collective_lowerings,
+    donation_pass,
+    f32_upcast_pass,
+    host_sync_pass,
+    recompile_hazard_pass,
+)
+
+
+def _feed_signature(feed: dict) -> tuple:
+    from paddle_tpu.trainer.trainer import _feed_signature as sig
+
+    return sig(feed)
+
+
+def trainer_preflight(topology, optimizer, feed, mesh=None, *,
+                      zero: int = 0, compute_dtype=None,
+                      sync_period: int | None = None,
+                      signatures=None, inject: str = "",
+                      name: str = "train_step",
+                      min_donate_bytes: int = 1 << 20) -> list[Finding]:
+    """Build the configured train step and run every applicable program
+    pass; returns the raw findings (caller applies the baseline)."""
+    import jax
+
+    from paddle_tpu.core import parameters as _params_mod
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.trainer.step import build_train_step
+
+    if inject not in ("", "host_sync", "collective_mismatch"):
+        raise ValueError(f"unknown preflight_inject {inject!r}")
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    dp = mesh.mesh.shape.get("data", 1)
+    specs = {s.name: s for s in topology.param_specs()}
+    params = _params_mod.create(topology).as_dict()
+    opt_state = optimizer.init(params, specs)
+    states = topology.init_states()
+    key = jax.random.key(0)
+
+    step = build_train_step(topology, optimizer, mesh,
+                            compute_dtype=compute_dtype, zero=zero)
+    args = (params, opt_state, states, feed, key)
+
+    probe = step
+    if inject == "host_sync":
+        def probe(*a):  # noqa: F811 - the injected twin of the step
+            jax.debug.callback(lambda: None)
+            return step(*a)
+
+    findings: list[Finding] = []
+    try:
+        findings += host_sync_pass(probe, *args, name=name,
+                                   sync_period=sync_period)
+    except Exception as e:
+        # the config_parser-style rejection: a program that cannot even
+        # trace must be fixed before anything runs (commonly: provider
+        # input_types unreachable, so the probe feed mistypes a layer)
+        findings.append(Finding(
+            "GL-P-BUILD", f"<program:{name}>", 0, "trace",
+            f"train step failed to trace ({type(e).__name__}: {e}) — "
+            f"the configured program cannot be built"))
+        return finalize_build(findings)
+    try:
+        lowered_text = step.lower(*args).as_text()
+    except Exception as e:
+        findings.append(Finding(
+            "GL-P-DONATE", f"<program:{name}>", 0, "lowering",
+            f"step failed to lower for the donation check ({e}) — the "
+            f"program cannot be statically audited"))
+        lowered_text = None
+    if lowered_text is not None:
+        findings += donation_pass(lowered_text, name=name,
+                                  min_bytes=min_donate_bytes)
+    bf16 = compute_dtype is not None and "bfloat16" in str(compute_dtype)
+    if bf16:
+        findings += f32_upcast_pass(step, *args, name=name)
+
+    sigs = list(signatures or [])
+    sigs.append(_feed_signature(feed))
+    findings += recompile_hazard_pass(sigs, name=name)
+
+    from paddle_tpu.parallel import zero as zero_mod
+
+    if zero >= 2 and dp > 1 and zero_mod.explicit_lowering_ok(mesh.mesh):
+        explicit_step = build_train_step(
+            topology, optimizer, mesh, compute_dtype=compute_dtype,
+            zero=zero, lowering="explicit")
+        seq_a = collective_sequence_from_jaxpr(explicit_step, *args)
+        gspmd_step = build_train_step(
+            topology, optimizer, mesh, compute_dtype=compute_dtype,
+            zero=zero, lowering="gspmd")
+        hlo = gspmd_step.lower(*args).compile().as_text()
+        seq_b = collective_sequence_from_hlo_text(hlo)
+        if inject == "collective_mismatch":
+            # drop every gradient reduction from one side: the seeded
+            # config-drift defect (one host's program never reduces)
+            seq_b = [k for k in seq_b
+                     if k not in ("all_reduce", "reduce_scatter")]
+        findings += compare_collective_lowerings(
+            seq_a, seq_b, name=name, label_a="shard_map", label_b="gspmd")
+    elif inject == "collective_mismatch":
+        # the seeded defect must fire even where the mesh has no second
+        # lowering to compare (dp == 1): perturb the explicit sequence
+        # against itself so the CLI wiring is still provable end-to-end
+        seq = ["reduce_scatter", "all_gather"]
+        findings += compare_collective_lowerings(
+            seq, ["all_gather"], name=name,
+            label_a="shard_map", label_b="gspmd")
+    return findings
+
+
+def emit_preflight_record(findings, suppressed, *, registry=None,
+                          run: str = "preflight", config: str = "") -> dict:
+    """One schema/7 ``kind="preflight"`` record: per-rule counts, the
+    unsuppressed finding ids, clean flag — rendered by
+    ``tools/metrics_to_md.py``'s Preflight table."""
+    from paddle_tpu import metrics as metrics_mod
+
+    reg = registry or metrics_mod.get_registry()
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        reg.counter("preflight_findings",
+                    "unsuppressed preflight/analysis findings").inc(
+            1.0, rule=f.rule)
+    rec = {
+        "run": run, "config": config, "clean": not findings,
+        "findings": len(findings), "suppressed": len(suppressed),
+        "by_rule": by_rule,
+        "ids": [f.fid for f in findings[:32]],
+    }
+    if reg.active:
+        return reg.emit(rec, kind="preflight")
+    return rec
+
+
+def run_preflight(topology, optimizer, feed, mesh=None, *,
+                  zero: int = 0, compute_dtype=None,
+                  sync_period: int | None = None, inject: str = "",
+                  baseline_path: str | None = None, registry=None,
+                  config: str = "", name: str = "train_step",
+                  ) -> tuple[list[Finding], list[Finding]]:
+    """The full `trainer --preflight` flow: build + analyze + baseline +
+    telemetry.  Returns (unsuppressed, suppressed)."""
+    raw = trainer_preflight(
+        topology, optimizer, feed, mesh, zero=zero,
+        compute_dtype=compute_dtype, sync_period=sync_period,
+        inject=inject, name=name)
+    unsup, sup, _stale = apply_baseline(
+        raw, load_baseline(baseline_path), full_run=False)
+    emit_preflight_record(unsup, sup, registry=registry, config=config)
+    return unsup, sup

@@ -1,0 +1,431 @@
+"""Program passes — static analysis over the jaxpr / lowered HLO of a
+built train or serve step (the GDP framing, arxiv 1910.01578: analyze
+the dataflow program, don't just run it).
+
+Every pass returns :class:`~paddle_tpu.analysis.core.Finding`\\ s whose
+``path`` is ``<program:NAME>`` — program findings have no file/line,
+their anchor is the pass-specific object (a primitive, an argument, a
+signature group).
+
+- ``GL-P-SYNC``      host-device sync points compiled INTO the program:
+  callback/infeed/outfeed primitives force a host round-trip on every
+  execution — inside the trainer's deferred-fence window (``sync_period``
+  > 1) that silently serializes host and device each step.
+- ``GL-P-RECOMPILE`` per-signature recompilation hazards over the
+  compiled-signature set: the same feed structure compiled many times
+  with different dims (shape churn) or flip-flopping dtypes.
+- ``GL-P-DONATE``    large buffers that flow through the update step
+  un-donated: an input the size of the parameters with an identically
+  typed output and no ``tf.aliasing_output``/``jax.buffer_donor``
+  marker doubles its HBM footprint.
+- ``GL-P-COLL``      collective-sequence mismatch between two lowerings
+  of the same step (the shard_map and GSPMD ZeRO paths): a fleet whose
+  hosts disagree on which program they built issues collectives in
+  different orders and deadlocks.  Kind-SET mismatch is always a
+  finding; exact order is checked only with ``check_order=True``
+  (the XLA partitioner may legally fuse/batch collectives, so order
+  across *different* lowerings is advisory).
+- ``GL-P-UPCAST``    silent f32 upcasts feeding matmuls in a program
+  that declared bf16 compute: a ``convert_element_type`` bf16→f32 whose
+  result reaches a ``dot_general``/``conv_general_dilated`` operand
+  runs the MXU at half rate without anyone asking for it.
+"""
+
+from __future__ import annotations
+
+import re
+
+from paddle_tpu.analysis.core import Finding, finalize
+
+
+def _pname(name: str) -> str:
+    return f"<program:{name}>"
+
+
+# -- jaxpr plumbing -------------------------------------------------------------
+
+
+def jaxpr_of(fn_or_jaxpr, *args, **kwargs):
+    """ClosedJaxpr of a callable (traced on ``args``) or pass-through
+    for an already-made jaxpr."""
+    if hasattr(fn_or_jaxpr, "jaxpr"):   # ClosedJaxpr
+        return fn_or_jaxpr
+    import jax
+
+    return jax.make_jaxpr(fn_or_jaxpr)(*args, **kwargs)
+
+
+def _walk_eqns(jaxpr):
+    """Depth-first over every eqn including sub-jaxprs (pjit bodies,
+    shard_map regions, scan/while/cond branches, custom_vjp calls)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield from _walk_eqns(inner)
+            elif hasattr(v, "eqns"):
+                yield from _walk_eqns(v)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        yield from _walk_eqns(inner)
+                    elif hasattr(item, "eqns"):
+                        yield from _walk_eqns(item)
+
+
+# -- GL-P-SYNC ------------------------------------------------------------------
+
+HOST_SYNC_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "infeed", "outfeed",
+})
+
+
+def host_sync_pass(fn_or_jaxpr, *args, name: str = "step",
+                   sync_period: int | None = None) -> list[Finding]:
+    """Flag host-callback/infeed primitives compiled into the program —
+    each one is a host-device sync point every execution pays.  The
+    optional ``sync_period`` is only used to sharpen the message (the
+    deferred-fence window makes the stall worse, not the rule)."""
+    jaxpr = jaxpr_of(fn_or_jaxpr, *args)
+    findings = []
+    for eqn in _walk_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name in HOST_SYNC_PRIMS:
+            window = (f" inside a sync_period={sync_period} deferred-"
+                      f"fence window" if sync_period and sync_period > 1
+                      else "")
+            findings.append(Finding(
+                "GL-P-SYNC", _pname(name), 0, eqn.primitive.name,
+                f"host sync point `{eqn.primitive.name}` compiled into "
+                f"the program{window}: every execution round-trips the "
+                f"host (a stray device_get/.item()-shaped transfer); "
+                f"move it out of the step or fence explicitly"))
+    return finalize(findings)
+
+
+# -- GL-P-RECOMPILE -------------------------------------------------------------
+
+
+def _skeleton(sig, mask_dtypes: bool = False):
+    """Signature with int leaves (dims) — and optionally dtype-looking
+    strings — masked, so signatures differing only in those group
+    together."""
+    if isinstance(sig, (tuple, list)):
+        return tuple(_skeleton(s, mask_dtypes) for s in sig)
+    if isinstance(sig, bool):
+        return sig
+    if isinstance(sig, int):
+        return "*"
+    if mask_dtypes and isinstance(sig, str) and re.fullmatch(
+            r"(float|bfloat|int|uint|complex|bool)[0-9_]*", sig):
+        return "?"
+    return sig
+
+
+def recompile_hazard_pass(signatures, name: str = "step",
+                          max_signatures: int = 8,
+                          max_shape_variants: int = 2) -> list[Finding]:
+    """Analyze a compiled-signature set (``SGD._compiled_sigs`` /
+    preflight probes) for recompilation hazards.
+
+    - more than ``max_signatures`` distinct programs = churn outright;
+    - one structure compiled more than ``max_shape_variants`` times
+      with different dims = shape churn (a tail batch is expected —
+      two variants — an unpinned batch/sequence dim is not);
+    - two signatures identical up to a dtype flip = dtype churn (every
+      flip recompiles AND silently changes numerics).
+    """
+    sigs = [tuple(s) if isinstance(s, list) else s for s in signatures]
+    sigs = list(dict.fromkeys(sigs))  # stable dedup
+    findings = []
+    if len(sigs) > max_signatures:
+        findings.append(Finding(
+            "GL-P-RECOMPILE", _pname(name), 0, "signature-count",
+            f"{len(sigs)} distinct compiled signatures (> "
+            f"{max_signatures}): every new signature pays a full XLA "
+            f"compile — pin feed shapes (bucket_batch / drop_last / "
+            f"pad) or raise the bucket sizes"))
+    by_skel: dict = {}
+    for s in sigs:
+        by_skel.setdefault(_skeleton(s), []).append(s)
+    for skel, group in by_skel.items():
+        if len(group) > max_shape_variants:
+            findings.append(Finding(
+                "GL-P-RECOMPILE", _pname(name), 0, "shape-churn",
+                f"one feed structure compiled {len(group)} times with "
+                f"different dims (> {max_shape_variants}: full batch + "
+                f"one tail is the expected ceiling) — an unpinned "
+                f"batch/sequence dim is recompiling per batch"))
+    by_dt: dict = {}
+    for s in sigs:
+        # same fully-masked structure, more than one dims-masked (i.e.
+        # dtype-visible) variant = signatures differing only in dtype
+        by_dt.setdefault(_skeleton(s, mask_dtypes=True),
+                         set()).add(_skeleton(s))
+    for _skel, variants in by_dt.items():
+        if len(variants) > 1:
+            findings.append(Finding(
+                "GL-P-RECOMPILE", _pname(name), 0, "dtype-churn",
+                f"signatures identical up to a dtype flip "
+                f"({len(variants)} variants): the feed path is not "
+                f"converting consistently — every flip recompiles and "
+                f"changes numerics"))
+    return finalize(findings)
+
+
+# -- GL-P-DONATE ----------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1, "c64": 8, "c128": 16,
+}
+
+_ARG_HEAD_RE = re.compile(r"%arg(\d+): tensor<([^>]+)>")
+_RET_RE = re.compile(r"^\s*(?:func\.)?return\b.*?:\s*(.+)$", re.M)
+_TENSOR_RE = re.compile(r"tensor<([^>]+)>")
+
+
+def _parse_main_args(sig: str) -> list[tuple[str, str, str]]:
+    """(index, tensor type, attr text) per ``%argN`` in a func
+    signature.  The attr dict is scanned brace-aware and quote-aware —
+    values like ``mhlo.sharding = "{maximal device=0}"`` contain braces
+    a regex capture would stop at, hiding ``tf.aliasing_output``."""
+    out = []
+    for m in _ARG_HEAD_RE.finditer(sig):
+        i = m.end()
+        while i < len(sig) and sig[i] in " \t":
+            i += 1
+        attrs = ""
+        if i < len(sig) and sig[i] == "{":
+            depth, j, in_str = 0, i, False
+            while j < len(sig):
+                c = sig[j]
+                if c == '"' and sig[j - 1] != "\\":
+                    in_str = not in_str
+                elif not in_str and c == "{":
+                    depth += 1
+                elif not in_str and c == "}":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            attrs = sig[i + 1:j]
+        out.append((m.group(1), m.group(2), attrs))
+    return out
+
+
+def _tensor_bytes(ty: str) -> int:
+    parts = ty.split("x")
+    dtype = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        try:
+            n *= int(p)
+        except ValueError:
+            return 0  # dynamic dim: size unknown
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def donation_pass(lowered_or_text, name: str = "step",
+                  min_bytes: int = 1 << 20) -> list[Finding]:
+    """Flag update-in-place candidates that are not donated: a main-
+    function input of at least ``min_bytes`` whose exact tensor type
+    also appears among the outputs (params/opt-state flowing through)
+    and that carries neither ``tf.aliasing_output`` nor
+    ``jax.buffer_donor``.  Works on a ``jax.stages.Lowered`` or its
+    StableHLO text; backends that strip the markers yield no findings
+    (best-effort by design)."""
+    text = (lowered_or_text if isinstance(lowered_or_text, str)
+            else lowered_or_text.as_text())
+    main = text.split("func.func public @main", 1)
+    if len(main) < 2:
+        return []
+    sig = main[1].split("\n", 1)[0]  # the signature is one line
+    # only @main's returns are aliasable outputs; helper funcs' returns
+    # (outlined regions, custom-call wrappers) must not inflate the
+    # budget.  The main body ends at the next func.func (or EOF).
+    body = re.split(r"\n\s*func\.func\b", main[1], 1)[0]
+    # per-type output budget: an input can only alias an output of its
+    # exact type, and each aliased output is spoken for — two same-type
+    # inputs with one output means only one is donatable at all
+    out_budget: dict[str, int] = {}
+    for m in _RET_RE.finditer(body):
+        for ty in _TENSOR_RE.findall(m.group(1)):
+            out_budget[ty] = out_budget.get(ty, 0) + 1
+    args = _parse_main_args(sig)
+    for _idx, ty, attrs in args:
+        if "tf.aliasing_output" in attrs:
+            out_budget[ty] = out_budget.get(ty, 0) - 1
+    findings = []
+    for idx, ty, attrs in args:
+        nbytes = _tensor_bytes(ty)
+        if nbytes < min_bytes:
+            continue
+        if "tf.aliasing_output" in attrs or "jax.buffer_donor" in attrs:
+            continue
+        if out_budget.get(ty, 0) <= 0:
+            continue  # no un-aliased output left to update in place
+        out_budget[ty] -= 1
+        findings.append(Finding(
+            "GL-P-DONATE", _pname(name), 0, f"arg{idx}",
+            f"input %arg{idx} (tensor<{ty}>, {nbytes / 1e6:.1f} MB) "
+            f"flows through to an identically-typed output but is not "
+            f"donated — the update step holds two copies; add it to "
+            f"donate_argnums"))
+    return finalize(findings)
+
+
+# -- GL-P-COLL ------------------------------------------------------------------
+
+_JAXPR_COLLECTIVES = {
+    "psum": "all_reduce", "psum2": "all_reduce", "pmean": "all_reduce",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "all_gather": "all_gather", "all_to_all": "all_to_all",
+    "ppermute": "collective_permute",
+}
+
+# opcode immediately before its operand paren; references carry an id
+# suffix (%all-reduce.30) and never match.  -start counts the op once,
+# -done is skipped (async pairs on TPU).
+_HLO_COLL_RE = re.compile(
+    r"\s(all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)(-start)?\(")
+
+
+def collective_sequence_from_jaxpr(fn_or_jaxpr, *args) -> list[str]:
+    """Ordered normalized collective kinds of a program's jaxpr (the
+    explicit/shard_map lowering carries its collectives as primitives)."""
+    jaxpr = jaxpr_of(fn_or_jaxpr, *args)
+    return [_JAXPR_COLLECTIVES[e.primitive.name]
+            for e in _walk_eqns(jaxpr.jaxpr)
+            if e.primitive.name in _JAXPR_COLLECTIVES]
+
+
+_HLO_RS_SLICE_RE = re.compile(r"\sdynamic-slice\([^)]*%[\w.-]*all-reduce")
+
+
+def collective_sequence_from_hlo_text(text: str) -> list[str]:
+    """Ordered normalized collective kinds from compiled HLO text (the
+    GSPMD lowering's collectives only exist post-partitioning).
+
+    Partitioners may legally decompose reduce-scatter into all-reduce +
+    dynamic-slice-of-the-result (XLA:CPU does); that pattern is
+    normalized back to ``reduce_scatter`` so the cross-lowering
+    comparison checks semantics, not backend lowering choices."""
+    out = []
+    for line in text.splitlines():
+        if _HLO_RS_SLICE_RE.search(line):
+            out.append("reduce_scatter")
+            continue
+        m = _HLO_COLL_RE.search(line)
+        if m:
+            out.append(m.group(1).replace("-", "_"))
+    return out
+
+
+# semantic classes that survive backend lowering choices: the XLA
+# all-reduce-combiner may merge per-param reductions and a partitioner
+# may express reduce-scatter as all-reduce + slice, but a program that
+# REDUCES gradients / GATHERS params / SHUFFLES (MoE, ring) cannot
+# compile to one that doesn't
+_COLL_CLASS = {
+    "all_reduce": "reduction", "reduce_scatter": "reduction",
+    "all_gather": "gather", "all_to_all": "shuffle",
+    "collective_permute": "shuffle",
+}
+
+
+def compare_collective_lowerings(seq_a, seq_b, name: str = "step",
+                                 label_a: str = "shard_map",
+                                 label_b: str = "gspmd",
+                                 check_order: bool = False) -> list[Finding]:
+    """Compare two lowerings' collective sequences — the multi-host
+    deadlock class: hosts that disagree on the program (config drift
+    picking different ZeRO lowerings, a dropped/reordered collective)
+    block forever in each other's collectives.
+
+    Across DIFFERENT lowering families the comparison is by semantic
+    class (reduction / gather / shuffle — see ``_COLL_CLASS``): the
+    partitioner may legally combine all per-param reductions into one
+    op or decompose reduce-scatter, but a program missing a class its
+    twin has (e.g. one lowering never reduces gradients) is the
+    config-drift desync.  With ``check_order=True`` (sequences from the
+    SAME family, e.g. two builds of the explicit lowering) the exact
+    kind order must match too."""
+    classes_a = {_COLL_CLASS[k] for k in seq_a if k in _COLL_CLASS}
+    classes_b = {_COLL_CLASS[k] for k in seq_b if k in _COLL_CLASS}
+    findings = []
+    if classes_a != classes_b:
+        only_a = sorted(classes_a - classes_b)
+        only_b = sorted(classes_b - classes_a)
+        detail = "; ".join(
+            f"only in {lbl}: {', '.join(only)}"
+            for lbl, only in ((label_a, only_a), (label_b, only_b)) if only)
+        findings.append(Finding(
+            "GL-P-COLL", _pname(name), 0, "kind-set",
+            f"collective classes differ between the {label_a} and "
+            f"{label_b} lowerings ({detail}) — a fleet mixing these "
+            f"programs deadlocks in the gradient flow"))
+    elif check_order and list(seq_a) != list(seq_b):
+        findings.append(Finding(
+            "GL-P-COLL", _pname(name), 0, "order",
+            f"collective order differs between {label_a} "
+            f"({' '.join(seq_a) or 'none'}) and {label_b} "
+            f"({' '.join(seq_b) or 'none'}) — hosts executing "
+            f"different orders deadlock under contention"))
+    return finalize(findings)
+
+
+# -- GL-P-UPCAST ----------------------------------------------------------------
+
+_LAYOUT_PRIMS = {"broadcast_in_dim", "transpose", "reshape", "squeeze",
+                 "slice", "rev", "expand_dims", "copy"}
+_MXU_PRIMS = {"dot_general", "conv_general_dilated"}
+
+
+def f32_upcast_pass(fn_or_jaxpr, *args, name: str = "step") -> list[Finding]:
+    """In a program that declared bf16 compute, flag bf16→f32
+    ``convert_element_type`` results reaching a matmul/conv operand
+    (directly or through layout-only ops): the MXU runs that
+    contraction at f32 rate without the config asking for it.  The
+    sanctioned upcasts — gradients upcast AFTER the backward for the
+    f32 optimizer update, BN statistics — feed elementwise ops, not
+    contractions, and are not flagged."""
+    jaxpr = jaxpr_of(fn_or_jaxpr, *args)
+    findings = []
+
+    def scan(jx):
+        upcast_vars = {}   # var -> source eqn (bf16 -> f32 converts)
+        for eqn in jx.eqns:
+            pname = eqn.primitive.name
+            if pname == "convert_element_type":
+                inv = eqn.invars[0]
+                src = getattr(getattr(inv, "aval", None), "dtype", None)
+                dst = getattr(getattr(eqn.outvars[0], "aval", None),
+                              "dtype", None)
+                if str(src) == "bfloat16" and str(dst) == "float32":
+                    upcast_vars[eqn.outvars[0]] = eqn
+            elif pname in _LAYOUT_PRIMS:
+                if eqn.invars and eqn.invars[0] in upcast_vars:
+                    upcast_vars[eqn.outvars[0]] = upcast_vars[eqn.invars[0]]
+            elif pname in _MXU_PRIMS:
+                for inv in eqn.invars:
+                    if inv in upcast_vars:
+                        findings.append(Finding(
+                            "GL-P-UPCAST", _pname(name), 0, pname,
+                            f"bf16 operand upcast to f32 feeds "
+                            f"`{pname}`: the contraction runs at f32 "
+                            f"MXU rate in a bf16 program — cast after "
+                            f"the matmul or keep the operand bf16"))
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    scan(inner)
+                elif hasattr(v, "eqns"):
+                    scan(v)
+
+    scan(jaxpr.jaxpr)
+    return finalize(findings)

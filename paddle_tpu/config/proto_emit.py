@@ -21,7 +21,7 @@ import math
 
 from paddle_tpu import proto
 from paddle_tpu.config.protostr import to_protostr
-from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.enforce import EnforceError, enforce
 from paddle_tpu.layers.attr import ParamAttr
 from paddle_tpu.layers.base import LayerOutput
 
@@ -479,7 +479,7 @@ def _batch_norm(E, node):
         if parent.size % channels == 0 and (parent.size // channels) >= 1:
             try:
                 ic.img_size, ic.img_size_y = get_img_size(parent, channels)
-            except Exception:
+            except EnforceError:  # non-square pixels: 1-D geometry stands
                 ic.img_size = parent.size // channels
                 ic.img_size_y = 1
         if img_size_set:

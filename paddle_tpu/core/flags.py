@@ -113,6 +113,37 @@ def all_flags() -> dict[str, Any]:
     return {n: get(n) for n in _REGISTRY}
 
 
+# -- declared env passthroughs -------------------------------------------------
+#
+# Some configuration is process-environment by nature — the launcher's
+# per-rank rendezvous variables, externally owned knobs like
+# JAX_PLATFORMS — and cannot be a flag (a flag is per-invocation; these
+# are per-process and set by another program).  They still must be
+# REGISTERED so every env read in the tree is discoverable in one place:
+# the GL-ENV static-analysis pass (paddle_tpu/analysis) rejects any
+# literal os.environ/os.getenv read whose name is neither a defined
+# flag's PADDLE_TPU_<NAME> override nor declared here.
+
+_ENV_REGISTRY: dict[str, str] = {}
+
+
+def declare_env(name: str, help: str = "") -> None:
+    """Register an environment variable read directly (not through a
+    flag) somewhere in the tree, with a one-line description."""
+    _ENV_REGISTRY[name] = help
+
+
+def declared_env() -> dict[str, str]:
+    return dict(_ENV_REGISTRY)
+
+
+def known_env_names() -> set[str]:
+    """Every env name the tree may legitimately read: each flag's
+    PADDLE_TPU_<NAME> override plus the declared passthroughs."""
+    # NB: the builtin set() is shadowed by the gflags-mirror set() above
+    return {f"PADDLE_TPU_{n.upper()}" for n in _REGISTRY} | {*_ENV_REGISTRY}
+
+
 # --- The central flag set (TPU-era rewrite of Flags.h:19-43) -----------------
 define("use_tpu", True, "run compute on TPU when available (was: use_gpu)")
 define("trainer_count", 1, "data-parallel replicas on this host (mesh batch axis)")
@@ -211,3 +242,34 @@ define("fused_kernels", "auto", "route conv/BN/optimizer hot paths through "
                                 "the TPP fused Pallas microkernels "
                                 "(ops/pallas/tpp): auto = on-TPU only | "
                                 "on | off")
+# static analysis / preflight (paddle_tpu/analysis): the jaxpr/HLO
+# program passes run by `trainer --preflight` before any step executes
+define("preflight_inject", "", "seed a deterministic defect into the "
+                               "preflight program checks to prove they "
+                               "fire: host_sync | collective_mismatch "
+                               "(TESTING ONLY)")
+
+# -- env passthroughs read directly (see declare_env above) --------------------
+declare_env("PADDLE_TPU_COORDINATOR",
+            "launcher rendezvous: coordinator host:port for "
+            "jax.distributed.initialize (distributed/multihost.py)")
+declare_env("PADDLE_TPU_NPROC",
+            "launcher rendezvous: total participating processes "
+            "(distributed.launch sets it per rank)")
+declare_env("PADDLE_TPU_TRAINER_ID",
+            "launcher rendezvous: this process's rank; also the "
+            "telemetry host-index fallback before backend init")
+declare_env("PADDLE_TPU_RENDEZVOUS_EPOCH",
+            "elastic fleet: membership epoch this process joined under "
+            "(distributed.launch --elastic)")
+declare_env("PADDLE_TPU_MEMBERSHIP",
+            "elastic fleet: membership file the launcher rewrites on "
+            "host loss/scale events")
+declare_env("JAX_PLATFORMS",
+            "externally owned jax backend selector; capi_bridge "
+            "forwards it before first device use")
+declare_env("PADDLE_REFERENCE_ROOT",
+            "demo runners: checkout of the reference framework for "
+            "side-by-side parity runs")
+declare_env("PADDLE_TPU_IMDB_SYNTH_N",
+            "demo/benchmark: synthetic IMDB corpus size override")

@@ -14,6 +14,8 @@ import socket
 import subprocess
 import time
 
+from paddle_tpu.core import logger as log
+
 
 class MasterClient:
     """Blocking line-protocol client; one socket per client (trainers keep
@@ -206,7 +208,9 @@ class MasterServer:
             try:
                 self.client(timeout=2.0).stop_server()
                 self._proc.wait(timeout=5.0)
-            except Exception:
+            except Exception as e:
+                log.warning("master graceful stop failed (%s: %s); "
+                            "killing the process", type(e).__name__, e)
                 self._proc.kill()
                 self._proc.wait()
 
@@ -237,7 +241,10 @@ def master_reader(client: MasterClient, task_to_records,
             tid, epoch, payload = got
             try:
                 yield from task_to_records(payload)
-            except Exception:
+            except Exception as e:
+                log.warning("task %s failed mid-read (%s: %s); re-queued "
+                            "on the master for another trainer", tid,
+                            type(e).__name__, e)
                 client.task_failed(tid, epoch)
                 continue
             client.task_finished(tid, epoch)

@@ -404,7 +404,17 @@ class FlightRecorder:
                 json.dump(payload, f, default=json_default)
             os.replace(tmp, path)
             return path
-        except Exception:
+        except Exception as e:
+            # dump() must never raise (it runs in crash paths), but a
+            # lost post-mortem must not be invisible either
+            from paddle_tpu.telemetry import swallow
+
+            with swallow("flight_dump"):
+                from paddle_tpu.core import logger as log
+
+                log.error("flight-recorder dump failed (%s: %s); the "
+                          "post-mortem ring was NOT written",
+                          type(e).__name__, e)
             return None
 
 
@@ -560,8 +570,8 @@ def host_str() -> str:
         from paddle_tpu.telemetry import host_index
 
         return str(host_index())
-    except Exception:
-        return "?"
+    except (ImportError, ValueError):  # telemetry not importable yet /
+        return "?"                     # garbage in the rank env var
 
 
 def chain_signal(signum, frame, prev) -> None:

@@ -40,9 +40,9 @@ def _comm_record(op: str, axis_name, x, divide: int = 1) -> None:
     must not break compilation).  ``divide`` scales the recorded payload
     (reduce_scatter records the per-device OUTPUT shard, i.e. input
     bytes / axis size — the bytes each rank materializes and applies)."""
-    try:
-        from paddle_tpu.telemetry import record_comm
+    from paddle_tpu.telemetry import record_comm, swallow
 
+    with swallow("collective_census"):
         nbytes = 0
         for leaf in jax.tree.leaves(x):
             shape = getattr(leaf, "shape", None)
@@ -56,8 +56,6 @@ def _comm_record(op: str, axis_name, x, divide: int = 1) -> None:
         axis = "+".join(axis_name) if isinstance(axis_name, (tuple, list)) \
             else str(axis_name)
         record_comm(op, axis, nbytes // max(int(divide), 1))
-    except Exception:
-        pass
 
 
 def _scope(op: str, axis_name):
@@ -98,7 +96,13 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
     grad-reduce saving the census is meant to show."""
     try:
         n = compat.axis_size(axis_name)
-    except Exception:
+    except Exception as e:
+        # axis unbound in this trace (e.g. a pure-accounting probe
+        # outside the mesh): record the undivided payload
+        from paddle_tpu.core import logger as _log
+
+        _log.debug("reduce_scatter census: axis size of %r unavailable "
+                   "(%s); recording undivided bytes", axis_name, e)
         n = 1
     _comm_record("reduce_scatter", axis_name, x, divide=n)
     with _scope("reduce_scatter", axis_name):

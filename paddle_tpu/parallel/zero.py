@@ -201,15 +201,14 @@ def shard_opt_state(opt_state, params, mesh, axis: str = "data",
     specs = state_specs(opt_state, params, mesh, axis,
                         param_specs=param_specs)
     placed = _put_tree(opt_state, specs, mesh)
-    try:  # telemetry gauge: per-device slot residency (the ZeRO headline)
-        from paddle_tpu.telemetry import get_default_registry
+    # telemetry gauge: per-device slot residency (the ZeRO headline)
+    from paddle_tpu.telemetry import get_default_registry, swallow
 
+    with swallow("zero_state_gauge"):
         get_default_registry().gauge(
             "zero1_state_bytes_per_device",
             "addressable optimizer-slot bytes on one device").set(
             float(state_bytes_per_device(placed)), axis=axis)
-    except Exception:
-        pass
     return placed
 
 
@@ -243,12 +242,10 @@ def _record_directed(op: str, axis: str, nbytes: float) -> None:
     """Account a collective the GSPMD lowering DIRECTS the partitioner
     to emit (the explicit lowering records through the wrappers
     instead).  Never raises."""
-    try:
-        from paddle_tpu.telemetry import record_comm
+    from paddle_tpu.telemetry import record_comm, swallow
 
+    with swallow("zero_directed_census"):
         record_comm(op, axis, int(nbytes))
-    except Exception:
-        pass
 
 
 def constrain_grads(grads, specs, mesh, axis: str = "data"):

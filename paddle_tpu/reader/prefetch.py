@@ -214,6 +214,10 @@ class DevicePrefetcher:
         enforce(depth >= 1, f"prefetch depth must be >= 1, got {depth}")
         self._reader = reader
         self._feeder = feeder
+        # _mesh is written by rebind_mesh (consumer thread, elastic
+        # resharding) while the producer reads it per batch — every
+        # access holds _mesh_lock (the GL-THREAD audited contract)
+        self._mesh_lock = threading.Lock()
         self._mesh = mesh
         self._remainder = remainder
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -229,7 +233,9 @@ class DevicePrefetcher:
             for batch in self._reader():
                 if self._stop.is_set():
                     return
-                item = _convert(batch, self._feeder, self._mesh,
+                with self._mesh_lock:
+                    mesh = self._mesh
+                item = _convert(batch, self._feeder, mesh,
                                 self._remainder)
                 if item is None:
                     continue
@@ -270,7 +276,8 @@ class DevicePrefetcher:
             self._thread.join(timeout=5.0)
             raise item.exc
         examples, feed, used_mesh = item
-        mesh_now = self._mesh
+        with self._mesh_lock:
+            mesh_now = self._mesh
         if mesh_now is not None and used_mesh is not mesh_now:
             # staged under a mesh that has since been rebuilt (elastic
             # resharding): re-place on the consumer thread rather than
@@ -285,7 +292,8 @@ class DevicePrefetcher:
         already staged (or mid-conversion) under the old mesh are
         detected by their mesh tag at ``__next__`` and re-placed, so
         the stream stays gapless and in order."""
-        self._mesh = mesh
+        with self._mesh_lock:
+            self._mesh = mesh
 
     # -- shutdown ---------------------------------------------------------------
     def close(self) -> None:

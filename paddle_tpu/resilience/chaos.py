@@ -159,19 +159,17 @@ class ChaosSchedule:
     def _fire(self, fault: _Fault, where: str) -> None:
         fault.fired = True
         log.warning("chaos: injecting %s at %s", fault.kind, where)
-        from paddle_tpu.telemetry import safe_inc
+        from paddle_tpu.telemetry import safe_inc, swallow
 
         safe_inc("faults_injected", "chaos faults fired",
                  registry=self._registry, kind=fault.kind)
-        try:
+        with swallow("chaos_heartbeat"):  # never blocks the injection
             flight = self._flight
             if flight is None:
                 from paddle_tpu.distributed import multihost as mh
 
                 flight = mh.flight_recorder()
             flight.heartbeat(f"chaos:{fault.kind}", **{"at": where})
-        except Exception:
-            pass  # accounting never blocks the injection itself
 
     # -- wrappers --------------------------------------------------------------
     def wrap_reader(self, reader):

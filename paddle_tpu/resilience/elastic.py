@@ -286,7 +286,10 @@ class ElasticCoordinator:
 
     # -- train-loop side -------------------------------------------------------
     def pending(self) -> bool:
-        return bool(self._events)
+        # _events is appended from watcher threads and signal handlers;
+        # every access holds _lock (the GL-THREAD audited contract)
+        with self._lock:
+            return bool(self._events)
 
     def reset_pending(self) -> None:
         """Drop queued events — the supervisor calls this between restart
@@ -470,8 +473,10 @@ class ElasticCoordinator:
         if respec:
             rec["respec"] = respec
         self.applied.append(rec)
+        from paddle_tpu.telemetry import swallow
+
         r = self._registry_or_default()
-        try:
+        with swallow("elastic_accounting", r):  # never blocks the rebuild
             r.counter("elastic_events",
                       "live mesh rebuilds taken").inc(1.0, kind=event.kind)
             r.gauge("recovery_ms",
@@ -479,8 +484,6 @@ class ElasticCoordinator:
                 recovery_ms, run="elastic")
             if r.active:
                 r.emit(dict(rec))
-        except Exception:
-            pass  # accounting never blocks the rebuild
         log.warning("elastic: mesh rebuilt data=%d (epoch %d) in %.1f ms; "
                     "%s", new_dp, self.epoch, recovery_ms,
                     "replaying from cursor %s" % (replay_cursor,)

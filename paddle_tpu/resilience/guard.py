@@ -149,7 +149,9 @@ class NumericGuard:
                 from paddle_tpu.distributed import multihost as mh
 
                 flight = mh.flight_recorder()
-            except Exception:
+            except Exception as e:
+                log.debug("flight recorder unavailable for the guard "
+                          "heartbeat (%s)", e)
                 flight = None
         if flight is not None:
             flight.heartbeat(f"nan_{action}", pass_id=pass_id,

@@ -161,10 +161,11 @@ class ServingEngine:
                 temperature=float(temperature), arrival=time.perf_counter()))
         return rid
 
-    def _raise_loop_error(self) -> None:
-        raise RuntimeError(
-            "serving loop crashed; pending requests will never "
-            "complete") from self._loop_error
+    def _loop_error_now(self) -> BaseException | None:
+        # _loop_error is written by the background loop thread; every
+        # access holds _lock (the GL-THREAD audited contract)
+        with self._lock:
+            return self._loop_error
 
     def _pop_completed(self, block: bool, deadline: float | None,
                        raise_on_crash: bool):
@@ -177,8 +178,11 @@ class ServingEngine:
                 return self._completed.get(block=False)
             except queue.Empty:
                 pass
-            if self._loop_error is not None and raise_on_crash:
-                self._raise_loop_error()
+            err = self._loop_error_now()
+            if err is not None and raise_on_crash:
+                raise RuntimeError(
+                    "serving loop crashed; pending requests will never "
+                    "complete") from err
             if not block:
                 return None
             remaining = (None if deadline is None
@@ -238,7 +242,8 @@ class ServingEngine:
     def start(self) -> None:
         """Run the step loop on a background thread."""
         enforce(self._thread is None, "engine already started")
-        self._loop_error = None  # a restart forgives the previous crash
+        with self._lock:
+            self._loop_error = None  # a restart forgives the prior crash
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True)
@@ -266,7 +271,8 @@ class ServingEngine:
             # a dead loop must not strand waiters: record the cause —
             # results() re-raises it to every pending caller — and
             # count it, so a crashed engine can't masquerade as idle
-            self._loop_error = e
+            with self._lock:
+                self._loop_error = e
             from paddle_tpu.telemetry import safe_inc
 
             safe_inc("serve_loop_crashes",

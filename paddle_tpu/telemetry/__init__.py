@@ -23,6 +23,7 @@ from paddle_tpu.telemetry.registry import (  # noqa: F401
     host_index,
     record_comm,
     safe_inc,
+    swallow,
 )
 from paddle_tpu.telemetry.sinks import (  # noqa: F401
     JsonlSink,

@@ -56,7 +56,19 @@ from typing import Any
 # recovery_ms gauge labeled run="elastic", and the serving engine's
 # serve_loop_crashes counter (background loop deaths that failed
 # pending requests)
-SCHEMA = "paddle_tpu.metrics/6"
+# /7 added the static-analysis stream (paddle_tpu/analysis): record
+# kind "preflight" — one per `trainer --preflight` / analysis-CLI run,
+# carrying the per-pass finding counts, the unsuppressed finding ids
+# and whether the run was clean — plus the preflight_findings{rule}
+# counter.  RECORD_KINDS (below) became the registered kind set the
+# GL-SCHEMA drift pass checks every emitted record against.
+SCHEMA = "paddle_tpu.metrics/7"
+
+# every record kind the schema knows.  The GL-SCHEMA codebase pass
+# (paddle_tpu/analysis) cross-checks this against the tree: an emitted
+# kind missing here — or an entry here nothing produces — is drift.
+RECORD_KINDS = ("step", "bench", "fault", "recovery", "serve",
+                "serve_summary", "elastic_event", "preflight")
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)
@@ -260,10 +272,8 @@ class MetricsRegistry:
     def clear_sinks(self) -> None:
         with self._lock:
             for s in self._sinks:
-                try:
+                with swallow("sink_close", self):
                     s.close()
-                except Exception:
-                    pass
             self._sinks = []
 
     @property
@@ -308,10 +318,8 @@ class MetricsRegistry:
 
     def flush(self) -> None:
         for sink in self._sinks:
-            try:
+            with swallow("sink_flush", self):
                 sink.flush()
-            except Exception:
-                pass
 
     def snapshot(self) -> dict:
         """{metric name: list of labeled series dicts} — the pull-side
@@ -342,7 +350,9 @@ def host_index() -> int:
 
         if xla_bridge._backends:  # initialized already: reading is safe
             return jax.process_index()
-    except Exception:
+    except (ImportError, AttributeError, RuntimeError):
+        # jax absent/too old, or a backend probe that refuses before
+        # init — the env-var fallback below is the answer either way
         pass
     import os
 
@@ -367,6 +377,33 @@ def safe_inc(name: str, help: str = "", amount: float = 1.0,
         (registry or _default).counter(name, help).inc(amount, **labels)
     except Exception:
         pass
+
+
+@contextlib.contextmanager
+def swallow(scope: str, registry: MetricsRegistry | None = None):
+    """Accounting guard for telemetry/observability side work — the
+    multi-statement sibling of :func:`safe_inc`: the operation being
+    observed (a rebuild, a fault injection, a collective trace) must
+    never die of its own bookkeeping.  A failure inside the block is
+    logged at debug, counted (``telemetry_errors{scope}``) and
+    swallowed.  Use this instead of ad-hoc ``except Exception: pass``
+    blocks around accounting — the GL-EXCEPT static-analysis pass
+    rejects those."""
+    try:
+        yield
+    except Exception as e:
+        try:
+            from paddle_tpu.core import logger
+
+            logger.get_logger("paddle_tpu.metrics").debug(
+                "telemetry accounting failed in %s: %s: %s", scope,
+                type(e).__name__, e)
+            (registry or _default).counter(
+                "telemetry_errors",
+                "accounting failures swallowed by telemetry.swallow").inc(
+                1.0, scope=scope)
+        except Exception:
+            pass  # the guard of last resort stays silent by design
 
 
 # -- comm accounting (called by parallel/collective.py at trace time) ---------

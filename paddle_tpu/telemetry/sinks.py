@@ -31,7 +31,7 @@ def json_default(o):
     if hasattr(o, "item"):
         try:
             return o.item()
-        except Exception:
+        except (TypeError, ValueError):  # non-scalar .item() (size > 1)
             pass
     return str(o)
 

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import time
 
+from paddle_tpu.core import logger as log
+
 
 class StepTelemetry:
     """Builds/emits step records for one training run.
@@ -50,7 +52,9 @@ class StepTelemetry:
             from paddle_tpu.ops.pallas import tpp
 
             self.fused_kernels = bool(tpp.fused_enabled())
-        except Exception:
+        except Exception as e:
+            log.debug("fused-kernel routing unknown (%s); stamping "
+                      "fused_kernels=False", e)
             self.fused_kernels = False
 
     # -- hardware / program constants -----------------------------------------
@@ -60,7 +64,9 @@ class StepTelemetry:
                 from paddle_tpu import profiler
 
                 self._peak_flops = profiler.device_peak_flops()
-            except Exception:
+            except Exception as e:
+                log.debug("device peak FLOPs unavailable (%s); MFU will "
+                          "read 0", e)
                 self._peak_flops = 0.0
         return self._peak_flops
 
@@ -88,8 +94,9 @@ class StepTelemetry:
             cost = None
             try:
                 cost = lowered.cost_analysis()
-            except Exception:
-                pass
+            except Exception as e:  # capability probe: older jax only
+                log.debug("Lowered.cost_analysis unsupported (%s); "
+                          "forcing compile()", e)
             if not cost:
                 cost = lowered.compile().cost_analysis()
             if isinstance(cost, list):  # older jax returns [dict]
@@ -97,8 +104,10 @@ class StepTelemetry:
             if cost:
                 flops = float(cost.get("flops", 0.0) or 0.0)
                 nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
-        except Exception:
-            pass
+        except Exception as e:
+            # documented degrade: a record without MFU beats no record
+            log.debug("cost analysis failed for signature (%s); step "
+                      "records carry no FLOPs/bytes", e)
         self._cost_cache[sig] = (flops, nbytes, dict(comm))
         return self._cost_cache[sig]
 
@@ -213,6 +222,6 @@ def tokens_in_feed(feed: dict) -> int | None:
 
                 total += int(np.sum(np.asarray(length)))
                 seen = True
-            except Exception:
+            except (TypeError, ValueError):  # ragged/exotic length slot
                 pass
     return total if seen else None

@@ -160,7 +160,7 @@ def _data_shard_info(leaf) -> tuple[int, int] | None:
         return None
     try:
         n = int(dict(mesh.shape).get("data", 1))
-    except Exception:
+    except (TypeError, ValueError):  # exotic mesh stand-in (tests/mocks)
         return None
     if n <= 1:
         return None
@@ -442,9 +442,15 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, retry=None):
+        import threading
+
         from paddle_tpu.resilience.policy import RetryPolicy
 
         self._thread = None
+        # _err is written by the writer thread and read/cleared by the
+        # step loop in wait(); every access holds _lock (the GL-THREAD
+        # audited contract)
+        self._lock = threading.Lock()
         self._err = None
         self._retry = retry if retry is not None else RetryPolicy(
             max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
@@ -470,7 +476,8 @@ class AsyncCheckpointer:
                     opt_state=opt_h, states=states_h, meta=meta,
                     keep_last=keep_last, batch_id=batch_id)
             except BaseException as e:  # surfaced on next save()/wait()
-                self._err = e
+                with self._lock:
+                    self._err = e
                 from paddle_tpu.telemetry import safe_inc
 
                 safe_inc("checkpoint_write_failures",
@@ -487,6 +494,7 @@ class AsyncCheckpointer:
         t, self._thread = self._thread, None
         if t is not None:
             t.join()
-        if self._err is not None:
+        with self._lock:
             err, self._err = self._err, None
+        if err is not None:
             raise err

@@ -38,6 +38,14 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--config", required=True, help="v1 config file")
     p.add_argument("--job", default="train",
                    choices=["train", "test", "time", "checkgrad"])
+    p.add_argument("--preflight", action="store_true",
+                   help="build the configured train step and run the "
+                        "static program checks (paddle_tpu/analysis: "
+                        "host-sync points, un-donated update buffers, "
+                        "bf16 upcasts, ZeRO collective-lowering "
+                        "mismatch) instead of training; exit 1 on any "
+                        "unsuppressed finding — the config_parser-style "
+                        "reject-before-running gate")
     p.add_argument("--config_args", default="",
                    help="var=val,... exposed via get_config_arg")
     p.add_argument("--num_passes", type=int, default=1)
@@ -302,8 +310,11 @@ def _load_provider_types(args, parsed, topo):
         # header-derived types (no provider module to import)
         try:
             _raw_reader_from_data_config(rec, topo, parsed.input_layer_names)
-        except Exception:
-            pass  # data files unavailable: dense placeholders stand
+        except Exception as e:
+            from paddle_tpu.core import logger as log
+
+            log.debug("proto/multi data files unavailable (%s); dense "
+                      "placeholders stand", e)
         return
     if not rec.get("module"):
         return
@@ -311,8 +322,12 @@ def _load_provider_types(args, parsed, topo):
     try:
         mod = importlib.import_module(rec["module"])
         obj = getattr(mod, rec["obj"])
-    except Exception:
-        return  # provider unavailable: dense placeholders stand
+    except Exception as e:
+        from paddle_tpu.core import logger as log
+
+        log.debug("data provider %s unavailable (%s); dense placeholders "
+                  "stand", rec.get("module"), e)
+        return
     if getattr(obj, "input_types", None) is None:
         # init_hook providers declare types on ``settings`` at reader
         # construction (benchmark/paddle/image/provider.py pattern); run
@@ -366,6 +381,44 @@ def _build(parsed):
     ]
     feeding = {n: i for i, (n, _) in enumerate(types)}
     return topo, opt, types, feeding
+
+
+def cmd_preflight(args, parsed) -> int:
+    """--preflight: static program checks over the step cmd_train would
+    run — the config_parser-style validation gate, but over the
+    compiled program instead of the config text."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.preflight import run_preflight
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.parallel.mesh import get_mesh
+
+    topo, opt, types, feeding = _build(parsed)
+    _load_provider_types(args, parsed, topo)
+    mesh = get_mesh()
+    dp = mesh.mesh.shape.get("data", 1)
+    batch_size = parsed.opt_config.batch_size or 32
+    if batch_size % dp:  # the probe batch must shard like a real batch
+        batch_size += dp - batch_size % dp
+    feed = _synthetic_feed(topo, batch_size, seq_dim=args.seq_dim)
+    zero = args.zero if args.zero is not None else _flags.get("zero")
+    compute_dtype = jnp.bfloat16 if _flags.get("bf16") else None
+    sync_period = args.sync_period if args.sync_period is not None \
+        else _flags.get("sync_period")
+    unsup, sup = run_preflight(
+        topo, opt, feed, mesh, zero=zero, compute_dtype=compute_dtype,
+        sync_period=sync_period, inject=_flags.get("preflight_inject"),
+        config=os.path.basename(args.config))
+    for f in unsup:
+        print(f.render())
+    if sup:
+        print(f"({len(sup)} finding(s) suppressed by baseline)")
+    if unsup:
+        print(f"preflight: {len(unsup)} unsuppressed finding(s) — "
+              f"fix the program or baseline them with a reason")
+        return 1
+    print(f"preflight: OK — {args.config} (zero={zero}, data={dp})")
+    return 0
 
 
 def cmd_train(args, parsed) -> int:
@@ -568,7 +621,7 @@ def cmd_time(args, parsed) -> int:
     def _deleted(x):
         try:
             return x.is_deleted()
-        except Exception:
+        except (AttributeError, TypeError):  # plain numpy leaf
             return False
 
     def wall():
@@ -737,6 +790,8 @@ def main(argv=None) -> int:
     _metrics.configure_from_flags()
     try:
         parsed = parse_config(args.config, args.config_args)
+        if args.preflight:
+            return cmd_preflight(args, parsed)
         jobs = {
             "train": cmd_train,
             "test": cmd_test,

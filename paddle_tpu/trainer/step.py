@@ -91,7 +91,7 @@ def _batch_spec(x) -> P:
 def build_train_step(topology: Topology, optimizer,
                      mesh: MeshContext | None = None,
                      compute_dtype=None, fetch_layers=None,
-                     zero: int | None = None):
+                     zero: int | None = None, lowering: str = "auto"):
     """Returns jitted fn: (params, opt_state, states, feed, key)
     -> (params, opt_state, states, cost, metrics).
 
@@ -154,8 +154,23 @@ def build_train_step(topology: Topology, optimizer,
 
     dp = mesh.mesh.shape.get("data", 1) if mesh is not None else 1
     zero_on = zero >= 1 and mesh is not None and dp > 1
+    # ``lowering`` pins the ZeRO>=2 gradient-flow lowering: "auto" (the
+    # production rule — explicit shard_map on pure-data meshes, GSPMD
+    # constraints when TP/MoE axes are live), "explicit", or "gspmd".
+    # The preflight collective-sequence check (paddle_tpu/analysis)
+    # builds BOTH and compares them — the multi-host deadlock class is
+    # exactly a fleet whose hosts resolve "auto" differently.
+    if lowering not in ("auto", "explicit", "gspmd"):
+        raise ValueError(f"lowering must be auto|explicit|gspmd, "
+                         f"got {lowering!r}")
     explicit = (zero_on and zero >= 2
-                and zero_mod.explicit_lowering_ok(mesh.mesh))
+                and zero_mod.explicit_lowering_ok(mesh.mesh)
+                if lowering == "auto"
+                else (zero_on and zero >= 2 and lowering == "explicit"))
+    if lowering == "explicit" and zero_on and zero >= 2 \
+            and not zero_mod.explicit_lowering_ok(mesh.mesh):
+        raise ValueError("explicit ZeRO lowering requested but the mesh "
+                         "has live non-data axes")
     # TPP fused shard update (ops/pallas/tpp/update): under the explicit
     # ZeRO-2 lowering with the fused_kernels flag on, the SGD/momentum
     # update runs as one read-modify-write pass inside a shard_map region

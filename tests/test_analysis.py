@@ -1,0 +1,590 @@
+"""graftlint (paddle_tpu/analysis) — the static-analysis suite.
+
+Three layers of coverage:
+
+1. the repo-wide gate: every codebase pass over the actual tree must
+   come up clean modulo the checked-in baseline (this is the tier-1
+   enforcement of the suite — a regression anywhere in the repo fails
+   HERE with the finding id);
+2. seeded-defect fixtures: for each pass, a tiny module/program with
+   exactly one planted violation asserts the pass fires exactly once
+   with its stable ID, plus a clean twin asserting no false positive;
+3. the ``trainer --preflight`` CLI: clean configs exit 0; the
+   ``preflight_inject`` flag's seeded host-sync and collective-mismatch
+   defects exit 1 through the real CLI (including the ZeRO-2 dual-
+   lowering comparison on the forced 8-device mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- 1. the repo-wide gate ------------------------------------------------------
+
+
+def test_repo_wide_suite_clean():
+    from paddle_tpu.analysis import (
+        apply_baseline,
+        load_baseline,
+        run_codebase,
+    )
+
+    findings = run_codebase()
+    unsup, sup, stale = apply_baseline(findings, load_baseline())
+    assert not unsup, "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in unsup)
+    assert not stale, f"stale baseline suppressions: {stale}"
+    # the baseline documents the canonical telemetry guards — if it
+    # goes empty the suppression machinery itself is untested
+    assert sup, "expected the baselined telemetry guards to match"
+
+
+def test_analysis_cli_exits_zero():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
+
+
+def test_lint_changed_mode_runs():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--changed"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_lock_registry_covers_threaded_subsystems():
+    from paddle_tpu.analysis import lock_registry
+
+    reg = lock_registry()
+    assert reg["paddle_tpu/serving/engine.py"]["ServingEngine"] == ["_lock"]
+    assert "_mesh_lock" in \
+        reg["paddle_tpu/reader/prefetch.py"]["DevicePrefetcher"]
+    assert reg["paddle_tpu/resilience/elastic.py"]["ElasticCoordinator"] \
+        == ["_lock"]
+    assert reg["paddle_tpu/trainer/checkpoint.py"]["AsyncCheckpointer"] \
+        == ["_lock"]
+
+
+# -- 2. codebase-pass fixtures --------------------------------------------------
+
+
+def _corpus(tmp_path, rel, src):
+    from paddle_tpu.analysis.codebase import iter_corpus
+
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return iter_corpus(str(tmp_path), files=[rel])
+
+
+def test_swallow_except_fires_once_with_stable_id(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_swallow_except
+
+    corpus = _corpus(tmp_path, "paddle_tpu/mod.py", """\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass            # the planted defect
+
+        def logged():
+            try:
+                risky()
+            except Exception as e:
+                log.warning("failed: %s", e)
+
+        def narrow():
+            try:
+                risky()
+            except (OSError, ValueError):
+                pass
+
+        def propagated(q):
+            try:
+                risky()
+            except Exception as e:
+                q.put(e)
+        """)
+    found = pass_swallow_except(corpus, str(tmp_path))
+    assert len(found) == 1, [f.fid for f in found]
+    assert found[0].fid == "GL-EXCEPT:paddle_tpu/mod.py:silent"
+
+
+def test_swallow_except_clean_fixture_negative(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_swallow_except
+
+    corpus = _corpus(tmp_path, "paddle_tpu/mod.py", """\
+        def f():
+            try:
+                risky()
+            except Exception:
+                raise RuntimeError("wrapped")
+        """)
+    assert pass_swallow_except(corpus, str(tmp_path)) == []
+
+
+def test_env_pass_fires_on_unregistered_read(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_env_registration
+
+    corpus = _corpus(tmp_path, "paddle_tpu/mod.py", """\
+        import os
+        A = os.environ.get("PADDLE_TPU_NOT_A_FLAG")     # planted
+        B = os.environ.get("PADDLE_TPU_ZERO")           # flag override
+        C = os.environ.get("JAX_PLATFORMS")             # declared env
+        D = os.environ.get(dynamic_name)                # non-literal: skip
+        """)
+    found = pass_env_registration(corpus, str(tmp_path))
+    assert [f.fid for f in found] == \
+        ["GL-ENV:paddle_tpu/mod.py:<module>"]
+    assert "PADDLE_TPU_NOT_A_FLAG" in found[0].message
+
+
+def test_env_pass_clean_fixture_negative(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_env_registration
+
+    corpus = _corpus(tmp_path, "paddle_tpu/mod.py", """\
+        import os
+        B = os.getenv("PADDLE_TPU_SEED")
+        os.environ["PADDLE_TPU_WHATEVER"] = "writes are the launcher's"
+        """)
+    assert pass_env_registration(corpus, str(tmp_path)) == []
+
+
+def test_schema_pass_fires_on_unknown_kind(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_schema_kinds
+
+    corpus = _corpus(tmp_path, "paddle_tpu/mod.py", """\
+        def a(reg):
+            reg.emit({"x": 1}, kind="good")
+
+        def b(reg):
+            rec = {"kind": "planted_bad", "x": 1}
+            reg.emit(dict(rec))
+
+        LAYER_ATTR = {"kind": "embedding"}   # never emitted: not a record
+        """)
+    found = pass_schema_kinds(corpus, str(tmp_path),
+                              known=frozenset({"good"}))
+    assert len(found) == 1, [f.fid for f in found]
+    assert found[0].fid == "GL-SCHEMA:paddle_tpu/mod.py:b"
+    assert "planted_bad" in found[0].message
+
+
+def test_schema_pass_reports_stale_registered_kind(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_schema_kinds
+
+    corpus = _corpus(tmp_path, "paddle_tpu/mod.py", """\
+        def a(reg):
+            reg.emit({"x": 1}, kind="good")
+        """)
+    found = pass_schema_kinds(corpus, str(tmp_path),
+                              known=frozenset({"good", "never_made"}))
+    assert len(found) == 1
+    assert "never_made" in found[0].message
+
+
+_THREAD_FIXTURE = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = None
+            self._t = threading.Thread(target=self._work)
+
+        def _work(self):
+            {worker_body}
+
+        def read(self):
+            {consumer_body}
+    """
+
+
+def test_thread_pass_fires_on_unlocked_cross_thread_attr(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_thread_safety
+
+    rel = "paddle_tpu/fix_thread.py"
+    corpus = _corpus(tmp_path, rel, _THREAD_FIXTURE.format(
+        worker_body="self._state = 1    # planted: no lock",
+        consumer_body="return self._state"))
+    found = pass_thread_safety(corpus, str(tmp_path), modules=(rel,))
+    assert [f.fid for f in found] == \
+        [f"GL-THREAD:{rel}:Worker._state"]
+
+
+def test_thread_pass_clean_when_locked(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_thread_safety
+
+    rel = "paddle_tpu/fix_thread.py"
+    corpus = _corpus(tmp_path, rel, _THREAD_FIXTURE.format(
+        worker_body="""
+            with self._lock:
+                self._state = 1""",
+        consumer_body="""
+            with self._lock:
+                return self._state"""))
+    assert pass_thread_safety(corpus, str(tmp_path), modules=(rel,)) == []
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_lock_order
+
+    rel = "paddle_tpu/fix_locks.py"
+    corpus = _corpus(tmp_path, rel, """\
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                with self._a:
+                    with self._b:       # a -> b
+                        pass
+
+            def other(self):
+                with self._b:
+                    with self._a:       # b -> a: the planted cycle
+                        pass
+        """)
+    found = pass_lock_order(corpus, str(tmp_path), modules=(rel,))
+    assert [f.fid for f in found] == [f"GL-LOCKORDER:{rel}:TwoLocks"]
+    assert "_a" in found[0].message and "_b" in found[0].message
+
+
+def test_lock_order_clean_when_consistent(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_lock_order
+
+    rel = "paddle_tpu/fix_locks.py"
+    corpus = _corpus(tmp_path, rel, """\
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def other(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert pass_lock_order(corpus, str(tmp_path), modules=(rel,)) == []
+
+
+def test_kernel_parity_pass_fires_without_reference_twin(tmp_path):
+    from paddle_tpu.analysis.kernel_parity import kernel_parity_findings
+
+    pallas = tmp_path / "paddle_tpu" / "ops" / "pallas"
+    pallas.mkdir(parents=True)
+    (tmp_path / "tests").mkdir()
+    (pallas / "badkernel.py").write_text(textwrap.dedent("""\
+        def fused_op(x):
+            return pallas_call(x)   # planted: no jnp reference twin
+        """))
+    found = kernel_parity_findings(str(tmp_path))
+    assert [f.fid for f in found] == \
+        ["GL-KERNEL:paddle_tpu/ops/pallas/badkernel.py:<module>"]
+    # add the twin + a parity test: the pass goes quiet
+    (pallas / "badkernel.py").write_text(textwrap.dedent("""\
+        def fused_op(x):
+            return pallas_call(x)
+
+        def fused_op_reference(x):
+            return x
+        """))
+    (tmp_path / "tests" / "test_parity.py").write_text(
+        "# fused_op vs fused_op_reference interpret-mode parity\n")
+    assert kernel_parity_findings(str(tmp_path)) == []
+
+
+def test_stable_ids_survive_line_drift(tmp_path):
+    from paddle_tpu.analysis.codebase import pass_swallow_except
+
+    body = """\
+        def silent():
+            try:
+                risky()
+            except Exception:
+                pass
+        """
+    a = pass_swallow_except(_corpus(tmp_path, "paddle_tpu/mod.py", body),
+                            str(tmp_path))
+    shifted = "# one\n# two\n# three\n" + textwrap.dedent(body)
+    b = pass_swallow_except(_corpus(tmp_path, "paddle_tpu/mod.py", shifted),
+                            str(tmp_path))
+    assert a[0].fid == b[0].fid
+    assert a[0].line != b[0].line
+
+
+# -- 2b. program-pass fixtures --------------------------------------------------
+
+
+def test_host_sync_pass_fires_on_injected_callback():
+    import jax
+
+    from paddle_tpu.analysis import host_sync_pass
+
+    def dirty(x):
+        jax.debug.callback(lambda: None)
+        return x * 2
+
+    found = host_sync_pass(dirty, 1.0, name="p", sync_period=8)
+    assert [f.fid for f in found] == ["GL-P-SYNC:<program:p>:debug_callback"]
+    assert "sync_period=8" in found[0].message
+
+    def clean(x):
+        return x * 2
+
+    assert host_sync_pass(clean, 1.0, name="p") == []
+
+
+def test_recompile_pass_shape_and_dtype_churn():
+    from paddle_tpu.analysis import recompile_hazard_pass
+
+    base = (("x", (32, 64), "float32"), ("y", (32,), "int32"))
+
+    def with_batch(n):
+        return (("x", (n, 64), "float32"), ("y", (n,), "int32"))
+
+    # full batch + one tail = the expected ceiling: clean
+    assert recompile_hazard_pass([with_batch(32), with_batch(8)]) == []
+    # three dims variants of one structure: shape churn
+    churn = recompile_hazard_pass(
+        [with_batch(32), with_batch(31), with_batch(30)])
+    assert any(f.anchor == "shape-churn" for f in churn)
+    # dtype flip
+    flipped = (("x", (32, 64), "float64"), ("y", (32,), "int32"))
+    dt = recompile_hazard_pass([base, flipped])
+    assert any(f.anchor == "dtype-churn" for f in dt)
+    # signature-count ceiling
+    many = [with_batch(n) for n in range(20)]
+    cnt = recompile_hazard_pass(many)
+    assert any(f.anchor == "signature-count" for f in cnt)
+
+
+def test_donation_pass_flags_undonated_update_buffer():
+    import jax
+    import numpy as np
+
+    from paddle_tpu.analysis import donation_pass
+
+    def update(p, g):
+        return p - 0.1 * g, (g * g).sum()
+
+    a = np.zeros((64, 64), np.float32)  # 16 KiB
+    undonated = jax.jit(update).lower(a, a).as_text()
+    found = donation_pass(undonated, name="p", min_bytes=1 << 10)
+    # one update-shaped output: exactly one donation candidate flagged
+    assert [f.fid for f in found] == ["GL-P-DONATE:<program:p>:arg0"]
+
+    donated = jax.jit(update, donate_argnums=(0,)).lower(a, a).as_text()
+    assert donation_pass(donated, name="p", min_bytes=1 << 10) == []
+
+
+def test_collective_sequence_extraction_and_mismatch():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import compat
+    from paddle_tpu.analysis import (
+        collective_sequence_from_hlo_text,
+        collective_sequence_from_jaxpr,
+        compare_collective_lowerings,
+    )
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def body(x):
+        s = jax.lax.psum_scatter(x, "data", scatter_dimension=0,
+                                 tiled=True)
+        return jax.lax.all_gather(s, "data", tiled=True)
+
+    f = compat.shard_map(body, mesh=mesh, in_specs=P("data"),
+                         out_specs=P("data"))
+    seq = collective_sequence_from_jaxpr(f, jnp.ones((8,)))
+    assert seq == ["reduce_scatter", "all_gather"]
+
+    # the seeded defect: one lowering never reduces gradients
+    bad = compare_collective_lowerings(
+        ["reduce_scatter", "all_gather"], ["all_gather"], name="p")
+    assert [f_.fid for f_ in bad] == ["GL-P-COLL:<program:p>:kind-set"]
+    # class-equivalent lowerings are clean (combiner/decomposition)
+    assert compare_collective_lowerings(
+        ["reduce_scatter", "all_gather"],
+        ["all_reduce", "all_gather"], name="p") == []
+    # same-family order check
+    order = compare_collective_lowerings(
+        ["reduce_scatter", "all_gather"],
+        ["all_gather", "reduce_scatter"], name="p", check_order=True)
+    assert [f_.anchor for f_ in order] == ["order"]
+
+    # HLO-text extraction normalizes the all-reduce+slice decomposition
+    hlo = textwrap.dedent("""\
+        %all-reduce.3 = f32[64]{0} all-reduce(f32[64]{0} %p), to_apply=%sum
+        %ds.4 = f32[8]{0} dynamic-slice(f32[64]{0} %all-reduce.3, s32[] %i)
+        %ag.5 = f32[64]{0} all-gather(f32[8]{0} %ds.4), dimensions={0}
+        %use.6 = f32[64]{0} add(f32[64]{0} %ag.5, f32[64]{0} %all-reduce.3)
+        """)
+    assert collective_sequence_from_hlo_text(hlo) == \
+        ["all_reduce", "reduce_scatter", "all_gather"]
+
+
+def test_f32_upcast_pass_flags_pre_matmul_upcast():
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import f32_upcast_pass
+
+    x = jnp.ones((8, 16), jnp.bfloat16)
+    w = jnp.ones((16, 4), jnp.bfloat16)
+
+    def dirty(x, w):
+        return (x.astype(jnp.float32) @ w.astype(jnp.float32)).sum()
+
+    found = f32_upcast_pass(dirty, x, w, name="p")
+    assert found and all(f.rule == "GL-P-UPCAST" for f in found)
+    assert found[0].anchor == "dot_general"
+
+    def clean(x, w):
+        return (x @ w).astype(jnp.float32).sum()  # sanctioned: post-dot
+
+    assert f32_upcast_pass(clean, x, w, name="p") == []
+
+
+# -- 3. trainer --preflight through the real CLI --------------------------------
+
+
+def _write_preflight_config(tmp_path):
+    cfg = tmp_path / "digits.conf"
+    cfg.write_text(textwrap.dedent("""\
+        from paddle.trainer_config_helpers import *
+
+        define_py_data_sources2(
+            train_list='{d}/train.list', test_list=None,
+            module='digits_provider', obj='process')
+        settings(batch_size=16, learning_rate=1e-2)
+
+        img = data_layer(name='pixel', size=64)
+        hidden = fc_layer(input=img, size=32, act=ReluActivation())
+        predict = fc_layer(input=hidden, size=4, act=SoftmaxActivation())
+        lbl = data_layer(name='label', size=4)
+        outputs(classification_cost(input=predict, label=lbl))
+        """).format(d=tmp_path))
+    (tmp_path / "digits_provider.py").write_text(textwrap.dedent("""\
+        import numpy as np
+        from paddle.trainer.PyDataProvider2 import (
+            provider, dense_vector, integer_value)
+
+        @provider(input_types={'pixel': dense_vector(64),
+                               'label': integer_value(4)})
+        def process(settings, filename):
+            rng = np.random.default_rng(0)
+            for _ in range(64):
+                yield (rng.normal(size=(64,)).astype(np.float32),
+                       int(rng.integers(0, 4)))
+        """))
+    (tmp_path / "train.list").write_text("seed-0\n")
+    return str(cfg)
+
+
+def _run_preflight(cfg, *extra, inject="", devices=0, jsonl=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_PREFLIGHT_INJECT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if inject:
+        env["PADDLE_TPU_PREFLIGHT_INJECT"] = inject
+    if devices:
+        flag = f"--xla_force_host_platform_device_count={devices}"
+        prev = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in prev:
+            env["XLA_FLAGS"] = (prev + " " + flag).strip()
+    cmd = [sys.executable, "-m", "paddle_tpu.trainer",
+           "--config", cfg, "--preflight", *extra]
+    if jsonl:
+        cmd += ["--metrics_jsonl", jsonl]
+    return subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=600)
+
+
+def test_preflight_cli_clean_config_exits_zero(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    jsonl = str(tmp_path / "metrics.jsonl")
+    out = _run_preflight(cfg, jsonl=jsonl)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "preflight: OK" in out.stdout
+    # the schema/7 preflight record reached the sink
+    recs = [json.loads(line) for line in open(jsonl)]
+    pf = [r for r in recs if r.get("kind") == "preflight"]
+    assert pf and pf[0]["clean"] is True
+    assert pf[0]["schema"] == "paddle_tpu.metrics/7"
+    # and metrics_to_md renders it
+    md = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_to_md.py"),
+         jsonl], capture_output=True, text=True)
+    assert md.returncode == 0
+    assert "Preflight (static analysis)" in md.stdout
+
+
+def test_preflight_cli_catches_injected_host_sync(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    out = _run_preflight(cfg, inject="host_sync")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "GL-P-SYNC" in out.stdout
+
+
+def test_preflight_cli_zero2_dual_lowering_clean(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    out = _run_preflight(cfg, "--zero", "2", devices=8)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "zero=2, data=8" in out.stdout
+
+
+def test_preflight_cli_catches_injected_collective_mismatch(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    out = _run_preflight(cfg, "--zero", "2", devices=8,
+                         inject="collective_mismatch")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "GL-P-COLL" in out.stdout
+
+
+def test_preflight_record_emission_in_process():
+    from paddle_tpu.analysis.core import Finding
+    from paddle_tpu.analysis.preflight import emit_preflight_record
+    from paddle_tpu.telemetry import MemorySink, MetricsRegistry
+
+    reg = MetricsRegistry("t")
+    sink = MemorySink()
+    reg.add_sink(sink)
+    f = Finding("GL-P-SYNC", "<program:p>", 0, "debug_callback", "m")
+    rec = emit_preflight_record([f], [], registry=reg, config="c.conf")
+    assert rec["kind"] == "preflight" and rec["clean"] is False
+    assert rec["by_rule"] == {"GL-P-SYNC": 1}
+    assert sink.records[-1]["ids"] == [f.fid]
+    assert reg.get("preflight_findings").value(rule="GL-P-SYNC") == 1.0

@@ -179,7 +179,9 @@ def _collective_census_from_trace(run_once, steps: int):
         return None
     try:
         rows, _ = profile_step(run_once, steps=steps, top=0)
-    except Exception:
+    except Exception as e:
+        print(f"bench_multichip: trace census unavailable ({e}); "
+              f"falling back to the HLO census", file=sys.stderr)
         return None
     if not rows:
         return None
@@ -211,7 +213,9 @@ def _collective_census_from_hlo(hlo_text_fn) -> dict[str, int]:
 
     try:
         text = hlo_text_fn()
-    except Exception:
+    except Exception as e:
+        print(f"bench_multichip: compiled HLO text unavailable ({e}); "
+              f"no static collective census", file=sys.stderr)
         return {}
     # HLO op syntax: `%name = TYPE all-reduce(...)` (TYPE may be a long
     # tuple); match the opcode immediately before its operand paren —

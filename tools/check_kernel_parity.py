@@ -1,104 +1,27 @@
 #!/usr/bin/env python
-"""Kernel/oracle pairing audit for ``paddle_tpu/ops/pallas/``.
+"""Kernel/oracle pairing audit — THIN SHIM.
 
-Every Pallas kernel module must ship a pure-jnp reference twin
-(``<entry>_reference``) and an interpret-mode parity test, so a future
-one-off kernel can't land without an oracle (the Compare2Function
-discipline the reference applied to its CUDA kernels,
-``paddle/function/FunctionTest.h``).  Concretely, for every module under
-``paddle_tpu/ops/pallas/`` (recursively, ``__init__`` excluded) that
-calls ``pallas_call``:
-
-1. the module defines at least one public ``<entry>_reference`` function
-   whose base name ``<entry>`` is also defined in the module;
-2. for each such pair, some file under ``tests/`` mentions BOTH the
-   entry name and its reference name (the parity test — kernel vs
-   oracle in interpret mode).
-
-Run directly (exit 1 + a violation listing on failure) or through
-``tests/test_kernel_parity.py``, which wires it into tier-1.
+The audit moved into the static-analysis suite as the ``GL-KERNEL``
+pass (``paddle_tpu/analysis/kernel_parity.py``); this script keeps the
+historical entry points (``audit()`` returning violation strings, a CLI
+printing ``OK``/violations) so ``tests/test_kernel_parity.py`` and any
+operator muscle memory keep working unchanged.  The rule itself is
+unchanged: every ``pallas_call`` module under ``paddle_tpu/ops/pallas/``
+must expose a public ``<entry>/<entry>_reference`` pair, both mentioned
+by a parity test under ``tests/`` (the Compare2Function discipline,
+``paddle/function/FunctionTest.h``).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PALLAS_DIR = os.path.join(REPO, "paddle_tpu", "ops", "pallas")
-TESTS_DIR = os.path.join(REPO, "tests")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-
-def kernel_modules() -> list[str]:
-    out = []
-    for root, _dirs, files in os.walk(PALLAS_DIR):
-        for f in sorted(files):
-            if f.endswith(".py") and f != "__init__.py":
-                out.append(os.path.join(root, f))
-    return out
-
-
-def module_defs(path: str) -> list[str]:
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    return [n.name for n in tree.body
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-
-
-def uses_pallas(path: str) -> bool:
-    with open(path) as fh:
-        return "pallas_call" in fh.read()
-
-
-def tests_corpus() -> str:
-    chunks = []
-    for f in sorted(os.listdir(TESTS_DIR)):
-        if f.endswith(".py"):
-            with open(os.path.join(TESTS_DIR, f)) as fh:
-                chunks.append(fh.read())
-    return "\n".join(chunks)
-
-
-def audit() -> list[str]:
-    """Returns a list of violation strings (empty = pass)."""
-    violations = []
-    corpus = tests_corpus()
-    for path in kernel_modules():
-        rel = os.path.relpath(path, REPO)
-        if not uses_pallas(path):
-            continue
-        defs = module_defs(path)
-        pairs = [(n[:-len("_reference")], n) for n in defs
-                 if n.endswith("_reference") and not n.startswith("_")]
-        pairs = [(base, ref) for base, ref in pairs if base in defs]
-        if not pairs:
-            violations.append(
-                f"{rel}: no public <entry>/<entry>_reference pair — every "
-                f"kernel module needs a jnp oracle")
-            continue
-        for base, ref in pairs:
-            if base not in corpus or ref not in corpus:
-                missing = [n for n in (base, ref) if n not in corpus]
-                violations.append(
-                    f"{rel}: {base!r} has no interpret-mode parity test "
-                    f"under tests/ ({', '.join(missing)} never referenced)")
-    return violations
-
-
-def main() -> int:
-    violations = audit()
-    mods = [m for m in kernel_modules() if uses_pallas(m)]
-    if violations:
-        print(f"check_kernel_parity: {len(violations)} violation(s) over "
-              f"{len(mods)} kernel modules:")
-        for v in violations:
-            print(f"  - {v}")
-        return 1
-    print(f"check_kernel_parity: OK — {len(mods)} kernel modules, every "
-          f"entry has a jnp reference and a tests/ parity mention")
-    return 0
-
+from paddle_tpu.analysis.kernel_parity import audit, main  # noqa: E402,F401
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(REPO))

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""tools/lint.py — the graftlint CI entry point.
+
+A thin driver over ``python -m paddle_tpu.analysis`` (the codebase
+static-analysis suite: swallow-all excepts, threaded-subsystem lock
+audit, lock-order cycles, env-registration, telemetry schema drift,
+kernel reference twins) that adds git awareness:
+
+  python tools/lint.py              # repo-wide (what tier-1 runs)
+  python tools/lint.py --changed    # only files touched vs HEAD
+                                    # (staged + unstaged + untracked)
+  python tools/lint.py --changed origin/main   # ...vs a base ref
+
+``--changed`` mode skips the stale-baseline check and the corpus-global
+kernel pass (a subset can't evaluate either).  Exit 1 on any
+unsuppressed finding.  All other arguments are forwarded verbatim
+(``--json``, ``--passes``, ``--baseline``, ``--locks``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def changed_files(base: str | None = None) -> list[str] | None:
+    """Repo-relative paths touched vs ``base`` (default: HEAD),
+    including staged and untracked files.  Returns None when git
+    cannot answer (shallow clone without the base ref, no git at all)
+    — the caller must then run repo-wide, NOT treat it as clean."""
+    out: set[str] = set()
+    diff = ["git", "-C", REPO, "diff", "--name-only"]
+    cmds = [diff + [base] if base else diff,
+            diff + ["--cached"],
+            ["git", "-C", REPO, "ls-files", "--others",
+             "--exclude-standard"]]
+    for cmd in cmds:
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"lint: {' '.join(cmd)} failed ({e}); falling back to "
+                  f"a repo-wide run", file=sys.stderr)
+            return None
+        out.update(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    return sorted(f for f in out if f.endswith(".py"))
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    sys.path.insert(0, REPO)
+    from paddle_tpu.analysis.__main__ import main as analysis_main
+
+    if "--changed" in argv:
+        i = argv.index("--changed")
+        base = None
+        if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+            base = argv[i + 1]
+            del argv[i + 1]
+        del argv[i]
+        files = changed_files(base)
+        if files is None:
+            pass  # git couldn't answer: run the full suite instead
+        elif not files:
+            print("lint: no changed .py files")
+            return 0
+        else:
+            argv += ["--files"] + files
+    return analysis_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -273,6 +273,34 @@ def serving_table(serves: list[dict], summaries: list[dict]) -> None:
                   "tokens if TTFT p99 matters more than memory._")
 
 
+def preflight_table(records: list[dict]) -> None:
+    """Render the schema /7 static-analysis stream: one row per
+    ``trainer --preflight`` / analysis run, with a loud flag on any run
+    that was not clean — a program that failed its preflight must not
+    read as a healthy run."""
+    if not records:
+        return
+    print("\n## Preflight (static analysis)\n")
+    print("| config | clean | findings | suppressed | by rule |")
+    print("|---|---|---|---|---|")
+    dirty = []
+    for r in records:
+        clean = r.get("clean", not r.get("findings"))
+        if not clean:
+            dirty.append(r)
+        rules = ", ".join(f"{k}×{v}" for k, v in
+                          (r.get("by_rule") or {}).items()) or "-"
+        print(f"| {r.get('config') or '-'} | {'yes' if clean else '**NO** ⚠'} "
+              f"| {r.get('findings', 0)} | {r.get('suppressed', 0)} "
+              f"| {rules} |")
+    if dirty:
+        ids = "; ".join(i for r in dirty for i in (r.get("ids") or [])[:4])
+        print(f"\n**⚠ {len(dirty)} preflight run(s) failed** — the "
+              f"program carries statically detectable hazards "
+              f"({ids}); fix them or baseline them with a reason "
+              f"before trusting the run.")
+
+
 MFU_TARGET_PCT = 50.0  # the ROADMAP north-star floor
 
 
@@ -333,6 +361,7 @@ def main(argv: list[str]) -> int:
     serve_summaries = [r for r in records
                        if r.get("kind") == "serve_summary"]
     elastics = [r for r in records if r.get("kind") == "elastic_event"]
+    preflights = [r for r in records if r.get("kind") == "preflight"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -348,9 +377,11 @@ def main(argv: list[str]) -> int:
     recovery_table(faults, recoveries)
     elastic_table(elastics)
     serving_table(serves, serve_summaries)
+    preflight_table(preflights)
     bench_table(bench)
     if not steps and not bench and not faults and not recoveries \
-            and not serves and not serve_summaries and not elastics:
+            and not serves and not serve_summaries and not elastics \
+            and not preflights:
         print("_no step, fault, serve or bench records found_")
     return 0
 
