@@ -624,6 +624,34 @@ def bench_serving(records):
         records.append(r)
 
 
+def bench_serving_fleet(records):
+    """Fleet availability row (tools/bench_serving_fleet.py in a
+    subprocess): 3 replicas on seeded Poisson arrivals, one injected
+    replica_loss — p99 TTFT with/without the failover and
+    requests_lost (the script RAISES unless it is 0)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_serving_fleet.py")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run([sys.executable, script], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench_serving_fleet subprocess failed: "
+                           f"{out.stderr[-400:]}")
+    for line in out.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        r = json.loads(line)
+        for k in ("schema", "ts", "host", "kind"):
+            r.pop(k, None)
+        records.append(r)
+
+
 def bench_transformer(records):
     """124M GPT-2-shape LM, bs 8x1024, mixed precision, flash attention,
     dots-remat — the modern-workload flagship row."""
@@ -732,7 +760,7 @@ def main() -> None:
     rows = (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
             bench_nmt, bench_ctr, bench_crnn, bench_saturation,
             bench_input_pipeline, bench_transformer, bench_zero,
-            bench_serving)
+            bench_serving, bench_serving_fleet)
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything.  --prefetch=0|N
     # sets the input-pipeline ablation depth (0 = sync row only).

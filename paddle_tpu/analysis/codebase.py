@@ -50,6 +50,9 @@ THREADED_MODULES = (
     "paddle_tpu/reader/prefetch.py",
     "paddle_tpu/serving/engine.py",
     "paddle_tpu/serving/dense.py",
+    "paddle_tpu/serving/fleet.py",
+    "paddle_tpu/serving/router.py",
+    "paddle_tpu/serving/health.py",
     "paddle_tpu/resilience/elastic.py",
     "paddle_tpu/resilience/supervisor.py",
     "paddle_tpu/trainer/checkpoint.py",

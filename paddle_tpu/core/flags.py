@@ -262,6 +262,12 @@ declare_env("PADDLE_TPU_TRAINER_ID",
 declare_env("PADDLE_TPU_RENDEZVOUS_EPOCH",
             "elastic fleet: membership epoch this process joined under "
             "(distributed.launch --elastic)")
+declare_env("PADDLE_TPU_REPLICA_ID",
+            "serving replica id stamped per process by "
+            "`distributed.launch --serving`")
+declare_env("PADDLE_TPU_NREPLICAS",
+            "serving fleet size stamped by `distributed.launch "
+            "--serving`")
 declare_env("PADDLE_TPU_MEMBERSHIP",
             "elastic fleet: membership file the launcher rewrites on "
             "host loss/scale events")

@@ -26,6 +26,11 @@ the trainer checkpoint-and-exit path — and are awaited, not killed);
 ``membership.json`` rewrite + SIGUSR1 to survivors) that an
 ``ElasticCoordinator`` on each survivor consumes as a live reshard —
 the Go master's task-re-queue survivability, without restarting anyone.
+``--serving`` spawns a serving-replica fleet instead: children get
+``PADDLE_TPU_REPLICA_ID``/``PADDLE_TPU_NREPLICAS`` (and no trainer
+rendezvous env — replicas are independent processes), and replica death
+is the same membership-event downgrade, which a fleet health monitor
+(``serving/health.py``) consumes as a failover verdict.
 
 Command templating: ``{rank}``, ``{nproc}`` and ``{port}`` inside the
 command argv are substituted per process.  Each child additionally gets
@@ -85,6 +90,21 @@ def rank_env(rank: int, nproc: int, port: int,
     return env
 
 
+def serving_env(rank: int, nreplicas: int, base_env=None) -> dict:
+    """Child environment for one SERVING replica (``--serving``).
+    Replicas are independent processes — no jax.distributed rendezvous,
+    so deliberately NO coordinator/world variables (a replica that
+    inherited them would try to rendezvous a collective fleet that
+    does not exist); just the replica identity the serving CLI and the
+    fleet router's membership bookkeeping key on."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.pop("PADDLE_TPU_COORDINATOR", None)
+    env.pop("PADDLE_TPU_NPROC", None)
+    env["PADDLE_TPU_REPLICA_ID"] = str(rank)
+    env["PADDLE_TPU_NREPLICAS"] = str(nreplicas)
+    return env
+
+
 class _Tee(threading.Thread):
     """Pump one child's combined output to a log file (+ console when
     asked), line-buffered so interleaved ranks stay readable."""
@@ -121,6 +141,7 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
                  log_dir: str | None = None, port: int | None = None,
                  echo_rank0: bool = True, timeout: float | None = None,
                  poll_s: float = 0.1, elastic: bool = False,
+                 serving: bool = False,
                  membership_path: str | None = None,
                  drain_signal: int | None = None,
                  grace_s: float = 5.0) -> int:
@@ -149,12 +170,20 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
     ``ElasticCoordinator`` on each survivor re-reads the file and
     reshards live.  The launcher keeps running until every rank has
     exited and returns 0 when the SURVIVORS all exited 0 (lost ranks
-    are the event, not the verdict)."""
+    are the event, not the verdict).
+
+    ``serving`` spawns a REPLICA fleet instead of a trainer fleet: each
+    child gets ``PADDLE_TPU_REPLICA_ID``/``PADDLE_TPU_NREPLICAS`` (and
+    no coordinator rendezvous — replicas are independent), and replica
+    death is downgraded to a membership event exactly like ``elastic``
+    — the membership file (written when ``membership_path``/``log_dir``
+    is given) is what a fleet health monitor reads to fail the lost
+    replica over (``serving/health.py``)."""
     port = port or _free_port()
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
     membership = None
-    if elastic:
+    if elastic or (serving and (membership_path or log_dir)):
         from paddle_tpu.distributed.multihost import Membership
 
         if membership_path is None:
@@ -177,7 +206,7 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
     # thread the disposition can't change — children then inherit the
     # caller's.
     spawn_ignore = None
-    if elastic:
+    if elastic or serving:
         try:
             spawn_ignore = signal.signal(signal.SIGUSR1, signal.SIG_IGN)
         except ValueError:
@@ -185,9 +214,12 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
     try:
         for rank in range(nproc):
             argv = _substitute(list(cmd), rank, nproc, port)
-            child_env = rank_env(
-                rank, nproc, port, base_env=env,
-                epoch=membership.epoch if membership else 0)
+            if serving:
+                child_env = serving_env(rank, nproc, base_env=env)
+            else:
+                child_env = rank_env(
+                    rank, nproc, port, base_env=env,
+                    epoch=membership.epoch if membership else 0)
             if membership_path:
                 child_env["PADDLE_TPU_MEMBERSHIP"] = membership_path
             p = subprocess.Popen(
@@ -271,19 +303,29 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
             for rank, code in enumerate(done):
                 if code is None or code == 0 or rank in lost:
                     continue
-                if elastic:
+                if elastic or serving:
                     # membership event, not fleet death: drop the rank,
-                    # bump the epoch, notify survivors
+                    # bump the epoch, notify survivors.  A serving
+                    # fleet without a membership file just records the
+                    # loss (no one to notify — the health monitor's
+                    # probes carry the verdict).
                     lost.add(rank)
-                    membership.remove(rank)
-                    membership.write(membership_path)
                     tees[rank].join(timeout=2.0)
+                    if membership is not None:
+                        membership.remove(rank)
+                        membership.write(membership_path)
+                        epoch, survivors = membership.epoch, membership.ranks
+                    else:
+                        epoch = "-"
+                        survivors = [r for r in range(nproc)
+                                     if r not in lost]
                     sys.stderr.write(
                         f"launch: rank {rank} lost (exit {code}); "
-                        f"membership epoch {membership.epoch}, "
-                        f"survivors {membership.ranks}.  Last output:\n"
+                        f"membership epoch {epoch}, "
+                        f"survivors {survivors}.  Last output:\n"
                         f"{tees[rank].tail_text()[-1500:]}\n")
-                    signal_live(signal.SIGUSR1)
+                    if membership is not None:
+                        signal_live(signal.SIGUSR1)
                     continue
                 if draining:
                     continue  # judged collectively once all exit
@@ -367,6 +409,11 @@ def main(argv=None) -> int:
                    help="rank death becomes a membership event (file "
                         "rewrite + SIGUSR1 to survivors) instead of "
                         "killing the fleet")
+    p.add_argument("--serving", action="store_true",
+                   help="spawn a serving-replica fleet: children get "
+                        "PADDLE_TPU_REPLICA_ID/NREPLICAS (no trainer "
+                        "rendezvous env) and replica death is a "
+                        "membership event, not fleet death")
     p.add_argument("--membership", default=None,
                    help="membership file path for --elastic (default: "
                         "<log_dir>/membership.json)")
@@ -394,7 +441,7 @@ def main(argv=None) -> int:
         return 0
     return launch_local(cmd, args.nproc, log_dir=args.log_dir,
                         port=args.port, timeout=args.timeout,
-                        elastic=args.elastic,
+                        elastic=args.elastic, serving=args.serving,
                         membership_path=args.membership,
                         drain_signal=signal.SIGUSR1 if args.drain
                         else None,

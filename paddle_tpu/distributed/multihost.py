@@ -266,6 +266,14 @@ class Membership:
         members, order-preserving."""
         return {r: i for i, r in enumerate(self.ranks)}
 
+    def missing(self, expected) -> list[int]:
+        """Ranks in ``expected`` that this view no longer lists — the
+        launcher removed them (host/replica loss).  The serving fleet's
+        health monitor reads launch's membership file through this to
+        turn a replica-process death into a failover verdict."""
+        return sorted(int(r) for r in expected
+                      if int(r) not in set(self.ranks))
+
     # -- serialization ---------------------------------------------------------
     def to_dict(self) -> dict:
         return {"schema": "paddle_tpu.membership/1",

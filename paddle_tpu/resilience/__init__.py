@@ -27,6 +27,7 @@ from paddle_tpu.resilience.chaos import (  # noqa: F401
     ChaosError,
     ChaosSchedule,
     corrupt_newest_checkpoint,
+    corrupt_servable,
     flaky,
     nan_poison_batch,
 )

@@ -21,9 +21,20 @@ A :class:`ChaosSchedule` is parsed from a spec string (the trainer CLI's
                             ... with the live shards declared
                             unrecoverable (checkpoint-fallback path)
     scale_up@8:dp=8         post a scale-up elastic event at step 8
+    replica_loss@5:replica=1
+                            kill serving replica 1 at fleet pump round 5
+    replica_hang@5:replica=0
+                            wedge replica 0 (alive but making no progress)
+    servable_corrupt@1      corrupt the servable artifact before the
+                            rolling weight swap's 2nd per-replica load
 
 The elastic kinds need a coordinator: call :meth:`ChaosSchedule.
 bind_elastic` with the run's ``ElasticCoordinator`` before training.
+The serving-fleet kinds need a router: pass the schedule as
+``FleetRouter(chaos=...)`` — the router polls
+:meth:`take_fleet_fault` at its own pump-round / swap-load counters
+(``serving/router.py``), so a serving chaos trace is as replayable as a
+training one.
 
 Batch/step indices are 0-based and cumulative over the schedule object's
 lifetime (they keep counting across passes), so a fault lands at one
@@ -92,7 +103,8 @@ class ChaosSchedule:
     """
 
     KINDS = ("reader_error", "nan", "step_error", "sigterm",
-             "host_loss", "scale_up")
+             "host_loss", "scale_up",
+             "replica_loss", "replica_hang", "servable_corrupt")
 
     def __init__(self, spec: str = "", seed: int = 0, registry=None,
                  flight=None):
@@ -117,6 +129,8 @@ class ChaosSchedule:
                     always = True
                 elif ex.startswith("dp="):
                     params["dp"] = int(ex[len("dp="):])
+                elif ex.startswith("replica="):
+                    params["replica"] = int(ex[len("replica="):])
                 elif ex.startswith("source="):
                     src = ex[len("source="):]
                     if src not in ("live", "checkpoint"):
@@ -138,6 +152,20 @@ class ChaosSchedule:
         :class:`~paddle_tpu.resilience.elastic.ElasticCoordinator`."""
         self._elastic = coordinator
         return self
+
+    def take_fleet_fault(self, kind: str, index: int) -> dict | None:
+        """Serving-fleet injection point (``FleetRouter`` polls this):
+        if a ``replica_loss``/``replica_hang``/``servable_corrupt``
+        fault is due at ``index`` (the router's own pump-round or
+        swap-load counter), fire it and return its params (e.g.
+        ``{"replica": 1}``); else None.  The router applies the effect —
+        the schedule only decides WHEN, so the same spec replays the
+        same fault at the same deterministic point."""
+        f = self._due(kind, index)
+        if f is None:
+            return None
+        self._fire(f, f"fleet {kind} @{index}")
+        return dict(f.params)
 
     def reset_counters(self) -> None:
         """Re-base the batch/step indexes to 0 for a new supervisor
@@ -256,6 +284,24 @@ def corrupt_newest_checkpoint(ckpt_dir: str, seed: int = 0,
 
     safe_inc("faults_injected", "chaos faults fired", registry=registry,
              kind="corrupt_ckpt")
+    return target
+
+
+def corrupt_servable(path: str, seed: int = 0) -> str:
+    """Append seeded garbage to a servable's payload so its manifest
+    sha256 no longer matches — ``load_servable`` must then refuse it,
+    which is what proves the rolling weight swap's verify-then-swap
+    order and its rollback path.  The ``servable_corrupt`` schedule
+    entry that triggered this already counted the fault
+    (``take_fleet_fault``), so this helper does not count again.
+    Returns the corrupted payload path."""
+    target = os.path.join(path, "params.npz")
+    if not os.path.exists(target):
+        raise FileNotFoundError(f"no servable payload at {target}")
+    rnd = np.random.default_rng(seed)
+    with open(target, "ab") as f:
+        f.write(rnd.integers(0, 256, size=64, dtype=np.uint8).tobytes())
+    log.warning("chaos: corrupted servable payload %s", target)
     return target
 
 

@@ -21,13 +21,35 @@ CTR/recommender models.
   trainer checkpoint format's serving twin);
 - ``dense``      — DenseBatcher: micro-batching front-end for the batch
   v2 ``Inference`` path (CTR / recommender scoring);
-- ``__main__``   — ``python -m paddle_tpu.serving`` stdin CLI loop.
+- ``fleet``      — FleetConfig + LocalReplica + build_local_fleet: N
+  replica engines behind one router (``distributed.launch --serving``
+  is the subprocess twin);
+- ``router``     — FleetRouter: load balancing, health-checked
+  failover (idempotent by fleet-global request id), overload shedding
+  with RetryAfter, per-request deadlines, zero-downtime weight swap;
+- ``health``     — HealthProbe/FleetHealth: per-replica liveness
+  verdicts (crash / hang / stale / membership);
+- ``__main__``   — ``python -m paddle_tpu.serving`` stdin CLI loop
+  (``--replicas N`` serves through a local fleet).
 
 Attention kernel: ``ops/pallas/paged_attention.py`` (ragged paged
 attention; Pallas on TPU, pure-jnp reference elsewhere).
 """
 
 from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
+from paddle_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig,
+    LocalReplica,
+    build_local_fleet,
+    fleet_launch_argv,
+)
+from paddle_tpu.serving.health import FleetHealth, HealthProbe  # noqa: F401
+from paddle_tpu.serving.router import (  # noqa: F401
+    FleetRouter,
+    ReplicaLost,
+    RetryAfter,
+    SwapFailed,
+)
 from paddle_tpu.serving.export import (  # noqa: F401
     checkpoint_to_servable,
     export_servable,

@@ -11,12 +11,16 @@ Each completed request prints ``<id>: <generated ids>``.  With
 (``serving/export.py``); ``--random`` serves seeded random weights (smoke
 tests / latency rehearsal).  ``--metrics_jsonl PATH`` streams the
 per-request records + the final serve_summary for
-``tools/metrics_to_md.py``.
+``tools/metrics_to_md.py``.  ``--replicas N`` serves through a local
+fleet (``serving/fleet.py``): N replica engines behind the FleetRouter,
+same loop, same output.  Under ``distributed.launch --serving`` each
+process announces its ``PADDLE_TPU_REPLICA_ID`` on stderr.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -40,6 +44,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--num_pages", type=int, default=64)
     p.add_argument("--max_prompt_len", type=int, default=32)
     p.add_argument("--metrics_jsonl", default=None)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a local fleet of N replica "
+                        "engines behind the FleetRouter (default: one "
+                        "bare engine)")
     return p
 
 
@@ -68,10 +76,24 @@ def main(argv=None) -> int:
             mlp_dim=args.embed * 4, max_seq_len=256, remat=False)
         params = T.init_params(cfg, jax.random.key(args.seed))
 
-    eng = ServingEngine(cfg, params, ServingConfig(
+    scfg = ServingConfig(
         max_slots=args.slots, page_size=args.page_size,
         num_pages=args.num_pages, max_prompt_len=args.max_prompt_len,
-        max_new_tokens=args.max_new_tokens, seed=args.seed))
+        max_new_tokens=args.max_new_tokens, seed=args.seed)
+    if args.replicas > 1:
+        from paddle_tpu.serving.fleet import build_local_fleet
+
+        eng = build_local_fleet(cfg, params, scfg, n=args.replicas)
+    else:
+        eng = ServingEngine(cfg, params, scfg)
+
+    # a replica spawned by `distributed.launch --serving` announces its
+    # identity so the per-rank logs are attributable
+    replica = os.environ.get("PADDLE_TPU_REPLICA_ID")
+    if replica is not None:
+        print(f"serving: replica {replica} of "
+              f"{os.environ.get('PADDLE_TPU_NREPLICAS', '?')}",
+              file=sys.stderr)
 
     # synchronous per-line loop: submit, drain, print — deterministic
     # output order for scripted callers; a long-lived front-end would

@@ -49,6 +49,58 @@ _LAT_HISTS = ("serve_queue_wait_ms", "serve_prefill_ms",
               "serve_decode_step_ms", "serve_ttft_ms", "serve_tpot_ms")
 
 
+def drain_results(completed: "queue.Queue", loop_error_now, what: str,
+                  n: int | None = None, timeout: float | None = None):
+    """The shared ``results()`` back-end (ServingEngine and the fleet's
+    FleetRouter): pop up to ``n`` completed results (all currently
+    available if None), blocking up to ``timeout`` for the first.
+    Blocking waits run in short slices re-checking ``loop_error_now``,
+    so a dying loop thread fails blocked callers with its exception
+    (labeled ``what``) instead of parking them forever — already-queued
+    results are always handed out first."""
+    def pop(block: bool, deadline: float | None, raise_on_crash: bool):
+        while True:
+            try:
+                return completed.get(block=False)
+            except queue.Empty:
+                pass
+            err = loop_error_now()
+            if err is not None and raise_on_crash:
+                raise RuntimeError(
+                    f"{what} crashed; pending requests will never "
+                    "complete") from err
+            if not block:
+                return None
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return None
+            try:
+                return completed.get(
+                    timeout=0.05 if remaining is None
+                    else min(0.05, remaining))
+            except queue.Empty:
+                continue
+
+    out: list = []
+    deadline = None if timeout is None else time.monotonic() + timeout
+    if n is None:
+        # drain mode: optionally wait up to timeout for the first, then
+        # take whatever else is already there
+        r = pop(block=timeout is not None, deadline=deadline,
+                raise_on_crash=True)
+        while r is not None:
+            out.append(r)
+            r = pop(block=False, deadline=None, raise_on_crash=False)
+        return out
+    while len(out) < n:
+        r = pop(block=True, deadline=deadline, raise_on_crash=not out)
+        if r is None:
+            break
+        out.append(r)
+    return out
+
+
 class ServingEngine:
     def __init__(self, cfg, params, serving: ServingConfig | None = None,
                  registry=None):
@@ -89,6 +141,7 @@ class ServingEngine:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._loop_error: BaseException | None = None
+        self._stopped = False  # a stop()ed loop marks the engine dead
         self._build_fns()
 
     # -- jitted compute -------------------------------------------------------
@@ -136,10 +189,13 @@ class ServingEngine:
         self._decode = jax.jit(decode, donate_argnums=donate)
 
     # -- public API -----------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int | None = None,
-               temperature: float = 0.0) -> int:
-        """Queue one request (thread-safe); returns its request id.
-        Prompt/limit validation errors raise here, not in the loop."""
+    def check_request(self, prompt,
+                      max_new_tokens: int | None = None
+                      ) -> tuple[list[int], int]:
+        """Validate one request against the engine's caps and return the
+        normalized ``(prompt, max_new_tokens)``.  Shared by :meth:`submit`
+        and the fleet router (which must reject a bad request at its own
+        front door instead of crashing a replica's step loop)."""
         s = self.serving
         prompt = [int(t) for t in prompt]
         n = s.max_new_tokens if max_new_tokens is None else max_new_tokens
@@ -153,48 +209,52 @@ class ServingEngine:
         bad = [t for t in prompt if not 0 <= t < v]
         enforce(not bad, f"prompt ids {bad[:8]} outside [0, {v}) — jnp "
                 "gather would clamp them silently")
+        return prompt, n
+
+    def submit(self, prompt, max_new_tokens: int | None = None,
+               temperature: float = 0.0,
+               request_id: int | None = None) -> int:
+        """Queue one request (thread-safe); returns its request id.
+        Prompt/limit validation errors raise here, not in the loop.
+
+        ``request_id`` lets a fleet router pin the id (sampling keys are
+        keyed by it, so a request re-dispatched to another replica after
+        a failover samples the SAME tokens); uniqueness among in-flight
+        ids is then the caller's contract.  A dead engine — background
+        loop crashed, or ``stop()``\\ ed after running one — refuses the
+        submit instead of enqueueing work nothing will ever serve."""
+        prompt, n = self.check_request(prompt, max_new_tokens)
+        err = self._loop_error_now()
+        if err is not None:
+            raise RuntimeError(
+                "serving loop crashed; submit refused (restart the "
+                "engine to forgive the crash)") from err
         with self._lock:
-            rid = self._next_id
-            self._next_id += 1
+            if self._stopped:
+                raise RuntimeError(
+                    "engine is stopped; submit would enqueue into a dead "
+                    "engine (call start() to serve again)")
+            if request_id is None:
+                rid = self._next_id
+            else:
+                rid = int(request_id)
+                enforce(rid >= 0, f"request_id must be >= 0, got {rid}")
+            self._next_id = max(self._next_id, rid + 1)
             self._incoming.append(Request(
                 id=rid, prompt=prompt, max_new_tokens=n,
                 temperature=float(temperature), arrival=time.perf_counter()))
         return rid
+
+    def queued(self) -> int:
+        """Requests accepted but not yet handed to the scheduler."""
+        with self._lock:
+            return len(self._incoming)
 
     def _loop_error_now(self) -> BaseException | None:
         # _loop_error is written by the background loop thread; every
         # access holds _lock (the GL-THREAD audited contract)
         with self._lock:
             return self._loop_error
-
-    def _pop_completed(self, block: bool, deadline: float | None,
-                       raise_on_crash: bool):
-        """One completed result, or None on timeout/empty.  Waits in
-        short slices so a dying loop thread fails blocked callers with
-        its exception instead of parking them forever (already-queued
-        results are always handed out first)."""
-        while True:
-            try:
-                return self._completed.get(block=False)
-            except queue.Empty:
-                pass
-            err = self._loop_error_now()
-            if err is not None and raise_on_crash:
-                raise RuntimeError(
-                    "serving loop crashed; pending requests will never "
-                    "complete") from err
-            if not block:
-                return None
-            remaining = (None if deadline is None
-                         else deadline - time.monotonic())
-            if remaining is not None and remaining <= 0:
-                return None
-            try:
-                return self._completed.get(
-                    timeout=0.05 if remaining is None
-                    else min(0.05, remaining))
-            except queue.Empty:
-                continue
 
     def results(self, n: int | None = None,
                 timeout: float | None = None) -> list[RequestResult]:
@@ -203,26 +263,8 @@ class ServingEngine:
         background loop has died, callers that would otherwise come
         back empty-handed (or block forever) get the loop's exception
         re-raised instead — a pending future must fail, not hang."""
-        out: list[RequestResult] = []
-        deadline = None if timeout is None else time.monotonic() + timeout
-        if n is None:
-            # drain mode: optionally wait up to timeout for the first,
-            # then take whatever else is already there
-            r = self._pop_completed(block=timeout is not None,
-                                    deadline=deadline,
-                                    raise_on_crash=True)
-            while r is not None:
-                out.append(r)
-                r = self._pop_completed(block=False, deadline=None,
-                                        raise_on_crash=False)
-            return out
-        while len(out) < n:
-            r = self._pop_completed(block=True, deadline=deadline,
-                                    raise_on_crash=not out)
-            if r is None:
-                break
-            out.append(r)
-        return out
+        return drain_results(self._completed, self._loop_error_now,
+                             "serving loop", n=n, timeout=timeout)
 
     def generate(self, prompts, max_new_tokens: int | None = None,
                  temperature: float = 0.0) -> list[RequestResult]:
@@ -244,6 +286,7 @@ class ServingEngine:
         enforce(self._thread is None, "engine already started")
         with self._lock:
             self._loop_error = None  # a restart forgives the prior crash
+            self._stopped = False
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True)
@@ -254,6 +297,12 @@ class ServingEngine:
         t, self._thread = self._thread, None
         if t is not None:
             t.join()
+            # a stopped background engine is DEAD until start(): a
+            # submit() now would park in the queue forever, so refuse it
+            # there.  Engines only ever driven synchronously (no thread)
+            # keep accepting — generate()/run_until_idle still serve.
+            with self._lock:
+                self._stopped = True
         self.emit_summary()
 
     def run_until_idle(self) -> None:

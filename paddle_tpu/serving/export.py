@@ -83,6 +83,12 @@ def export_servable(out_dir: str, cfg, params: dict,
     os.makedirs(tmp, exist_ok=True)
     try:
         np.savez(os.path.join(tmp, "params.npz"), **_flatten(params))
+        # record the payload inventory {param name: dtype-as-stored} so a
+        # partial or rewritten payload (param dropped, dtype changed)
+        # is refused at load even if the manifest hashes were regenerated
+        # to match — the manifest is the contract, not just a checksum
+        with np.load(os.path.join(tmp, "params.npz")) as z:
+            param_inventory = {k: str(z[k].dtype) for k in z.files}
         manifest = {
             "schema": SCHEMA,
             "uuid": uuid_mod.uuid4().hex,
@@ -90,6 +96,7 @@ def export_servable(out_dir: str, cfg, params: dict,
             "config": _cfg_to_json(cfg),
             "files": {f: _sha256(os.path.join(tmp, f))
                       for f in sorted(os.listdir(tmp))},
+            "params": param_inventory,
             "meta": meta or {},
         }
         with open(os.path.join(tmp, MANIFEST), "w") as f:
@@ -124,12 +131,34 @@ def load_servable(path: str):
     with open(mpath) as f:
         manifest = json.load(f)
     for fname, digest in manifest["files"].items():
-        enforce(_sha256(os.path.join(path, fname)) == digest,
+        fpath = os.path.join(path, fname)
+        enforce(os.path.exists(fpath),
+                f"servable {path}: {fname} is listed in the manifest "
+                "but missing from disk — refusing a partial artifact")
+        enforce(_sha256(fpath) == digest,
                 f"servable {path}: {fname} hash mismatch — refusing to "
                 "serve a corrupt/tampered artifact")
     cfg = _cfg_from_json(manifest["config"])
     with np.load(os.path.join(path, "params.npz")) as z:
         flat = {k: z[k] for k in z.files}
+    # payload-vs-manifest inventory check (manifests before /1's
+    # "params" field skip it): a param missing from the payload, an
+    # extra one, or a dtype drift means the artifact is NOT what was
+    # exported — refuse rather than serve garbage-shaped weights
+    inventory = manifest.get("params")
+    if inventory is not None:
+        missing = sorted(set(inventory) - set(flat))
+        extra = sorted(set(flat) - set(inventory))
+        enforce(not missing and not extra,
+                f"servable {path}: payload params do not match the "
+                f"manifest (missing {missing[:4]}, unexpected "
+                f"{extra[:4]}) — refusing a partial artifact")
+        drift = {k: (inventory[k], str(flat[k].dtype)) for k in inventory
+                 if str(flat[k].dtype) != inventory[k]}
+        enforce(not drift,
+                f"servable {path}: param dtype mismatch vs manifest "
+                f"{dict(list(drift.items())[:4])} — refusing to serve "
+                "garbage")
     # float payloads come back at the config's compute dtype (npz stores
     # extension dtypes upcast, the checkpoint convention)
     params = {k: jnp.asarray(v, dtype=cfg.dtype if v.dtype.kind == "f"

@@ -1,0 +1,670 @@
+"""FleetRouter — fault-tolerant request routing over replica engines.
+
+The client-facing front door of the serving fleet (``serving/fleet.py``
+builds one): ``submit()/results()`` with the same shape as a single
+:class:`~paddle_tpu.serving.engine.ServingEngine`, load-balanced over N
+replicas, surviving the failures a single engine cannot:
+
+- **failover** — a replica judged dead by :class:`~paddle_tpu.serving.
+  health.FleetHealth` (crash, hang, staleness) has its in-flight
+  requests re-dispatched to survivors, the Go master's task-re-queue /
+  client-redial rule (PAPER.md §pserver) at serving granularity.  The
+  redial is bounded by a :class:`~paddle_tpu.resilience.policy.
+  RetryPolicy` (attempt budget + exception-class filter), and
+  idempotent: request ids are FLEET-global and pinned through
+  ``ServingEngine.submit(request_id=)``, so a re-dispatched request
+  samples the same tokens on any replica, and a late duplicate result
+  (a hung replica waking up after its work was re-run) is dropped, never
+  double-delivered.
+- **overload shedding** — ``submit()`` raises :class:`RetryAfter` (with
+  a client back-off hint) instead of queueing unboundedly, once queue
+  depth, the fleet-wide free-page watermark, or the observed p99 TTFT
+  breaches the :class:`~paddle_tpu.serving.fleet.FleetConfig` SLO.
+  Per-request deadlines (``ttl_s``) make head-of-line requests that can
+  no longer be served in time fail fast (``finish_reason="deadline"``)
+  instead of wedging the queue.
+- **zero-downtime weight swap** — :meth:`swap_servable` rolls a new
+  exported servable across replicas one at a time (drain, sha256-verify
+  via ``load_servable``, swap, smoke-decode, re-admit) while the rest
+  of the fleet keeps serving; any failure rolls every already-swapped
+  replica back to the old weights and raises :class:`SwapFailed`.
+
+Drive it like the engine: a background thread (``start()/stop()``), or
+synchronously (``pump()``/``run_until_idle()``) for deterministic tests
+and benches.  Chaos (``resilience/chaos.py``) injects ``replica_loss``
+/ ``replica_hang`` at pump-round k and ``servable_corrupt`` at
+swap-load k, so every recovery path here is exercised by
+``tests/test_fleet.py`` rather than hoped about.
+
+Telemetry: counters ``fleet_failovers`` / ``fleet_requeued`` /
+``fleet_shed`` / ``fleet_swaps`` / ``fleet_swap_rollbacks`` /
+``fleet_deadline_expired`` / ``fleet_redial_exhausted`` /
+``fleet_duplicate_results``, gauges ``fleet_alive_replicas`` /
+``fleet_queue_depth``, plus one ``kind="fleet"`` record per event
+(replica_down / swap / swap_rollback / summary) rendered by
+``tools/metrics_to_md.py``'s "Serving fleet" table.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+from paddle_tpu.core import logger as log
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.resilience.policy import RetryPolicy
+from paddle_tpu.serving.engine import drain_results
+from paddle_tpu.serving.health import FleetHealth
+from paddle_tpu.serving.scheduler import RequestResult
+
+
+class RetryAfter(RuntimeError):
+    """The overload-shedding rejection: the fleet is past its admission
+    watermarks, try again in ``retry_after_s`` — the 429 of this stack.
+    Raised by ``submit()`` so a client backs off instead of growing an
+    unbounded queue nobody can serve in SLO."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(
+            f"fleet overloaded ({reason}); retry after {retry_after_s}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class ReplicaLost(RuntimeError):
+    """A replica died with work in flight — the retryable failover
+    exception the router's RetryPolicy filters on."""
+
+
+class SwapFailed(RuntimeError):
+    """A rolling weight swap aborted; every already-swapped replica was
+    rolled back to the previous weights before this raised."""
+
+
+class _FleetReq:
+    """One routed request: fleet-global id + dispatch bookkeeping."""
+
+    __slots__ = ("id", "prompt", "max_new", "temperature", "deadline",
+                 "arrival", "attempts", "replica")
+
+    def __init__(self, rid: int, prompt: list[int], max_new: int,
+                 temperature: float, deadline: float | None,
+                 arrival: float):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.deadline = deadline
+        self.arrival = arrival
+        self.attempts = 0        # dispatches so far (RetryPolicy-bounded)
+        self.replica: int | None = None
+
+
+class FleetRouter:
+    def __init__(self, replicas, fleet=None, registry=None, chaos=None,
+                 clock=time.monotonic, policy: RetryPolicy | None = None):
+        """``replicas``: replica handles (``fleet.LocalReplica`` or
+        anything with its surface) sharing ONE model/serving config —
+        same caps, same sampling seed, so placement never changes
+        tokens.  ``chaos``: a bound ChaosSchedule for fault injection.
+        ``clock``: injectable monotonic clock (deadline tests).
+        ``policy``: redial bound + exception filter for failover
+        re-dispatch (default: ``fleet.redial_attempts`` total attempts,
+        retrying ReplicaLost only)."""
+        from paddle_tpu import metrics as metrics_mod
+        from paddle_tpu.serving.fleet import FleetConfig
+
+        enforce(len(replicas) >= 1, "a fleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.fleet = fleet or FleetConfig()
+        self.registry = registry or metrics_mod.get_registry()
+        self.health = FleetHealth(
+            stale_after_s=self.fleet.stale_after_s,
+            hang_rounds=self.fleet.hang_rounds, clock=clock,
+            registry=self.registry)
+        self.policy = policy or RetryPolicy(
+            max_attempts=self.fleet.redial_attempts,
+            retry_on=(ReplicaLost,), scope="fleet_redial",
+            registry=self.registry)
+        self._chaos = chaos
+        self._clock = clock
+        self._pump_lock = threading.Lock()   # serializes pump rounds
+        self._lock = threading.Lock()        # guards the books below
+        self._pending: collections.deque[_FleetReq] = collections.deque()
+        self._inflight: dict[int, _FleetReq] = {}
+        self._delivered: set[int] = set()
+        self._done: queue.Queue[RequestResult] = queue.Queue()
+        self._next_id = 0
+        self._rounds = 0
+        self._swap_loads = 0
+        self._swapping = False
+        self._draining: set[int] = set()     # no NEW work routed there
+        self._held: set[int] = set()         # not pumped (mid-swap)
+        self._last_probes: list = []
+        self._counts = {
+            "submitted": 0, "delivered": 0, "shed": 0, "failovers": 0,
+            "requeued": 0, "redial_exhausted": 0, "deadline_expired": 0,
+            "duplicates": 0, "swaps": 0, "swap_rollbacks": 0,
+            "dispatch_errors": 0,
+        }
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._loop_error: BaseException | None = None
+        self._stopped = False  # a stop()ed loop marks the router dead
+
+    # -- client API ------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int | None = None,
+               temperature: float = 0.0,
+               ttl_s: float | None = None) -> int:
+        """Queue one request on the fleet (thread-safe); returns its
+        fleet-global request id.  Raises :class:`RetryAfter` when the
+        fleet is shedding, and validation errors immediately (every
+        replica shares the caps, so replica 0's checker speaks for the
+        fleet).  ``ttl_s`` (default ``fleet.default_ttl_s``): if the
+        request is still unadmitted past its deadline it completes with
+        ``finish_reason="deadline"`` instead of blocking the queue."""
+        prompt, n = self.replicas[0].check(prompt, max_new_tokens)
+        err = self._loop_error_now()
+        if err is not None:
+            raise RuntimeError(
+                "fleet router loop crashed; submit refused") from err
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError(
+                    "fleet router is stopped; submit would enqueue work "
+                    "nothing will ever pump (call start() to serve "
+                    "again)")
+        self._check_shed()
+        ttl = self.fleet.default_ttl_s if ttl_s is None else ttl_s
+        now = self._clock()
+        deadline = now + ttl if ttl and ttl > 0 else None
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._counts["submitted"] += 1
+            self._pending.append(_FleetReq(
+                rid, prompt, n, float(temperature), deadline, now))
+        return rid
+
+    def results(self, n: int | None = None,
+                timeout: float | None = None) -> list[RequestResult]:
+        """Pop up to ``n`` completed results (all currently available if
+        None), blocking up to ``timeout`` for the first — the engine's
+        contract, including failing blocked callers when the background
+        loop has died instead of parking them forever."""
+        return drain_results(self._done, self._loop_error_now,
+                             "fleet router loop", n=n, timeout=timeout)
+
+    def _loop_error_now(self) -> BaseException | None:
+        with self._lock:
+            return self._loop_error
+
+    # -- overload shedding -----------------------------------------------------
+    def _check_shed(self) -> None:
+        f = self.fleet
+        with self._lock:
+            depth = len(self._pending) + len(self._inflight)
+            probes = list(self._last_probes)
+        if f.shed_queue_depth and depth >= f.shed_queue_depth:
+            self._shed("queue_depth",
+                       f"{depth} requests queued >= {f.shed_queue_depth}")
+        if f.slo_p99_ttft_ms:
+            h = self.registry.get("serve_ttft_ms")
+            p99 = h.percentile(99) if h is not None else None
+            if p99 is not None and p99 > f.slo_p99_ttft_ms:
+                self._shed("slo_ttft",
+                           f"p99 TTFT {p99:.1f}ms > SLO "
+                           f"{f.slo_p99_ttft_ms}ms")
+        if f.shed_free_page_frac and probes:
+            free = sum(p.free_pages for p in probes)
+            cap = sum(p.total_pages for p in probes)
+            if cap and free / cap < f.shed_free_page_frac:
+                self._shed("pages",
+                           f"{free}/{cap} KV pages free < watermark "
+                           f"{f.shed_free_page_frac:.0%}")
+
+    def _shed(self, reason: str, detail: str) -> None:
+        with self._lock:
+            self._counts["shed"] += 1
+        from paddle_tpu.telemetry import safe_inc
+
+        safe_inc("fleet_shed", "requests rejected by admission shedding",
+                 registry=self.registry, reason=reason)
+        raise RetryAfter(f"{reason}: {detail}", self.fleet.retry_after_s)
+
+    # -- the pump loop ---------------------------------------------------------
+    def start(self) -> None:
+        """Run the fleet pump on a background thread."""
+        enforce(self._thread is None, "router already started")
+        with self._lock:
+            self._loop_error = None
+            self._stopped = False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-router", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+            # a stopped background router is DEAD until start(): a
+            # submit() now would park in _pending forever (the engine's
+            # dead-engine contract).  Synchronous-only routers (never
+            # start()ed) keep accepting — run_until_idle still serves.
+            with self._lock:
+                self._stopped = True
+        self.emit_summary()
+
+    def run_until_idle(self) -> None:
+        """Drive the fleet on the calling thread until no work remains."""
+        while self.pump():
+            pass
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self.pump():
+                    time.sleep(1e-3)
+        except BaseException as e:
+            with self._lock:
+                self._loop_error = e
+            from paddle_tpu.telemetry import safe_inc
+
+            safe_inc("serve_loop_crashes",
+                     "serving background loops that died",
+                     registry=self.registry)
+            log.error("fleet router loop crashed (%s: %s); failing "
+                      "pending requests", type(e).__name__, e)
+
+    def pump(self) -> bool:
+        """One fleet round: inject due chaos, probe health + fail over,
+        route pending requests, step every live replica, collect
+        results.  Returns False when fully idle.  Serialized — the
+        background loop and a synchronous caller never interleave."""
+        with self._pump_lock:
+            return self._pump_once()
+
+    def _pump_once(self) -> bool:
+        worked = False
+        with self._lock:
+            rnd = self._rounds
+            self._rounds += 1
+        self._inject_chaos(rnd)
+        with self._lock:
+            held = set(self._held)
+        # held replicas (mid-swap) are under the swap thread's exclusive
+        # control: they are not pumped, so their progress is frozen by
+        # DESIGN — judging them would "hang"-kill a healthy replica on
+        # every rolling swap.  They rejoin the probe stream on release.
+        probes = [rep.probe() for i, rep in enumerate(self.replicas)
+                  if not self.health.is_dead(i) and i not in held]
+        for idx, reason in self.health.observe(probes):
+            self._failover(idx, reason)
+            worked = True
+        with self._lock:
+            self._last_probes = [p for p in probes
+                                 if not self.health.is_dead(p.replica)]
+        if self._route():
+            worked = True
+        for i, rep in enumerate(self.replicas):
+            if self.health.is_dead(i):
+                continue
+            with self._lock:
+                held = i in self._held
+            if not held and rep.pump():
+                worked = True
+        if self._collect():
+            worked = True
+        self._update_gauges()
+        with self._lock:
+            outstanding = bool(self._pending or self._inflight)
+        # outstanding work counts as "not idle" even when nothing moved
+        # this round: a hung replica's work looks motionless until the
+        # health monitor's hang_rounds verdict re-dispatches it — the
+        # driver must keep pumping (probing) or the verdict never lands
+        return worked or outstanding
+
+    def _inject_chaos(self, rnd: int) -> None:
+        if self._chaos is None:
+            return
+        p = self._chaos.take_fleet_fault("replica_loss", rnd)
+        if p is not None:
+            self.replicas[p.get("replica", 0)].kill("chaos replica_loss")
+        p = self._chaos.take_fleet_fault("replica_hang", rnd)
+        if p is not None:
+            self.replicas[p.get("replica", 0)].hang()
+
+    # -- routing ---------------------------------------------------------------
+    def _route(self) -> bool:
+        worked = False
+        while True:
+            with self._lock:
+                req = self._pending.popleft() if self._pending else None
+            if req is None:
+                break
+            if req.deadline is not None and self._clock() >= req.deadline:
+                self._finish_local(
+                    req, "deadline",
+                    "deadline expired before admission (ttl "
+                    "exhausted in queue)", count="deadline_expired",
+                    counter="fleet_deadline_expired",
+                    help="requests that timed out before admission")
+                worked = True
+                continue
+            target = self._pick()
+            if target is None:
+                if self.health.alive_count(len(self.replicas)) == 0:
+                    # a fleet with no survivors can never serve this —
+                    # fail it now rather than pump a dead fleet forever
+                    self._finish_local(
+                        req, "error", "no replicas alive",
+                        count="dispatch_errors",
+                        counter="fleet_dispatch_errors",
+                        help="dispatches a replica refused outright")
+                    worked = True
+                    continue
+                # nothing routable right now (all draining) — the head
+                # stays the head; deadline scan happens next round
+                with self._lock:
+                    self._pending.appendleft(req)
+                break
+            idx, rep = target
+            req.attempts += 1
+            req.replica = idx
+            try:
+                rep.submit(req.prompt, req.max_new, req.temperature,
+                           request_id=req.id)
+            except Exception as e:
+                self._finish_local(
+                    req, "error", f"replica {idx} rejected the "
+                    f"dispatch: {e}", count="dispatch_errors",
+                    counter="fleet_dispatch_errors",
+                    help="dispatches a replica refused outright")
+                worked = True
+                continue
+            with self._lock:
+                self._inflight[req.id] = req
+            worked = True
+        return worked
+
+    def _pick(self):
+        """Least-loaded alive, non-draining replica; ties break to the
+        lowest index — deterministic given the books."""
+        with self._lock:
+            load: dict[int, int] = {}
+            for r in self._inflight.values():
+                load[r.replica] = load.get(r.replica, 0) + 1
+            draining = set(self._draining)
+        best = None
+        for i, rep in enumerate(self.replicas):
+            if self.health.is_dead(i) or i in draining:
+                continue
+            key = (load.get(i, 0), i)
+            if best is None or key < best[0]:
+                best = (key, i, rep)
+        return None if best is None else (best[1], best[2])
+
+    # -- failover --------------------------------------------------------------
+    def _failover(self, idx: int, reason: str) -> None:
+        """Re-dispatch a dead replica's in-flight requests to survivors
+        (RetryPolicy-bounded), preserving FIFO order at the queue head —
+        the task-re-queue rule."""
+        with self._lock:
+            mine = sorted((r for r in self._inflight.values()
+                           if r.replica == idx), key=lambda r: r.id)
+            for r in mine:
+                del self._inflight[r.id]
+        requeued = []
+        for r in mine:
+            exc = ReplicaLost(
+                f"replica {idx} died ({reason}) with request {r.id} "
+                f"in flight")
+            if r.attempts >= self.policy.max_attempts \
+                    or not self.policy.should_retry(exc):
+                self._finish_local(
+                    r, "error",
+                    f"{exc}; redial budget "
+                    f"({self.policy.max_attempts} attempts) exhausted",
+                    count="redial_exhausted",
+                    counter="fleet_redial_exhausted",
+                    help="requests failed after the redial budget")
+                continue
+            r.replica = None
+            requeued.append(r)
+        from paddle_tpu.telemetry import safe_inc
+
+        with self._lock:
+            # requeued work goes to the FRONT in id order: it was
+            # admitted before anything still pending
+            self._pending.extendleft(reversed(requeued))
+            self._counts["failovers"] += 1
+            self._counts["requeued"] += len(requeued)
+        safe_inc("fleet_failovers", "replica deaths failed over",
+                 registry=self.registry)
+        for _ in requeued:
+            safe_inc("retries", "retried transient faults",
+                     registry=self.registry, scope=self.policy.scope)
+        log.warning("fleet: replica %d down (%s); re-queued %d in-flight "
+                    "request(s) to survivors", idx, reason, len(requeued))
+        if self.registry.active:
+            self.registry.emit(
+                {"event": "replica_down", "replica": idx,
+                 "reason": reason, "requeued": len(requeued),
+                 "failed": len(mine) - len(requeued)}, kind="fleet")
+
+    def _finish_local(self, req: _FleetReq, finish: str, msg: str, *,
+                      count: str, counter: str, help: str) -> None:
+        """Deliver a router-side terminal result (deadline/error)."""
+        with self._lock:
+            self._delivered.add(req.id)
+            self._counts[count] += 1
+            self._counts["delivered"] += 1
+        from paddle_tpu.telemetry import safe_inc
+
+        safe_inc(counter, help, registry=self.registry)
+        self._done.put(RequestResult(
+            id=req.id, prompt=list(req.prompt), tokens=[],
+            finish_reason=finish,
+            metrics={"error": msg, "attempts": req.attempts}))
+
+    # -- result collection -----------------------------------------------------
+    def _collect(self) -> bool:
+        worked = False
+        for i, rep in enumerate(self.replicas):
+            if self.health.is_dead(i):
+                continue
+            with self._lock:
+                held = i in self._held
+            if held:
+                continue
+            for res in rep.collect():
+                deliver = False
+                with self._lock:
+                    if res.id in self._inflight \
+                            and res.id not in self._delivered:
+                        del self._inflight[res.id]
+                        self._delivered.add(res.id)
+                        self._counts["delivered"] += 1
+                        deliver = True
+                    else:
+                        # a requeued copy may still sit in _pending (its
+                        # first home hung, then delivered late): this
+                        # result IS that request — deliver it and drop
+                        # the duplicate dispatch
+                        for q in self._pending:
+                            if q.id == res.id \
+                                    and res.id not in self._delivered:
+                                self._pending.remove(q)
+                                self._delivered.add(res.id)
+                                self._counts["delivered"] += 1
+                                deliver = True
+                                break
+                        if not deliver:
+                            self._counts["duplicates"] += 1
+                if deliver:
+                    self._done.put(res)
+                    worked = True
+                else:
+                    from paddle_tpu.telemetry import safe_inc
+
+                    safe_inc("fleet_duplicate_results",
+                             "late duplicate results dropped "
+                             "(idempotent request ids)",
+                             registry=self.registry)
+        return worked
+
+    def _update_gauges(self) -> None:
+        with self._lock:
+            depth = len(self._pending) + len(self._inflight)
+        self.registry.gauge(
+            "fleet_alive_replicas", "replicas serving traffic").set(
+                self.health.alive_count(len(self.replicas)))
+        self.registry.gauge(
+            "fleet_queue_depth",
+            "requests pending or in flight across the fleet").set(depth)
+
+    # -- zero-downtime weight swap ---------------------------------------------
+    def swap_servable(self, path: str) -> dict[int, str]:
+        """Roll the exported servable at ``path`` across the fleet, one
+        replica at a time: drain → sha256-verify (``load_servable``) →
+        swap params → smoke decode → re-admit.  The rest of the fleet
+        serves throughout.  On ANY failure (corrupt artifact, config
+        mismatch, smoke mismatch) every already-swapped replica is
+        rolled back to the old weights and :class:`SwapFailed` raises —
+        the fleet never serves a mix of old and new weights.  Returns
+        {replica: "swapped" | "dead: skipped"}."""
+        from paddle_tpu.serving.export import load_servable
+        from paddle_tpu.serving.fleet import smoke_check
+
+        with self._lock:
+            enforce(not self._swapping, "a weight swap is already "
+                    "in progress")
+            self._swapping = True
+        report: dict[int, str] = {}
+        swapped: list[tuple[int, object, object]] = []
+        try:
+            for idx, rep in enumerate(self.replicas):
+                if self.health.is_dead(idx):
+                    report[idx] = "dead: skipped"
+                    continue
+                with self._lock:
+                    self._draining.add(idx)
+                self._wait_drained(idx)
+                with self._lock:
+                    k = self._swap_loads
+                    self._swap_loads += 1
+                if self._chaos is not None and self._chaos.take_fleet_fault(
+                        "servable_corrupt", k) is not None:
+                    from paddle_tpu.resilience.chaos import corrupt_servable
+
+                    corrupt_servable(path)
+                cfg2, params2 = load_servable(path)  # verify, or raise
+                with self._lock:
+                    self._held.add(idx)
+                old = rep.swap_params(cfg2, params2)
+                swapped.append((idx, rep, old))
+                smoke = rep.smoke_decode(list(self.fleet.smoke_prompt),
+                                         self.fleet.smoke_tokens)
+                if not smoke_check(cfg2, params2,
+                                   list(self.fleet.smoke_prompt), smoke):
+                    raise SwapFailed(
+                        f"replica {idx}: smoke decode {smoke} is not "
+                        f"the greedy continuation under the new "
+                        f"weights — refusing to serve it")
+                with self._lock:
+                    self._held.discard(idx)
+                    self._draining.discard(idx)
+                report[idx] = "swapped"
+                log.info("fleet: replica %d swapped to %s", idx, path)
+        except BaseException as e:
+            for idx, rep, old in reversed(swapped):
+                rep.swap_params(rep.cfg, old)
+            with self._lock:
+                for idx in range(len(self.replicas)):
+                    self._held.discard(idx)
+                    self._draining.discard(idx)
+                self._counts["swap_rollbacks"] += 1
+                self._swapping = False
+            from paddle_tpu.telemetry import safe_inc
+
+            safe_inc("fleet_swap_rollbacks",
+                     "weight swaps aborted and rolled back",
+                     registry=self.registry)
+            if self.registry.active:
+                self.registry.emit(
+                    {"event": "swap_rollback", "servable": path,
+                     "rolled_back": [i for i, _, _ in swapped],
+                     "error": f"{type(e).__name__}: {e}"[:300]},
+                    kind="fleet")
+            log.error("fleet: weight swap of %s FAILED (%s: %s); rolled "
+                      "back %d replica(s)", path, type(e).__name__, e,
+                      len(swapped))
+            if isinstance(e, SwapFailed):
+                raise
+            raise SwapFailed(f"weight swap of {path} failed: {e}") from e
+        with self._lock:
+            self._counts["swaps"] += 1
+            self._swapping = False
+        from paddle_tpu.telemetry import safe_inc
+
+        safe_inc("fleet_swaps", "completed rolling weight swaps",
+                 registry=self.registry)
+        if self.registry.active:
+            self.registry.emit(
+                {"event": "swap", "servable": path,
+                 "replicas": {str(k): v for k, v in report.items()}},
+                kind="fleet")
+        return report
+
+    def _wait_drained(self, idx: int) -> None:
+        """Wait for replica ``idx``'s in-flight work to finish (it keeps
+        decoding while draining; it just gets no NEW work).  Pumps
+        inline when no background loop runs; a death mid-drain resolves
+        through the normal failover path."""
+        while True:
+            with self._lock:
+                n = sum(1 for r in self._inflight.values()
+                        if r.replica == idx)
+                threaded = self._thread is not None
+                err = self._loop_error
+            if err is not None:
+                # the pump loop died: nothing will ever drain this —
+                # abort the swap (the caller's rollback handles it)
+                raise RuntimeError(
+                    "fleet router loop crashed while draining replica "
+                    f"{idx}; aborting the weight swap") from err
+            if n == 0 or self.health.is_dead(idx):
+                return
+            if threaded:
+                time.sleep(2e-3)
+            else:
+                self.pump()
+
+    # -- stats + summary -------------------------------------------------------
+    def stats(self) -> dict:
+        """A snapshot of the router's books.  ``requests_lost`` must be
+        0 at idle: every accepted request either delivered a result
+        (any finish reason) or is still queued/in flight."""
+        with self._lock:
+            c = dict(self._counts)
+            pending = len(self._pending)
+            inflight = len(self._inflight)
+        c.update({
+            "pending": pending, "inflight": inflight,
+            "alive_replicas": self.health.alive_count(len(self.replicas)),
+            "requests_lost": c["submitted"] - c["delivered"]
+            - pending - inflight,
+        })
+        return c
+
+    def emit_summary(self) -> None:
+        """One ``kind="fleet"`` summary record — the availability rollup
+        (failovers, sheds, swaps, requests_lost) operators read."""
+        if not self.registry.active:
+            return
+        self.registry.emit({"event": "summary", **self.stats()},
+                           kind="fleet")

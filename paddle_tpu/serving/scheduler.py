@@ -139,6 +139,33 @@ class Scheduler:
         enforce(req.max_new_tokens <= self.serving.max_new_tokens,
                 f"max_new_tokens {req.max_new_tokens} exceeds the "
                 f"engine cap {self.serving.max_new_tokens}")
+        # admission is FIFO with head-of-line blocking, so a request
+        # whose reservation can NEVER be satisfied — more pages than the
+        # whole pool (or a table row) holds, or a bigger reservation
+        # than the concurrent-token budget — would park at the head and
+        # starve everything behind it forever.  Reject it now with the
+        # reason, instead of letting it wedge the queue.  (ServingEngine
+        # configs can't construct this case — its __init__ liveness
+        # checks guarantee one max-size request always fits an empty
+        # engine — but a standalone Scheduler over a small pool can.)
+        reserve = len(req.prompt) + req.max_new_tokens
+        need = self.cache.pages_needed(reserve)
+        pool = self.cache.allocator.num_pages - 1  # page 0 is null
+        enforce(need <= self.cache.max_pages_per_seq,
+                f"request {req.id}: {reserve}-token reservation needs "
+                f"{need} pages > max_pages_per_seq "
+                f"{self.cache.max_pages_per_seq} — it could never be "
+                f"admitted and would block FIFO admission forever")
+        enforce(need <= pool,
+                f"request {req.id}: {reserve}-token reservation needs "
+                f"{need} pages but the whole pool holds {pool} — it "
+                f"could never be admitted and would block FIFO "
+                f"admission forever")
+        budget = self.serving.max_concurrent_tokens
+        enforce(not budget or reserve <= budget,
+                f"request {req.id}: {reserve}-token reservation exceeds "
+                f"max_concurrent_tokens {budget} — it could never be "
+                f"admitted and would block FIFO admission forever")
         self.queue.append(req)
 
     def admit(self, now: float = 0.0) -> list[_Active]:

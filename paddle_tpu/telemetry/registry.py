@@ -62,13 +62,22 @@ from typing import Any
 # and whether the run was clean — plus the preflight_findings{rule}
 # counter.  RECORD_KINDS (below) became the registered kind set the
 # GL-SCHEMA drift pass checks every emitted record against.
-SCHEMA = "paddle_tpu.metrics/7"
+# /8 added the serving-fleet stream (serving/router.py): record kind
+# "fleet" — one per fleet event (replica_down with its failover
+# requeue count, swap / swap_rollback for rolling weight swaps, and
+# the summary availability rollup whose requests_lost must be 0) —
+# plus the fleet_failovers / fleet_requeued / fleet_shed{reason} /
+# fleet_swaps / fleet_swap_rollbacks / fleet_deadline_expired /
+# fleet_redial_exhausted / fleet_duplicate_results /
+# fleet_replica_down{reason} counters and the fleet_alive_replicas /
+# fleet_queue_depth gauges.
+SCHEMA = "paddle_tpu.metrics/8"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted
 # kind missing here — or an entry here nothing produces — is drift.
 RECORD_KINDS = ("step", "bench", "fault", "recovery", "serve",
-                "serve_summary", "elastic_event", "preflight")
+                "serve_summary", "elastic_event", "preflight", "fleet")
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)

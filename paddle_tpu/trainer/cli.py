@@ -105,7 +105,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--chaos", default=None,
                    help="deterministic fault-injection schedule, e.g. "
                         "'reader_error@3,nan@5,sigterm@7,host_loss@9:dp=4'"
-                        " — TESTING ONLY (see resilience/chaos.py)")
+                        "; serving-fleet kinds (replica_loss/replica_"
+                        "hang@k:replica=i, servable_corrupt@k) arm via "
+                        "FleetRouter(chaos=...) — TESTING ONLY (see "
+                        "resilience/chaos.py)")
     p.add_argument("--elastic", action="store_true", default=None,
                    help="arm live resharding on host-loss/scale events: "
                         "membership changes rebuild the mesh at the new "

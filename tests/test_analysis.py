@@ -77,6 +77,16 @@ def test_lock_registry_covers_threaded_subsystems():
         == ["_lock"]
     assert reg["paddle_tpu/trainer/checkpoint.py"]["AsyncCheckpointer"] \
         == ["_lock"]
+    # the serving-fleet threads (PR 11) ride the same audit: the router
+    # runs a pump thread, so its books live under declared locks; the
+    # replica/health modules are registered (thread-free today — a
+    # thread added later is audited the moment it appears)
+    assert reg["paddle_tpu/serving/router.py"]["FleetRouter"] \
+        == ["_lock", "_pump_lock"]
+    from paddle_tpu.analysis.codebase import THREADED_MODULES
+
+    assert "paddle_tpu/serving/fleet.py" in THREADED_MODULES
+    assert "paddle_tpu/serving/health.py" in THREADED_MODULES
 
 
 # -- 2. codebase-pass fixtures --------------------------------------------------
@@ -543,7 +553,7 @@ def test_preflight_cli_clean_config_exits_zero(tmp_path):
     recs = [json.loads(line) for line in open(jsonl)]
     pf = [r for r in recs if r.get("kind") == "preflight"]
     assert pf and pf[0]["clean"] is True
-    assert pf[0]["schema"] == "paddle_tpu.metrics/7"
+    assert pf[0]["schema"] == "paddle_tpu.metrics/8"
     # and metrics_to_md renders it
     md = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "metrics_to_md.py"),

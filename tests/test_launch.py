@@ -241,3 +241,46 @@ def test_elastic_sigusr1_ignored_until_armed(tmp_path):
                       timeout=60, elastic=True)
     assert rc == 0
     assert "survived unarmed" in (tmp_path / "rank0.log").read_text()
+
+
+def test_serving_env_has_replica_id_and_no_rendezvous():
+    from paddle_tpu.distributed.launch import serving_env
+
+    base = {"PATH": "/bin", "PADDLE_TPU_COORDINATOR": "stale:1"}
+    env = serving_env(2, 3, base_env=base)
+    assert env["PADDLE_TPU_REPLICA_ID"] == "2"
+    assert env["PADDLE_TPU_NREPLICAS"] == "3"
+    # replicas are independent processes: no trainer rendezvous vars,
+    # and a stale inherited coordinator is scrubbed (a replica that
+    # kept it would try to join a collective fleet that does not exist)
+    assert "PADDLE_TPU_COORDINATOR" not in env
+    assert "PADDLE_TPU_NPROC" not in env
+
+
+def test_serving_replica_death_is_membership_event_not_fleet_death(
+        tmp_path):
+    """--serving: one replica dying removes it from the membership file
+    (the fleet health monitor's failover signal) while the survivors
+    keep serving and decide the verdict."""
+    child = (
+        "import os, sys, time\n"
+        "r = int(os.environ['PADDLE_TPU_REPLICA_ID'])\n"
+        "assert os.environ['PADDLE_TPU_NREPLICAS'] == '3'\n"
+        "assert 'PADDLE_TPU_COORDINATOR' not in os.environ\n"
+        "if r == 1:\n"
+        "    sys.exit(3)\n"
+        "time.sleep(0.5)\n"
+        "print('replica', r, 'served', flush=True)\n"
+    )
+    rc = launch_local([_PY, "-c", child], nproc=3,
+                      log_dir=str(tmp_path), echo_rank0=False,
+                      timeout=60, serving=True)
+    assert rc == 0  # survivors' verdict; the lost replica is the event
+    from paddle_tpu.distributed.multihost import Membership
+
+    m = Membership.read(str(tmp_path / "membership.json"))
+    assert m.ranks == [0, 2] and m.epoch == 1
+    assert m.missing(range(3)) == [1]
+    for r in (0, 2):
+        assert f"replica {r} served" in \
+            (tmp_path / f"rank{r}.log").read_text()

@@ -219,10 +219,13 @@ class TestSchedulerAndEngine:
         def bad_step():
             raise boom
 
+        # submit BEFORE arming the crash: with the dead-engine guard a
+        # post-crash submit refuses (asserted below), so the pending
+        # request must predate the loop death
+        eng.submit([1, 2, 3], max_new_tokens=3)
         eng.step = bad_step
         eng.start()
         try:
-            eng.submit([1, 2, 3], max_new_tokens=3)
             with pytest.raises(RuntimeError,
                                match="serving loop crashed") as ei:
                 eng.results(n=1, timeout=30.0)
@@ -231,9 +234,71 @@ class TestSchedulerAndEngine:
             # returning an innocent-looking empty list
             with pytest.raises(RuntimeError, match="serving loop crashed"):
                 eng.results()
+            # ... and so does submit(): enqueueing into the dead engine
+            # would park the request forever (PR 8 regression family)
+            with pytest.raises(RuntimeError, match="submit refused"):
+                eng.submit([1, 2, 3], max_new_tokens=3)
         finally:
             eng.stop()
         assert reg.counter("serve_loop_crashes", "").value() == 1.0
+
+    def test_submit_after_stop_raises(self, rng_np):
+        """stop() on a background engine marks it dead: a later submit
+        must raise immediately, not enqueue into a loop that will never
+        run again.  start() forgives (and sync-only engines that never
+        ran a loop keep accepting)."""
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(1))
+        eng = ServingEngine(cfg, params, ServingConfig(
+            max_slots=2, page_size=4, num_pages=32, max_prompt_len=8,
+            max_new_tokens=4, prefill_batch=2))
+        eng.start()
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.results(n=1, timeout=60.0)
+        eng.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.start()  # a restart re-opens the front door
+        try:
+            eng.submit([1, 2, 3], max_new_tokens=2)
+            assert len(eng.results(n=1, timeout=60.0)) == 1
+        finally:
+            eng.stop()
+
+    def test_impossible_reservation_rejected_at_enqueue(self):
+        """A request whose prompt+max_new reservation exceeds the TOTAL
+        page pool (or a table row, or the token budget) can never be
+        admitted — FIFO admission would block forever behind it, so
+        enqueue must reject it immediately with the reason."""
+        from paddle_tpu.serving.kv_cache import PagedKVCache
+        from paddle_tpu.serving.scheduler import Request, Scheduler
+
+        def mk(num_pages, max_pages_per_seq, budget=0):
+            cache = PagedKVCache(1, 2, 16, num_pages, 4, 2,
+                                 max_pages_per_seq)
+            s = ServingConfig(max_slots=2, page_size=4,
+                              num_pages=num_pages, max_prompt_len=64,
+                              max_new_tokens=64,
+                              max_concurrent_tokens=budget)
+            return Scheduler(s, cache)
+
+        # 8+8 tokens -> 4 pages, pool has 3 usable
+        sched = mk(num_pages=4, max_pages_per_seq=8)
+        with pytest.raises(Exception, match="whole pool"):
+            sched.enqueue(Request(id=0, prompt=[1] * 8, max_new_tokens=8))
+        assert not sched.queue  # nothing wedged at the head
+        # table row too short even though the pool is big enough
+        sched = mk(num_pages=64, max_pages_per_seq=2)
+        with pytest.raises(Exception, match="max_pages_per_seq"):
+            sched.enqueue(Request(id=1, prompt=[1] * 8, max_new_tokens=8))
+        # reservation above the concurrent-token budget
+        sched = mk(num_pages=64, max_pages_per_seq=32, budget=10)
+        with pytest.raises(Exception, match="max_concurrent_tokens"):
+            sched.enqueue(Request(id=2, prompt=[1] * 8, max_new_tokens=8))
+        # a request that fits all three still queues, and drains
+        sched = mk(num_pages=8, max_pages_per_seq=4, budget=16)
+        sched.enqueue(Request(id=3, prompt=[1] * 4, max_new_tokens=4))
+        assert len(sched.queue) == 1 and len(sched.admit()) == 1
 
 
 class TestServeTelemetry:
@@ -252,7 +317,7 @@ class TestServeTelemetry:
         serves = [r for r in sink.records if r.get("kind") == "serve"]
         assert len(serves) == 3
         for r in serves:
-            assert r["schema"] == "paddle_tpu.metrics/7"
+            assert r["schema"] == "paddle_tpu.metrics/8"
             for f in ("queue_wait_ms", "ttft_ms", "tpot_ms", "total_ms"):
                 assert r[f] >= 0.0
             assert r["new_tokens"] == 4
@@ -431,6 +496,49 @@ class TestExport:
         np.testing.assert_allclose(
             np.asarray(params2["blocks"]["wq"]),
             np.asarray(params["blocks"]["wq"]))
+
+    def test_partial_manifest_cases_refuse_to_load(self, tmp_path):
+        """load_servable must refuse, with the reason, every partial-
+        artifact shape: a manifest-listed file missing from disk, a
+        payload param set that drifted from the manifest inventory, and
+        a per-param dtype mismatch — never serve garbage-shaped
+        weights."""
+        import json
+
+        from paddle_tpu.serving.export import export_servable, load_servable
+
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(5))
+
+        def fresh(name):
+            out = str(tmp_path / name)
+            export_servable(out, cfg, params)
+            return out
+
+        # (a) payload file listed in the manifest but deleted on disk
+        out = fresh("missing_file")
+        (tmp_path / "missing_file" / "params.npz").unlink()
+        with pytest.raises(Exception, match="missing from disk"):
+            load_servable(out)
+
+        # (b) manifest inventory lists a param the payload lacks
+        out = fresh("missing_param")
+        mpath = tmp_path / "missing_param" / "servable.json"
+        m = json.loads(mpath.read_text())
+        m["params"]["blocks/extra_w"] = "float32"
+        mpath.write_text(json.dumps(m))
+        with pytest.raises(Exception, match="do not match the"):
+            load_servable(out)
+
+        # (c) dtype drift between manifest inventory and payload
+        out = fresh("dtype_drift")
+        mpath = tmp_path / "dtype_drift" / "servable.json"
+        m = json.loads(mpath.read_text())
+        key = next(k for k in m["params"])
+        m["params"][key] = "float16"
+        mpath.write_text(json.dumps(m))
+        with pytest.raises(Exception, match="dtype mismatch"):
+            load_servable(out)
 
 
 @pytest.mark.slow

@@ -1,0 +1,143 @@
+"""Serving-fleet availability bench: p99 TTFT with and without a
+replica loss, on the same seeded Poisson arrival trace.
+
+Three in-process replicas behind the FleetRouter serve the trace twice:
+a fault-free baseline, then the same trace with ONE injected
+``replica_loss`` (ChaosSchedule, deterministic pump-round index) whose
+in-flight requests fail over to the survivors.  The row reports both
+p99 TTFTs — the availability/latency trade under replica churn the
+Gemma-on-TPU serving study (PAPERS arxiv 2605.25645) benchmarks — and
+``requests_lost``, which MUST be 0: losing a request to a replica death
+is a correctness failure, not a latency number, so this script raises
+rather than report it.
+
+Standalone: ``python tools/bench_serving_fleet.py`` (CPU-safe; the jnp
+reference paged-attention path serves).  ``bench.py`` shells out to
+this script so the row rides the normal bench stream.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _repo not in sys.path:
+        sys.path.insert(0, _repo)
+    _tools = os.path.dirname(os.path.abspath(__file__))
+    if _tools not in sys.path:
+        sys.path.insert(0, _tools)
+
+from bench_serving import make_trace  # noqa: E402  (tools/ sibling)
+
+REPLICAS = 3
+LOSS_ROUND = 40  # pump round of the injected loss (mid-trace in flight)
+
+
+def run_fleet(cfg, params, trace, chaos_spec: str | None, seed: int = 0):
+    """Feed the trace (real sleeps between arrivals) through a local
+    fleet; returns (p99_ttft_ms, tokens_per_sec, results, stats)."""
+    from paddle_tpu.resilience.chaos import ChaosSchedule
+    from paddle_tpu.serving.fleet import FleetConfig, build_local_fleet
+    from paddle_tpu.serving.scheduler import ServingConfig
+    from paddle_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry("bench_serving_fleet")
+    chaos = (ChaosSchedule(chaos_spec, registry=reg)
+             if chaos_spec else None)
+    scfg = ServingConfig(
+        max_slots=8, page_size=16, num_pages=128, max_prompt_len=16,
+        max_new_tokens=48, prefill_batch=4, seed=seed)
+    router = build_local_fleet(cfg, params, scfg, n=REPLICAS,
+                               registry=reg, chaos=chaos,
+                               fleet=FleetConfig())
+    # pay every compile signature before timing (prefill, decode) — the
+    # replicas share shapes but not jitted closures, so warm each
+    for rep in router.replicas:
+        rep.engine.generate([[1, 2, 3]] * 2, max_new_tokens=2)
+
+    t0 = time.perf_counter()
+    for prompt, max_new, arrival in trace:
+        while time.perf_counter() - t0 < arrival:
+            if not router.pump():
+                time.sleep(2e-4)
+        router.submit(prompt, max_new_tokens=max_new)
+    router.run_until_idle()
+    elapsed = time.perf_counter() - t0
+    results = router.results()
+    stats = router.stats()
+    total = sum(len(r.tokens) for r in results)
+    ttfts = sorted(float(r.metrics["ttft_ms"]) for r in results
+                   if "ttft_ms" in r.metrics)
+    p99 = ttfts[min(int(round(0.99 * (len(ttfts) - 1))),
+                    len(ttfts) - 1)] if ttfts else 0.0
+    return p99, total / elapsed, results, stats
+
+
+def run_bench(n_requests: int = 32, seed: int = 0) -> list[dict]:
+    import jax
+
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, embed_dim=64,
+        mlp_dim=128, max_seq_len=128, remat=False)
+    params = T.init_params(cfg, jax.random.key(seed))
+    trace = make_trace(n_requests, seed=seed, rate_per_s=150.0)
+
+    base_p99, base_tps, base_res, base_stats = run_fleet(
+        cfg, params, trace, chaos_spec=None, seed=seed)
+    loss_p99, loss_tps, loss_res, loss_stats = run_fleet(
+        cfg, params, trace,
+        chaos_spec=f"replica_loss@{LOSS_ROUND}:replica=1", seed=seed)
+
+    # the acceptance property, not a latency number: a replica death
+    # may cost TTFT, never requests
+    if loss_stats["requests_lost"] != 0 or len(loss_res) != n_requests:
+        raise RuntimeError(
+            f"fleet lost requests under replica_loss: "
+            f"{loss_stats['requests_lost']} lost, "
+            f"{len(loss_res)}/{n_requests} delivered — {loss_stats}")
+    if loss_stats["failovers"] < 1:
+        raise RuntimeError(
+            f"injected replica_loss did not fail over: {loss_stats}")
+    # greedy trace → failover must be token-invisible (enforced, like
+    # requests_lost: a drifted redial is a correctness bug, not noise)
+    same = all(a.tokens == b.tokens for a, b in
+               zip(sorted(base_res, key=lambda r: r.id),
+                   sorted(loss_res, key=lambda r: r.id)))
+    if not same:
+        raise RuntimeError(
+            "failover changed generated tokens vs the fault-free run — "
+            "the fleet-global request-id sampling contract is broken")
+    config = (f"2L/64d transformer, {n_requests} Poisson arrivals, "
+              f"{REPLICAS} replicas, one replica_loss@" f"{LOSS_ROUND}")
+    return [{
+        "metric": "serving_fleet_p99_ttft_ms",
+        "value": round(loss_p99, 1), "unit": "ms",
+        "baseline_p99_ttft_ms": round(base_p99, 1),
+        "tokens_per_sec": round(loss_tps, 1),
+        "baseline_tokens_per_sec": round(base_tps, 1),
+        "requests_lost": loss_stats["requests_lost"],
+        "failovers": loss_stats["failovers"],
+        "requeued": loss_stats["requeued"],
+        "tokens_identical": bool(same),
+        "config": config, "vs_baseline": 0,
+    }]
+
+
+def main() -> None:
+    rows = run_bench()
+    from paddle_tpu.telemetry import JsonlSink, MetricsRegistry
+
+    reg = MetricsRegistry("bench_serving_fleet")
+    reg.add_sink(JsonlSink(sys.stdout))
+    for r in rows:
+        reg.emit(r, kind="bench")
+
+
+if __name__ == "__main__":
+    main()

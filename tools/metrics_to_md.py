@@ -222,6 +222,63 @@ def elastic_table(events: list[dict]) -> None:
               f"Shorten --checkpoint_batch_period if this recurs.")
 
 
+def fleet_table(events: list[dict]) -> None:
+    """Render the schema /8 serving-fleet stream: one row per fleet
+    event (replica_down / swap / swap_rollback), then the newest
+    availability summary — with loud flags on lost requests and
+    rolled-back swaps, which must never read as a healthy fleet."""
+    if not events:
+        return
+    print("\n## Serving fleet\n")
+    rows = [r for r in events if r.get("event") != "summary"]
+    if rows:
+        print("| event | detail |")
+        print("|---|---|")
+        for r in rows:
+            ev = r.get("event", "-")
+            if ev == "replica_down":
+                detail = (f"replica {r.get('replica', '?')} "
+                          f"({r.get('reason', '?')}) — "
+                          f"{r.get('requeued', 0)} request(s) re-queued"
+                          + (f", {r['failed']} failed ⚠"
+                             if r.get("failed") else ""))
+            elif ev == "swap":
+                detail = (f"servable `{r.get('servable', '?')}` rolled "
+                          f"across {len(r.get('replicas') or {})} "
+                          f"replica(s), zero downtime")
+            elif ev == "swap_rollback":
+                detail = (f"⚠ servable `{r.get('servable', '?')}` "
+                          f"REFUSED ({r.get('error', '?')}); rolled "
+                          f"back {len(r.get('rolled_back') or [])} "
+                          f"replica(s)")
+            else:
+                detail = str({k: v for k, v in r.items()
+                              if k not in ("event", "kind", "schema",
+                                           "ts", "host")})
+            print(f"| {ev} | {detail} |")
+    summaries = [r for r in events if r.get("event") == "summary"]
+    for s in summaries[-1:]:
+        lost = s.get("requests_lost", 0)
+        print(f"\n**fleet summary** · {s.get('submitted', 0)} submitted "
+              f"· {s.get('delivered', 0)} delivered "
+              f"· {s.get('failovers', 0)} failover(s) "
+              f"· {s.get('shed', 0)} shed "
+              f"· {s.get('swaps', 0)} swap(s) "
+              f"· {s.get('alive_replicas', '?')} replica(s) alive "
+              f"· requests lost: "
+              f"{'**' + str(lost) + '** ⚠' if lost else '0'}")
+        if lost:
+            print("\n**⚠ requests were lost** — an accepted request "
+                  "neither delivered a result nor remains queued; the "
+                  "failover/idempotence contract is broken.  This is a "
+                  "bug, not load.")
+        if s.get("shed"):
+            print("\n_shedding engaged: clients received retry-after "
+                  "rejections while the fleet was past its admission "
+                  "watermarks — raise capacity or relax the SLO if "
+                  "this recurs under normal load._")
+
+
 def _pctl(vals: list[float], q: float) -> float:
     """Nearest-rank-with-interpolation percentile over raw values (the
     per-request serve records carry exact latencies, so no bucket
@@ -361,6 +418,7 @@ def main(argv: list[str]) -> int:
     serve_summaries = [r for r in records
                        if r.get("kind") == "serve_summary"]
     elastics = [r for r in records if r.get("kind") == "elastic_event"]
+    fleets = [r for r in records if r.get("kind") == "fleet"]
     preflights = [r for r in records if r.get("kind") == "preflight"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
@@ -376,12 +434,13 @@ def main(argv: list[str]) -> int:
         comm_table(steps)
     recovery_table(faults, recoveries)
     elastic_table(elastics)
+    fleet_table(fleets)
     serving_table(serves, serve_summaries)
     preflight_table(preflights)
     bench_table(bench)
     if not steps and not bench and not faults and not recoveries \
             and not serves and not serve_summaries and not elastics \
-            and not preflights:
+            and not fleets and not preflights:
         print("_no step, fault, serve or bench records found_")
     return 0
 
