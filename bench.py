@@ -58,7 +58,13 @@ def _two_point(step_fn, warmup=3, n1=5, n2=25):
     from paddle_tpu.profiler import device_step_ms
 
     try:
-        return device_step_ms(step_fn, steps=max(n2 // 2, 8), warmup=warmup)
+        ms = device_step_ms(step_fn, steps=max(n2 // 2, 8), warmup=warmup)
+        if ms <= 0.0:
+            # a trace with no device events (CPU-only box) reads as 0 —
+            # that is a failed measurement, not an infinitely fast step
+            raise RuntimeError("device trace yielded 0 ms (no device "
+                               "events on this backend)")
+        return ms
     except Exception as e:
         # record it: wall-clock numbers must not masquerade as device-side
         TIMING_FALLBACKS.append(f"{type(e).__name__}: {e}"[:120])

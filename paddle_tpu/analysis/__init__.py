@@ -9,19 +9,25 @@ that builds it), don't just run it and wait for the bench to regress.
 
 Two pass families share one finding/baseline machinery (:mod:`core`):
 
-- **Program passes** (:mod:`program`) run over the jaxpr / lowered HLO
-  of a built train or serve step: host-sync points inside the deferred-
-  fence window, per-signature recompilation hazards, large non-donated
-  update-step buffers, collective-sequence mismatch between the two
-  ZeRO lowerings (the multi-host deadlock class), silent f32 upcasts in
-  bf16 programs.  ``trainer --preflight`` drives them over the actual
-  configured step (:mod:`preflight`).
-- **Codebase passes** (:mod:`codebase`, :mod:`kernel_parity`) run over
-  the repo's own AST: thread-safety of the five threaded subsystems
-  (cross-thread attributes without the declared lock, lock-order
-  cycles), swallow-all ``except`` blocks, the kernel reference-twin
-  rule, telemetry record-kind drift vs SCHEMA, env-var reads without a
-  ``core/flags`` registration.
+- **Program passes** (:mod:`program`, :mod:`memory`, :mod:`sharding`,
+  :mod:`diverge`) run over the jaxpr / lowered HLO of a built train or
+  serve step: host-sync points inside the deferred-fence window,
+  per-signature recompilation hazards, large non-donated update-step
+  buffers, collective-sequence mismatch between the two ZeRO lowerings
+  (the multi-host deadlock class), silent f32 upcasts in bf16
+  programs, static per-device memory accounting vs an HBM/VMEM budget
+  (GL-P-MEM), sharding-flow audit of the GSPMD lowering (GL-P-SHARD),
+  and cross-rank program-fingerprint divergence (GL-P-DIVERGE).
+  ``trainer --preflight`` drives them over the actual configured train
+  AND eval steps (:mod:`preflight`).
+- **Codebase passes** (:mod:`codebase`, :mod:`kernel_parity`,
+  :mod:`rng`) run over the repo's own AST: thread-safety of the
+  threaded subsystems (cross-thread attributes without the declared
+  lock, lock-order cycles), swallow-all ``except`` blocks, the kernel
+  reference-twin rule, telemetry record-kind drift vs SCHEMA, env-var
+  reads without a ``core/flags`` registration, and PRNG key discipline
+  (reused keys, literal-seeded draws) over the fold-in-convention
+  subsystems.
 
 Findings carry stable IDs (``RULE:path:anchor``) so the checked-in
 baseline (``baseline.json``) survives line drift; the repo-wide suite
@@ -51,6 +57,26 @@ from paddle_tpu.analysis.program import (  # noqa: F401
     f32_upcast_pass,
     host_sync_pass,
     recompile_hazard_pass,
+)
+from paddle_tpu.analysis.memory import (  # noqa: F401
+    activation_peak_bytes,
+    memory_budget_pass,
+    memory_report,
+    opt_state_bytes_per_device,
+    pallas_vmem_estimates,
+)
+from paddle_tpu.analysis.sharding import (  # noqa: F401
+    sharding_flow_pass,
+)
+from paddle_tpu.analysis.diverge import (  # noqa: F401
+    divergence_pass,
+    exchange_fingerprints,
+    program_fingerprint,
+)
+from paddle_tpu.analysis.rng import (  # noqa: F401
+    RNG_MODULES,
+    pass_rng_discipline,
+    rng_fold_pass,
 )
 from paddle_tpu.analysis.preflight import (  # noqa: F401
     emit_preflight_record,

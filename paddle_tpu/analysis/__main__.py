@@ -1,5 +1,6 @@
 """``python -m paddle_tpu.analysis`` — run the graftlint codebase suite
-repo-wide (exit 0 = clean: no unsuppressed findings).
+repo-wide (exit 0 = clean: no unsuppressed findings AND no stale
+baseline entries; a full run fails on a stale suppression, naming it).
 
 Options:
   --files F [F ...]   restrict to these repo-relative files (the
@@ -7,9 +8,11 @@ Options:
                       stale-baseline check and the corpus-global kernel
                       pass)
   --passes P [P ...]  run only these passes (except thread lockorder
-                      env schema kernel)
+                      env schema kernel rng)
   --baseline PATH     alternate suppression file
-  --json              machine-readable output (one JSON object)
+  --json              machine-readable output (one JSON object, incl.
+                      suppressed findings and suppressed_count /
+                      stale_count)
   --locks             print the per-module lock registry and exit
 """
 
@@ -46,23 +49,31 @@ def main(argv=None) -> int:
     unsup, sup, stale = apply_baseline(
         findings, load_baseline(args.baseline), full_run=full_run)
 
+    # a stale suppression is dead weight that would silently mask the
+    # next real finding with the same fid — full runs FAIL on it, with
+    # the entry name in the message (subset runs can't evaluate it)
     if args.json:
         print(json.dumps({
-            "clean": not unsup,
+            "clean": not unsup and not stale,
             "findings": [vars(f) | {"fid": f.fid} for f in unsup],
-            "suppressed": [f.fid for f in sup],
+            "suppressed": [vars(f) | {"fid": f.fid} for f in sup],
+            "suppressed_count": len(sup),
             "stale_suppressions": stale,
+            "stale_count": len(stale),
         }, indent=2))
-        return 1 if unsup else 0
+        return 1 if (unsup or stale) else 0
 
     for f in unsup:
         print(f.render())
     if sup:
         print(f"({len(sup)} finding(s) suppressed by baseline)")
     for fid in stale:
-        print(f"stale suppression (matches nothing): {fid}")
-    if unsup:
-        print(f"graftlint: {len(unsup)} unsuppressed finding(s)")
+        print(f"stale baseline suppression (matches nothing): {fid} — "
+              f"remove it from baseline.json or fix the drifted anchor")
+    if unsup or stale:
+        print(f"graftlint: {len(unsup)} unsuppressed finding(s), "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
         return 1
     print("graftlint: OK — repo-wide suite clean"
           if full_run else "graftlint: OK")

@@ -675,6 +675,15 @@ def pass_kernel_parity(corpus, root) -> list[Finding]:
     return kernel_parity_findings(root)
 
 
+# -- GL-RNG rides in from analysis/rng (registered here) ------------------------
+
+
+def pass_rng(corpus, root) -> list[Finding]:
+    from paddle_tpu.analysis.rng import pass_rng_discipline
+
+    return pass_rng_discipline(corpus, root)
+
+
 CODEBASE_PASSES = {
     "except": pass_swallow_except,
     "thread": pass_thread_safety,
@@ -682,6 +691,7 @@ CODEBASE_PASSES = {
     "env": pass_env_registration,
     "schema": pass_schema_kinds,
     "kernel": pass_kernel_parity,
+    "rng": pass_rng,
 }
 
 

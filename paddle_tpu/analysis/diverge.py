@@ -1,0 +1,195 @@
+"""GL-P-DIVERGE — cross-rank program-divergence detection.
+
+A multi-host fleet only works if every rank traced the SAME program:
+a rank whose config drift (env override, version skew, different auto-
+resolved lowering) produced a different HLO issues its collectives in a
+different order and the whole fleet deadlocks in the first one — with
+no error, no log line, and a hardware hold until someone pages.
+
+The fix is the one the GL-P-COLL dual-lowering check applies within a
+process, lifted across ranks: every rank fingerprints its lowered
+program (canonicalized so SSA numbering/metadata churn doesn't count as
+divergence), publishes the fingerprint at a filesystem rendezvous
+(``distributed.launch``'s shared directory model — the same medium as
+the elastic membership file), waits for its peers, and ABORTS preflight
+with a named diff when any rank disagrees — instead of hanging in the
+first collective of step one.
+
+The fingerprint keeps the canonical op-kind sequence alongside the
+hash, so a mismatch names the first divergent operation
+(``op[37]: all-gather vs reduce-scatter``), not just "hashes differ".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+
+from paddle_tpu.analysis.core import Finding, finalize
+
+
+def _pname(name: str) -> str:
+    return f"<program:{name}>"
+
+
+_METADATA_RE = re.compile(r"metadata=\{[^}]*\}")
+_SSA_RE = re.compile(r"%[\w.\-#]+")
+_LOC_RE = re.compile(r"loc\([^)]*\)")
+_WS_RE = re.compile(r"\s+")
+# opcode of one canonicalized line: `%_ = stablehlo.add %_, %_ : ...`
+# or HLO `%_ = f32[8]{0} add(f32[8]{0} %_, ...)`
+_OP_RE = re.compile(r"^%_ =\s*(?:[\w\[\]{},]+\s+)?([\w.\-]+)")
+
+
+def canonical_lines(program_text: str) -> list[str]:
+    """Program text with SSA ids, source metadata and whitespace
+    normalized away — two builds of the same program canonicalize
+    identically even across process restarts."""
+    out = []
+    for line in program_text.splitlines():
+        s = line.strip()
+        if not s or s.startswith(("//", "#")):
+            continue
+        s = _METADATA_RE.sub("", s)
+        s = _LOC_RE.sub("", s)
+        s = _SSA_RE.sub("%_", s)
+        s = _WS_RE.sub(" ", s).strip()
+        out.append(s)
+    return out
+
+
+def _op_kinds(lines: list[str]) -> list[str]:
+    kinds = []
+    for s in lines:
+        m = _OP_RE.match(s)
+        if m:
+            kinds.append(m.group(1))
+    return kinds
+
+
+def program_fingerprint(program_text: str, *, rank: int = 0,
+                        label: str = "") -> dict:
+    """Canonical fingerprint of a lowered program: a hash over the
+    canonical text plus the op-kind sequence AND the canonical lines —
+    both ride along so a mismatch can name the divergent instruction
+    even when the op-kind sequences agree (shape-only drift: same ops,
+    different batch/seq dims)."""
+    lines = canonical_lines(program_text)
+    digest = hashlib.sha256("\n".join(lines).encode()).hexdigest()
+    return {"hash": digest, "ops": _op_kinds(lines), "lines": lines,
+            "n_lines": len(lines), "rank": int(rank), "label": label}
+
+
+def _fp_path(rendezvous_dir: str, epoch: int, rank: int) -> str:
+    return os.path.join(rendezvous_dir,
+                        f"preflight-fp-e{epoch}-rank{rank}.json")
+
+
+def publish_fingerprint(fp: dict, rendezvous_dir: str, rank: int, *,
+                        epoch: int = 0) -> str:
+    """Atomically write this rank's fingerprint into the rendezvous
+    directory (tmp + rename, the membership-file discipline)."""
+    os.makedirs(rendezvous_dir, exist_ok=True)
+    path = _fp_path(rendezvous_dir, epoch, rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(fp, f)
+    os.replace(tmp, path)
+    return path
+
+
+def exchange_fingerprints(fp: dict, rendezvous_dir: str, rank: int,
+                          nproc: int, *, epoch: int = 0,
+                          timeout_s: float = 120.0,
+                          poll_s: float = 0.05) -> dict[int, dict]:
+    """Publish this rank's fingerprint and collect every peer's.
+    Raises TimeoutError naming the ranks that never published — a rank
+    that cannot even build its program is itself the divergence.
+
+    ``rendezvous_dir`` must be unique per launch (``distributed.launch``
+    stamps a pid-suffixed directory): reusing a directory across
+    launches would let stale files from a previous fleet vouch for a
+    rank that died before publishing."""
+    publish_fingerprint(fp, rendezvous_dir, rank, epoch=epoch)
+    deadline = time.monotonic() + timeout_s
+    fps: dict[int, dict] = {int(rank): fp}
+    while True:
+        missing = []
+        for r in range(nproc):
+            if r in fps:
+                continue
+            try:
+                with open(_fp_path(rendezvous_dir, epoch, r)) as f:
+                    fps[r] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                missing.append(r)
+        if not missing:
+            return fps
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"preflight rendezvous: rank(s) {missing} published no "
+                f"program fingerprint within {timeout_s:.0f}s")
+        time.sleep(poll_s)
+
+
+def _first_diff(a: list[str], b: list[str]) -> tuple[int, str, str] | None:
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            return i, x, y
+    if len(a) == len(b):
+        return None
+    i = min(len(a), len(b))
+    return (i, a[i] if i < len(a) else "<end-of-program>",
+            b[i] if i < len(b) else "<end-of-program>")
+
+
+def _named_diff(fp_a: dict, fp_b: dict) -> str:
+    """Human-readable first divergence between two fingerprints: try
+    the op-kind sequences, and when those agree (shape-only drift —
+    same ops, different dims) fall back to the canonical LINES so the
+    actual mismatching instruction is still named."""
+    d = _first_diff(list(fp_a.get("ops") or []), list(fp_b.get("ops") or []))
+    if d is not None:
+        i, theirs, ours = d
+        return f"op[{i}]: {theirs} vs {ours}"
+    d = _first_diff(list(fp_a.get("lines") or []),
+                    list(fp_b.get("lines") or []))
+    if d is not None:
+        i, theirs, ours = d
+        return f"line[{i}]: {theirs[:80]} vs {ours[:80]}"
+    return "op kinds agree — divergence is in canonicalized text not " \
+           "captured line-wise (constants/attributes)"
+
+
+def divergence_pass(fps: dict[int, dict],
+                    name: str = "train_step") -> list[Finding]:
+    """Compare every rank's fingerprint; one finding per divergent hash
+    group, named by the first op where it parts from the majority
+    program (ties break toward the lowest-rank group — rank 0 is the
+    reference the launcher seeded)."""
+    by_hash: dict[str, list[int]] = {}
+    for r, fp in fps.items():
+        by_hash.setdefault(str(fp.get("hash")), []).append(int(r))
+    if len(by_hash) <= 1:
+        return []
+    ref_hash = max(by_hash, key=lambda h: (len(by_hash[h]),
+                                           -min(by_hash[h])))
+    ref_rank = min(by_hash[ref_hash])
+    findings = []
+    for h, ranks in sorted(by_hash.items(), key=lambda kv: min(kv[1])):
+        if h == ref_hash:
+            continue
+        low = min(ranks)
+        named = _named_diff(fps[low], fps[ref_rank])
+        ranks_s = ",".join(str(r) for r in sorted(ranks))
+        findings.append(Finding(
+            "GL-P-DIVERGE", _pname(name), 0, f"rank-{low}",
+            f"rank(s) {ranks_s} traced a DIFFERENT program than rank "
+            f"{ref_rank} (hash {h[:12]} vs {ref_hash[:12]}; first "
+            f"divergence at {named}) — a fleet mixing these programs "
+            f"deadlocks in its first collective; align configs/env "
+            f"before launch"))
+    return finalize(findings)

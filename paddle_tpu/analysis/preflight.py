@@ -11,21 +11,43 @@ dtype), lowers it once, and runs:
 - ``GL-P-DONATE``  over the lowered StableHLO (un-donated update-size
   buffers);
 - ``GL-P-UPCAST``  over the jaxpr when the run declared bf16 compute;
+- ``GL-P-MEM``     static per-device memory accounting (params +
+  optimizer slots under the active zero mode + activation liveness
+  from the jaxpr, refined by XLA's ``memory_analysis`` when the step
+  compiles) against the ``--hbm_gb`` budget, plus per-``pallas_call``
+  VMEM footprints against ``--vmem_mb``;
+- ``GL-P-SHARD``   sharding-flow over the lowering when the data axis
+  is live: large ``{replicated}`` pins and partitioner-inserted
+  all-gathers that are not donated-parameter types;
+- ``GL-RNG``       the per-replica fold-in discipline of shard_map
+  regions that draw random bits;
 - ``GL-P-COLL``    when ``zero >= 2`` on a multi-device pure-data mesh:
   both ZeRO lowerings (explicit shard_map and GSPMD constraints) are
   built and their collective sequences compared — the multi-host
   deadlock class;
+- ``GL-P-DIVERGE`` when launched as one rank of a fleet (``nproc > 1``
+  with a rendezvous directory): the canonicalized-HLO fingerprint is
+  exchanged with every peer and a rank that traced a different program
+  aborts preflight with a named diff instead of deadlocking in the
+  first collective;
 - ``GL-P-RECOMPILE`` over the probe-signature set (the step's own feed
   signature plus any caller-supplied set, e.g. a resumed run's
-  ``SGD._compiled_sigs``).
+  ``SGD._compiled_sigs``);
+- the same SYNC/BUILD checks over the EVAL step (``build_eval_step``)
+  — eval programs can host-sync or fail to build independently of the
+  train step.
 
 ``inject`` (the ``preflight_inject`` flag; TESTING ONLY) seeds a
-deterministic defect — ``host_sync`` wraps the step with a host
-callback, ``collective_mismatch`` perturbs the GSPMD sequence — so the
-regression tests can prove each check fires through the real CLI.
+deterministic defect — ``host_sync`` wraps the train step with a host
+callback, ``host_sync_eval`` wraps the eval step, ``collective_
+mismatch`` perturbs the GSPMD sequence, ``rank_divergence`` perturbs
+every non-zero rank's program fingerprint — so the regression tests
+can prove each check fires through the real CLI.
 
-One ``kind="preflight"`` telemetry record (schema /7) is emitted per
-run with the per-rule counts and unsuppressed finding ids.
+One ``kind="preflight"`` telemetry record (schema /9) is emitted per
+run with the per-rule counts, the unsuppressed finding ids and the
+GL-P-MEM memory report (rendered as a budget table by
+``tools/metrics_to_md.py``).
 """
 
 from __future__ import annotations
@@ -36,6 +58,15 @@ from paddle_tpu.analysis.core import (
     load_baseline,
 )
 from paddle_tpu.analysis.core import finalize as finalize_build
+from paddle_tpu.analysis.diverge import (
+    divergence_pass,
+    exchange_fingerprints,
+    program_fingerprint,
+)
+from paddle_tpu.analysis.memory import (
+    memory_budget_pass,
+    memory_report,
+)
 from paddle_tpu.analysis.program import (
     collective_sequence_from_hlo_text,
     collective_sequence_from_jaxpr,
@@ -45,6 +76,11 @@ from paddle_tpu.analysis.program import (
     host_sync_pass,
     recompile_hazard_pass,
 )
+from paddle_tpu.analysis.rng import rng_fold_pass
+from paddle_tpu.analysis.sharding import sharding_flow_pass
+
+_INJECT_KINDS = ("", "host_sync", "host_sync_eval", "collective_mismatch",
+                 "rank_divergence")
 
 
 def _feed_signature(feed: dict) -> tuple:
@@ -58,16 +94,24 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
                       sync_period: int | None = None,
                       signatures=None, inject: str = "",
                       name: str = "train_step",
-                      min_donate_bytes: int = 1 << 20) -> list[Finding]:
+                      min_donate_bytes: int = 1 << 20,
+                      hbm_gb: float = 0.0, vmem_mb: float = 128.0,
+                      shard_min_bytes: int = 1 << 20,
+                      include_eval: bool = True,
+                      rendezvous_dir: str = "", rank: int = 0,
+                      nproc: int = 1, rendezvous_epoch: int = 0,
+                      report_out: dict | None = None) -> list[Finding]:
     """Build the configured train step and run every applicable program
-    pass; returns the raw findings (caller applies the baseline)."""
+    pass; returns the raw findings (caller applies the baseline).
+    ``report_out`` (a dict) receives the GL-P-MEM memory report for the
+    telemetry record."""
     import jax
 
     from paddle_tpu.core import parameters as _params_mod
     from paddle_tpu.parallel import mesh as mesh_mod
-    from paddle_tpu.trainer.step import build_train_step
+    from paddle_tpu.trainer.step import build_eval_step, build_train_step
 
-    if inject not in ("", "host_sync", "collective_mismatch"):
+    if inject not in _INJECT_KINDS:
         raise ValueError(f"unknown preflight_inject {inject!r}")
     mesh = mesh if mesh is not None else mesh_mod.get_mesh()
     dp = mesh.mesh.shape.get("data", 1)
@@ -100,24 +144,87 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
             f"train step failed to trace ({type(e).__name__}: {e}) — "
             f"the configured program cannot be built"))
         return finalize_build(findings)
+    # trace ONCE: every jaxpr-level pass below accepts the pre-made
+    # ClosedJaxpr (jaxpr_of pass-through) — retracing a big step per
+    # pass would multiply seconds of pure tracing 3-4x per preflight
+    from paddle_tpu.analysis.program import jaxpr_of
+
+    step_jx = jaxpr_of(step, *args)
+    lowered = None
+    lowered_text = None
     try:
-        lowered_text = step.lower(*args).as_text()
+        lowered = step.lower(*args)
+        lowered_text = lowered.as_text()
     except Exception as e:
         findings.append(Finding(
             "GL-P-DONATE", f"<program:{name}>", 0, "lowering",
             f"step failed to lower for the donation check ({e}) — the "
             f"program cannot be statically audited"))
-        lowered_text = None
     if lowered_text is not None:
         findings += donation_pass(lowered_text, name=name,
                                   min_bytes=min_donate_bytes)
     bf16 = compute_dtype is not None and "bfloat16" in str(compute_dtype)
     if bf16:
-        findings += f32_upcast_pass(step, *args, name=name)
+        findings += f32_upcast_pass(step_jx, name=name)
+
+    # GL-RNG: the per-replica fold-in discipline of any shard_map region
+    # that draws (dropout under the explicit ZeRO lowering)
+    findings += rng_fold_pass(step_jx, name=name)
+
+    # GL-P-MEM: the static budget.  The compile (for XLA's own temp-size
+    # accounting and the GL-P-SHARD reshard scan) is best-effort — a
+    # backend that cannot compile here still gets the jaxpr-walk numbers.
+    compiled = None
+    compiled_text = None
+    if lowered is not None:
+        try:
+            compiled = lowered.compile()
+            compiled_text = compiled.as_text()
+        except Exception as e:
+            from paddle_tpu.core import logger as log
+
+            log.debug("preflight compile unavailable (%s); jaxpr-level "
+                      "checks stand", e)
+            compiled = None
+    report = memory_report(params, opt_state, states, feed, mesh,
+                           zero=zero, step=step_jx, args=(),
+                           compiled=compiled)
+    if report_out is not None:
+        report_out.update(report)
+    findings += memory_budget_pass(report, name=name, hbm_gb=hbm_gb,
+                                   vmem_mb=vmem_mb)
+
+    # GL-P-SHARD: sharding flow of the program that will actually run —
+    # only meaningful with a live data axis (dp == 1 has no resharding)
+    if dp > 1:
+        findings += sharding_flow_pass(lowered_text, compiled_text,
+                                       name=name,
+                                       min_bytes=shard_min_bytes)
 
     sigs = list(signatures or [])
     sigs.append(_feed_signature(feed))
     findings += recompile_hazard_pass(sigs, name=name)
+
+    # the EVAL program is built/compiled independently of the train step
+    # (trainer.test, declared evaluators) and can host-sync on its own
+    if include_eval:
+        eval_step = build_eval_step(topology, mesh)
+        eval_args = (params, states, feed)
+        eval_probe = eval_step
+        if inject == "host_sync_eval":
+            def eval_probe(*a):  # noqa: F811
+                jax.debug.callback(lambda: None)
+                return eval_step(*a)
+        try:
+            findings += host_sync_pass(eval_probe, *eval_args,
+                                       name="eval_step",
+                                       sync_period=sync_period)
+        except Exception as e:
+            findings.append(Finding(
+                "GL-P-BUILD", "<program:eval_step>", 0, "trace",
+                f"eval step failed to trace ({type(e).__name__}: {e}) "
+                f"— trainer.test / the declared evaluators would die "
+                f"on their first batch"))
 
     from paddle_tpu.parallel import zero as zero_mod
 
@@ -146,14 +253,38 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
         findings += compare_collective_lowerings(
             seq, ["all_gather"], name=name,
             label_a="shard_map", label_b="gspmd")
+
+    # GL-P-DIVERGE: fingerprint this rank's program and rendezvous with
+    # every peer — a fleet must agree on the program BEFORE the first
+    # collective, not deadlock inside it
+    if nproc > 1 and rendezvous_dir:
+        fp_text = (lowered_text if lowered_text is not None
+                   else str(step_jx))
+        fp = program_fingerprint(fp_text, rank=rank, label=name)
+        if inject == "rank_divergence" and rank != 0:
+            # the seeded config-drift defect: this rank's program
+            # carries one extra op nobody else traced
+            fp["ops"] = fp["ops"] + ["chaos.divergence"]
+            fp["hash"] = f"chaos-{fp['hash'][:32]}-r{rank}"
+        try:
+            fps = exchange_fingerprints(fp, rendezvous_dir, rank, nproc,
+                                        epoch=rendezvous_epoch)
+            findings += divergence_pass(fps, name=name)
+        except TimeoutError as e:
+            findings.append(Finding(
+                "GL-P-DIVERGE", f"<program:{name}>", 0, "rendezvous",
+                f"{e} — a rank that cannot publish its program is "
+                f"itself the divergence; do not launch"))
     return findings
 
 
 def emit_preflight_record(findings, suppressed, *, registry=None,
-                          run: str = "preflight", config: str = "") -> dict:
-    """One schema/7 ``kind="preflight"`` record: per-rule counts, the
-    unsuppressed finding ids, clean flag — rendered by
-    ``tools/metrics_to_md.py``'s Preflight table."""
+                          run: str = "preflight", config: str = "",
+                          memory: dict | None = None) -> dict:
+    """One schema/9 ``kind="preflight"`` record: per-rule counts, the
+    unsuppressed finding ids, clean flag — plus the GL-P-MEM ``memory``
+    budget report — rendered by ``tools/metrics_to_md.py``'s Preflight
+    tables."""
     from paddle_tpu import metrics as metrics_mod
 
     reg = registry or metrics_mod.get_registry()
@@ -169,6 +300,8 @@ def emit_preflight_record(findings, suppressed, *, registry=None,
         "by_rule": by_rule,
         "ids": [f.fid for f in findings[:32]],
     }
+    if memory:
+        rec["memory"] = dict(memory)
     if reg.active:
         return reg.emit(rec, kind="preflight")
     return rec
@@ -179,14 +312,23 @@ def run_preflight(topology, optimizer, feed, mesh=None, *,
                   sync_period: int | None = None, inject: str = "",
                   baseline_path: str | None = None, registry=None,
                   config: str = "", name: str = "train_step",
+                  hbm_gb: float = 0.0, vmem_mb: float = 128.0,
+                  include_eval: bool = True,
+                  rendezvous_dir: str = "", rank: int = 0, nproc: int = 1,
+                  rendezvous_epoch: int = 0,
                   ) -> tuple[list[Finding], list[Finding]]:
     """The full `trainer --preflight` flow: build + analyze + baseline +
     telemetry.  Returns (unsuppressed, suppressed)."""
+    report: dict = {}
     raw = trainer_preflight(
         topology, optimizer, feed, mesh, zero=zero,
         compute_dtype=compute_dtype, sync_period=sync_period,
-        inject=inject, name=name)
+        inject=inject, name=name, hbm_gb=hbm_gb, vmem_mb=vmem_mb,
+        include_eval=include_eval, rendezvous_dir=rendezvous_dir,
+        rank=rank, nproc=nproc, rendezvous_epoch=rendezvous_epoch,
+        report_out=report)
     unsup, sup, _stale = apply_baseline(
         raw, load_baseline(baseline_path), full_run=False)
-    emit_preflight_record(unsup, sup, registry=registry, config=config)
+    emit_preflight_record(unsup, sup, registry=registry, config=config,
+                          memory=report)
     return unsup, sup

@@ -55,24 +55,32 @@ def jaxpr_of(fn_or_jaxpr, *args, **kwargs):
     return jax.make_jaxpr(fn_or_jaxpr)(*args, **kwargs)
 
 
+def inner_jaxprs(eqn):
+    """Sub-jaxprs of one equation (pjit bodies, shard_map regions,
+    scan/while/cond branches, custom_vjp calls) — THE one place that
+    knows how sub-jaxprs hang off ``eqn.params`` (every analysis
+    traversal builds on it)."""
+    for v in eqn.params.values():
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            yield inner
+        elif hasattr(v, "eqns"):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield inner
+                elif hasattr(item, "eqns"):
+                    yield item
+
+
 def _walk_eqns(jaxpr):
-    """Depth-first over every eqn including sub-jaxprs (pjit bodies,
-    shard_map regions, scan/while/cond branches, custom_vjp calls)."""
+    """Depth-first over every eqn including sub-jaxprs."""
     for eqn in jaxpr.eqns:
         yield eqn
-        for v in eqn.params.values():
-            inner = getattr(v, "jaxpr", None)
-            if inner is not None and hasattr(inner, "eqns"):
-                yield from _walk_eqns(inner)
-            elif hasattr(v, "eqns"):
-                yield from _walk_eqns(v)
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    inner = getattr(item, "jaxpr", None)
-                    if inner is not None and hasattr(inner, "eqns"):
-                        yield from _walk_eqns(inner)
-                    elif hasattr(item, "eqns"):
-                        yield from _walk_eqns(item)
+        for sub in inner_jaxprs(eqn):
+            yield from _walk_eqns(sub)
 
 
 # -- GL-P-SYNC ------------------------------------------------------------------

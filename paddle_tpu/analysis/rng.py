@@ -1,0 +1,305 @@
+"""GL-RNG — PRNG key-discipline passes (AST + jaxpr).
+
+The repo's randomness convention is the reference's per-thread streams
+rebuilt functionally: ONE seed enters at the edge (``core/rng.seed``,
+``ServingConfig.seed``), and every consumer derives its stream with
+``fold_in`` — per-layer (``LayerContext.key_for``), per-timestep
+(``recurrent_group``), per-replica (the explicit ZeRO lowering folds
+``axis_index("data")``), per-request/per-token (``serving/sampling``).
+Two failure classes silently break it:
+
+- **key reuse** — the same key drawn from twice yields correlated
+  samples (dropout masks equal across layers, every sampled token the
+  same draw).  The AST check flags a key name passed to two
+  ``jax.random`` draws with no re-derivation between them (branch-
+  aware: draws on mutually exclusive ``if``/``else`` arms share a key
+  legitimately).
+- **raw key literals** — ``jax.random.key(0)``/``PRNGKey(0)`` feeding
+  a draw inside library code pins every run/replica to the same
+  stream.  Literal keys are fine as *seeds* (CLI entry points,
+  deterministic eval forwards that never draw); a literal that reaches
+  a draw is the defect.
+
+The jaxpr side (:func:`rng_fold_pass`) checks the per-replica
+discipline the explicit data-parallel lowering established: a
+``shard_map`` region over the data axis that DRAWS random bits without
+folding ``axis_index`` into its key gives every replica the same
+dropout mask — a silent effective-batch reduction no test notices.
+
+Scope: the modules that own the fold-in convention
+(:data:`RNG_MODULES`), the GL-THREAD model of scoping.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from paddle_tpu.analysis.core import Finding, finalize
+
+# the subsystems that own key derivation/consumption
+RNG_MODULES = (
+    "paddle_tpu/core/rng.py",
+    "paddle_tpu/layers/api.py",
+    "paddle_tpu/layers/base.py",
+    "paddle_tpu/layers/recurrent_group.py",
+    "paddle_tpu/ops/nn.py",
+    "paddle_tpu/serving/sampling.py",
+    "paddle_tpu/serving/engine.py",
+    "paddle_tpu/resilience/chaos.py",
+    "paddle_tpu/trainer/step.py",
+)
+
+# jax.random samplers that CONSUME a key (fold_in/split DERIVE and are
+# the sanctioned re-use points)
+_DRAW_FNS = frozenset({
+    "normal", "uniform", "bernoulli", "categorical", "randint",
+    "truncated_normal", "gumbel", "choice", "permutation", "exponential",
+    "laplace", "gamma", "beta", "poisson", "bits", "rademacher",
+})
+_KEY_CTORS = frozenset({"key", "PRNGKey"})
+
+
+def _is_random_attr(fn: ast.AST, names: frozenset) -> bool:
+    """``jax.random.<name>`` / bare ``random.<name>`` (the
+    ``from jax import random`` spelling) call heads — NOT
+    ``np.random.<name>``: numpy samplers take distribution params, not
+    keys, so matching them would read a mean/sigma as a reused key."""
+    if not (isinstance(fn, ast.Attribute) and fn.attr in names):
+        return False
+    base = fn.value
+    if isinstance(base, ast.Name):
+        return base.id == "random"
+    if isinstance(base, ast.Attribute):
+        return (base.attr == "random"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "jax")
+    return False
+
+
+def _is_literal_key_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _is_random_attr(node.func, _KEY_CTORS)
+            and node.args
+            and all(isinstance(a, ast.Constant) for a in node.args))
+
+
+def _draw_key_expr(call: ast.Call) -> ast.AST | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+def _paths_exclusive(pa: tuple, pb: tuple) -> bool:
+    """True when two branch paths sit on mutually exclusive arms of the
+    same conditional — the one legitimate same-key-two-draws shape."""
+    for a, b in zip(pa, pb):
+        if a == b:
+            continue
+        return a[0] == b[0]  # same If node, different arms: exclusive
+    return False
+
+
+class _FnRng(ast.NodeVisitor):
+    """RNG events of ONE function body (nested defs/lambdas are their
+    own units): key-name assignments, draw uses with their branch path,
+    names bound to literal keys."""
+
+    def __init__(self):
+        self.assigns: list[tuple[str, int]] = []
+        self.uses: list[tuple[str, int, tuple]] = []   # (name, line, path)
+        self.literal_draws: list[int] = []             # draw lines
+        self.litkey_lines: dict[str, int] = {}         # name -> bind line
+        self._path: tuple = ()
+
+    # nested functions/lambdas are separate units
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def visit_If(self, node: ast.If):
+        self.visit(node.test)
+        base = self._path
+        self._path = base + ((id(node), "if"),)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._path = base + ((id(node), "else"),)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self._path = base
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.assigns.append((node.id, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        if _is_literal_key_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.litkey_lines[t.id] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if _is_random_attr(node.func, _DRAW_FNS):
+            kexpr = _draw_key_expr(node)
+            if kexpr is not None and _is_literal_key_call(kexpr):
+                self.literal_draws.append(node.lineno)
+            elif isinstance(kexpr, ast.Name):
+                self.uses.append((kexpr.id, node.lineno, self._path))
+        self.generic_visit(node)
+
+
+def _function_units(tree: ast.AST):
+    """(qualname, body statements) per function, nested defs collapsed
+    out of their parents (each body analyzed exactly once)."""
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield ".".join(stack + [child.name]), child
+                yield from walk(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, stack + [child.name])
+            else:
+                yield from walk(child, stack)
+
+    yield from walk(tree, [])
+
+
+def pass_rng_discipline(corpus, root,
+                        modules: tuple = RNG_MODULES) -> list[Finding]:
+    findings = []
+    for rel in modules:
+        if rel not in corpus:
+            continue
+        _src, tree = corpus[rel]
+        for qual, fn in _function_units(tree):
+            v = _FnRng()
+            for stmt in fn.body:
+                v.visit(stmt)
+            for line in v.literal_draws:
+                findings.append(Finding(
+                    "GL-RNG", rel, line, qual,
+                    "a literal-seeded key (jax.random.key/PRNGKey of a "
+                    "constant) feeds a random draw — every run and "
+                    "every replica gets the same stream; derive the key "
+                    "from the step/request key via fold_in"))
+            used_lit: set[str] = set()
+            for name, line, _path in v.uses:
+                bind = v.litkey_lines.get(name)
+                if bind is not None and bind < line and name not in used_lit:
+                    used_lit.add(name)
+                    findings.append(Finding(
+                        "GL-RNG", rel, line, qual,
+                        f"key `{name}` is bound to a literal seed and "
+                        f"then drawn from — a fixed stream in library "
+                        f"code; thread the caller's key in and fold_in "
+                        f"a scope instead"))
+            reused: set[str] = set()
+            uses_by_name: dict[str, list] = {}
+            for name, line, path in sorted(v.uses, key=lambda u: u[1]):
+                uses_by_name.setdefault(name, []).append((line, path))
+            for name, uses in uses_by_name.items():
+                if name in used_lit:
+                    continue
+                for (l1, p1), (l2, p2) in zip(uses, uses[1:]):
+                    if name in reused:
+                        break
+                    if any(l1 < al <= l2 for an, al in v.assigns
+                           if an == name):
+                        continue  # re-derived between the draws
+                    if _paths_exclusive(p1, p2):
+                        continue  # if/else arms: only one executes
+                    reused.add(name)
+                    findings.append(Finding(
+                        "GL-RNG", rel, l2, qual,
+                        f"key `{name}` is consumed by two random draws "
+                        f"(lines {l1} and {l2}) without an intervening "
+                        f"split/fold_in — the draws are identical/"
+                        f"correlated; derive a fresh subkey per draw"))
+    return findings
+
+
+# -- jaxpr side: the per-replica fold-in discipline -----------------------------
+
+_DRAW_PRIMS = frozenset({"random_bits", "threefry2x32", "random_gamma"})
+_FOLD_PRIMS = frozenset({"random_fold_in"})
+
+
+def _inner_jaxprs(eqn):
+    from paddle_tpu.analysis.program import inner_jaxprs
+
+    return inner_jaxprs(eqn)
+
+
+def _is_lit(v) -> bool:
+    return hasattr(v, "val")
+
+
+def _fold_sees_axis(jx, tainted: set | None = None) -> bool:
+    """True when some ``random_fold_in`` consumes a value derived from
+    ``axis_index`` — the per-replica key derivation.  Taint propagates
+    through every eqn that touches a tainted var, including positionally
+    into sub-jaxpr bodies (pjit-wrapped fold_in helpers)."""
+    tainted = set(tainted or ())
+    for eqn in jx.eqns:
+        pname = eqn.primitive.name
+        if pname == "axis_index":
+            tainted.update(eqn.outvars)
+            continue
+        hit = any((not _is_lit(v)) and v in tainted for v in eqn.invars)
+        if not hit:
+            continue
+        if pname in _FOLD_PRIMS:
+            return True
+        for sub in _inner_jaxprs(eqn):
+            if len(sub.invars) == len(eqn.invars):
+                inner_tainted = {sub.invars[i]
+                                 for i, v in enumerate(eqn.invars)
+                                 if (not _is_lit(v)) and v in tainted}
+            else:  # unknown calling convention: taint everything
+                inner_tainted = set(sub.invars)
+            if _fold_sees_axis(sub, inner_tainted):
+                return True
+        tainted.update(eqn.outvars)
+    return False
+
+
+def rng_fold_pass(fn_or_jaxpr, *args, name: str = "step",
+                  axis: str = "data") -> list[Finding]:
+    """Flag a ``shard_map`` region over ``axis`` that draws random bits
+    without folding ``axis_index(axis)`` into its key: every replica
+    draws the SAME dropout mask/noise, silently collapsing the
+    independent per-replica streams the reference's per-thread RNGs
+    (and the explicit ZeRO lowering) guarantee."""
+    from paddle_tpu.analysis.program import _walk_eqns, jaxpr_of
+
+    jaxpr = jaxpr_of(fn_or_jaxpr, *args)
+    findings = []
+    for eqn in _walk_eqns(jaxpr.jaxpr):
+        if eqn.primitive.name != "shard_map":
+            continue
+        mesh = eqn.params.get("mesh")
+        axis_names = tuple(getattr(mesh, "axis_names", ()) or ())
+        if axis_names and axis not in axis_names:
+            continue
+        body = next(iter(_inner_jaxprs(eqn)), None)
+        if body is None:
+            continue
+        draws = any(e.primitive.name in _DRAW_PRIMS
+                    for e in _walk_eqns(body))
+        if draws and not _fold_sees_axis(body):
+            findings.append(Finding(
+                "GL-RNG", f"<program:{name}>", 0, "shard-fold",
+                f"a shard_map region over '{axis}' draws random bits "
+                f"without folding axis_index('{axis}') into its key — "
+                f"every replica draws the SAME mask/noise; fold the "
+                f"replica index in (trainer/step.py's explicit-lowering "
+                f"convention)"))
+    return finalize(findings)

@@ -1,0 +1,165 @@
+"""GL-P-SHARD — sharding-flow analysis over the GSPMD lowering.
+
+The GSPMD partitioner (the machinery arxiv 2004.13336's weight-update
+sharding directs with constraints) is free to satisfy a program's
+sharding annotations by materializing replicated copies or inserting
+resharding collectives the author never asked for.  Small ones are
+noise; big ones are exactly the residency/traffic ZeRO-3 parameter
+sharding exists to remove.  This pass statically flags, over the
+lowered StableHLO (pre-partitioning, where ``mhlo.sharding``
+annotations live) and the compiled HLO (post-partitioning, where the
+inserted collectives live):
+
+- ``replicated:<type>``  an intermediate explicitly constrained
+  ``{replicated}`` of at least ``min_bytes`` whose type is NOT one of
+  the donated entry arguments (params/opt-state flowing through are
+  *sanctioned* replicated until ZeRO-3 exists — they are the donation
+  pass's business, not this one's);
+- ``reshard:<type>``     an ``all-gather`` the partitioner inserted
+  whose output is at least ``min_bytes`` and is neither a donated-
+  parameter type (the ZeRO param all-gather) nor on the caller's
+  ``allowlist`` — an implicit resharding of activations/intermediates
+  that multiplies step traffic without appearing anywhere in the
+  source program.
+
+Both checks are byte-gated (default 1 MiB, like GL-P-DONATE): on test-
+sized programs the partitioner's small boundary gathers are healthy;
+at model scale the same pattern is the regression this pass exists to
+catch before the step runs.
+"""
+
+from __future__ import annotations
+
+import re
+
+from paddle_tpu.analysis.core import Finding, finalize
+from paddle_tpu.analysis.program import (
+    _DTYPE_BYTES,
+    _parse_main_args,
+    _tensor_bytes,
+)
+
+
+def _pname(name: str) -> str:
+    return f"<program:{name}>"
+
+
+# stablehlo tensor type "64x128xf32" -> normalized "f32[64,128]"
+def _normalize_tensor(ty: str) -> str:
+    parts = ty.split("x")
+    return f"{parts[-1]}[{','.join(parts[:-1])}]"
+
+
+_REPLICATED_CC_RE = re.compile(
+    r'@Sharding\(%[\w.#]+\)\s*(\{[^\n]*?mhlo\.sharding\s*=\s*'
+    r'"\{replicated\}"[^\n]*?\})\s*:\s*\([^)]*\)\s*->\s*tensor<([^>]+)>')
+
+# compiled-HLO all-gather ops, sync `%x = f32[64,128]{1,0} all-gather(`
+# AND async-start `%x = (f32[64,16], f32[64,128]) all-gather-start(` —
+# TPU HLO emits the async pair by default, and `-done` lines reference
+# the same result so only the defining op is counted
+_HLO_AG_OP_RE = re.compile(r"\sall-gather(?:-start)?\(")
+_HLO_TYPE_RE = re.compile(
+    r"([a-z]+[0-9]*(?:e[0-9]+m[0-9]+(?:fn)?)?)\[([0-9,]*)\]")
+
+_HLO_DTYPE_BYTES = dict(_DTYPE_BYTES, pred=1, s64=8, s32=4, s16=2, s8=1,
+                        u64=8, u32=4, u16=2, u8=1)
+
+
+def donated_entry_types(stablehlo_text: str) -> set[str]:
+    """Normalized types (``f32[64,128]``) of @main arguments marked
+    donated (``tf.aliasing_output``/``jax.buffer_donor``) — the
+    update-in-place params/opt-state whose replication is sanctioned
+    pre-ZeRO-3."""
+    main = stablehlo_text.split("func.func public @main", 1)
+    if len(main) < 2:
+        return set()
+    sig = main[1].split("\n", 1)[0]
+    out = set()
+    for _idx, ty, attrs in _parse_main_args(sig):
+        if "tf.aliasing_output" in attrs or "jax.buffer_donor" in attrs:
+            out.add(_normalize_tensor(ty))
+    return out
+
+
+def replicated_intermediates(stablehlo_text: str,
+                             min_bytes: int) -> list[tuple[str, int]]:
+    """(normalized type, bytes) per ``{replicated}`` sharding constraint
+    of at least ``min_bytes`` — explicit replication pins in the traced
+    program."""
+    out = []
+    for m in _REPLICATED_CC_RE.finditer(stablehlo_text):
+        ty = m.group(2)
+        nbytes = _tensor_bytes(ty)
+        if nbytes >= min_bytes:
+            out.append((_normalize_tensor(ty), nbytes))
+    return out
+
+
+def inserted_gathers(compiled_text: str,
+                     min_bytes: int) -> list[tuple[str, int]]:
+    """(normalized type, bytes) per ``all-gather`` in the compiled HLO
+    whose output is at least ``min_bytes`` — the partitioner's
+    materialization points."""
+    out = []
+    for line in compiled_text.splitlines():
+        m = _HLO_AG_OP_RE.search(line)
+        if not m or "=" not in line[:m.start()]:
+            continue
+        # result type(s) sit between `=` and the op name; the async
+        # start form is a tuple (operand alias, gathered result) — the
+        # materialized output is the LARGEST element
+        head = line[line.index("=") + 1:m.start()]
+        best: tuple[str, int] | None = None
+        for dtype, dims in _HLO_TYPE_RE.findall(head):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes = n * _HLO_DTYPE_BYTES.get(dtype, 4)
+            if best is None or nbytes > best[1]:
+                best = (f"{dtype}[{dims}]", nbytes)
+        if best is not None and best[1] >= min_bytes:
+            out.append(best)
+    return out
+
+
+def sharding_flow_pass(stablehlo_text: str | None,
+                       compiled_text: str | None = None,
+                       name: str = "train_step", *,
+                       min_bytes: int = 1 << 20,
+                       allowlist: tuple = ()) -> list[Finding]:
+    """Run both sharding-flow checks; either text may be None (the
+    corresponding check is skipped).  ``allowlist`` entries are
+    normalized type strings (``f32[1024,4096]``) the operator has
+    reviewed and accepted."""
+    findings: list[Finding] = []
+    allowed: set[str] = set(allowlist)
+    if stablehlo_text:
+        allowed |= donated_entry_types(stablehlo_text)
+        seen: set[str] = set()
+        for ty, nbytes in replicated_intermediates(stablehlo_text,
+                                                   min_bytes):
+            if ty in allowed or ty in seen:
+                continue
+            seen.add(ty)
+            findings.append(Finding(
+                "GL-P-SHARD", _pname(name), 0, f"replicated:{ty}",
+                f"intermediate {ty} ({nbytes / 1e6:.1f} MB) is pinned "
+                f"{{replicated}} on every device — a full copy per "
+                f"rank; shard it along a mesh axis or allowlist the "
+                f"type with a reason"))
+    if compiled_text:
+        seen = set()
+        for ty, nbytes in inserted_gathers(compiled_text, min_bytes):
+            if ty in allowed or ty in seen:
+                continue
+            seen.add(ty)
+            findings.append(Finding(
+                "GL-P-SHARD", _pname(name), 0, f"reshard:{ty}",
+                f"the partitioner inserted an all-gather materializing "
+                f"{ty} ({nbytes / 1e6:.1f} MB) that is not a donated "
+                f"parameter type — an implicit resharding the source "
+                f"program never asked for; align the producer/consumer "
+                f"shardings or allowlist the type with a reason"))
+    return finalize(findings)

@@ -246,8 +246,24 @@ define("fused_kernels", "auto", "route conv/BN/optimizer hot paths through "
 # program passes run by `trainer --preflight` before any step executes
 define("preflight_inject", "", "seed a deterministic defect into the "
                                "preflight program checks to prove they "
-                               "fire: host_sync | collective_mismatch "
+                               "fire: host_sync | host_sync_eval | "
+                               "collective_mismatch | rank_divergence "
                                "(TESTING ONLY)")
+define("hbm_gb", 0.0, "per-device HBM budget for the GL-P-MEM preflight "
+                      "check: static params + optimizer slots (under the "
+                      "active zero mode) + activation liveness must fit "
+                      "(0 = report only, no gate)")
+define("vmem_mb", 128.0, "per-kernel VMEM budget for the GL-P-MEM "
+                         "preflight check: each pallas_call's static "
+                         "block footprint must fit (0 = no gate; v5e "
+                         "cores carry 128 MB)")
+define("preflight_rendezvous", "", "shared directory where preflight "
+                                   "ranks exchange program fingerprints "
+                                   "(GL-P-DIVERGE); with "
+                                   "PADDLE_TPU_NPROC > 1 a rank tracing "
+                                   "a different program aborts preflight "
+                                   "instead of deadlocking in the first "
+                                   "collective")
 
 # -- env passthroughs read directly (see declare_env above) --------------------
 declare_env("PADDLE_TPU_COORDINATOR",

@@ -222,6 +222,20 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
                     epoch=membership.epoch if membership else 0)
             if membership_path:
                 child_env["PADDLE_TPU_MEMBERSHIP"] = membership_path
+            if not serving and log_dir and \
+                    "PADDLE_TPU_PREFLIGHT_RENDEZVOUS" not in child_env:
+                # arm the GL-P-DIVERGE fingerprint exchange for free on
+                # launched trainer fleets: `trainer --preflight` ranks
+                # rendezvous here and abort on a program mismatch
+                # instead of deadlocking in their first collective.
+                # The dir is unique PER LAUNCH (launcher pid): a reused
+                # --log_dir must not let this fleet read a previous
+                # launch's stale fingerprints — a rank that died before
+                # publishing would otherwise be vouched for by its
+                # predecessor's file
+                child_env["PADDLE_TPU_PREFLIGHT_RENDEZVOUS"] = \
+                    os.path.join(log_dir,
+                                 f"preflight-rendezvous-{os.getpid()}")
             p = subprocess.Popen(
                 argv, env=child_env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT)

@@ -71,7 +71,15 @@ from typing import Any
 # fleet_redial_exhausted / fleet_duplicate_results /
 # fleet_replica_down{reason} counters and the fleet_alive_replicas /
 # fleet_queue_depth gauges.
-SCHEMA = "paddle_tpu.metrics/8"
+# /9 extended the "preflight" record with the GL-P-MEM static memory
+# report (graftlint v2): a ``memory`` dict carrying the per-device byte
+# accounting of the built step — params_bytes, opt_state_bytes (under
+# the active zero mode's state_specs layout), states_bytes, feed_bytes,
+# activation_bytes (+ activation_source: jaxpr-liveness or
+# xla-memory-analysis), total_bytes, dp, zero and the per-pallas_call
+# pallas_vmem footprints — rendered as a budget table by
+# tools/metrics_to_md.py.  No new record kinds.
+SCHEMA = "paddle_tpu.metrics/9"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted

@@ -39,13 +39,19 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--job", default="train",
                    choices=["train", "test", "time", "checkgrad"])
     p.add_argument("--preflight", action="store_true",
-                   help="build the configured train step and run the "
-                        "static program checks (paddle_tpu/analysis: "
-                        "host-sync points, un-donated update buffers, "
-                        "bf16 upcasts, ZeRO collective-lowering "
-                        "mismatch) instead of training; exit 1 on any "
+                   help="build the configured train AND eval steps and "
+                        "run the static program checks (paddle_tpu/"
+                        "analysis: host-sync points, un-donated update "
+                        "buffers, bf16 upcasts, per-device memory vs "
+                        "--hbm_gb / --vmem_mb budgets, sharding-flow "
+                        "audit, RNG fold-in discipline, ZeRO collective-"
+                        "lowering mismatch, cross-rank program-"
+                        "fingerprint divergence under --preflight_"
+                        "rendezvous) instead of training; exit 1 on any "
                         "unsuppressed finding — the config_parser-style "
-                        "reject-before-running gate")
+                        "reject-before-running gate.  --hbm_gb, "
+                        "--vmem_mb and --preflight_rendezvous are "
+                        "registry flags (PADDLE_TPU_* overridable)")
     p.add_argument("--config_args", default="",
                    help="var=val,... exposed via get_config_arg")
     p.add_argument("--num_passes", type=int, default=1)
@@ -408,10 +414,19 @@ def cmd_preflight(args, parsed) -> int:
     compute_dtype = jnp.bfloat16 if _flags.get("bf16") else None
     sync_period = args.sync_period if args.sync_period is not None \
         else _flags.get("sync_period")
+    # fleet identity comes from the launcher's rendezvous env (the same
+    # vars distributed.launch stamps per rank); with a rendezvous dir
+    # and nproc > 1 the GL-P-DIVERGE fingerprint exchange is armed
+    rank = int(os.environ.get("PADDLE_TPU_TRAINER_ID", "0"))
+    nproc = int(os.environ.get("PADDLE_TPU_NPROC", "1"))
+    epoch = int(os.environ.get("PADDLE_TPU_RENDEZVOUS_EPOCH", "0"))
     unsup, sup = run_preflight(
         topo, opt, feed, mesh, zero=zero, compute_dtype=compute_dtype,
         sync_period=sync_period, inject=_flags.get("preflight_inject"),
-        config=os.path.basename(args.config))
+        config=os.path.basename(args.config),
+        hbm_gb=_flags.get("hbm_gb"), vmem_mb=_flags.get("vmem_mb"),
+        rendezvous_dir=_flags.get("preflight_rendezvous"),
+        rank=rank, nproc=nproc, rendezvous_epoch=epoch)
     for f in unsup:
         print(f.render())
     if sup:
@@ -420,7 +435,10 @@ def cmd_preflight(args, parsed) -> int:
         print(f"preflight: {len(unsup)} unsuppressed finding(s) — "
               f"fix the program or baseline them with a reason")
         return 1
-    print(f"preflight: OK — {args.config} (zero={zero}, data={dp})")
+    budget = (f", {float(_flags.get('hbm_gb')):.1f} GB budget"
+              if _flags.get("hbm_gb") else "")
+    print(f"preflight: OK — {args.config} (zero={zero}, data={dp}"
+          f"{budget})")
     return 0
 
 

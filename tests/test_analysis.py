@@ -553,13 +553,20 @@ def test_preflight_cli_clean_config_exits_zero(tmp_path):
     recs = [json.loads(line) for line in open(jsonl)]
     pf = [r for r in recs if r.get("kind") == "preflight"]
     assert pf and pf[0]["clean"] is True
-    assert pf[0]["schema"] == "paddle_tpu.metrics/8"
-    # and metrics_to_md renders it
+    assert pf[0]["schema"] == "paddle_tpu.metrics/9"
+    # the schema/9 GL-P-MEM memory report rode along
+    mem = pf[0]["memory"]
+    assert mem["params_bytes"] > 0 and mem["opt_state_bytes"] > 0
+    assert mem["total_bytes"] >= mem["params_bytes"] + mem["opt_state_bytes"]
+    assert mem["activation_source"] in ("jaxpr-liveness",
+                                        "xla-memory-analysis")
+    # and metrics_to_md renders it, budget table included
     md = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "metrics_to_md.py"),
          jsonl], capture_output=True, text=True)
     assert md.returncode == 0
     assert "Preflight (static analysis)" in md.stdout
+    assert "Memory budget (GL-P-MEM" in md.stdout
 
 
 def test_preflight_cli_catches_injected_host_sync(tmp_path):
@@ -598,3 +605,457 @@ def test_preflight_record_emission_in_process():
     assert rec["by_rule"] == {"GL-P-SYNC": 1}
     assert sink.records[-1]["ids"] == [f.fid]
     assert reg.get("preflight_findings").value(rule="GL-P-SYNC") == 1.0
+
+
+# -- 4. graftlint v2: memory / sharding / divergence / rng ----------------------
+
+
+def test_activation_liveness_walk_counts_intermediates():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import activation_peak_bytes
+
+    def f(x, w):
+        h = x @ w              # 32x128 f32 intermediate
+        h2 = jnp.tanh(h)       # second one, while h is still live
+        return (h2 * h).sum()
+
+    x, w = jnp.ones((32, 64)), jnp.ones((64, 128))
+    peak = activation_peak_bytes(jax.jit(f), x, w)
+    # h and h2 (16 KiB each) overlap; the product makes a third
+    assert peak >= 2 * 32 * 128 * 4
+    assert peak < 1 << 20
+
+
+def test_memory_budget_hbm_fires_once_with_stable_id():
+    from paddle_tpu.analysis import memory_budget_pass
+
+    report = {"zero": 0, "dp": 1, "params_bytes": 3 << 20,
+              "opt_state_bytes": 6 << 20, "states_bytes": 0,
+              "feed_bytes": 1 << 20, "activation_bytes": 2 << 20,
+              "total_bytes": 12 << 20, "pallas_vmem": []}
+    found = memory_budget_pass(report, name="p", hbm_gb=0.001)
+    assert [f.fid for f in found] == ["GL-P-MEM:<program:p>:hbm-budget"]
+    assert "0.013 GB" in found[0].message  # 12 MiB total named
+    # generous budget and report-only mode are both clean
+    assert memory_budget_pass(report, name="p", hbm_gb=16.0) == []
+    assert memory_budget_pass(report, name="p", hbm_gb=0.0) == []
+
+
+def test_pallas_vmem_fixture_fires_once_with_stable_id():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.analysis import (
+        memory_budget_pass,
+        pallas_vmem_estimates,
+    )
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2
+
+    def big(x):  # 64 MiB in + 64 MiB out of VMEM-resident blocks
+        return pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(
+            (4096, 4096), jnp.float32), interpret=True)(x)
+
+    est = pallas_vmem_estimates(
+        jax.make_jaxpr(big)(jnp.ones((4096, 4096), jnp.float32)))
+    assert len(est) == 1 and est[0][1] == 2 * 4096 * 4096 * 4
+    report = {"total_bytes": 0, "zero": 0, "dp": 1,
+              "pallas_vmem": [{"kernel": k, "bytes": b} for k, b in est]}
+    found = memory_budget_pass(report, name="p", vmem_mb=64.0)
+    assert len(found) == 1 and found[0].rule == "GL-P-MEM"
+    assert found[0].anchor.startswith("vmem:")
+    # the same kernel on small blocks is clean
+    assert memory_budget_pass(report, name="p", vmem_mb=256.0) == []
+
+
+def test_opt_state_bytes_agree_with_zero_census():
+    """Static GL-P-MEM param+opt accounting vs the runtime census on a
+    forced-8-device mesh: at every zero mode the static slot bytes must
+    equal the placed addressable shard bytes (the scalar `step` slot is
+    the only delta — the census counts slots only)."""
+    script = textwrap.dedent("""\
+        import jax, numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.layers import api as layer, base, data_type
+        from paddle_tpu.layers import activation as act
+        from paddle_tpu.config.topology import Topology
+        from paddle_tpu.optimizer import Adam
+        from paddle_tpu.parallel import zero as Z
+        from paddle_tpu.parallel.mesh import get_mesh
+        from paddle_tpu.analysis import opt_state_bytes_per_device
+        from paddle_tpu.analysis.memory import tree_bytes
+
+        base.reset_name_counters()
+        x = layer.data(name='x', type=data_type.dense_vector(64))
+        h = layer.fc(input=x, size=128, act=act.ReluActivation())
+        p = layer.fc(input=h, size=8, act=act.SoftmaxActivation())
+        y = layer.data(name='y', type=data_type.integer_value(8))
+        topo = Topology(layer.classification_cost(input=p, label=y))
+        specs = {s.name: s for s in topo.param_specs()}
+        params = paddle.parameters.create(topo).as_dict()
+        opt = Adam(learning_rate=1e-2)
+        opt_state = opt.init(params, specs)
+        mesh = get_mesh().mesh
+        step_bytes = tree_bytes({"step": opt_state["step"]})
+        for zero in (0, 1, 2):
+            static = opt_state_bytes_per_device(opt_state, params, mesh,
+                                                zero)
+            if zero == 0:
+                measured = sum(
+                    leaf.size * leaf.dtype.itemsize for leaf in
+                    jax.tree.leaves(opt_state["slots"]))
+            else:
+                placed = Z.shard_opt_state(opt_state, params, mesh)
+                measured = Z.state_bytes_per_device(placed)
+            assert static - step_bytes == measured, (
+                zero, static, measured, step_bytes)
+        print("CENSUS_AGREE")
+        """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=8"])
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CENSUS_AGREE" in out.stdout
+
+
+def test_sharding_flow_replicated_intermediate_fixture():
+    from paddle_tpu.analysis import sharding_flow_pass
+
+    big = "1024x4096xf32"  # 16 MiB
+    stablehlo = textwrap.dedent("""\
+        func.func public @main(%arg0: tensor<{big}> {{tf.aliasing_output = 0 : i32}}, %arg1: tensor<8x{big}>) -> (tensor<{big}>) {{
+          %0 = stablehlo.custom_call @Sharding(%arg1) {{backend_config = "", mhlo.sharding = "{{replicated}}"}} : (tensor<8x{big}>) -> tensor<8x{big}>
+          %1 = stablehlo.custom_call @Sharding(%arg0) {{backend_config = "", mhlo.sharding = "{{replicated}}"}} : (tensor<{big}>) -> tensor<{big}>
+          return %1 : tensor<{big}>
+        }}
+        """).format(big=big)
+    found = sharding_flow_pass(stablehlo, None, name="p")
+    # the donated param pin (%arg0's type) is sanctioned pre-ZeRO-3;
+    # the 8x-sized activation pin is the planted defect, firing once
+    assert [f.fid for f in found] == \
+        ["GL-P-SHARD:<program:p>:replicated:f32[8,1024,4096]"]
+    # allowlisting the reviewed type silences it
+    assert sharding_flow_pass(stablehlo, None, name="p",
+                              allowlist=("f32[8,1024,4096]",)) == []
+    # small intermediates never fire (byte-gated like GL-P-DONATE)
+    assert sharding_flow_pass(stablehlo, None, name="p",
+                              min_bytes=1 << 30) == []
+
+
+def test_sharding_flow_implicit_reshard_fixture():
+    from paddle_tpu.analysis import sharding_flow_pass
+
+    stablehlo = textwrap.dedent("""\
+        func.func public @main(%arg0: tensor<1024x4096xf32> {tf.aliasing_output = 0 : i32}, %arg1: tensor<32x4096xf32>) -> (tensor<1024x4096xf32>) {
+          return %arg0 : tensor<1024x4096xf32>
+        }
+        """)
+    compiled = textwrap.dedent("""\
+        %ag.1 = f32[1024,4096]{1,0} all-gather(f32[128,4096]{1,0} %p0), dimensions={0}
+        %ag.2 = f32[4096,4096]{1,0} all-gather(f32[4096,512]{1,0} %act), dimensions={1}
+        %ag.3 = f32[8,8]{1,0} all-gather(f32[1,8]{1,0} %tiny), dimensions={0}
+        """)
+    found = sharding_flow_pass(stablehlo, compiled, name="p")
+    # ag.1 rebuilds the donated param type (the ZeRO all-gather) and
+    # ag.3 is below the byte gate; ag.2 is the planted implicit reshard
+    assert [f.fid for f in found] == \
+        ["GL-P-SHARD:<program:p>:reshard:f32[4096,4096]"]
+    assert "67.1 MB" in found[0].message  # the payload is named
+    # TPU HLO emits collectives as async start/done pairs with a TUPLE
+    # result type — the start op must fire identically, the done op
+    # (referencing the same result) must not double-count
+    async_compiled = textwrap.dedent("""\
+        %ags = (f32[4096,512]{1,0}, f32[4096,4096]{1,0}) all-gather-start(f32[4096,512]{1,0} %act), dimensions={1}
+        %agd = f32[4096,4096]{1,0} all-gather-done(%ags)
+        """)
+    found = sharding_flow_pass(stablehlo, async_compiled, name="p")
+    assert [f.fid for f in found] == \
+        ["GL-P-SHARD:<program:p>:reshard:f32[4096,4096]"]
+
+
+def test_rng_key_reuse_fixture_fires_once_with_stable_id(tmp_path):
+    from paddle_tpu.analysis.rng import pass_rng_discipline
+
+    rel = "paddle_tpu/fix_rng.py"
+    corpus = _corpus(tmp_path, rel, """\
+        import jax
+
+        def reused(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))    # planted: same key
+            return a + b
+
+        def split_ok(key):
+            k1, k2 = jax.random.split(key)
+            return jax.random.normal(k1, (2,)) + \\
+                jax.random.uniform(k2, (2,))
+
+        def branch_ok(key, flag):
+            if flag:
+                return jax.random.normal(key, (2,))
+            else:
+                return jax.random.uniform(key, (2,))
+
+        def refold_ok(key):
+            a = jax.random.normal(key, (2,))
+            key = jax.random.fold_in(key, 1)
+            return a + jax.random.normal(key, (2,))
+        """)
+    found = pass_rng_discipline(corpus, str(tmp_path), modules=(rel,))
+    assert [f.fid for f in found] == [f"GL-RNG:{rel}:reused"]
+    assert "without an intervening split/fold_in" in found[0].message
+
+
+def test_rng_literal_key_fixture(tmp_path):
+    from paddle_tpu.analysis.rng import pass_rng_discipline
+
+    rel = "paddle_tpu/fix_rng.py"
+    corpus = _corpus(tmp_path, rel, """\
+        import jax
+
+        def literal_draw():
+            return jax.random.normal(jax.random.PRNGKey(0), (2,))
+
+        def literal_bound():
+            k = jax.random.key(0)
+            return jax.random.uniform(k, (2,))
+
+        def seed_only():
+            return jax.random.key(0)   # a seed, never drawn from: fine
+
+        def threaded(key):
+            return jax.random.normal(key, (2,))
+        """)
+    found = pass_rng_discipline(corpus, str(tmp_path), modules=(rel,))
+    assert sorted(f.fid for f in found) == [
+        f"GL-RNG:{rel}:literal_bound",
+        f"GL-RNG:{rel}:literal_draw",
+    ]
+
+
+def test_rng_fold_pass_flags_unfolded_shard_map_draw():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import compat
+    from paddle_tpu.analysis import rng_fold_pass
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+
+    def nofold(x, key):
+        return x * jax.random.uniform(key, x.shape)
+
+    def folded(x, key):
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        return x * jax.random.uniform(key, x.shape)
+
+    x, key = jnp.ones((8, 4)), jax.random.key(0)
+    bad = compat.shard_map(nofold, mesh=mesh, in_specs=(P("data"), P()),
+                           out_specs=P("data"))
+    good = compat.shard_map(folded, mesh=mesh, in_specs=(P("data"), P()),
+                            out_specs=P("data"))
+    found = rng_fold_pass(bad, x, key, name="p")
+    assert [f.fid for f in found] == ["GL-RNG:<program:p>:shard-fold"]
+    assert rng_fold_pass(good, x, key, name="p") == []
+
+
+def test_rng_pass_clean_on_repo():
+    from paddle_tpu.analysis.codebase import iter_corpus
+    from paddle_tpu.analysis.rng import pass_rng_discipline
+
+    found = pass_rng_discipline(iter_corpus(REPO), REPO)
+    assert found == [], [f.fid for f in found]
+
+
+def test_program_fingerprint_canonicalization():
+    from paddle_tpu.analysis import program_fingerprint
+
+    a = ("%1 = f32[8]{0} add(f32[8]{0} %p0, f32[8]{0} %p1), "
+         "metadata={op_name=\"x\" source_line=3}\n"
+         "%2 = f32[8]{0} all-gather(f32[8]{0} %1)")
+    # SSA renumbering + metadata churn canonicalize away
+    b = ("%41 = f32[8]{0} add(f32[8]{0} %arg0, f32[8]{0} %arg1), "
+         "metadata={op_name=\"y\" source_line=99}\n"
+         "%55 = f32[8]{0} all-gather(f32[8]{0} %41)")
+    fa, fb = program_fingerprint(a), program_fingerprint(b)
+    assert fa["hash"] == fb["hash"]
+    assert fa["ops"] == ["add", "all-gather"]
+    # a real op change does not
+    c = a.replace("all-gather", "reduce-scatter")
+    assert program_fingerprint(c)["hash"] != fa["hash"]
+
+
+def test_divergence_pass_names_the_diff():
+    from paddle_tpu.analysis import divergence_pass, program_fingerprint
+
+    same = "%1 = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)\n" \
+           "%2 = f32[8]{0} all-gather(f32[8]{0} %1)"
+    diff = same.replace("all-gather", "reduce-scatter")
+    fps = {0: program_fingerprint(same, rank=0),
+           1: program_fingerprint(same, rank=1),
+           2: program_fingerprint(diff, rank=2)}
+    found = divergence_pass(fps, name="p")
+    assert [f.fid for f in found] == ["GL-P-DIVERGE:<program:p>:rank-2"]
+    assert "op[1]: reduce-scatter vs all-gather" in found[0].message
+    # agreement is clean
+    assert divergence_pass({0: fps[0], 1: fps[1]}, name="p") == []
+
+
+def test_exchange_fingerprints_roundtrip_and_timeout(tmp_path):
+    from paddle_tpu.analysis import (
+        exchange_fingerprints,
+        program_fingerprint,
+    )
+    from paddle_tpu.analysis.diverge import publish_fingerprint
+
+    d = str(tmp_path / "rdv")
+    fp1 = program_fingerprint("%1 = f32[8]{0} add(f32[8]{0} %a)", rank=1)
+    publish_fingerprint(fp1, d, 1)
+    fp0 = program_fingerprint("%1 = f32[8]{0} add(f32[8]{0} %a)", rank=0)
+    fps = exchange_fingerprints(fp0, d, 0, 2, timeout_s=10)
+    assert set(fps) == {0, 1} and fps[1]["hash"] == fp0["hash"]
+    # a missing rank times out naming who never published
+    with pytest.raises(TimeoutError, match=r"rank\(s\) \[2\]"):
+        exchange_fingerprints(fp0, d, 0, 3, timeout_s=0.3)
+
+
+# -- 4b. graftlint v2 through the real CLI --------------------------------------
+
+
+def test_preflight_cli_hbm_budget(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    # a deliberately over-budget device (10 KB of HBM) fails with the
+    # GL-P-MEM finding; a real budget passes and is echoed
+    out = _run_preflight(cfg, "--hbm_gb", "0.00001")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "GL-P-MEM" in out.stdout and "hbm-budget" in out.stdout
+    out = _run_preflight(cfg, "--hbm_gb", "16")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "16.0 GB budget" in out.stdout
+
+
+def test_preflight_cli_zero2_with_budget_clean(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    out = _run_preflight(cfg, "--zero", "2", "--hbm_gb", "16", devices=8)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_preflight_cli_catches_injected_eval_host_sync(tmp_path):
+    cfg = _write_preflight_config(tmp_path)
+    out = _run_preflight(cfg, inject="host_sync_eval")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "GL-P-SYNC:<program:eval_step>" in out.stdout
+
+
+def _run_preflight_rank(cfg, rank, nproc, rdv, inject=""):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_PREFLIGHT_INJECT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_TRAINER_ID"] = str(rank)
+    env["PADDLE_TPU_NPROC"] = str(nproc)
+    env["PADDLE_TPU_PREFLIGHT_RENDEZVOUS"] = rdv
+    if inject:
+        env["PADDLE_TPU_PREFLIGHT_INJECT"] = inject
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.trainer", "--config", cfg,
+         "--preflight"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+
+
+def test_preflight_cli_rank_divergence_aborts_with_named_diff(tmp_path):
+    """The GL-P-DIVERGE acceptance: two ranks preflight the same config
+    through the real CLI with the chaos hook perturbing rank 1's
+    program — BOTH abort with the named diff instead of a fleet that
+    would deadlock in its first collective; without the injection the
+    exchange agrees and both pass."""
+    cfg = _write_preflight_config(tmp_path)
+    rdv = str(tmp_path / "rdv")
+    procs = [_run_preflight_rank(cfg, r, 2, rdv, inject="rank_divergence")
+             for r in range(2)]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 1, out
+        assert "GL-P-DIVERGE" in out
+        assert "chaos.divergence" in out  # the diff names the alien op
+    # the clean twin: same fleet, no injection, agreement
+    rdv2 = str(tmp_path / "rdv2")
+    procs = [_run_preflight_rank(cfg, r, 2, rdv2) for r in range(2)]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+
+
+# -- 4c. baseline staleness + machine-readable counts ---------------------------
+
+
+def _baseline_with_bogus_entry(tmp_path):
+    from paddle_tpu.analysis import load_baseline
+
+    sup = load_baseline()
+    sup["GL-EXCEPT:paddle_tpu/does_not_exist.py:gone"] = "stale on purpose"
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"suppressions": sup}))
+    return str(path)
+
+
+def test_analysis_json_reports_suppressed_and_stale_counts(tmp_path):
+    bl = _baseline_with_bogus_entry(tmp_path)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--json",
+         "--baseline", bl],
+        capture_output=True, text=True, cwd=REPO)
+    data = json.loads(out.stdout)
+    assert out.returncode == 1          # stale entry fails the full run
+    assert data["clean"] is False
+    assert data["findings"] == []       # no real findings — only stale
+    assert data["suppressed_count"] == len(data["suppressed"]) >= 3
+    assert data["suppressed"][0]["fid"]  # full finding objects, not fids
+    assert data["stale_count"] == 1
+    assert data["stale_suppressions"] == \
+        ["GL-EXCEPT:paddle_tpu/does_not_exist.py:gone"]
+
+
+def test_lint_full_run_fails_on_stale_baseline_entry(tmp_path):
+    bl = _baseline_with_bogus_entry(tmp_path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--baseline", bl],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1, out.stdout + out.stderr
+    # the dead entry is named in the failure
+    assert "GL-EXCEPT:paddle_tpu/does_not_exist.py:gone" in out.stdout
+    assert "stale baseline" in out.stdout
+    # --changed subset runs can't evaluate staleness: still green
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--changed", "--baseline", bl],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_divergence_pass_shape_only_drift_names_the_line():
+    """Same op kinds, different dims (the classic batch-size config
+    drift) must still name the divergent instruction — the op-kind diff
+    comes up empty, so the canonical-line diff takes over."""
+    from paddle_tpu.analysis import divergence_pass, program_fingerprint
+
+    a = "%1 = f32[32,64]{1,0} add(f32[32,64]{1,0} %p0, f32[32,64]{1,0} %p1)"
+    b = "%1 = f32[64,64]{1,0} add(f32[64,64]{1,0} %p0, f32[64,64]{1,0} %p1)"
+    found = divergence_pass({0: program_fingerprint(a, rank=0),
+                             1: program_fingerprint(b, rank=1)}, name="p")
+    assert len(found) == 1
+    assert "line[0]" in found[0].message
+    assert "f32[64,64]" in found[0].message

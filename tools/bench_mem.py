@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""tools/bench_mem.py — GL-P-MEM static memory estimates for the bench
+grid's representative configs.
+
+Runs the same static per-device accounting ``trainer --preflight
+--hbm_gb`` gates on (``paddle_tpu/analysis/memory.py``) over the bench
+models WITHOUT executing a step: params + optimizer slots under the
+requested zero mode + jaxpr activation liveness, plus any
+``pallas_call`` VMEM footprints.  Output is the BENCHMARKS.md budget
+table (markdown; ``--json`` for JSON lines), so published bench rows
+carry a citable static byte count next to the measured HBM traffic.
+
+Trace-only: safe on a CPU dev box, no accelerator required.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _topology_row(name, cost_fn, feed, optimizer=None, compute_dtype=None):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.memory import memory_report
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.layers import base
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.trainer.step import build_train_step
+
+    base.reset_name_counters()
+    topo = Topology(cost_fn())
+    opt = optimizer or Momentum(momentum=0.9, learning_rate=0.01)
+    specs = {s.name: s for s in topo.param_specs()}
+    params = paddle.parameters.create(topo).as_dict()
+    opt_state = opt.init(params, specs)
+    states = topo.init_states()
+    step = build_train_step(
+        topo, opt,
+        compute_dtype=jnp.bfloat16 if compute_dtype is None
+        else compute_dtype)
+    import jax
+
+    args = (params, opt_state, states, feed, jax.random.key(0))
+    rep = memory_report(params, opt_state, states, feed, None, zero=0,
+                        step=step, args=args)
+    rep["config"] = name
+    return rep
+
+
+def _transformer_row():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.memory import memory_report
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.optimizer import Adam
+
+    cfg = T.TransformerConfig(
+        vocab_size=50257, num_layers=12, num_heads=12, embed_dim=768,
+        mlp_dim=3072, max_seq_len=2048, dtype=jnp.float32, remat=False,
+        attn_impl="flash", attn_block_size=1024)
+    params = T.init_params(cfg, jax.random.key(0))
+    opt = Adam(learning_rate=1e-4, moment_dtype=jnp.bfloat16)
+    opt_state = opt.init_tree(params)
+    bs, seqlen = 16, 1024
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(bs, seqlen + 1))
+    step = T.build_train_step(cfg, opt, compute_dtype=jnp.bfloat16)
+    rep = memory_report(params, opt_state, {}, {"ids": ids}, None, zero=0,
+                        step=step, args=(params, opt_state, ids))
+    rep["config"] = "transformer_lm_124m bs16x1024 bf16"
+    return rep
+
+
+def rows() -> list[dict]:
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.models import image as M
+    from paddle_tpu.models.ocr_crnn import crnn_ctc_cost
+    from paddle_tpu.optimizer import Adam
+
+    rng = np.random.default_rng(0)
+    out = []
+    specs = [
+        ("transformer", _transformer_row),
+        ("resnet50 bs128 bf16", lambda: _topology_row(
+            "resnet50 bs128 bf16", lambda: M.resnet_cost(depth=50)[0],
+            {"image": rng.normal(size=(128, 224 * 224 * 3)).astype(
+                np.float32),
+             "label": rng.integers(0, 1000, size=(128,))})),
+        ("lstm h512 bs256 bf16", lambda: _topology_row(
+            "lstm h512 bs256 bf16",
+            lambda: __import__("bench")._lstm_classify_cost(512),
+            {"data": SequenceBatch(
+                data=rng.integers(0, 30000, size=(256, 100)),
+                length=np.full((256,), 100, np.int32)),
+             "label": rng.integers(0, 2, size=(256,))},
+            optimizer=Adam(learning_rate=2e-3,
+                           moment_dtype=jnp.bfloat16))),
+        ("ocr_crnn bs64 bf16", lambda: _topology_row(
+            "ocr_crnn bs64 bf16", lambda: crnn_ctc_cost()[0],
+            {"image": rng.normal(size=(64, 32 * 96)).astype(np.float32),
+             "label": SequenceBatch(
+                 data=rng.integers(1, 95, size=(64, 8)),
+                 length=np.full((64,), 8, np.int32))},
+            optimizer=Adam(learning_rate=1e-3,
+                           moment_dtype=jnp.bfloat16))),
+    ]
+    for label, fn in specs:
+        try:
+            out.append(fn())
+        except Exception as e:  # keep the table alive per-row
+            out.append({"config": label,
+                        "error": f"{type(e).__name__}: {e}"[:200]})
+    return out
+
+
+def main(argv: list[str]) -> int:
+    as_json = "--json" in argv
+    reports = rows()
+    if as_json:
+        for r in reports:
+            print(json.dumps(r))
+        return 0
+    print("| config | params MB | opt MB | acts MB (est) | feed MB "
+          "| total MB | pallas VMEM MB |")
+    print("|---|---|---|---|---|---|---|")
+    for r in reports:
+        if "error" in r:
+            print(f"| {r['config']} | (skipped: {r['error']}) ||||||")
+            continue
+        vmem = max((k["bytes"] for k in r.get("pallas_vmem", ())),
+                   default=0)
+        print(f"| {r['config']} | {r['params_bytes'] / 1e6:.1f} "
+              f"| {r['opt_state_bytes'] / 1e6:.1f} "
+              f"| {r['activation_bytes'] / 1e6:.1f} "
+              f"| {r['feed_bytes'] / 1e6:.1f} "
+              f"| **{r['total_bytes'] / 1e6:.1f}** "
+              f"| {vmem / 1e6:.1f} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -4,7 +4,7 @@
 A thin driver over ``python -m paddle_tpu.analysis`` (the codebase
 static-analysis suite: swallow-all excepts, threaded-subsystem lock
 audit, lock-order cycles, env-registration, telemetry schema drift,
-kernel reference twins) that adds git awareness:
+kernel reference twins, PRNG key discipline) that adds git awareness:
 
   python tools/lint.py              # repo-wide (what tier-1 runs)
   python tools/lint.py --changed    # only files touched vs HEAD
@@ -13,8 +13,10 @@ kernel reference twins) that adds git awareness:
 
 ``--changed`` mode skips the stale-baseline check and the corpus-global
 kernel pass (a subset can't evaluate either).  Exit 1 on any
-unsuppressed finding.  All other arguments are forwarded verbatim
-(``--json``, ``--passes``, ``--baseline``, ``--locks``).
+unsuppressed finding — and, on full runs, on any STALE baseline entry
+(the message names the dead fid so the suppression gets cleaned up).
+All other arguments are forwarded verbatim (``--json``, ``--passes``,
+``--baseline``, ``--locks``).
 """
 
 from __future__ import annotations

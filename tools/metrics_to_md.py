@@ -356,6 +356,37 @@ def preflight_table(records: list[dict]) -> None:
               f"program carries statically detectable hazards "
               f"({ids}); fix them or baseline them with a reason "
               f"before trusting the run.")
+    _memory_budget_table([r for r in records if r.get("memory")])
+
+
+def _memory_budget_table(records: list[dict]) -> None:
+    """The schema /9 GL-P-MEM budget table: static per-device byte
+    accounting of each preflighted step (params + zero-mode optimizer
+    slots + activation liveness), the future sharding/kernel PR's
+    citable byte-count assertion."""
+    if not records:
+        return
+    print("\n### Memory budget (GL-P-MEM, static per device)\n")
+    print("| config | zero | dp | params MB | opt MB | acts MB "
+          "| total MB | activations via |")
+    print("|---|---|---|---|---|---|---|---|")
+    for r in records:
+        m = r["memory"]
+        print(f"| {r.get('config') or '-'} | {m.get('zero', 0)} "
+              f"| {m.get('dp', 1)} "
+              f"| {_fmt(m.get('params_bytes', 0) / 1e6)} "
+              f"| {_fmt(m.get('opt_state_bytes', 0) / 1e6)} "
+              f"| {_fmt(m.get('activation_bytes', 0) / 1e6)} "
+              f"| **{_fmt(m.get('total_bytes', 0) / 1e6)}** "
+              f"| {m.get('activation_source', '-')} |")
+    vmem = [(r.get("config"), k) for r in records
+            for k in (r["memory"].get("pallas_vmem") or ())]
+    if vmem:
+        print("\n| config | pallas kernel | VMEM MB |")
+        print("|---|---|---|")
+        for cfg, k in vmem:
+            print(f"| {cfg or '-'} | {k.get('kernel')} "
+                  f"| {_fmt(k.get('bytes', 0) / 1e6)} |")
 
 
 MFU_TARGET_PCT = 50.0  # the ROADMAP north-star floor
