@@ -243,6 +243,70 @@ def bench_lstm(records, bs=64, hiddens=(256, 512, 1280),
         records.append(row)
 
 
+def bench_lstm_ablation(records, bs=32, seqlen=64, hidden=256,
+                        vocab=30000):
+    """Persistent-recurrence ablation for the LSTM text model: flag on
+    routes the lstmemory sweep through remat mode (no [T, B, 4D] gates
+    residual round-tripped through HBM) and, on TPU, the fused-input
+    kernels — trajectory asserted, bit-identical on CPU where both
+    modes resolve to the same unfused program.  Separate from
+    ``bench_lstm`` so the CPU testbed snapshot can run it without the
+    h256-1280 reference grid."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.optimizer import Adam
+
+    rng = np.random.default_rng(0)
+
+    def feed_fn():
+        return {
+            "data": SequenceBatch(
+                data=rng.integers(0, vocab, size=(bs, seqlen)),
+                length=np.full((bs,), seqlen, np.int32)),
+            "label": jax.device_put(rng.integers(0, 2, size=(bs,))),
+        }
+
+    _fused_ablation_row(
+        records, "lstm_fused_ablation_speedup",
+        lambda: _lstm_classify_cost(hidden), feed_fn,
+        lambda: Adam(learning_rate=2e-3, moment_dtype=jnp.bfloat16),
+        per_unit="steps_per_sec", n2=8, steps=3)
+
+
+def bench_nmt_ablation(records, bs=16, tlen=16, vocab=2000, dim=64):
+    """Fused-recurrence ablation for the NMT encoder/decoder GRUs (same
+    contract as the other _fused_ablation_row rows; scaled-down config so
+    the row is measurable on the CPU testbed)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lod import SequenceBatch
+    from paddle_tpu.models import seqtoseq as S
+    from paddle_tpu.optimizer import Adam
+
+    rng = np.random.default_rng(0)
+
+    def feed_fn():
+        def seq():
+            return SequenceBatch(
+                data=rng.integers(0, vocab, size=(bs, tlen)),
+                length=np.full((bs,), tlen, np.int32))
+        return {
+            "source_language_word": seq(),
+            "target_language_word": seq(),
+            "target_language_next_word": seq(),
+        }
+
+    _fused_ablation_row(
+        records, "nmt_fused_ablation_speedup",
+        lambda: S.seqtoseq_net(vocab, vocab, word_vector_dim=dim,
+                               encoder_size=dim, decoder_size=dim),
+        feed_fn,
+        lambda: Adam(learning_rate=5e-4, moment_dtype=jnp.bfloat16),
+        per_unit="steps_per_sec", n2=8, steps=3)
+
+
 def bench_nmt(records, bs=64, saturated=False):
     import jax.numpy as jnp
 
@@ -568,6 +632,110 @@ def bench_input_pipeline(records):
     })
 
 
+def bench_input_bucketing(records):
+    """Sequence-bucketing ablation on a skewed-length text workload (85%
+    short sequences, 15% ~12x longer — the realistic tagging/OCR/NMT
+    length mix): the SAME model + sample stream through ``SGD.train``,
+    once batched in arrival order (every batch pads to the long tail's
+    ceiling) and once through ``reader.bucket_by_length`` + the matching
+    feeder ``seq_buckets`` table.  Rows carry the measured per-step
+    ``padding_ratio`` (from the schema/10 telemetry field) and seq/s;
+    the speedup row is the seq/s ratio.  Unlike the fused-kernel
+    ablations there is no trajectory assert — bucketing reorders batch
+    composition by design."""
+    import paddle_tpu as paddle
+    from paddle_tpu import metrics as metrics_mod
+    from paddle_tpu.core import rng as prng
+    from paddle_tpu.layers import activation as act
+    from paddle_tpu.layers import api as layer_api
+    from paddle_tpu.layers import base as layer_base
+    from paddle_tpu.layers import data_type
+    from paddle_tpu.reader.decorator import bucket_by_length
+
+    vocab, hidden, bs, n_samples = 1000, 64, 32, 384
+    buckets = (16, 192)
+    rngnp = np.random.default_rng(0)
+    samples = []
+    for _ in range(n_samples):
+        t = (int(rngnp.integers(6, 15)) if rngnp.random() < 0.85
+             else int(rngnp.integers(150, 190)))
+        samples.append((rngnp.integers(0, vocab, size=t).tolist(),
+                        int(rngnp.integers(0, 2))))
+
+    def raw_reader():
+        yield from samples
+
+    def build():
+        layer_base.reset_name_counters()
+        prng.seed(7)
+        data = layer_api.data(
+            name="data", type=data_type.integer_value_sequence(vocab))
+        net = layer_api.embedding(input=data, size=32)
+        net = layer_api.fc(input=net, size=hidden * 4,
+                           act=act.LinearActivation())
+        net = layer_api.lstmemory(input=net)
+        net = layer_api.last_seq(input=net)
+        net = layer_api.fc(input=net, size=2, act=act.SoftmaxActivation())
+        label = layer_api.data(name="label",
+                               type=data_type.integer_value(2))
+        cost = layer_api.classification_cost(input=net, label=label)
+        params = paddle.parameters.create(paddle.topology.Topology(cost))
+        return paddle.trainer.SGD(
+            cost=cost, parameters=params,
+            update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
+
+    def run(bucketed):
+        trainer = build()
+        sink = metrics_mod.MemorySink()
+        reg = metrics_mod.MetricsRegistry("bench_input_bucketing")
+        reg.add_sink(sink)
+        if bucketed:
+            reader = bucket_by_length(raw_reader, bs, buckets=buckets)
+            table = buckets
+        else:
+            reader = paddle.reader.batch(raw_reader, bs, drop_last=True)
+            table = None
+        marks = {}
+
+        def on_event(e):
+            if isinstance(e, paddle.event.BeginPass) and e.pass_id == 1:
+                marks["t0"] = time.perf_counter()
+            elif isinstance(e, paddle.event.EndPass) and e.pass_id == 1:
+                marks["t1"] = time.perf_counter()
+
+        # pass 0 pays the per-bucket compiles; pass 1 is the measurement
+        trainer.train(reader=reader, num_passes=2, event_handler=on_event,
+                      metrics_registry=reg, seq_buckets=table)
+        steps = [r for r in sink.records
+                 if r.get("kind") == "step" and r.get("pass_id") == 1]
+        pads = [r["padding_ratio"] for r in steps if "padding_ratio" in r]
+        examples = sum(
+            r["examples_per_sec"] * r["step_ms"] / 1e3 for r in steps)
+        sps = examples / max(marks["t1"] - marks["t0"], 1e-9)
+        return sps, (sum(pads) / len(pads) if pads else 0.0)
+
+    sps_off, pad_off = run(False)
+    sps_on, pad_on = run(True)
+    cfg = (f"emb32-lstm{hidden}, bs {bs}, {n_samples} samples, 85% len "
+           f"6-15 / 15% len 150-190, buckets {list(buckets)}")
+    records.append({
+        "metric": "input_bucketing_padded_timestep_ratio_off",
+        "value": round(pad_off, 4), "unit": "ratio", "config": cfg,
+        "vs_baseline": 0})
+    records.append({
+        "metric": "input_bucketing_padded_timestep_ratio_on",
+        "value": round(pad_on, 4), "unit": "ratio", "config": cfg,
+        "vs_baseline": 0})
+    records.append({
+        "metric": "input_bucketing_speedup",
+        "value": round(sps_on / max(sps_off, 1e-9), 2), "unit": "x",
+        "seq_per_sec_off": round(sps_off, 1),
+        "seq_per_sec_on": round(sps_on, 1),
+        "padded_ratio_off": round(pad_off, 4),
+        "padded_ratio_on": round(pad_on, 4),
+        "config": cfg, "vs_baseline": 0})
+
+
 def bench_zero(records):
     """ZeRO weight-update-sharding ablation (tools/bench_zero.py):
     replicated vs zero1 vs zero2 on a forced-8-device host mesh, in a
@@ -764,8 +932,9 @@ def main() -> None:
     records: list[dict] = []
     failures = []
     rows = (bench_alexnet, bench_googlenet, bench_smallnet, bench_lstm,
-            bench_nmt, bench_ctr, bench_crnn, bench_saturation,
-            bench_input_pipeline, bench_transformer, bench_zero,
+            bench_lstm_ablation, bench_nmt, bench_nmt_ablation, bench_ctr,
+            bench_crnn, bench_saturation, bench_input_pipeline,
+            bench_input_bucketing, bench_transformer, bench_zero,
             bench_serving, bench_serving_fleet)
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything.  --prefetch=0|N

@@ -28,7 +28,7 @@ the mesh, the active ``zero`` mode and the step's jaxpr:
   budget fails preflight instead of failing to fit at compile time.
 
 :func:`memory_report` returns the accounting dict (attached to the
-``preflight`` telemetry record, schema ``paddle_tpu.metrics/9``);
+``preflight`` telemetry record, schema ``paddle_tpu.metrics/10``);
 :func:`memory_budget_pass` turns it into GL-P-MEM findings against an
 ``--hbm_gb`` / ``--vmem_mb`` budget.
 """

@@ -242,6 +242,14 @@ define("fused_kernels", "auto", "route conv/BN/optimizer hot paths through "
                                 "the TPP fused Pallas microkernels "
                                 "(ops/pallas/tpp): auto = on-TPU only | "
                                 "on | off")
+# sequence bucketing (reader/decorator.bucket_by_length + DataFeeder
+# seq_buckets): one quantization table shared by the bucketed reader and
+# the feeder's sequence-slot padding, so every bucket is ONE jit
+# signature and padded timesteps stop burning flops/bytes
+define("seq_buckets", "", "length-quantization bucket table for sequence "
+                          "feeds, e.g. '8,16,32,64' (empty = the default "
+                          "doubling table); wire the SAME table into "
+                          "bucket_by_length readers")
 # static analysis / preflight (paddle_tpu/analysis): the jaxpr/HLO
 # program passes run by `trainer --preflight` before any step executes
 define("preflight_inject", "", "seed a deterministic defect into the "

@@ -107,13 +107,16 @@ class NestedSequenceBatch:
 
 
 def pad_sequences(
-    seqs: Sequence[np.ndarray], max_len: int | None = None, bucket: bool = True, pad_value=0
+    seqs: Sequence[np.ndarray], max_len: int | None = None, bucket: bool = True, pad_value=0,
+    buckets: Sequence[int] | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Ragged list -> (padded [B, T, ...], lengths [B]).  Host-side."""
+    """Ragged list -> (padded [B, T, ...], lengths [B]).  Host-side.
+    ``buckets`` overrides the default quantization table (the
+    ``seq_buckets`` knob — a bucketed reader and its feeder must agree)."""
     lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
     t = int(max_len if max_len is not None else (lengths.max() if len(seqs) else 1) or 1)
     if bucket and max_len is None:
-        t = bucket_length(t)
+        t = bucket_length(t) if buckets is None else bucket_length(t, buckets)
     first = np.asarray(seqs[0])
     trailing = first.shape[1:]
     out = np.full((len(seqs), t) + trailing, pad_value, dtype=first.dtype)
@@ -123,8 +126,9 @@ def pad_sequences(
     return out, np.minimum(lengths, t)
 
 
-def from_ragged(seqs: Sequence[np.ndarray], max_len: int | None = None) -> SequenceBatch:
-    data, length = pad_sequences(seqs, max_len=max_len)
+def from_ragged(seqs: Sequence[np.ndarray], max_len: int | None = None,
+                buckets: Sequence[int] | None = None) -> SequenceBatch:
+    data, length = pad_sequences(seqs, max_len=max_len, buckets=buckets)
     return SequenceBatch(data=jnp.asarray(data), length=jnp.asarray(length))
 
 

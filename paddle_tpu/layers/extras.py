@@ -98,6 +98,16 @@ def crf_decoding(input: LayerOutput, size: int | None = None,
 crf_decoding_layer = crf_decoding
 
 
+def _fused_ctc_on() -> bool:
+    """Route the CTC cost through ops/pallas/ctc when the fused_kernels
+    flag resolves on.  impl="auto" inside the fused entry still picks
+    the scan references off-TPU, so a flag-on CPU run (the bench
+    ablation) computes EXACTLY the unfused program."""
+    from paddle_tpu.ops.pallas.tpp import fused_enabled
+
+    return fused_enabled()
+
+
 def ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
         name: str | None = None, norm_by_times: bool = False) -> LayerOutput:
     """CTC cost (≅ ctc_layer / CTCLayer): ``input`` is post-softmax
@@ -117,9 +127,19 @@ def ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
     def fwd(ctx, params, states, probs, lbl):
         enforce(is_sequence(probs) and is_sequence(lbl),
                 "ctc expects sequence probs and labels")
-        loss = ctc_ops.ctc_loss_from_probs(
-            probs.data, probs.length, raw(lbl).astype(jnp.int32), lbl.length,
-            blank=blank)
+        if _fused_ctc_on():
+            # fused forward-backward kernel on TPU (hand-derived grad,
+            # no jax.grad re-trace of the alpha scan); the reference
+            # resolution on CPU is bit-identical to the unfused path
+            from paddle_tpu.ops.pallas.ctc import ctc_loss_fused
+
+            loss = ctc_loss_fused(
+                jnp.log(jnp.clip(probs.data, 1e-12)), probs.length,
+                raw(lbl).astype(jnp.int32), lbl.length, blank=blank)
+        else:
+            loss = ctc_ops.ctc_loss_from_probs(
+                probs.data, probs.length, raw(lbl).astype(jnp.int32),
+                lbl.length, blank=blank)
         if norm_by_times:
             loss = loss / jnp.maximum(probs.length.astype(loss.dtype), 1.0)
         return jnp.mean(loss)
@@ -149,10 +169,19 @@ def warp_ctc(input: LayerOutput, label: LayerOutput, size: int | None = None,
     def fwd(ctx, params, states, logits, lbl):
         enforce(is_sequence(logits) and is_sequence(lbl),
                 "warp_ctc expects sequence logits and labels")
-        log_probs = jax.nn.log_softmax(logits.data, axis=-1)
-        loss = ctc_ops.ctc_loss(
-            log_probs, logits.length, raw(lbl).astype(jnp.int32), lbl.length,
-            blank=blank)
+        if _fused_ctc_on():
+            # normalize=True folds the log-softmax into the fused kernel
+            # (the [B, T, V] log-prob slab never lands in HBM on TPU)
+            from paddle_tpu.ops.pallas.ctc import ctc_loss_fused
+
+            loss = ctc_loss_fused(
+                logits.data, logits.length, raw(lbl).astype(jnp.int32),
+                lbl.length, blank=blank, normalize=True)
+        else:
+            log_probs = jax.nn.log_softmax(logits.data, axis=-1)
+            loss = ctc_ops.ctc_loss(
+                log_probs, logits.length, raw(lbl).astype(jnp.int32),
+                lbl.length, blank=blank)
         if norm_by_times:
             loss = loss / jnp.maximum(logits.length.astype(loss.dtype), 1.0)
         return jnp.mean(loss)

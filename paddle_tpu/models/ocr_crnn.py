@@ -58,12 +58,12 @@ def crnn_ctc_cost(image_height: int = 32, image_width: int = 96,
     seq_w = pool2.width  # pool layers use ceil-mode output sizes
 
     seq = _columns_to_sequence(pool2, seq_w)
-    fwd = layer.lstmemory(input=layer.fc(input=seq, size=rnn_size * 4,
-                                         act=act.LinearActivation()))
-    bwd = layer.lstmemory(input=layer.fc(input=seq, size=rnn_size * 4,
-                                         act=act.LinearActivation()),
-                          reverse=True)
-    feat = layer.concat(input=[fwd, bwd])
+    # fused BiLSTM node (layer.bilstm -> ops/rnn.bilstm_fused): with
+    # fused_kernels on (TPU) both directions + both input projections run
+    # in ONE Pallas program over a single weight residency
+    # (ops/pallas/lstm.bilstm_seq); the unfused composition is the exact
+    # fc + lstmemory pair per direction
+    feat = layer.bilstm(input=seq, size=rnn_size, name="crnn_bilstm")
     probs = layer.fc(input=feat, size=num_classes + 1,
                      act=act.SoftmaxActivation())
     label = layer.data(
@@ -72,6 +72,16 @@ def crnn_ctc_cost(image_height: int = 32, image_width: int = 96,
     )
     cost = extras.ctc(input=probs, label=label, size=num_classes + 1)
     return cost, probs, ["image", "label"]
+
+
+def ctc_decode(log_probs, lengths, blank: int):
+    """Serving/eval greedy decode for the CRNN head: argmax + the
+    blank/repeat collapse through the fused Pallas decode kernel on TPU
+    (``ops/pallas/ctc.ctc_greedy_decode_fused``; the scan reference
+    everywhere else).  Returns (ids [B, W'] padded with -1, lengths)."""
+    from paddle_tpu.ops.pallas.ctc import ctc_greedy_decode_fused
+
+    return ctc_greedy_decode_fused(log_probs, lengths, blank=blank)
 
 
 def synthetic_ocr_reader(n_samples: int = 512, image_height: int = 32,

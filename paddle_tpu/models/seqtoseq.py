@@ -7,7 +7,15 @@ demo (``demo/seqToseq/seqToseq_net.py`` semantics, exercised through
 Encoder: source embedding -> bidirectional GRU.  Decoder: recurrent_group with
 a GRU step conditioned on a Bahdanau attention context.  Training builds the
 per-timestep cross-entropy cost; generation builds a compiled beam search
-(one ``lax.scan``, top-k pruning — see ``layers/recurrent_group.py``)."""
+(one ``lax.scan``, top-k pruning — see ``layers/recurrent_group.py``).
+
+Perf routing: the encoder GRUs lower through ``ops/rnn.gru_fused`` (the
+persistent Pallas sequence kernel), which under the ``fused_kernels``
+flag on TPU also enables REMAT mode — the [T, B, 3D] u/r/c residual
+slab is recomputed in the reverse kernel instead of round-tripping
+through HBM.  Pad waste on ragged WMT batches is the reader's job:
+batch with ``reader.bucket_by_length`` + ``seq_buckets`` so source /
+target feeds pad only to their bucket ceilings."""
 
 from __future__ import annotations
 

@@ -7,10 +7,28 @@ CRF Viterbi decoding for evaluation."""
 from __future__ import annotations
 
 from paddle_tpu.dataset import conll05
+from paddle_tpu.reader.decorator import bucket_by_length
 from paddle_tpu.layers import activation as act
 from paddle_tpu.layers import api as layer
 from paddle_tpu.layers import data_type, extras
 from paddle_tpu.layers.attr import ParamAttr
+
+#: CoNLL-05 sentence-length quantization for the tagging workload —
+#: most sentences sit under 32 tokens with a long tail past 100, so an
+#: arrival-order batch pads nearly everything to the tail's ceiling.
+SRL_SEQ_BUCKETS = (16, 32, 64, 128, 256)
+
+
+def srl_bucketed_batches(reader, batch_size: int, seed: int = 0,
+                         size_multiple: int = 1):
+    """Length-bucketed batching for the SRL reader (the per-sample
+    conll05 stream): quantizes on the longest slot of each sample via
+    ``reader.bucket_by_length`` with :data:`SRL_SEQ_BUCKETS` — feed the
+    same table to ``SGD.train(seq_buckets=SRL_SEQ_BUCKETS)`` (or
+    ``--seq_buckets``) so the feeder pads to the bucket ceilings and
+    every bucket stays one jit signature."""
+    return bucket_by_length(reader, batch_size, buckets=SRL_SEQ_BUCKETS,
+                            seed=seed, size_multiple=size_multiple)
 
 
 def srl_cost(emb_dim: int = 32, hidden: int = 64):

@@ -29,6 +29,21 @@ def _extend_labels(labels: jax.Array, blank: int) -> jax.Array:
     return ext.at[:, 1::2].set(labels)
 
 
+def ctc_tables(labels: jax.Array, label_lengths: jax.Array, blank: int):
+    """The static per-batch CTC transition tables, built ONCE and shared
+    by the scan below and the fused Pallas kernel (ops/pallas/ctc.py):
+    (ext [B, 2L+1] extended labels, ext_valid [B, S] bool, can_skip
+    [B, S] bool — the s-2 skip is allowed only onto non-blank positions
+    whose label differs from the label two back).  Hoisted out of
+    :func:`ctc_loss` so the labels are not re-extended per call site."""
+    s = 2 * labels.shape[1] + 1
+    ext = _extend_labels(labels.astype(jnp.int32), blank)  # [B, S]
+    ext_valid = jnp.arange(s)[None, :] < (2 * label_lengths[:, None] + 1)
+    prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    can_skip = (ext != blank) & (ext != prev2)  # [B, S]
+    return ext, ext_valid, can_skip
+
+
 def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array,
              labels: jax.Array, label_lengths: jax.Array,
              blank: int = 0) -> jax.Array:
@@ -36,18 +51,18 @@ def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array,
 
     log_probs: [B, T, V] log-softmax outputs; input_lengths: [B];
     labels: [B, L] int (padded, no blanks); label_lengths: [B].
-    Returns [B] loss = -log p(labels | inputs)."""
+    Returns [B] loss = -log p(labels | inputs).  The recursion runs in
+    f32 and every step saturates at ``NEG_INF`` (impossible paths pin at
+    the sentinel instead of drifting toward -inf — a bf16-adjacent input
+    can no longer push the accumulation into junk), so degenerate
+    configs (zero-length labels, T < 2L+1) yield a finite loss and zero
+    gradients rather than NaNs."""
+    log_probs = log_probs.astype(jnp.float32)
     bsz, t_max, v = log_probs.shape
     l_max = labels.shape[1]
     s = 2 * l_max + 1
 
-    ext = _extend_labels(labels.astype(jnp.int32), blank)  # [B, S]
-    ext_valid = jnp.arange(s)[None, :] < (2 * label_lengths[:, None] + 1)
-
-    # allowed skip from s-2: only onto non-blank positions whose label
-    # differs from the label two back (standard CTC transition rule)
-    prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
-    can_skip = (ext != blank) & (ext != prev2)  # [B, S]
+    ext, ext_valid, can_skip = ctc_tables(labels, label_lengths, blank)
 
     # emission log-probs for EVERY (t, s) in one vectorized gather OUTSIDE
     # the scan, so the loop body is elementwise only.  A per-step
@@ -71,7 +86,12 @@ def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array,
                         constant_values=NEG_INF)
         from2 = jnp.where(can_skip, from2, NEG_INF)
         new = jnp.logaddexp(jnp.logaddexp(stay, from1), from2) + emit
-        new = jnp.where(ext_valid, new, NEG_INF)
+        # saturate at the sentinel: impossible paths must not drift more
+        # negative (NEG_INF + NEG_INF + ... eventually overflows f32).
+        # The select (not maximum) also CUTS the gradient of saturated
+        # entries — a tie in jnp.maximum leaks junk cotangents into the
+        # emission slab for infeasible alignments
+        new = jnp.where(ext_valid & (new > NEG_INF), new, NEG_INF)
         # frozen once past this row's input length
         active = (t < input_lengths)[:, None]
         return jnp.where(active, new, alpha), None
@@ -89,7 +109,11 @@ def ctc_loss(log_probs: jax.Array, input_lengths: jax.Array,
         jnp.take_along_axis(
             alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0],
         NEG_INF)
+    # clamp: an infeasible alignment (more frames needed than available)
+    # reports the finite sentinel loss instead of inf, and the select
+    # pins its gradient to exactly zero
     ll = jnp.logaddexp(a_last, a_prev)
+    ll = jnp.where(ll > NEG_INF, ll, NEG_INF)
     return -ll
 
 
@@ -101,15 +125,12 @@ def ctc_loss_from_probs(probs: jax.Array, input_lengths, labels,
                     label_lengths, blank)
 
 
-def ctc_greedy_decode(log_probs: jax.Array, input_lengths: jax.Array,
-                      blank: int = 0):
-    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
-    Returns (ids [B, T] padded with -1, lengths [B])."""
-    bsz, t_max, _ = log_probs.shape
-    best = jnp.argmax(log_probs, axis=2).astype(jnp.int32)  # [B, T]
-    frame_valid = jnp.arange(t_max)[None, :] < input_lengths[:, None]
-    prev = jnp.pad(best[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
-    keep = (best != blank) & (best != prev) & frame_valid
+def compact_decoded(best: jax.Array, keep: jax.Array):
+    """Front-compact kept frames per row: (best [B, T], keep [B, T]
+    bool) -> (ids [B, T] padded with -1, lengths [B]).  Shared by the
+    scan decode below and the fused Pallas decode (ops/pallas/ctc.py),
+    whose kernel emits exactly this (argmax, keep-mask) pair."""
+    t_max = best.shape[1]
 
     # scatter compaction per row (vmapped): kept tokens to the front
     def compact(row, keep_row):
@@ -122,3 +143,15 @@ def ctc_greedy_decode(log_probs: jax.Array, input_lengths: jax.Array,
     ids = jax.vmap(compact)(best, keep)
     lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
     return ids, lengths
+
+
+def ctc_greedy_decode(log_probs: jax.Array, input_lengths: jax.Array,
+                      blank: int = 0):
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+    Returns (ids [B, T] padded with -1, lengths [B])."""
+    bsz, t_max, _ = log_probs.shape
+    best = jnp.argmax(log_probs, axis=2).astype(jnp.int32)  # [B, T]
+    frame_valid = jnp.arange(t_max)[None, :] < input_lengths[:, None]
+    prev = jnp.pad(best[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    keep = (best != blank) & (best != prev) & frame_valid
+    return compact_decoded(best, keep)

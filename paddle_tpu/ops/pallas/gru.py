@@ -27,8 +27,30 @@ from paddle_tpu.ops.pallas import (mxu_precision as _prec,
                                    time_major_mask as _mask3)
 
 
+def _gru_gates(xw, h, wh_ref, whc_ref, d):
+    """One GRU gate bundle from a [B, 3D] f32 gate input and the carry h
+    (matmul dtype): returns (u, r, c, hf) — shared by the forward kernels
+    and the remat backward's recomputation."""
+    hf = h.astype(jnp.float32)
+    ur = xw[:, :2 * d] + jnp.dot(
+        h, wh_ref[...], preferred_element_type=jnp.float32,
+        precision=_prec(wh_ref))
+    u = jax.nn.sigmoid(ur[:, :d])
+    r = jax.nn.sigmoid(ur[:, d:])
+    rh = (r * hf).astype(whc_ref.dtype)
+    c = jnp.tanh(xw[:, 2 * d:] + jnp.dot(
+        rh, whc_ref[...], preferred_element_type=jnp.float32,
+        precision=_prec(whc_ref)))
+    return u, r, c, hf
+
+
 def _fwd_kernel(xw_ref, mask_ref, wh_ref, whc_ref, h0_ref,
-                hs_ref, urc_ref, hT_ref, h_scr, *, d):
+                *rest, d, emit_gates=True):
+    if emit_gates:
+        hs_ref, urc_ref, hT_ref, h_scr = rest
+    else:
+        hs_ref, hT_ref, h_scr = rest
+        urc_ref = None
     t = pl.program_id(0)
     nt = pl.num_programs(0)
 
@@ -37,27 +59,40 @@ def _fwd_kernel(xw_ref, mask_ref, wh_ref, whc_ref, h0_ref,
         h_scr[...] = h0_ref[...].astype(h_scr.dtype)
 
     h = h_scr[...]
-    hf = h.astype(jnp.float32)
-    ur = xw_ref[0][:, :2 * d] + jnp.dot(
-        h, wh_ref[...], preferred_element_type=jnp.float32,
-        precision=_prec(wh_ref))
-    u = jax.nn.sigmoid(ur[:, :d])
-    r = jax.nn.sigmoid(ur[:, d:])
-    rh = (r * hf).astype(whc_ref.dtype)
-    c = jnp.tanh(xw_ref[0][:, 2 * d:] + jnp.dot(
-        rh, whc_ref[...], preferred_element_type=jnp.float32,
-        precision=_prec(whc_ref)))
+    u, r, c, hf = _gru_gates(xw_ref[0], h, wh_ref, whc_ref, d)
     h_new = u * hf + (1.0 - u) * c
     m = mask_ref[0]  # [B, 1]
     h_new = m * h_new + (1.0 - m) * hf
 
     h_scr[...] = h_new.astype(h_scr.dtype)
     hs_ref[0] = h_new.astype(hs_ref.dtype)
-    urc_ref[0] = jnp.concatenate([u, r, c], axis=-1).astype(urc_ref.dtype)
+    if urc_ref is not None:
+        urc_ref[0] = jnp.concatenate([u, r, c], axis=-1).astype(
+            urc_ref.dtype)
 
     @pl.when(t == nt - 1)
     def _final():
         hT_ref[...] = h_new.astype(hT_ref.dtype)
+
+
+def _durc_bwd(u, r, c, h_prev, dh, m, wh_ref, whc_ref):
+    """Per-step GRU cotangents; h' = u*h + (1-u)*c, all grads masked
+    (frozen rows pass dh through).  Returns (dxw [B, 3D], dh_prev)."""
+    du = dh * (h_prev - c) * u * (1.0 - u) * m        # = dpre_u
+    dcand = dh * (1.0 - u) * m
+    dpre_c = dcand * (1.0 - c * c)
+    # (r*h) branch through w_hc
+    drh = jnp.dot(dpre_c.astype(whc_ref.dtype), whc_ref[...].T,
+                  preferred_element_type=jnp.float32,
+                  precision=_prec(whc_ref))
+    dr = drh * h_prev * r * (1.0 - r)                 # = dpre_r
+    dur = jnp.concatenate([du, dr], axis=-1)
+    dh_prev = (dh * u * m
+               + drh * r
+               + jnp.dot(dur.astype(wh_ref.dtype), wh_ref[...].T,
+                         preferred_element_type=jnp.float32,
+                         precision=_prec(wh_ref)))
+    return jnp.concatenate([dur, dpre_c], axis=-1), dh_prev
 
 
 def _bwd_kernel(mask_ref, wh_ref, whc_ref, urc_ref, hs_prev_ref,
@@ -80,23 +115,8 @@ def _bwd_kernel(mask_ref, wh_ref, whc_ref, urc_ref, hs_prev_ref,
     c = urc[:, 2 * d:3 * d]
     h_prev = hs_prev_ref[0].astype(jnp.float32)
 
-    # h' = u*h + (1-u)*c, all grads masked (frozen rows pass dh through)
-    du = dh * (h_prev - c) * u * (1.0 - u) * m        # = dpre_u
-    dcand = dh * (1.0 - u) * m
-    dpre_c = dcand * (1.0 - c * c)
-    # (r*h) branch through w_hc
-    drh = jnp.dot(dpre_c.astype(whc_ref.dtype), whc_ref[...].T,
-                  preferred_element_type=jnp.float32,
-                  precision=_prec(whc_ref))
-    dr = drh * h_prev * r * (1.0 - r)                 # = dpre_r
-    dur = jnp.concatenate([du, dr], axis=-1)
-    dh_prev = (dh * u * m
-               + drh * r
-               + jnp.dot(dur.astype(wh_ref.dtype), wh_ref[...].T,
-                         preferred_element_type=jnp.float32,
-                         precision=_prec(wh_ref)))
-    dxw_ref[0] = jnp.concatenate([dur, dpre_c], axis=-1).astype(
-        dxw_ref.dtype)
+    dxw, dh_prev = _durc_bwd(u, r, c, h_prev, dh, m, wh_ref, whc_ref)
+    dxw_ref[0] = dxw.astype(dxw_ref.dtype)
     dh_scr[...] = dh_prev + (1.0 - m) * dh
 
     @pl.when(t == nt - 1)
@@ -104,14 +124,59 @@ def _bwd_kernel(mask_ref, wh_ref, whc_ref, urc_ref, hs_prev_ref,
         dh0_ref[...] = dh_scr[...]
 
 
-def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret):
+def _bwd_remat_kernel(xw_ref, mask_ref, wh_ref, whc_ref, hs_prev_ref,
+                      dhs_ref, dhT_ref,
+                      dxw_ref, dh0_ref, dh_scr, *, d, io_dtype):
+    """Reverse-time step with in-kernel u/r/c recomputation (remat mode):
+    the [T, B, 3D] urc slab is never written as a forward residual —
+    gates are re-derived from xw (a primal input) and the h stack, then
+    round-tripped through the forward's io dtype so remat stays a pure
+    memory knob (bit-identical to stored-gates mode per backend)."""
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        dh_scr[...] = dhT_ref[...]
+
+    m = mask_ref[0]
+    dh = dh_scr[...] + dhs_ref[0].astype(jnp.float32)
+
+    h_prev_m = hs_prev_ref[0]  # io dtype == the fwd carry's matmul dtype
+    u, r, c, hf = _gru_gates(
+        xw_ref[0].astype(jnp.float32),
+        h_prev_m.astype(wh_ref.dtype), wh_ref, whc_ref, d)
+    urc = jnp.concatenate([u, r, c], axis=-1).astype(io_dtype).astype(
+        jnp.float32)
+    u = urc[:, 0 * d:1 * d]
+    r = urc[:, 1 * d:2 * d]
+    c = urc[:, 2 * d:3 * d]
+
+    dxw, dh_prev = _durc_bwd(u, r, c, hf, dh, m, wh_ref, whc_ref)
+    dxw_ref[0] = dxw.astype(dxw_ref.dtype)
+    dh_scr[...] = dh_prev + (1.0 - m) * dh
+
+    @pl.when(t == nt - 1)
+    def _final():
+        dh0_ref[...] = dh_scr[...]
+
+
+def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret,
+              emit_gates=True):
     t, b, dd3 = xw.shape  # time-major [T, B, 3D]
     d = dd3 // 3
     io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
-    kernel = functools.partial(_fwd_kernel, d=d)
+    kernel = functools.partial(_fwd_kernel, d=d, emit_gates=emit_gates)
     # reversed index maps instead of flipped HBM copies (see lstm.py)
     step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
-    hs, urc, hT = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, b, d), step)]                 # hs
+    out_shape = [jax.ShapeDtypeStruct((t, b, d), io_dtype)]
+    if emit_gates:
+        out_specs.append(pl.BlockSpec((1, b, dd3), step))       # u,r,c
+        out_shape.append(jax.ShapeDtypeStruct((t, b, dd3), io_dtype))
+    out_specs.append(pl.BlockSpec((b, d), lambda i: (0, 0)))    # h_T
+    out_shape.append(jax.ShapeDtypeStruct((b, d), jnp.float32))
+    out = pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
@@ -121,22 +186,18 @@ def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret):
             pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
             pl.BlockSpec((b, d), lambda i: (0, 0)),             # h0
         ],
-        out_specs=[
-            pl.BlockSpec((1, b, d), step),                      # hs
-            pl.BlockSpec((1, b, dd3), step),                    # u,r,c
-            pl.BlockSpec((b, d), lambda i: (0, 0)),             # h_T
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((t, b, d), io_dtype),
-            jax.ShapeDtypeStruct((t, b, dd3), io_dtype),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((b, d), w_h.dtype)],
         compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(xw, mask, w_h, w_hc, h0)
+    if emit_gates:
+        hs, urc, hT = out
+    else:
+        (hs, hT), urc = out, None
     return hs, urc, hT
 
 
@@ -176,55 +237,277 @@ def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, reverse,
     return dxw, dh0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def gru_seq(xw, mask, w_h, w_hc, h0, reverse=False, interpret=False):
+def _bwd_remat_call(xw, mask, w_h, w_hc, hs_prev, dhs, dhT, *, reverse,
+                    interpret):
+    t, b, dd3 = xw.shape
+    d = dd3 // 3
+    io_dtype = jnp.bfloat16 if hs_prev.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_bwd_remat_kernel, d=d, io_dtype=io_dtype)
+    rev = ((lambda i: (i, 0, 0)) if reverse
+           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
+    dxw, dh0 = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, dd3), rev),                     # xw
+            pl.BlockSpec((1, b, 1), rev),                       # mask
+            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
+            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
+            pl.BlockSpec((1, b, d), rev),                       # h_{t-1}
+            pl.BlockSpec((1, b, d), rev),                       # dh_t
+            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh_T
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, dd3), rev),                     # dxw
+            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, dd3), jnp.float32),
+            jax.ShapeDtypeStruct((b, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(xw, mask, w_h, w_hc, hs_prev, dhs, dhT)
+    return dxw, dh0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def gru_seq(xw, mask, w_h, w_hc, h0, reverse=False, interpret=False,
+            remat=False):
     """Fused GRU over a whole sequence.
 
     xw: [B, T, 3D] precomputed x @ W_x (+ bias), layout [update, reset,
     candidate]; mask: [B, T]; w_h: [D, 2D]; w_hc: [D, D]; h0: [B, D];
-    reverse iterates time T-1..0 via index maps (no data flips).
-    Returns (hs [B, T, D], h_T).
+    reverse iterates time T-1..0 via index maps (no data flips); remat
+    drops the [T, B, 3D] u/r/c residual slab and recomputes the gates in
+    the reverse kernel (same numerics — round-tripped through the io
+    dtype).  Returns (hs [B, T, D], h_T).
     """
     hs, _, hT = _fwd_call(jnp.swapaxes(xw, 0, 1), _mask3(mask),
                           w_h, w_hc, h0, reverse=reverse,
-                          interpret=interpret)
+                          interpret=interpret, emit_gates=False)
     return jnp.swapaxes(hs, 0, 1), hT
 
 
-def _gru_seq_fwd(xw, mask, w_h, w_hc, h0, reverse, interpret):
-    hs, urc, hT = _fwd_call(jnp.swapaxes(xw, 0, 1), _mask3(mask),
+def _recompute_urc(xw_t, hs_prev, w_h, w_hc, io_dtype):
+    """Host-graph u/r/c recomputation for the weight-grad contractions in
+    remat mode (the kernel recomputes its own copy per step): only the r
+    slice is needed, via one [T*B] matmul against w_h's reset half."""
+    d = w_hc.shape[0]
+    r_pre = (xw_t[:, :, d:2 * d].astype(jnp.float32)
+             + jnp.dot(hs_prev.astype(w_h.dtype), w_h[:, d:],
+                       preferred_element_type=jnp.float32,
+                       precision=_prec(w_h)))
+    return jax.nn.sigmoid(r_pre).astype(io_dtype)
+
+
+def _gru_seq_fwd(xw, mask, w_h, w_hc, h0, reverse, interpret, remat):
+    xw_t = jnp.swapaxes(xw, 0, 1)
+    hs, urc, hT = _fwd_call(xw_t, _mask3(mask),
                             w_h, w_hc, h0, reverse=reverse,
-                            interpret=interpret)
-    return (jnp.swapaxes(hs, 0, 1), hT), (mask, w_h, w_hc, h0, hs, urc)
+                            interpret=interpret, emit_gates=not remat)
+    return ((jnp.swapaxes(hs, 0, 1), hT),
+            (xw_t if remat else None, mask, w_h, w_hc, h0, hs, urc))
 
 
-def _gru_seq_bwd(reverse, interpret, res, cts):
+def _gru_dxw_bwd(xw_t, mask, w_h, w_hc, h0, hs, urc, d_hs_t, d_hT,
+                 reverse, interpret, remat):
+    """Shared reverse pass (stored-gates or remat kernel) + the large
+    weight-grad contractions.  Returns (dxw [T,B,3D], dwh, dwhc, dh0)."""
     from paddle_tpu.ops.pallas import mxu_precision
     from paddle_tpu.ops.pallas.lstm import _shift_prev
 
-    mask, w_h, w_hc, h0, hs, urc = res
-    d_hs, d_hT = cts
     d = w_hc.shape[0]
     hs_prev = _shift_prev(hs, h0, reverse)
-    dxw, dh0 = _bwd_call(
-        _mask3(mask), w_h, w_hc, urc, hs_prev,
-        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
-        d_hT.astype(jnp.float32), reverse=reverse, interpret=interpret)
+    if remat:
+        dxw, dh0 = _bwd_remat_call(
+            xw_t, _mask3(mask), w_h, w_hc, hs_prev,
+            d_hs_t, d_hT, reverse=reverse, interpret=interpret)
+        r_gate = _recompute_urc(xw_t, hs_prev, w_h, w_hc, hs.dtype)
+    else:
+        dxw, dh0 = _bwd_call(
+            _mask3(mask), w_h, w_hc, urc, hs_prev,
+            d_hs_t, d_hT, reverse=reverse, interpret=interpret)
+        r_gate = urc[:, :, d:2 * d]
     # weight grads as single large contractions
     prec = mxu_precision(w_h)
     hp = hs_prev.astype(w_h.dtype)
     dwh = jnp.einsum("tbd,tbe->de", hp, dxw[:, :, :2 * d].astype(w_h.dtype),
                      preferred_element_type=jnp.float32, precision=prec)
-    rh = (urc[:, :, d:2 * d].astype(jnp.float32)
+    rh = (r_gate.astype(jnp.float32)
           * hs_prev.astype(jnp.float32)).astype(w_hc.dtype)
     dwhc = jnp.einsum("tbd,tbe->de", rh, dxw[:, :, 2 * d:].astype(w_hc.dtype),
                       preferred_element_type=jnp.float32, precision=prec)
+    return dxw, dwh, dwhc, dh0
+
+
+def _gru_seq_bwd(reverse, interpret, remat, res, cts):
+    xw_t, mask, w_h, w_hc, h0, hs, urc = res
+    d_hs, d_hT = cts
+    dxw, dwh, dwhc, dh0 = _gru_dxw_bwd(
+        xw_t, mask, w_h, w_hc, h0, hs, urc,
+        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+        d_hT.astype(jnp.float32), reverse, interpret, remat)
     dxw_b = jnp.swapaxes(dxw, 0, 1).astype(hs.dtype)
     return (dxw_b, None, dwh.astype(w_h.dtype), dwhc.astype(w_hc.dtype),
             dh0.astype(h0.dtype))
 
 
 gru_seq.defvjp(_gru_seq_fwd, _gru_seq_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused-input entry: x @ W_x folded INTO the time loop
+# ---------------------------------------------------------------------------
+
+
+def _fwd_fi_kernel(x_ref, mask_ref, wx_ref, b_ref, wh_ref, whc_ref, h0_ref,
+                   *rest, d, emit_gates=True):
+    """Forward step with the input projection fused into the loop: x
+    [T, B, E] streams once while W_x [E, 3D], W_h and W_hc all stay
+    VMEM-resident — the [T, B, 3D] gate-input slab never exists in HBM."""
+    if emit_gates:
+        hs_ref, urc_ref, hT_ref, h_scr = rest
+    else:
+        hs_ref, hT_ref, h_scr = rest
+        urc_ref = None
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = h0_ref[...].astype(h_scr.dtype)
+
+    h = h_scr[...]
+    xw = jnp.dot(x_ref[0].astype(wx_ref.dtype), wx_ref[...],
+                 preferred_element_type=jnp.float32,
+                 precision=_prec(wx_ref)) + b_ref[...].astype(jnp.float32)
+    u, r, c, hf = _gru_gates(xw, h, wh_ref, whc_ref, d)
+    h_new = u * hf + (1.0 - u) * c
+    m = mask_ref[0]
+    h_new = m * h_new + (1.0 - m) * hf
+
+    h_scr[...] = h_new.astype(h_scr.dtype)
+    hs_ref[0] = h_new.astype(hs_ref.dtype)
+    if urc_ref is not None:
+        urc_ref[0] = jnp.concatenate([u, r, c], axis=-1).astype(
+            urc_ref.dtype)
+
+    @pl.when(t == nt - 1)
+    def _final():
+        hT_ref[...] = h_new.astype(hT_ref.dtype)
+
+
+def _fwd_fi_call(x, mask, w_x, b, w_h, w_hc, h0, *, reverse, interpret,
+                 emit_gates):
+    t, bsz, e = x.shape
+    d = w_hc.shape[0]
+    dd3 = 3 * d
+    io_dtype = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_fwd_fi_kernel, d=d, emit_gates=emit_gates)
+    step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
+    out_specs = [pl.BlockSpec((1, bsz, d), step)]
+    out_shape = [jax.ShapeDtypeStruct((t, bsz, d), io_dtype)]
+    if emit_gates:
+        out_specs.append(pl.BlockSpec((1, bsz, dd3), step))
+        out_shape.append(jax.ShapeDtypeStruct((t, bsz, dd3), io_dtype))
+    out_specs.append(pl.BlockSpec((bsz, d), lambda i: (0, 0)))
+    out_shape.append(jax.ShapeDtypeStruct((bsz, d), jnp.float32))
+    out = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bsz, e), step),                    # x
+            pl.BlockSpec((1, bsz, 1), step),                    # mask
+            pl.BlockSpec((e, dd3), lambda i: (0, 0)),           # w_x resident
+            pl.BlockSpec((1, dd3), lambda i: (0, 0)),           # bias
+            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
+            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
+            pl.BlockSpec((bsz, d), lambda i: (0, 0)),           # h0
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((bsz, d), w_h.dtype)],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, mask, w_x, b.reshape(1, dd3), w_h, w_hc, h0)
+    if emit_gates:
+        hs, urc, hT = out
+    else:
+        (hs, hT), urc = out, None
+    return hs, urc, hT
+
+
+def _project_xw(x_t, w_x, b):
+    """Backward-side xw recomputation for fused-input remat: one large
+    MXU matmul matching the kernel's in-loop projection numerics."""
+    return jnp.dot(x_t.astype(w_x.dtype), w_x,
+                   preferred_element_type=jnp.float32,
+                   precision=_prec(w_x)) + b.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def gru_seq_fi(x, mask, w_x, b, w_h, w_hc, h0, reverse=False,
+               interpret=False, remat=False):
+    """Fused-input GRU over a whole sequence: ``x @ W_x`` runs INSIDE the
+    time-loop kernel (see :func:`gru_seq` for the cell and mask
+    semantics).  x: [B, T, E]; w_x: [E, 3D]; b: [3D] (zeros for no
+    bias).  Returns (hs [B, T, D], h_T)."""
+    hs, _, hT = _fwd_fi_call(
+        jnp.swapaxes(x, 0, 1), _mask3(mask), w_x, b, w_h, w_hc, h0,
+        reverse=reverse, interpret=interpret, emit_gates=False)
+    return jnp.swapaxes(hs, 0, 1), hT
+
+
+def _gru_seq_fi_fwd(x, mask, w_x, b, w_h, w_hc, h0, reverse, interpret,
+                    remat):
+    x_t = jnp.swapaxes(x, 0, 1)
+    hs, urc, hT = _fwd_fi_call(
+        x_t, _mask3(mask), w_x, b, w_h, w_hc, h0, reverse=reverse,
+        interpret=interpret, emit_gates=not remat)
+    return ((jnp.swapaxes(hs, 0, 1), hT),
+            (x_t, mask, w_x, b, w_h, w_hc, h0, hs, urc))
+
+
+def _gru_seq_fi_bwd(reverse, interpret, remat, res, cts):
+    from paddle_tpu.ops.pallas import mxu_precision
+
+    x_t, mask, w_x, b, w_h, w_hc, h0, hs, urc = res
+    d_hs, d_hT = cts
+    xw_t = _project_xw(x_t, w_x, b) if remat else None
+    dxw, dwh, dwhc, dh0 = _gru_dxw_bwd(
+        xw_t, mask, w_h, w_hc, h0, hs, urc,
+        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+        d_hT.astype(jnp.float32), reverse, interpret, remat)
+    prec = mxu_precision(w_x)
+    dg_c = dxw.astype(w_x.dtype)
+    dwx = jnp.einsum("tbe,tbg->eg", x_t.astype(w_x.dtype), dg_c,
+                     preferred_element_type=jnp.float32, precision=prec)
+    db = jnp.sum(dxw, axis=(0, 1))
+    dx = jnp.einsum("tbg,eg->tbe", dg_c, w_x,
+                    preferred_element_type=jnp.float32, precision=prec)
+    return (jnp.swapaxes(dx, 0, 1).astype(x_t.dtype), None,
+            dwx.astype(w_x.dtype), db.astype(b.dtype),
+            dwh.astype(w_h.dtype), dwhc.astype(w_hc.dtype),
+            dh0.astype(h0.dtype))
+
+
+gru_seq_fi.defvjp(_gru_seq_fi_fwd, _gru_seq_fi_bwd)
+
+
+def gru_seq_fi_reference(x, mask, w_x, b, w_h, w_hc, h0, reverse=False):
+    """Pure-jnp oracle of :func:`gru_seq_fi`: the hoisted projection (one
+    big f32 matmul) followed by the :func:`gru_seq_reference` scan."""
+    bsz, t, e = x.shape
+    xw = (x.reshape(bsz * t, e).astype(jnp.float32)
+          @ w_x.astype(jnp.float32)
+          + b.astype(jnp.float32)).reshape(bsz, t, -1)
+    return gru_seq_reference(xw, mask, w_h, w_hc, h0, reverse)
 
 
 def gru_seq_reference(xw, mask, w_h, w_hc, h0, reverse=False):

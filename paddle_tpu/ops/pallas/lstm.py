@@ -51,9 +51,26 @@ def _sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
+def _cell_step(pre, c, peep, d):
+    """One LSTM gate bundle on a [B, 4D] f32 pre-activation: returns
+    (i, f, g, o, c_new, h_new) — shared by every forward kernel here and
+    by the remat backward's in-kernel gate recomputation."""
+    i = _sigmoid(pre[:, 0 * d:1 * d] + peep[0] * c)
+    f = _sigmoid(pre[:, 1 * d:2 * d] + peep[1] * c)
+    g = jnp.tanh(pre[:, 2 * d:3 * d])
+    c_new = f * c + i * g
+    o = _sigmoid(pre[:, 3 * d:4 * d] + peep[2] * c_new)
+    h_new = o * jnp.tanh(c_new)
+    return i, f, g, o, c_new, h_new
+
+
 def _fwd_kernel(xw_ref, mask_ref, wh_ref, peep_ref, h0_ref, c0_ref,
-                hs_ref, cs_ref, gates_ref, hT_ref, cT_ref,
-                h_scr, c_scr, *, d):
+                *rest, d, emit_gates=True):
+    if emit_gates:
+        hs_ref, cs_ref, gates_ref, hT_ref, cT_ref, h_scr, c_scr = rest
+    else:
+        hs_ref, cs_ref, hT_ref, cT_ref, h_scr, c_scr = rest
+        gates_ref = None
     t = pl.program_id(0)
     nt = pl.num_programs(0)
 
@@ -68,12 +85,7 @@ def _fwd_kernel(xw_ref, mask_ref, wh_ref, peep_ref, h0_ref, c0_ref,
         h, wh_ref[...], preferred_element_type=jnp.float32,
         precision=_prec(wh_ref))
     peep = peep_ref[...].astype(jnp.float32)  # [3, D]
-    i = _sigmoid(pre[:, 0 * d:1 * d] + peep[0] * c)
-    f = _sigmoid(pre[:, 1 * d:2 * d] + peep[1] * c)
-    g = jnp.tanh(pre[:, 2 * d:3 * d])
-    c_new = f * c + i * g
-    o = _sigmoid(pre[:, 3 * d:4 * d] + peep[2] * c_new)
-    h_new = o * jnp.tanh(c_new)
+    i, f, g, o, c_new, h_new = _cell_step(pre, c, peep, d)
     # freeze rows past their length (the _masked_scan rule)
     m = mask_ref[0]  # [B, 1] f32
     h_new = m * h_new + (1.0 - m) * h.astype(jnp.float32)
@@ -83,13 +95,26 @@ def _fwd_kernel(xw_ref, mask_ref, wh_ref, peep_ref, h0_ref, c0_ref,
     c_scr[...] = c_new
     hs_ref[0] = h_new.astype(hs_ref.dtype)
     cs_ref[0] = c_new.astype(cs_ref.dtype)
-    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(
-        gates_ref.dtype)
+    if gates_ref is not None:
+        gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(
+            gates_ref.dtype)
 
     @pl.when(t == nt - 1)
     def _final():
         hT_ref[...] = h_new.astype(hT_ref.dtype)
         cT_ref[...] = c_new.astype(cT_ref.dtype)
+
+
+def _dgate_step(i, f, g, o, c, c_prev, peep, dh, dc, m):
+    """Per-step gate cotangents — masked rows passed state through
+    unchanged, so gate grads are zero there and dh/dc flow to t-1."""
+    tanh_c = jnp.tanh(c)
+    do = dh * tanh_c * o * (1.0 - o) * m          # = dpre_o
+    dc_t = (dc + dh * o * (1.0 - tanh_c * tanh_c)) * m + do * peep[2]
+    di = dc_t * g * i * (1.0 - i)                 # = dpre_i
+    df = dc_t * c_prev * f * (1.0 - f)            # = dpre_f
+    dg = dc_t * i * (1.0 - g * g)
+    return di, df, dg, do, dc_t
 
 
 def _bwd_kernel(mask_ref, wh_ref, peep_ref, gates_ref, cs_prev_ref, cs_ref,
@@ -123,14 +148,7 @@ def _bwd_kernel(mask_ref, wh_ref, peep_ref, gates_ref, cs_prev_ref, cs_ref,
     c_prev = cs_prev_ref[0].astype(jnp.float32)
     peep = peep_ref[...].astype(jnp.float32)  # [3, D]
 
-    tanh_c = jnp.tanh(c)
-    # masked rows passed state through unchanged: gate grads are zero
-    # there and dh/dc flow straight to t-1
-    do = dh * tanh_c * o * (1.0 - o) * m          # = dpre_o
-    dc_t = (dc + dh * o * (1.0 - tanh_c * tanh_c)) * m + do * peep[2]
-    di = dc_t * g * i * (1.0 - i)                 # = dpre_i
-    df = dc_t * c_prev * f * (1.0 - f)            # = dpre_f
-    dg = dc_t * i * (1.0 - g * g)
+    di, df, dg, do, dc_t = _dgate_step(i, f, g, o, c, c_prev, peep, dh, dc, m)
     dgates = jnp.concatenate([di, df, dg, do], axis=-1)
     dgates_ref[0] = dgates.astype(dgates_ref.dtype)
 
@@ -155,15 +173,37 @@ def _bwd_kernel(mask_ref, wh_ref, peep_ref, gates_ref, cs_prev_ref, cs_ref,
         dpeep_ref[...] = dpeep_scr[...]
 
 
-def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret):
+def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret,
+              emit_gates=True):
     t, b, dd4 = xw.shape  # time-major [T, B, 4D]
     d = dd4 // 4
     io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
-    kernel = functools.partial(_fwd_kernel, d=d)
+    kernel = functools.partial(_fwd_kernel, d=d, emit_gates=emit_gates)
     # reverse runs the SAME carry recurrence over array indices T-1..0 via
     # reversed index maps — no flipped HBM copies of the sequence
     step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
-    hs, cs, gates, hT, cT = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, b, d), step),                           # hs
+        pl.BlockSpec((1, b, d), step),                           # cs
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((t, b, d), io_dtype),
+        jax.ShapeDtypeStruct((t, b, d), jnp.float32),
+    ]
+    if emit_gates:
+        # the gates slab exists only as a backward residual; remat mode
+        # drops it entirely and recomputes gates in the reverse kernel
+        out_specs.append(pl.BlockSpec((1, b, dd4), step))        # gates
+        out_shape.append(jax.ShapeDtypeStruct((t, b, dd4), io_dtype))
+    out_specs += [
+        pl.BlockSpec((b, d), lambda i: (0, 0)),                  # h_T
+        pl.BlockSpec((b, d), lambda i: (0, 0)),                  # c_T
+    ]
+    out_shape += [
+        jax.ShapeDtypeStruct((b, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, d), jnp.float32),
+    ]
+    out = pl.pallas_call(
         kernel,
         grid=(t,),
         in_specs=[
@@ -174,20 +214,8 @@ def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret):
             pl.BlockSpec((b, d), lambda i: (0, 0)),              # h0
             pl.BlockSpec((b, d), lambda i: (0, 0)),              # c0
         ],
-        out_specs=[
-            pl.BlockSpec((1, b, d), step),                       # hs
-            pl.BlockSpec((1, b, d), step),                       # cs
-            pl.BlockSpec((1, b, dd4), step),                     # gates
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # h_T
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # c_T
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((t, b, d), io_dtype),
-            jax.ShapeDtypeStruct((t, b, d), jnp.float32),
-            jax.ShapeDtypeStruct((t, b, dd4), io_dtype),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((b, d), w_h.dtype),     # h carry (matmul dtype)
             pltpu.VMEM((b, d), jnp.float32),   # c carry
@@ -199,6 +227,11 @@ def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret):
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(xw, mask, w_h, peep, h0, c0)
+    if emit_gates:
+        hs, cs, gates, hT, cT = out
+    else:
+        hs, cs, hT, cT = out
+        gates = None
     return hs, cs, gates, hT, cT
 
 
@@ -252,9 +285,120 @@ def _bwd_call(mask, w_h, peep, gates, cs_prev, cs, dhs, dhT, dcT,
     return dgates, dh0, dc0, dpeep
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _bwd_remat_kernel(xw_ref, mask_ref, wh_ref, peep_ref, hs_prev_ref,
+                      cs_prev_ref, cs_ref, dhs_ref, dhT_ref, dcT_ref,
+                      dgates_ref, dh0_ref, dc0_ref, dpeep_ref,
+                      dh_scr, dc_scr, dpeep_scr, *, d, io_dtype):
+    """Reverse-time step with in-kernel gate recomputation (remat mode):
+    instead of round-tripping the [T, B, 4D] gates slab through HBM as a
+    forward residual, re-run the gate bundle from the xw slab (a primal
+    input — no extra residual) and the h/c stacks.  Recomputed gates are
+    round-tripped through the forward's io dtype so remat is a pure
+    memory knob, not a numerics change (bit-identical to stored-gates
+    mode per backend)."""
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        dh_scr[...] = dhT_ref[...]
+        dc_scr[...] = dcT_ref[...]
+        dpeep_scr[...] = jnp.zeros_like(dpeep_scr)
+
+    m = mask_ref[0]  # [B, 1]
+    dh = dh_scr[...] + dhs_ref[0].astype(jnp.float32)
+    dc = dc_scr[...]
+
+    peep = peep_ref[...].astype(jnp.float32)  # [3, D]
+    c_prev = cs_prev_ref[0].astype(jnp.float32)
+    h_prev = hs_prev_ref[0]
+    pre = xw_ref[0] + jnp.dot(
+        h_prev.astype(wh_ref.dtype), wh_ref[...],
+        preferred_element_type=jnp.float32, precision=_prec(wh_ref))
+    i, f, g, o, _, _ = _cell_step(pre, c_prev, peep, d)
+    # replicate the stored-residual rounding exactly
+    gates = jnp.concatenate([i, f, g, o], axis=-1).astype(io_dtype).astype(
+        jnp.float32)
+    i = gates[:, 0 * d:1 * d]
+    f = gates[:, 1 * d:2 * d]
+    g = gates[:, 2 * d:3 * d]
+    o = gates[:, 3 * d:4 * d]
+    c = cs_ref[0].astype(jnp.float32)
+
+    di, df, dg, do, dc_t = _dgate_step(i, f, g, o, c, c_prev, peep, dh, dc, m)
+    dgates = jnp.concatenate([di, df, dg, do], axis=-1)
+    dgates_ref[0] = dgates.astype(dgates_ref.dtype)
+
+    dpeep_scr[...] = dpeep_scr[...] + jnp.stack([
+        jnp.sum(di * c_prev, axis=0),
+        jnp.sum(df * c_prev, axis=0),
+        jnp.sum(do * c, axis=0),
+    ])
+
+    dh_prev = jnp.dot(dgates.astype(wh_ref.dtype), wh_ref[...].T,
+                      preferred_element_type=jnp.float32,
+                      precision=_prec(wh_ref))
+    dh_scr[...] = dh_prev + (1.0 - m) * dh
+    dc_scr[...] = dc_t * f + di * peep[0] + df * peep[1] + (1.0 - m) * dc
+
+    @pl.when(t == nt - 1)
+    def _final():
+        dh0_ref[...] = dh_scr[...]
+        dc0_ref[...] = dc_scr[...]
+        dpeep_ref[...] = dpeep_scr[...]
+
+
+def _bwd_remat_call(xw, mask, w_h, peep, hs_prev, cs_prev, cs, dhs, dhT,
+                    dcT, *, reverse, interpret):
+    t, b, dd4 = xw.shape
+    d = dd4 // 4
+    io_dtype = jnp.bfloat16 if hs_prev.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_bwd_remat_kernel, d=d, io_dtype=io_dtype)
+    rev = ((lambda i: (i, 0, 0)) if reverse
+           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
+    dgates, dh0, dc0, dpeep = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, dd4), rev),                      # xw
+            pl.BlockSpec((1, b, 1), rev),                        # mask
+            pl.BlockSpec((d, dd4), lambda i: (0, 0)),            # w_h
+            pl.BlockSpec((3, d), lambda i: (0, 0)),              # peephole
+            pl.BlockSpec((1, b, d), rev),                        # h_{t-1}
+            pl.BlockSpec((1, b, d), rev),                        # c_{t-1}
+            pl.BlockSpec((1, b, d), rev),                        # c_t
+            pl.BlockSpec((1, b, d), rev),                        # dh_t (ys)
+            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dh_T
+            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dc_T
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, dd4), rev),                      # dgates
+            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dh0
+            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dc0
+            pl.BlockSpec((3, d), lambda i: (0, 0)),              # dpeep
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, dd4), jnp.float32),
+            jax.ShapeDtypeStruct((b, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, d), jnp.float32),
+            jax.ShapeDtypeStruct((3, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, d), jnp.float32),   # dh carry
+            pltpu.VMEM((b, d), jnp.float32),   # dc carry
+            pltpu.VMEM((3, d), jnp.float32),   # dpeep accumulator
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(xw, mask, w_h, peep, hs_prev, cs_prev, cs, dhs, dhT, dcT)
+    return dgates, dh0, dc0, dpeep
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
 def lstm_seq(xw, mask, w_h, peephole, h0, c0, reverse=False,
-             interpret=False):
+             interpret=False, remat=False):
     """Fused LSTM over a whole sequence.
 
     xw:   [B, T, 4D] precomputed x @ W_x (+ bias), gate order [i, f, g, o]
@@ -264,11 +408,16 @@ def lstm_seq(xw, mask, w_h, peephole, h0, c0, reverse=False,
               (pass zeros for a plain LSTM)
     h0, c0: [B, D] initial state
     reverse: iterate time T-1..0 (reversed index maps, no data flips)
+    remat: do not emit the [T, B, 4D] gates slab as a backward residual;
+        the reverse kernel recomputes gates from xw + the h/c stacks
+        (same numerics — recomputation is round-tripped through the io
+        dtype), trading one HBM slab write+read for in-kernel VPU work
     Returns (hs [B, T, D], (h_T, c_T)).
     """
     hs, _, _, hT, cT = _fwd_call(
         jnp.swapaxes(xw, 0, 1), _mask3(mask), w_h, peephole,
-        h0, c0.astype(jnp.float32), reverse=reverse, interpret=interpret)
+        h0, c0.astype(jnp.float32), reverse=reverse, interpret=interpret,
+        emit_gates=False)
     return jnp.swapaxes(hs, 0, 1), (hT, cT)
 
 
@@ -282,34 +431,53 @@ def _shift_prev(stack, boot, reverse):
     return jnp.concatenate([boot, stack[:-1]], axis=0)
 
 
-def _lstm_seq_fwd(xw, mask, w_h, peephole, h0, c0, reverse, interpret):
+def _lstm_seq_fwd(xw, mask, w_h, peephole, h0, c0, reverse, interpret,
+                  remat):
     xw_t = jnp.swapaxes(xw, 0, 1)
     hs, cs, gates, hT, cT = _fwd_call(
         xw_t, _mask3(mask), w_h, peephole, h0, c0.astype(jnp.float32),
-        reverse=reverse, interpret=interpret)
+        reverse=reverse, interpret=interpret, emit_gates=not remat)
     out = (jnp.swapaxes(hs, 0, 1), (hT, cT))
-    return out, (mask, w_h, peephole, h0, c0, hs, cs, gates)
+    return out, (xw_t if remat else None, mask, w_h, peephole, h0, c0,
+                 hs, cs, gates)
 
 
-def _lstm_seq_bwd(reverse, interpret, res, cts):
-    mask, w_h, peephole, h0, c0, hs, cs, gates = res
-    d_hs, (d_hT, d_cT) = cts
-    cs_prev = _shift_prev(cs, c0, reverse)
-    dgates, dh0, dc0, dpeep = _bwd_call(
-        _mask3(mask), w_h, peephole, gates, cs_prev, cs,
-        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
-        d_hT.astype(jnp.float32), d_cT.astype(jnp.float32),
-        reverse=reverse, interpret=interpret)
-    # weight grad as ONE large MXU contraction: [D, T*B] @ [T*B, 4D]
+def _dgates_bwd(xw_t, mask, w_h, peephole, h0, c0, hs, cs, gates,
+                d_hs_t, d_hT, d_cT, reverse, interpret, remat):
+    """Shared reverse pass: stored-gates or remat kernel, then the two
+    large weight-gradient MXU contractions.  Returns
+    (dgates [T,B,4D] f32, dwh, dpeep, dh0, dc0)."""
     from paddle_tpu.ops.pallas import mxu_precision
 
+    cs_prev = _shift_prev(cs, c0, reverse)
+    if remat:
+        dgates, dh0, dc0, dpeep = _bwd_remat_call(
+            xw_t, _mask3(mask), w_h, peephole, _shift_prev(hs, h0, reverse),
+            cs_prev, cs, d_hs_t, d_hT, d_cT,
+            reverse=reverse, interpret=interpret)
+    else:
+        dgates, dh0, dc0, dpeep = _bwd_call(
+            _mask3(mask), w_h, peephole, gates, cs_prev, cs,
+            d_hs_t, d_hT, d_cT, reverse=reverse, interpret=interpret)
+    # weight grad as ONE large MXU contraction: [D, T*B] @ [T*B, 4D]
     hs_prev = _shift_prev(hs, h0, reverse)
     dg_c = dgates.astype(w_h.dtype)
     dwh = jnp.einsum("tbd,tbe->de", hs_prev.astype(w_h.dtype), dg_c,
                      preferred_element_type=jnp.float32,
                      precision=mxu_precision(w_h))
-    # dgates IS dxw; cotangent dtype must match the primal xw (== gates io)
-    dxw = jnp.swapaxes(dgates, 0, 1).astype(gates.dtype)
+    return dgates, dwh, dpeep, dh0, dc0
+
+
+def _lstm_seq_bwd(reverse, interpret, remat, res, cts):
+    xw_t, mask, w_h, peephole, h0, c0, hs, cs, gates = res
+    d_hs, (d_hT, d_cT) = cts
+    dgates, dwh, dpeep, dh0, dc0 = _dgates_bwd(
+        xw_t, mask, w_h, peephole, h0, c0, hs, cs, gates,
+        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+        d_hT.astype(jnp.float32), d_cT.astype(jnp.float32),
+        reverse, interpret, remat)
+    # dgates IS dxw; cotangent dtype must match the primal xw (== hs io)
+    dxw = jnp.swapaxes(dgates, 0, 1).astype(hs.dtype)
     return (dxw, None, dwh.astype(w_h.dtype),
             dpeep.astype(peephole.dtype), dh0.astype(h0.dtype),
             dc0.astype(c0.dtype))
@@ -345,3 +513,419 @@ def lstm_seq_reference(xw, mask, w_h, peephole, h0, c0, reverse=False):
         step, (h0.astype(jnp.float32), c0.astype(jnp.float32)),
         (xw_t, m_t), reverse=reverse)
     return jnp.swapaxes(hs, 0, 1).astype(xw.dtype), (hT, cT)
+
+
+# ---------------------------------------------------------------------------
+# fused-input entry: x @ W_x folded INTO the time loop
+# ---------------------------------------------------------------------------
+
+
+def _fwd_fi_kernel(x_ref, mask_ref, wx_ref, b_ref, wh_ref, peep_ref,
+                   h0_ref, c0_ref, *rest, d, emit_gates=True):
+    """Forward step with the input projection fused into the loop: the
+    raw x [T, B, E] slab streams through ONCE while BOTH weight matrices
+    (W_x [E, 4D] and W_h [D, 4D]) stay VMEM-resident — the [T, B, 4D]
+    gate-input slab never exists in HBM."""
+    if emit_gates:
+        hs_ref, cs_ref, gates_ref, hT_ref, cT_ref, h_scr, c_scr = rest
+    else:
+        hs_ref, cs_ref, hT_ref, cT_ref, h_scr, c_scr = rest
+        gates_ref = None
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = h0_ref[...].astype(h_scr.dtype)
+        c_scr[...] = c0_ref[...]
+
+    h = h_scr[...]
+    c = c_scr[...]
+    xw = jnp.dot(x_ref[0].astype(wx_ref.dtype), wx_ref[...],
+                 preferred_element_type=jnp.float32,
+                 precision=_prec(wx_ref)) + b_ref[...].astype(jnp.float32)
+    pre = xw + jnp.dot(
+        h, wh_ref[...], preferred_element_type=jnp.float32,
+        precision=_prec(wh_ref))
+    peep = peep_ref[...].astype(jnp.float32)
+    i, f, g, o, c_new, h_new = _cell_step(pre, c, peep, d)
+    m = mask_ref[0]
+    h_new = m * h_new + (1.0 - m) * h.astype(jnp.float32)
+    c_new = m * c_new + (1.0 - m) * c
+
+    h_scr[...] = h_new.astype(h_scr.dtype)
+    c_scr[...] = c_new
+    hs_ref[0] = h_new.astype(hs_ref.dtype)
+    cs_ref[0] = c_new.astype(cs_ref.dtype)
+    if gates_ref is not None:
+        gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(
+            gates_ref.dtype)
+
+    @pl.when(t == nt - 1)
+    def _final():
+        hT_ref[...] = h_new.astype(hT_ref.dtype)
+        cT_ref[...] = c_new.astype(cT_ref.dtype)
+
+
+def _fwd_fi_call(x, mask, w_x, b, w_h, peep, h0, c0, *, reverse, interpret,
+                 emit_gates):
+    t, bsz, e = x.shape  # time-major [T, B, E]
+    d = w_h.shape[0]
+    dd4 = 4 * d
+    io_dtype = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_fwd_fi_kernel, d=d, emit_gates=emit_gates)
+    step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
+    out_specs = [
+        pl.BlockSpec((1, bsz, d), step),                         # hs
+        pl.BlockSpec((1, bsz, d), step),                         # cs
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((t, bsz, d), io_dtype),
+        jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
+    ]
+    if emit_gates:
+        out_specs.append(pl.BlockSpec((1, bsz, dd4), step))      # gates
+        out_shape.append(jax.ShapeDtypeStruct((t, bsz, dd4), io_dtype))
+    out_specs += [
+        pl.BlockSpec((bsz, d), lambda i: (0, 0)),                # h_T
+        pl.BlockSpec((bsz, d), lambda i: (0, 0)),                # c_T
+    ]
+    out_shape += [
+        jax.ShapeDtypeStruct((bsz, d), jnp.float32),
+        jax.ShapeDtypeStruct((bsz, d), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bsz, e), step),                     # x [T,B,E]
+            pl.BlockSpec((1, bsz, 1), step),                     # mask
+            pl.BlockSpec((e, dd4), lambda i: (0, 0)),            # w_x resident
+            pl.BlockSpec((1, dd4), lambda i: (0, 0)),            # bias
+            pl.BlockSpec((d, dd4), lambda i: (0, 0)),            # w_h resident
+            pl.BlockSpec((3, d), lambda i: (0, 0)),              # peephole
+            pl.BlockSpec((bsz, d), lambda i: (0, 0)),            # h0
+            pl.BlockSpec((bsz, d), lambda i: (0, 0)),            # c0
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bsz, d), w_h.dtype),   # h carry
+            pltpu.VMEM((bsz, d), jnp.float32),  # c carry
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, mask, w_x, b.reshape(1, dd4), w_h, peep, h0, c0)
+    if emit_gates:
+        hs, cs, gates, hT, cT = out
+    else:
+        hs, cs, hT, cT = out
+        gates = None
+    return hs, cs, gates, hT, cT
+
+
+def _project_xw(x_t, w_x, b):
+    """The backward-side xw recomputation for fused-input remat: ONE large
+    MXU matmul whose per-row numerics match the kernel's in-loop
+    projection (f32 accumulate, no intermediate downcast)."""
+    return jnp.dot(x_t.astype(w_x.dtype), w_x,
+                   preferred_element_type=jnp.float32,
+                   precision=_prec(w_x)) + b.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
+def lstm_seq_fi(x, mask, w_x, b, w_h, peephole, h0, c0, reverse=False,
+                interpret=False, remat=False):
+    """Fused-input LSTM over a whole sequence: ``x @ W_x`` runs INSIDE
+    the time-loop kernel, so the raw input streams through once and the
+    [T, B, 4D] gate-input slab is never materialized in HBM.
+
+    x: [B, T, E] raw inputs; w_x: [E, 4D]; b: [4D] (zeros for no bias);
+    w_h: [D, 4D]; peephole: [3, D]; h0/c0: [B, D]; ``remat`` recomputes
+    gates in the reverse kernel (and xw as one large matmul) instead of
+    storing the gates slab as a residual.  Returns (hs, (h_T, c_T))."""
+    hs, _, _, hT, cT = _fwd_fi_call(
+        jnp.swapaxes(x, 0, 1), _mask3(mask), w_x, b, w_h, peephole,
+        h0, c0.astype(jnp.float32), reverse=reverse, interpret=interpret,
+        emit_gates=False)
+    return jnp.swapaxes(hs, 0, 1), (hT, cT)
+
+
+def _lstm_seq_fi_fwd(x, mask, w_x, b, w_h, peephole, h0, c0, reverse,
+                     interpret, remat):
+    x_t = jnp.swapaxes(x, 0, 1)
+    hs, cs, gates, hT, cT = _fwd_fi_call(
+        x_t, _mask3(mask), w_x, b, w_h, peephole, h0,
+        c0.astype(jnp.float32), reverse=reverse, interpret=interpret,
+        emit_gates=not remat)
+    out = (jnp.swapaxes(hs, 0, 1), (hT, cT))
+    return out, (x_t, mask, w_x, b, w_h, peephole, h0, c0, hs, cs, gates)
+
+
+def _lstm_seq_fi_bwd(reverse, interpret, remat, res, cts):
+    from paddle_tpu.ops.pallas import mxu_precision
+
+    x_t, mask, w_x, b, w_h, peephole, h0, c0, hs, cs, gates = res
+    d_hs, (d_hT, d_cT) = cts
+    xw_t = _project_xw(x_t, w_x, b) if remat else None
+    dgates, dwh, dpeep, dh0, dc0 = _dgates_bwd(
+        xw_t, mask, w_h, peephole, h0, c0, hs, cs, gates,
+        jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+        d_hT.astype(jnp.float32), d_cT.astype(jnp.float32),
+        reverse, interpret, remat)
+    # input-projection grads as single large MXU contractions
+    prec = mxu_precision(w_x)
+    dg_c = dgates.astype(w_x.dtype)
+    dwx = jnp.einsum("tbe,tbg->eg", x_t.astype(w_x.dtype), dg_c,
+                     preferred_element_type=jnp.float32, precision=prec)
+    db = jnp.sum(dgates, axis=(0, 1))
+    dx = jnp.einsum("tbg,eg->tbe", dg_c, w_x,
+                    preferred_element_type=jnp.float32, precision=prec)
+    return (jnp.swapaxes(dx, 0, 1).astype(x_t.dtype), None,
+            dwx.astype(w_x.dtype), db.astype(b.dtype),
+            dwh.astype(w_h.dtype), dpeep.astype(peephole.dtype),
+            dh0.astype(h0.dtype), dc0.astype(c0.dtype))
+
+
+lstm_seq_fi.defvjp(_lstm_seq_fi_fwd, _lstm_seq_fi_bwd)
+
+
+def lstm_seq_fi_reference(x, mask, w_x, b, w_h, peephole, h0, c0,
+                          reverse=False):
+    """Pure-jnp oracle of :func:`lstm_seq_fi`: the hoisted projection (one
+    big f32 matmul) followed by the :func:`lstm_seq_reference` scan."""
+    bsz, t, e = x.shape
+    xw = (x.reshape(bsz * t, e).astype(jnp.float32)
+          @ w_x.astype(jnp.float32)
+          + b.astype(jnp.float32)).reshape(bsz, t, -1)
+    return lstm_seq_reference(xw, mask, w_h, peephole, h0, c0, reverse)
+
+
+# ---------------------------------------------------------------------------
+# fused bidirectional entry: both directions over ONE weight residency
+# ---------------------------------------------------------------------------
+
+
+def _bi_fwd_kernel(xf_ref, xb_ref, mf_ref, mb_ref,
+                   wxf_ref, bf_ref, whf_ref, pf_ref,
+                   wxb_ref, bb_ref, whb_ref, pb_ref,
+                   h0f_ref, c0f_ref, h0b_ref, c0b_ref,
+                   *rest, d, emit_gates=True):
+    """One grid pass computes BOTH directions: at step i the forward
+    recurrence advances array index i while the reverse recurrence
+    advances index T-1-i (via its own block index maps), so the fwd/rev
+    passes share a single residency of all four weight matrices instead
+    of paying the weight streaming twice (the BiLSTM double-pay)."""
+    if emit_gates:
+        (hsf_ref, csf_ref, gf_ref, hTf_ref, cTf_ref,
+         hsb_ref, csb_ref, gb_ref, hTb_ref, cTb_ref,
+         hf_scr, cf_scr, hb_scr, cb_scr) = rest
+    else:
+        (hsf_ref, csf_ref, hTf_ref, cTf_ref,
+         hsb_ref, csb_ref, hTb_ref, cTb_ref,
+         hf_scr, cf_scr, hb_scr, cb_scr) = rest
+        gf_ref = gb_ref = None
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        hf_scr[...] = h0f_ref[...].astype(hf_scr.dtype)
+        cf_scr[...] = c0f_ref[...]
+        hb_scr[...] = h0b_ref[...].astype(hb_scr.dtype)
+        cb_scr[...] = c0b_ref[...]
+
+    def one_dir(x_ref, m_ref, wx_ref, b_ref, wh_ref, peep_ref,
+                h_scr, c_scr, hs_ref, cs_ref, gates_ref, hT_ref, cT_ref):
+        h = h_scr[...]
+        c = c_scr[...]
+        xw = jnp.dot(x_ref[0].astype(wx_ref.dtype), wx_ref[...],
+                     preferred_element_type=jnp.float32,
+                     precision=_prec(wx_ref)) + b_ref[...].astype(jnp.float32)
+        pre = xw + jnp.dot(h, wh_ref[...],
+                           preferred_element_type=jnp.float32,
+                           precision=_prec(wh_ref))
+        peep = peep_ref[...].astype(jnp.float32)
+        i, f, g, o, c_new, h_new = _cell_step(pre, c, peep, d)
+        m = m_ref[0]
+        h_new = m * h_new + (1.0 - m) * h.astype(jnp.float32)
+        c_new = m * c_new + (1.0 - m) * c
+        h_scr[...] = h_new.astype(h_scr.dtype)
+        c_scr[...] = c_new
+        hs_ref[0] = h_new.astype(hs_ref.dtype)
+        cs_ref[0] = c_new.astype(cs_ref.dtype)
+        if gates_ref is not None:
+            gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(
+                gates_ref.dtype)
+
+        @pl.when(t == nt - 1)
+        def _final():
+            hT_ref[...] = h_new.astype(hT_ref.dtype)
+            cT_ref[...] = c_new.astype(cT_ref.dtype)
+
+    one_dir(xf_ref, mf_ref, wxf_ref, bf_ref, whf_ref, pf_ref,
+            hf_scr, cf_scr, hsf_ref, csf_ref, gf_ref, hTf_ref, cTf_ref)
+    one_dir(xb_ref, mb_ref, wxb_ref, bb_ref, whb_ref, pb_ref,
+            hb_scr, cb_scr, hsb_ref, csb_ref, gb_ref, hTb_ref, cTb_ref)
+
+
+def _bi_fwd_call(x, mask, w_x_f, b_f, w_h_f, peep_f,
+                 w_x_b, b_b, w_h_b, peep_b, h0f, c0f, h0b, c0b,
+                 *, interpret, emit_gates):
+    t, bsz, e = x.shape
+    d = w_h_f.shape[0]
+    dd4 = 4 * d
+    io_dtype = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_bi_fwd_kernel, d=d, emit_gates=emit_gates)
+    fwd = lambda i: (i, 0, 0)             # noqa: E731
+    rev = lambda i: (t - 1 - i, 0, 0)     # noqa: E731
+    res = lambda i: (0, 0)                # noqa: E731
+
+    def dir_outs(step):
+        specs = [pl.BlockSpec((1, bsz, d), step),
+                 pl.BlockSpec((1, bsz, d), step)]
+        shapes = [jax.ShapeDtypeStruct((t, bsz, d), io_dtype),
+                  jax.ShapeDtypeStruct((t, bsz, d), jnp.float32)]
+        if emit_gates:
+            specs.append(pl.BlockSpec((1, bsz, dd4), step))
+            shapes.append(jax.ShapeDtypeStruct((t, bsz, dd4), io_dtype))
+        specs += [pl.BlockSpec((bsz, d), res), pl.BlockSpec((bsz, d), res)]
+        shapes += [jax.ShapeDtypeStruct((bsz, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bsz, d), jnp.float32)]
+        return specs, shapes
+
+    f_specs, f_shapes = dir_outs(fwd)
+    b_specs, b_shapes = dir_outs(rev)
+    mask3 = mask
+    out = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bsz, e), fwd),                      # x (fwd view)
+            pl.BlockSpec((1, bsz, e), rev),                      # x (rev view)
+            pl.BlockSpec((1, bsz, 1), fwd),                      # mask fwd
+            pl.BlockSpec((1, bsz, 1), rev),                      # mask rev
+            pl.BlockSpec((e, dd4), res), pl.BlockSpec((1, dd4), res),
+            pl.BlockSpec((d, dd4), res), pl.BlockSpec((3, d), res),
+            pl.BlockSpec((e, dd4), res), pl.BlockSpec((1, dd4), res),
+            pl.BlockSpec((d, dd4), res), pl.BlockSpec((3, d), res),
+            pl.BlockSpec((bsz, d), res), pl.BlockSpec((bsz, d), res),
+            pl.BlockSpec((bsz, d), res), pl.BlockSpec((bsz, d), res),
+        ],
+        out_specs=f_specs + b_specs,
+        out_shape=f_shapes + b_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((bsz, d), w_h_f.dtype),
+            pltpu.VMEM((bsz, d), jnp.float32),
+            pltpu.VMEM((bsz, d), w_h_b.dtype),
+            pltpu.VMEM((bsz, d), jnp.float32),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, x, mask3, mask3, w_x_f, b_f.reshape(1, dd4), w_h_f, peep_f,
+      w_x_b, b_b.reshape(1, dd4), w_h_b, peep_b, h0f,
+      c0f, h0b, c0b)
+    k = 5 if emit_gates else 4
+    f_out, b_out = out[:k], out[k:]
+    if emit_gates:
+        hsf, csf, gf, hTf, cTf = f_out
+        hsb, csb, gb, hTb, cTb = b_out
+    else:
+        hsf, csf, hTf, cTf = f_out
+        hsb, csb, hTb, cTb = b_out
+        gf = gb = None
+    return (hsf, csf, gf, hTf, cTf), (hsb, csb, gb, hTb, cTb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(14, 15))
+def bilstm_seq(x, mask, w_x_f, b_f, w_h_f, peep_f,
+               w_x_b, b_b, w_h_b, peep_b, h0f, c0f, h0b, c0b,
+               interpret=False, remat=False):
+    """Fused bidirectional LSTM: forward and reverse recurrences run in
+    ONE pallas program over a single residency of both directions'
+    weights, streaming x once (the composed form pays the x/weight
+    traffic twice).  Returns (hs_f, hs_b, (hT_f, cT_f), (hT_b, cT_b));
+    concatenate hs_f/hs_b on the feature axis for the BiLSTM output."""
+    x_t = jnp.swapaxes(x, 0, 1)
+    f_out, b_out = _bi_fwd_call(
+        x_t, _mask3(mask), w_x_f, b_f, w_h_f, peep_f,
+        w_x_b, b_b, w_h_b, peep_b,
+        h0f, c0f.astype(jnp.float32), h0b, c0b.astype(jnp.float32),
+        interpret=interpret, emit_gates=False)
+    hsf, _, _, hTf, cTf = f_out
+    hsb, _, _, hTb, cTb = b_out
+    return (jnp.swapaxes(hsf, 0, 1), jnp.swapaxes(hsb, 0, 1),
+            (hTf, cTf), (hTb, cTb))
+
+
+def _bilstm_seq_fwd(x, mask, w_x_f, b_f, w_h_f, peep_f,
+                    w_x_b, b_b, w_h_b, peep_b, h0f, c0f, h0b, c0b,
+                    interpret, remat):
+    x_t = jnp.swapaxes(x, 0, 1)
+    f_out, b_out = _bi_fwd_call(
+        x_t, _mask3(mask), w_x_f, b_f, w_h_f, peep_f,
+        w_x_b, b_b, w_h_b, peep_b,
+        h0f, c0f.astype(jnp.float32), h0b, c0b.astype(jnp.float32),
+        interpret=interpret, emit_gates=not remat)
+    hsf, csf, gf, hTf, cTf = f_out
+    hsb, csb, gb, hTb, cTb = b_out
+    out = (jnp.swapaxes(hsf, 0, 1), jnp.swapaxes(hsb, 0, 1),
+           (hTf, cTf), (hTb, cTb))
+    res = (x_t, mask, w_x_f, b_f, w_h_f, peep_f, w_x_b, b_b, w_h_b,
+           peep_b, h0f, c0f, h0b, c0b, hsf, csf, gf, hsb, csb, gb)
+    return out, res
+
+
+def _bilstm_seq_bwd(interpret, remat, res, cts):
+    from paddle_tpu.ops.pallas import mxu_precision
+
+    (x_t, mask, w_x_f, b_f, w_h_f, peep_f, w_x_b, b_b, w_h_b, peep_b,
+     h0f, c0f, h0b, c0b, hsf, csf, gf, hsb, csb, gb) = res
+    d_hsf, d_hsb, (d_hTf, d_cTf), (d_hTb, d_cTb) = cts
+
+    def one_dir(w_x, b, w_h, peep, h0, c0, hs, cs, gates, d_hs, d_hT,
+                d_cT, reverse):
+        xw_t = _project_xw(x_t, w_x, b) if remat else None
+        dgates, dwh, dpeep, dh0, dc0 = _dgates_bwd(
+            xw_t, mask, w_h, peep, h0, c0, hs, cs, gates,
+            jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+            d_hT.astype(jnp.float32), d_cT.astype(jnp.float32),
+            reverse, interpret, remat)
+        prec = mxu_precision(w_x)
+        dg_c = dgates.astype(w_x.dtype)
+        dwx = jnp.einsum("tbe,tbg->eg", x_t.astype(w_x.dtype), dg_c,
+                         preferred_element_type=jnp.float32, precision=prec)
+        db = jnp.sum(dgates, axis=(0, 1))
+        dx = jnp.einsum("tbg,eg->tbe", dg_c, w_x,
+                        preferred_element_type=jnp.float32, precision=prec)
+        return (dx, dwx.astype(w_x.dtype), db.astype(b.dtype),
+                dwh.astype(w_h.dtype), dpeep.astype(peep.dtype),
+                dh0.astype(h0.dtype), dc0.astype(c0.dtype))
+
+    dxf, dwxf, dbf, dwhf, dpf, dh0f, dc0f = one_dir(
+        w_x_f, b_f, w_h_f, peep_f, h0f, c0f, hsf, csf, gf,
+        d_hsf, d_hTf, d_cTf, False)
+    dxb, dwxb, dbb, dwhb, dpb, dh0b, dc0b = one_dir(
+        w_x_b, b_b, w_h_b, peep_b, h0b, c0b, hsb, csb, gb,
+        d_hsb, d_hTb, d_cTb, True)
+    dx = jnp.swapaxes(dxf + dxb, 0, 1).astype(x_t.dtype)
+    return (dx, None, dwxf, dbf, dwhf, dpf, dwxb, dbb, dwhb, dpb,
+            dh0f, dc0f, dh0b, dc0b)
+
+
+bilstm_seq.defvjp(_bilstm_seq_fwd, _bilstm_seq_bwd)
+
+
+def bilstm_seq_reference(x, mask, w_x_f, b_f, w_h_f, peep_f,
+                         w_x_b, b_b, w_h_b, peep_b, h0f, c0f, h0b, c0b):
+    """Pure-jnp oracle of :func:`bilstm_seq`: the two fused-input
+    references composed (forward + reverse), same return contract."""
+    hs_f, last_f = lstm_seq_fi_reference(
+        x, mask, w_x_f, b_f, w_h_f, peep_f, h0f, c0f, False)
+    hs_b, last_b = lstm_seq_fi_reference(
+        x, mask, w_x_b, b_b, w_h_b, peep_b, h0b, c0b, True)
+    return hs_f, hs_b, last_f, last_b

@@ -115,14 +115,20 @@ def lstm(
     """
     b_, t = x.batch_size, x.max_len
     d = w_h.shape[0]
-    xw = matmul(x.data.reshape(b_ * t, -1), w_x)
-    if b is not None:
-        xw = xw + b
-    xw = xw.reshape(b_, t, 4 * d)
     if init is None:
         init = LSTMState(
             h=jnp.zeros((b_, d), jnp.float32), c=jnp.zeros((b_, d), jnp.float32)
         )
+    # standard activations + fused routing on: fold the input projection
+    # into the time-loop kernel (x streams once, W_x and W_h both
+    # VMEM-resident — the [B, T, 4D] xw slab never touches HBM)
+    if (gate_act is act.sigmoid and state_act is act.tanh
+            and fused_input_on() and _fused_fits(b_, d, 4, w_x, w_h)):
+        return lstm_fi(x, w_x, b, w_h, init, reverse=reverse)
+    xw = matmul(x.data.reshape(b_ * t, -1), w_x)
+    if b is not None:
+        xw = xw + b
+    xw = xw.reshape(b_, t, 4 * d)
 
     # standard cell (sigmoid gates, tanh state) -> the fused Pallas
     # sequence kernel: one program iterates time with w_h VMEM-resident,
@@ -151,16 +157,32 @@ def _fused_fits(b: int, d: int, gates: int, *weights) -> bool:
     return resident + slabs < 48 * 1024 * 1024
 
 
+def fused_input_on() -> bool:
+    """True when the fused-input / remat / bidirectional recurrence
+    kernels should engage: the ``fused_kernels`` flag resolves on AND a
+    real TPU is present.  The CPU path keeps the unfused composition
+    (external x @ W_x matmul + the pre-projected kernels), so the bench
+    ablation's flag-off/flag-on trajectories stay bit-identical there —
+    the same convention as ops/nn's TPP conv routing."""
+    import jax as _jax
+
+    from paddle_tpu.ops.pallas.tpp import fused_enabled
+
+    return fused_enabled() and _jax.default_backend() == "tpu"
+
+
 def lstm_fused(xw: SequenceBatch, w_h: jax.Array,
                init: LSTMState, peephole: jax.Array | None = None,
-               reverse: bool = False):
+               reverse: bool = False, remat: bool | None = None):
     """Standard-activation LSTM over precomputed gate inputs via the fused
     Pallas sequence kernel (ops/pallas/lstm.py); the shared fast path of
     ``lstm`` and the ``lstmemory`` layer.  Falls back to the lax.scan
     cell when the weights exceed the kernel's VMEM budget.
 
     xw: SequenceBatch of [B, T, 4D] pre-projected gate inputs;
-    peephole: optional [3D] flat [W_ci, W_cf, W_co] diagonals.
+    peephole: optional [3D] flat [W_ci, W_cf, W_co] diagonals;
+    remat (None = the ``fused_kernels`` flag on TPU): recompute gates in
+    the reverse kernel instead of storing the [T, B, 4D] residual slab.
     Returns (SequenceBatch of h, last LSTMState).
     """
     from paddle_tpu.core import dtype as dt
@@ -181,9 +203,12 @@ def lstm_fused(xw: SequenceBatch, w_h: jax.Array,
         return SequenceBatch(data=ys.h, length=xw.length), last
     peep = (jnp.zeros((3, d), w_h_c.dtype) if peephole is None
             else peephole.reshape(3, d).astype(w_h_c.dtype))
+    if remat is None:
+        remat = fused_input_on()
     hs, (hT, cT) = lstm_seq(
         data, mask, w_h_c, peep,
-        init.h.astype(w_h_c.dtype), init.c, reverse, default_interpret())
+        init.h.astype(w_h_c.dtype), init.c, reverse, default_interpret(),
+        remat)
     # outputs keep the CALLER's dtype, like matmul() does under the flag
     out_dtype = xw.data.dtype
     hs = hs.astype(out_dtype)
@@ -191,11 +216,104 @@ def lstm_fused(xw: SequenceBatch, w_h: jax.Array,
             LSTMState(h=hT.astype(out_dtype), c=cT.astype(out_dtype)))
 
 
+def lstm_fi(x: SequenceBatch, w_x: jax.Array, b: jax.Array | None,
+            w_h: jax.Array, init: LSTMState,
+            peephole: jax.Array | None = None, reverse: bool = False):
+    """Fused-input LSTM: raw x [B, T, E] + both weight matrices through
+    the ``lstm_seq_fi`` kernel (x streams once, W_x/W_h VMEM-resident,
+    no [T, B, 4D] gate-input slab in HBM).  Callers gate on
+    :func:`fused_input_on` + :func:`_fused_fits`; dtype policy matches
+    :func:`lstm_fused`.  Returns (SequenceBatch of h, last LSTMState)."""
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.ops.pallas import default_interpret
+    from paddle_tpu.ops.pallas.lstm import lstm_seq_fi
+
+    d = w_h.shape[0]
+    mask = x.mask().astype(jnp.float32)
+    data, w_x_c, w_h_c = dt.cast_for_matmul(x.data, w_x, w_h)
+    bias = (jnp.zeros((4 * d,), jnp.float32) if b is None
+            else b.astype(jnp.float32))
+    peep = (jnp.zeros((3, d), w_h_c.dtype) if peephole is None
+            else peephole.reshape(3, d).astype(w_h_c.dtype))
+    hs, (hT, cT) = lstm_seq_fi(
+        data, mask, w_x_c, bias, w_h_c, peep,
+        init.h.astype(w_h_c.dtype), init.c, reverse, default_interpret(),
+        True)
+    out_dtype = x.data.dtype
+    return (SequenceBatch(data=hs.astype(out_dtype), length=x.length),
+            LSTMState(h=hT.astype(out_dtype), c=cT.astype(out_dtype)))
+
+
+def bilstm_fused(x: SequenceBatch, fw: tuple, bw: tuple):
+    """Bidirectional LSTM over raw inputs: ONE kernel runs both
+    directions over a single residency of all four weight matrices when
+    the fused routing is on (``ops/pallas/lstm.bilstm_seq``); otherwise
+    the exact unfused composition (two projections + two pre-projected
+    passes).  ``fw``/``bw`` are (w_x [E, 4D], bias [4D] | None,
+    w_h [D, 4D], peephole [3D] | None) per direction.  Returns the
+    concatenated SequenceBatch [B, T, 2D] (forward features first)."""
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.ops.math import matmul
+    from paddle_tpu.ops.pallas import default_interpret
+    from paddle_tpu.ops.pallas.lstm import bilstm_seq
+
+    w_x_f, b_f, w_h_f, peep_f = fw
+    w_x_b, b_b, w_h_b, peep_b = bw
+    d = w_h_f.shape[0]
+    b_, t = x.batch_size, x.max_len
+    zero_state = LSTMState(h=jnp.zeros((b_, d), jnp.float32),
+                           c=jnp.zeros((b_, d), jnp.float32))
+    use_kernel = (fused_input_on()
+                  and _fused_fits(b_, d, 4, *dt.cast_for_matmul(
+                      x.data, w_x_f, w_h_f, w_x_b, w_h_b)[1:]))
+    if not use_kernel:
+        def one(w_x, bias, w_h, peephole, reverse):
+            xw = matmul(x.data.reshape(b_ * t, -1), w_x)
+            if bias is not None:
+                xw = xw + bias
+            out, _ = lstm_fused(
+                SequenceBatch(xw.reshape(b_, t, 4 * d), x.length), w_h,
+                zero_state, peephole=peephole, reverse=reverse)
+            return out
+
+        f = one(w_x_f, b_f, w_h_f, peep_f, False)
+        r = one(w_x_b, b_b, w_h_b, peep_b, True)
+        return SequenceBatch(
+            data=jnp.concatenate([f.data, r.data], axis=-1),
+            length=x.length)
+
+    data, wxf, whf, wxb, whb = dt.cast_for_matmul(
+        x.data, w_x_f, w_h_f, w_x_b, w_h_b)
+    mask = x.mask().astype(jnp.float32)
+
+    def prep(bias, peephole):
+        bias = (jnp.zeros((4 * d,), jnp.float32) if bias is None
+                else bias.astype(jnp.float32))
+        peep = (jnp.zeros((3, d), whf.dtype) if peephole is None
+                else peephole.reshape(3, d).astype(whf.dtype))
+        return bias, peep
+
+    bf, pf = prep(b_f, peep_f)
+    bb, pb = prep(b_b, peep_b)
+    z = zero_state
+    hs_f, hs_b, _, _ = bilstm_seq(
+        data, mask, wxf, bf, whf, pf, wxb, bb, whb, pb,
+        z.h.astype(whf.dtype), z.c, z.h.astype(whb.dtype), z.c,
+        default_interpret(), True)
+    out_dtype = x.data.dtype
+    return SequenceBatch(
+        data=jnp.concatenate([hs_f, hs_b], axis=-1).astype(out_dtype),
+        length=x.length)
+
+
 def gru_fused(xw: SequenceBatch, w_h: jax.Array, w_hc: jax.Array,
-              init: jax.Array, reverse: bool = False):
+              init: jax.Array, reverse: bool = False,
+              remat: bool | None = None):
     """Standard-activation GRU over precomputed gate inputs via the fused
     Pallas sequence kernel (ops/pallas/gru.py); shared fast path of
-    ``gru`` and the ``grumemory`` layer.  Returns (SequenceBatch, last h).
+    ``gru`` and the ``grumemory`` layer.  ``remat`` (None = the
+    ``fused_kernels`` flag on TPU) drops the u/r/c residual slab.
+    Returns (SequenceBatch, last h).
     """
     from paddle_tpu.core import dtype as dt
     from paddle_tpu.ops.pallas import default_interpret
@@ -210,11 +328,38 @@ def gru_fused(xw: SequenceBatch, w_h: jax.Array, w_hc: jax.Array,
         last, ys = _masked_scan(
             step, SequenceBatch(xw.data, xw.length), init, reverse=reverse)
         return SequenceBatch(data=ys, length=xw.length), last
+    if remat is None:
+        remat = fused_input_on()
     hs, hT = gru_seq(data, mask, w_h_c, w_hc_c,
-                     init.astype(w_h_c.dtype), reverse, default_interpret())
+                     init.astype(w_h_c.dtype), reverse, default_interpret(),
+                     remat)
     hs = hs.astype(xw.data.dtype)
     return (SequenceBatch(data=hs, length=xw.length),
             hT.astype(xw.data.dtype))
+
+
+def gru_fi(x: SequenceBatch, w_x: jax.Array, b: jax.Array | None,
+           w_h: jax.Array, w_hc: jax.Array, init: jax.Array,
+           reverse: bool = False):
+    """Fused-input GRU: raw x through the ``gru_seq_fi`` kernel (x
+    streams once; W_x, W_h, W_hc VMEM-resident).  Callers gate on
+    :func:`fused_input_on` + :func:`_fused_fits`.  Returns
+    (SequenceBatch of h, last h)."""
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.ops.pallas import default_interpret
+    from paddle_tpu.ops.pallas.gru import gru_seq_fi
+
+    d = w_hc.shape[0]
+    mask = x.mask().astype(jnp.float32)
+    data, w_x_c, w_h_c, w_hc_c = dt.cast_for_matmul(x.data, w_x, w_h, w_hc)
+    bias = (jnp.zeros((3 * d,), jnp.float32) if b is None
+            else b.astype(jnp.float32))
+    hs, hT = gru_seq_fi(
+        data, mask, w_x_c, bias, w_h_c, w_hc_c,
+        init.astype(w_h_c.dtype), reverse, default_interpret(), True)
+    out_dtype = x.data.dtype
+    return (SequenceBatch(data=hs.astype(out_dtype), length=x.length),
+            hT.astype(out_dtype))
 
 
 def gru(
@@ -231,12 +376,16 @@ def gru(
     """Full GRU over a ragged batch. Returns (SequenceBatch of h, last h)."""
     b_, t = x.batch_size, x.max_len
     d = w_h.shape[0]
+    if init is None:
+        init = jnp.zeros((b_, d), jnp.float32)
+    # fused-input routing: see lstm() above
+    if (gate_act is act.sigmoid and state_act is act.tanh
+            and fused_input_on() and _fused_fits(b_, d, 3, w_x, w_h, w_hc)):
+        return gru_fi(x, w_x, b, w_h, w_hc, init, reverse=reverse)
     xw = matmul(x.data.reshape(b_ * t, -1), w_x)
     if b is not None:
         xw = xw + b
     xw = xw.reshape(b_, t, 3 * d)
-    if init is None:
-        init = jnp.zeros((b_, d), jnp.float32)
 
     if gate_act is act.sigmoid and state_act is act.tanh:
         return gru_fused(SequenceBatch(xw, x.length), w_h, w_hc, init,

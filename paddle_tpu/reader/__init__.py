@@ -3,6 +3,8 @@ zero-arg callable returning an iterator of samples; decorators compose them."""
 
 from paddle_tpu.reader.decorator import (  # noqa: F401
     batch,
+    bucket_batch,
+    bucket_by_length,
     buffered,
     chain,
     compose,
@@ -11,7 +13,11 @@ from paddle_tpu.reader.decorator import (  # noqa: F401
     shuffle,
     xmap_readers,
 )
-from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401
+from paddle_tpu.reader.feeder import (  # noqa: F401
+    DataFeeder,
+    padding_stats,
+    parse_seq_buckets,
+)
 from paddle_tpu.reader.prefetch import (  # noqa: F401
     DevicePrefetcher,
     FeedBatch,

@@ -261,6 +261,114 @@ def batch(reader, batch_size: int, drop_last: bool = False):
     return batch_reader
 
 
+#: ceiling on distinct bucket lengths a bucketed reader may emit — each
+#: bucket is one jit signature for the train step, so an unbounded table
+#: is a recompile bomb (GL-P-RECOMPILE flags signature churn; the tests
+#: assert the trainer compiles at most this many step signatures)
+MAX_SEQ_BUCKETS = 16
+
+
+def _sample_max_len(sample) -> int:
+    """Longest sequence field of a sample — the shared length probe of
+    both bucketing entries (tuple/list samples AND @provider dict
+    samples; scalars count as length 1)."""
+    best = 1
+    if isinstance(sample, dict):
+        fields = list(sample.values())
+    elif isinstance(sample, (list, tuple)):
+        fields = sample
+    else:
+        fields = [sample]
+    for field in fields:
+        if isinstance(field, (list, tuple, np.ndarray)) \
+                and not np.isscalar(field):
+            try:
+                best = max(best, len(field))
+            except TypeError:
+                pass
+    return best
+
+
+def bucket_by_length(reader, batch_size,
+                     buckets=(16, 32, 64, 128, 256, 512, 1024),
+                     sample_length=None, seed: int = 0,
+                     remainder: str = "drop", size_multiple: int = 1):
+    """Length-quantized bucketing for a SAMPLE reader: group samples by
+    ``bucket_length`` of their longest sequence field and emit
+    fixed-size batches per bucket, so padded timesteps stop burning
+    flops and bytes (a mixed-length batch pads every row to the batch
+    max; a bucketed batch pads only to its own quantized ceiling).
+
+    Contract:
+
+    - every emitted batch has EXACTLY ``batch_size`` samples except the
+      end-of-stream flush, so the jit sees at most ``len(buckets)``
+      (batch, time) signatures — the table is capped at
+      ``MAX_SEQ_BUCKETS`` because each bucket is one recompile;
+    - ``remainder`` follows ``parallel.mesh.apply_remainder`` semantics
+      for the end-of-stream leftovers: ``"drop"`` trims each leftover
+      pool to the largest ``size_multiple`` multiple (dropping the
+      rest, logged), ``"pad"`` repeats the pool's last sample up to the
+      FULL ``batch_size`` (keeping the one-shape-per-bucket discipline
+      rather than minting a fresh tail shape);
+    - deterministic given ``seed``: in-stream flushes happen in arrival
+      order; the leftover pools flush in a seed-shuffled bucket order
+      (two runs with equal seeds yield identical batch streams).
+
+    Feed the same ``buckets`` table to ``DataFeeder(seq_buckets=...)``
+    (the trainer's ``seq_buckets``/``--seq_buckets`` knob wires both)
+    so the feeder pads each batch to its bucket ceiling instead of the
+    global table's.
+    """
+    from paddle_tpu.core.enforce import enforce
+    from paddle_tpu.core.lod import bucket_length
+
+    buckets = tuple(sorted(int(b) for b in buckets))
+    enforce(len(buckets) >= 1, "bucket_by_length: empty bucket table")
+    enforce(
+        len(buckets) <= MAX_SEQ_BUCKETS,
+        f"bucket_by_length: {len(buckets)} buckets > MAX_SEQ_BUCKETS "
+        f"({MAX_SEQ_BUCKETS}) — every bucket is one jit recompile of the "
+        f"train step; quantize coarser")
+    enforce(remainder in ("drop", "pad"),
+            f"bucket_by_length: remainder must be 'drop' or 'pad', got "
+            f"{remainder!r}")
+    m = max(int(size_multiple), 1)
+
+    length_of = sample_length or _sample_max_len
+
+    def batch_reader():
+        rng = _random.Random(seed)
+        pools: dict[int, list] = {}
+        for sample in reader():
+            key = bucket_length(length_of(sample), buckets)
+            pool = pools.setdefault(key, [])
+            pool.append(sample)
+            if len(pool) >= batch_size:
+                yield pool[:batch_size]
+                pools[key] = pool[batch_size:]
+        order = sorted(k for k, p in pools.items() if p)
+        rng.shuffle(order)
+        dropped = 0
+        for key in order:
+            pool = pools[key]
+            if remainder == "pad":
+                pool = pool + [pool[-1]] * (batch_size - len(pool))
+                yield pool
+                continue
+            n = (len(pool) // m) * m
+            dropped += len(pool) - n
+            if n:
+                yield pool[:n]
+        if dropped:
+            from paddle_tpu.core import logger as log
+
+            log.info("bucket_by_length: dropped %d tail samples not "
+                     "divisible by %d", dropped, m)
+
+    return batch_reader
+
+
 def bucket_batch(reader, batch_size, calc_batch_size=None, sample_length=None,
                  buckets=(16, 32, 64, 128, 256, 512, 1024),
                  drop_last: bool = False, size_multiple: int = 1):
@@ -290,17 +398,7 @@ def bucket_batch(reader, batch_size, calc_batch_size=None, sample_length=None,
     """
     from paddle_tpu.core.lod import bucket_length
 
-    def default_len(sample):
-        best = 1
-        for field in (sample if isinstance(sample, (list, tuple)) else [sample]):
-            if isinstance(field, (list, tuple, np.ndarray)) and not np.isscalar(field):
-                try:
-                    best = max(best, len(field))
-                except TypeError:
-                    pass
-        return best
-
-    length_of = sample_length or default_len
+    length_of = sample_length or _sample_max_len
     cost_of = calc_batch_size or (lambda s: 1)
 
     m = max(int(size_multiple), 1)

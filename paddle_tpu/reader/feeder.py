@@ -85,14 +85,54 @@ def _stack_uniform(col, dtype) -> np.ndarray | None:
     return None
 
 
+def parse_seq_buckets(spec) -> tuple[int, ...] | None:
+    """Bucket-table spec -> sorted tuple or None (use the default table).
+    Accepts a comma-separated string (the ``--seq_buckets`` CLI /
+    ``PADDLE_TPU_SEQ_BUCKETS`` env form, e.g. ``"8,16,32,64"``), any
+    int sequence, or empty/None."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        spec = [s for s in spec.replace(" ", "").split(",") if s]
+    table = tuple(sorted(int(b) for b in spec))
+    return table or None
+
+
+def padding_stats(feed: Mapping) -> tuple[int, int]:
+    """(padded, total) timesteps across the SequenceBatch slots of a feed
+    — the numerator/denominator of the per-step ``padding_ratio``
+    telemetry field.  Host-side and cheap: only the tiny [B] length
+    vectors are read."""
+    padded = total = 0
+    for v in feed.values():
+        length = getattr(v, "length", None)
+        data = getattr(v, "data", None)
+        if length is None or data is None:
+            continue
+        try:
+            lens = np.asarray(length)
+            t = int(data.shape[1])
+            total += int(lens.size) * t
+            padded += int(np.sum(np.maximum(t - lens, 0)))
+        except (TypeError, ValueError, IndexError):
+            continue  # exotic slot shapes carry no padding signal
+    return padded, total
+
+
 class DataFeeder:
     def __init__(self, data_types: Mapping[str, object] | Sequence[tuple],
-                 feeding: Mapping[str, int] | Sequence[str] | None = None):
+                 feeding: Mapping[str, int] | Sequence[str] | None = None,
+                 seq_buckets: Sequence[int] | None = None):
         """data_types: {layer_name: InputType} or [(name, InputType), ...];
-        feeding: {layer_name: index in sample tuple} (defaults to order)."""
+        feeding: {layer_name: index in sample tuple} (defaults to order);
+        seq_buckets: override the default length-quantization table for
+        sequence slots — MUST match the reader's ``bucket_by_length``
+        table so every batch of a bucket compiles to one static shape."""
         if not isinstance(data_types, Mapping):
             data_types = dict(data_types)
         self.types = dict(data_types)
+        self.seq_buckets = (tuple(sorted(int(b) for b in seq_buckets))
+                            if seq_buckets else None)
         if feeding is None:
             self.feeding = {n: i for i, n in enumerate(self.types)}
         elif isinstance(feeding, Mapping):
@@ -146,7 +186,8 @@ class DataFeeder:
                 stacked = _stack_uniform(col, dt)
                 if stacked is not None:
                     t_true = stacked.shape[1]
-                    t = bucket_length(t_true)
+                    t = (bucket_length(t_true) if self.seq_buckets is None
+                         else bucket_length(t_true, self.seq_buckets))
                     if t != t_true:
                         padded = np.zeros(
                             (len(col), t) + stacked.shape[2:], dt)
@@ -170,7 +211,7 @@ class DataFeeder:
                 seqs = [_densify_pairs(s, itype.dim) for s in col]
             else:
                 seqs = [np.asarray(s, dtype=np.float32) for s in col]
-            return from_ragged(seqs)
+            return from_ragged(seqs, buckets=self.seq_buckets)
         elif seq == SeqType.SUB_SEQUENCE:
             dt = np.int32 if kind == DataKind.INTEGER else np.float32
             nested = [[np.asarray(s, dtype=dt) for s in subs] for subs in col]

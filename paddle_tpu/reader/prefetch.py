@@ -61,6 +61,8 @@ class FeedBatch(NamedTuple):
     examples: int          # samples in the ORIGINAL batch (pre drop/pad)
     feed: dict             # sharded feed pytree
     input_wait_ms: float   # host time this batch kept the step loop waiting
+    padded_timesteps: int = 0   # padded steps across SequenceBatch slots
+    total_timesteps: int = 0    # all steps across SequenceBatch slots
 
 
 class _EndOfStream:
@@ -108,13 +110,18 @@ def skip_feed_batches(reader, skip: int, replicas: int = 1,
 
 
 def _convert(batch, feeder, mesh, remainder: str):
-    """batch -> (examples, sharded feed, mesh used) | None (batch fully
-    dropped).  The mesh rides along so a consumer whose mesh changed
-    between staging and use (elastic resharding — ``rebind_mesh``) can
-    detect and re-place a stale feed instead of handing the step arrays
-    committed to dead devices."""
+    """batch -> (examples, sharded feed, mesh used, padded_timesteps,
+    total_timesteps) | None (batch fully dropped).  The mesh rides along
+    so a consumer whose mesh changed between staging and use (elastic
+    resharding — ``rebind_mesh``) can detect and re-place a stale feed
+    instead of handing the step arrays committed to dead devices.  The
+    padding stats are taken host-side pre-shard (producer thread under
+    prefetch — off the step loop's critical path)."""
+    from paddle_tpu.reader.feeder import padding_stats
+
     examples = len(batch) if hasattr(batch, "__len__") else 0
     feed = feeder(batch) if feeder is not None else batch
+    padded, total = padding_stats(feed) if isinstance(feed, dict) else (0, 0)
     if mesh is not None:
         if remainder != "error":
             from paddle_tpu.parallel.mesh import apply_remainder
@@ -124,7 +131,7 @@ def _convert(batch, feeder, mesh, remainder: str):
             if feed is None:  # "drop" left nothing: skip the batch
                 return None
         feed = mesh.shard_batch(feed)
-    return examples, feed, mesh
+    return examples, feed, mesh, padded, total
 
 
 def _replace_feed(feed, mesh, remainder: str):
@@ -173,9 +180,10 @@ class SynchronousFeeds:
             batch = next(self._it)  # StopIteration ends the pass
             item = _convert(batch, self._feeder, self._mesh, self._remainder)
             if item is not None:
-                examples, feed, _ = item
+                examples, feed, _, padded, total = item
                 return FeedBatch(
-                    examples, feed, (time.perf_counter() - t0) * 1e3)
+                    examples, feed, (time.perf_counter() - t0) * 1e3,
+                    padded, total)
 
     def rebind_mesh(self, mesh) -> None:
         """Adopt a rebuilt mesh (elastic resharding): nothing is staged
@@ -275,7 +283,7 @@ class DevicePrefetcher:
             self._done = True
             self._thread.join(timeout=5.0)
             raise item.exc
-        examples, feed, used_mesh = item
+        examples, feed, used_mesh, padded, total = item
         with self._mesh_lock:
             mesh_now = self._mesh
         if mesh_now is not None and used_mesh is not mesh_now:
@@ -284,7 +292,7 @@ class DevicePrefetcher:
             # dropping — the reader already advanced past this batch,
             # so dropping would silently skip data
             feed = _replace_feed(feed, mesh_now, self._remainder)
-        return FeedBatch(examples, feed, wait_ms)
+        return FeedBatch(examples, feed, wait_ms, padded, total)
 
     def rebind_mesh(self, mesh) -> None:
         """Adopt a rebuilt mesh (elastic resharding).  The producer

@@ -71,6 +71,13 @@ from typing import Any
 # fleet_redial_exhausted / fleet_duplicate_results /
 # fleet_replica_down{reason} counters and the fleet_alive_replicas /
 # fleet_queue_depth gauges.
+# /10 added the per-step input padding signal (sequence bucketing):
+# step records carry ``padding_ratio`` (padded/total timesteps across
+# the feed's SequenceBatch slots, omitted for non-sequence feeds) plus
+# the matching pull-side padding_ratio gauge — rendered by
+# tools/metrics_to_md.py with a flag when >25% of fed timesteps are
+# padding (the signal that the reader should bucket by length).  No new
+# record kinds.
 # /9 extended the "preflight" record with the GL-P-MEM static memory
 # report (graftlint v2): a ``memory`` dict carrying the per-device byte
 # accounting of the built step — params_bytes, opt_state_bytes (under
@@ -79,7 +86,7 @@ from typing import Any
 # xla-memory-analysis), total_bytes, dp, zero and the per-pallas_call
 # pallas_vmem footprints — rendered as a budget table by
 # tools/metrics_to_md.py.  No new record kinds.
-SCHEMA = "paddle_tpu.metrics/9"
+SCHEMA = "paddle_tpu.metrics/10"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted

@@ -120,6 +120,7 @@ class StepTelemetry:
                     comm: dict | None = None,
                     input_wait_ms: float | None = None,
                     host_stall_ms: float | None = None,
+                    padding_ratio: float | None = None,
                     extra: dict | None = None) -> dict:
         """Assemble, aggregate, emit and flight-record one step record.
 
@@ -171,6 +172,11 @@ class StepTelemetry:
             rec["input_wait_ms"] = round(float(input_wait_ms), 4)
         if host_stall_ms is not None:
             rec["host_stall_ms"] = round(float(host_stall_ms), 4)
+        if padding_ratio is not None:
+            # padded/total timesteps of this step's sequence feeds — the
+            # bucketing signal (schema/10; >25% means most-of-a-quarter
+            # of the recurrent flops ran on padding)
+            rec["padding_ratio"] = round(float(padding_ratio), 4)
         if comm is None:
             comm = reg_mod.comm_snapshot(self.registry)
         if comm:
@@ -200,6 +206,10 @@ class StepTelemetry:
             r.gauge("host_stall_ms",
                     "amortized device-fence ms per step").set(
                 float(host_stall_ms), run=self.run)
+        if padding_ratio is not None:
+            r.gauge("padding_ratio",
+                    "padded/total timesteps of the step's feeds").set(
+                float(padding_ratio), run=self.run)
 
         if r.active:
             rec = r.emit(rec)

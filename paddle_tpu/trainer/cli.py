@@ -80,6 +80,12 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--sync_period", type=int, default=None,
                    help="fence device costs every N steps (1 = per-batch "
                         "v2 event cadence; default 8)")
+    p.add_argument("--seq_buckets", default=None,
+                   help="comma-separated length-bucket table (e.g. "
+                        "'8,16,32,64'): batch the training reader by "
+                        "quantized sequence length and pad feeds only to "
+                        "each bucket's ceiling — padded timesteps stop "
+                        "burning recurrent flops (empty = off)")
     # weight-update sharding (README "Weight-update sharding (ZeRO-1/2)"):
     # the pserver's sharded aggregation re-expressed in-mesh
     p.add_argument("--zero", type=int, default=None, choices=[0, 1, 2],
@@ -224,15 +230,31 @@ def _raw_reader_from_data_config(rec: dict, topo, input_order):
 
 def _reader_from_data_config(rec: dict, batch_size: int, shuffle: bool,
                              topo=None, input_order=None,
-                             drop_last: bool | None = None):
+                             drop_last: bool | None = None,
+                             seq_buckets=None):
     """DataConfig(py2) -> batched paddle reader via the provider module.
     The provider's declared ``input_types`` override the data layers' dense
-    placeholders (reference: types live in the provider, not the config)."""
+    placeholders (reference: types live in the provider, not the config).
+    ``seq_buckets`` (a table from ``--seq_buckets``) batches by quantized
+    length instead of arrival order, so padded timesteps stop burning
+    flops in the recurrent sweeps."""
     import paddle_tpu as paddle
 
     reader, obj = _raw_reader_from_data_config(rec, topo, input_order)
     if shuffle and getattr(obj, "should_shuffle", True) is not False:
         reader = paddle.reader.shuffle(reader, buf_size=4096)
+    if seq_buckets:
+        from paddle_tpu.parallel.mesh import get_mesh
+        from paddle_tpu.reader.decorator import bucket_by_length
+
+        # remainder="pad": leftover pools fill to the FULL batch size, so
+        # every bucket stays ONE jit signature — the same recompile
+        # discipline the drop_last rule below applies to plain batching
+        # (a "drop"-trimmed tail would mint a fresh (batch, time) shape
+        # every pass under shuffle)
+        return bucket_by_length(
+            reader, batch_size, buckets=seq_buckets, remainder="pad",
+            size_multiple=get_mesh().num_replicas)
     if drop_last is None:
         # train (shuffle=True): tail flushes would emit non-pinned batch
         # sizes and recompile every pass (shuffle reorders the tail).
@@ -454,9 +476,16 @@ def cmd_train(args, parsed) -> int:
               file=sys.stderr)
         return 2
     _add_config_dir_to_path(args.config)
+    from paddle_tpu.core import flags as _bflags
+    from paddle_tpu.reader.feeder import parse_seq_buckets
+
+    seq_buckets = parse_seq_buckets(
+        args.seq_buckets if args.seq_buckets is not None
+        else _bflags.get("seq_buckets"))
     reader = _reader_from_data_config(data_rec, batch_size, shuffle=True,
                                       topo=topo,
-                                      input_order=parsed.input_layer_names)
+                                      input_order=parsed.input_layer_names,
+                                      seq_buckets=seq_buckets)
 
     params = paddle.parameters.create(topo)
     if args.init_model_path:
@@ -556,7 +585,7 @@ def cmd_train(args, parsed) -> int:
             nan_policy=_resolve(args.nan_policy, "nan_policy", "none"),
             sync_period=_resolve(args.sync_period, "sync_period", 8),
             prefetch=_resolve(args.prefetch, "prefetch_depth", 2),
-            elastic=elastic)
+            elastic=elastic, seq_buckets=seq_buckets)
 
     max_restarts = _resolve(args.max_restarts, "max_restarts", 0)
     try:

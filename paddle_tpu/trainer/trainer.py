@@ -25,7 +25,8 @@ from paddle_tpu.core.lod import SequenceBatch
 from paddle_tpu.core.parameters import Parameters
 from paddle_tpu.layers.base import LayerOutput
 from paddle_tpu.parallel.mesh import MeshContext, get_mesh
-from paddle_tpu.reader.feeder import DataFeeder
+from paddle_tpu.reader import feeder as feeder_mod
+from paddle_tpu.reader.feeder import DataFeeder, parse_seq_buckets
 from paddle_tpu.trainer import event as v2_event
 from paddle_tpu.trainer.step import build_eval_step, build_train_step
 
@@ -193,7 +194,7 @@ class SGD:
 
                 self._tap_grads = build_tap_grads(self.topology, taps)
 
-    def _default_feeder(self, feeding):
+    def _default_feeder(self, feeding, seq_buckets=None):
         dl = self.topology.data_layers()
         types = {}
         for name, node in dl.items():
@@ -204,7 +205,9 @@ class SGD:
                 seq_type=node.attrs.get("seq_type", 0),
                 kind=node.attrs.get("data_type", DataKind.DENSE),
             )
-        return DataFeeder(types, feeding)
+        if seq_buckets is None:
+            seq_buckets = parse_seq_buckets(flags.get("seq_buckets"))
+        return DataFeeder(types, feeding, seq_buckets=seq_buckets)
 
     # -- the v2 train loop ----------------------------------------------------
     def train(self, reader, num_passes: int = 1,
@@ -213,7 +216,8 @@ class SGD:
               resume: bool = True, checkpoint_async: bool = False,
               metrics_registry=None, sync_period: int | None = None,
               prefetch: int | None = None, nan_policy: str | None = None,
-              checkpoint_batch_period: int | None = None, elastic=None):
+              checkpoint_batch_period: int | None = None, elastic=None,
+              seq_buckets=None):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
 
@@ -317,7 +321,10 @@ class SGD:
             # the finite-cost check below remains as a cheap backstop
             jax.config.update("jax_debug_nans", True)
         self._ensure_built()
-        feeder = self._default_feeder(feeding)
+        # seq_buckets (None = the flag): length-quantization table for the
+        # feeder's sequence slots — set it to the SAME table the reader's
+        # bucket_by_length stage uses so every bucket is one jit signature
+        feeder = self._default_feeder(feeding, seq_buckets)
         params = self.mesh.replicate(self._params_dict())
         states = self.mesh.replicate(self.states)
         if self._opt_state is None:
@@ -649,7 +656,9 @@ class SGD:
                             pass_id=p["pass_id"], batch_id=p["batch_id"],
                             metrics=metrics_f, comm=p["comm"],
                             input_wait_ms=p["wait_ms"],
-                            host_stall_ms=stall_ms)
+                            host_stall_ms=stall_ms,
+                            padding_ratio=(p["padded_ts"] / p["total_ts"]
+                                           if p["total_ts"] else None))
                     event_handler(v2_event.EndIteration(
                         p["pass_id"], p["batch_id"], cost_f, metrics_f,
                         self))
@@ -783,16 +792,22 @@ class SGD:
                                                               batch_id))
                         with stat.timer("feed"):
                             feed = feeder(data_batch)
+                            padded_ts, total_ts = feeder_mod.padding_stats(
+                                feed)
                             feed = self.mesh.shard_batch(feed)
                         wait_ms = (_time.perf_counter() - t_feed0) * 1e3
                         examples = len(data_batch)
                     else:
                         with stat.timer("feed"):
                             try:
-                                examples, feed, wait_ms = next(feed_it)
+                                fb = next(feed_it)
                             except StopIteration:
                                 pass_complete = True
                                 break
+                            examples, feed, wait_ms = (
+                                fb.examples, fb.feed, fb.input_wait_ms)
+                            padded_ts, total_ts = (fb.padded_timesteps,
+                                                   fb.total_timesteps)
                         event_handler(v2_event.BeginIteration(pass_id,
                                                               batch_id))
                     sig = _feed_signature(feed)
@@ -898,6 +913,7 @@ class SGD:
                         "flops": step_flops, "bytes": step_bytes,
                         "comm": step_comm, "wait_ms": wait_ms,
                         "dispatch_ms": dispatch_ms,
+                        "padded_ts": padded_ts, "total_ts": total_ts,
                     })
                     batch_id += 1
                     if len(pending) >= sync_period or preempted["flag"]:
@@ -1007,9 +1023,10 @@ class SGD:
         # eval batch doesn't kill a multi-device run ("drop" keeps metrics
         # exact and skips fully-dropped batches; "pad" over-weights the
         # last sample)
-        for _, feed, _ in SynchronousFeeds(
+        for fb in SynchronousFeeds(
                 reader, feeder, self.mesh,
                 remainder=flags.get("batch_remainder")):
+            feed = fb.feed
             values, cost, metrics = self._eval_step(params, states, feed)
             if self.declared_evaluators:
                 grads = None

@@ -553,7 +553,7 @@ def test_preflight_cli_clean_config_exits_zero(tmp_path):
     recs = [json.loads(line) for line in open(jsonl)]
     pf = [r for r in recs if r.get("kind") == "preflight"]
     assert pf and pf[0]["clean"] is True
-    assert pf[0]["schema"] == "paddle_tpu.metrics/9"
+    assert pf[0]["schema"] == "paddle_tpu.metrics/10"
     # the schema/9 GL-P-MEM memory report rode along
     mem = pf[0]["memory"]
     assert mem["params_bytes"] > 0 and mem["opt_state_bytes"] > 0
@@ -670,6 +670,38 @@ def test_pallas_vmem_fixture_fires_once_with_stable_id():
     assert found[0].anchor.startswith("vmem:")
     # the same kernel on small blocks is clean
     assert memory_budget_pass(report, name="p", vmem_mb=256.0) == []
+
+
+def test_fused_input_lstm_fits_default_vmem_budget():
+    """GL-P-MEM follow-through for the persistent-recurrence kernels:
+    the fused-input LSTM at the bench shapes (embed 128 -> h512, bs 64,
+    T 100, bf16) must fit the default --vmem_mb 128 budget, and an
+    oversized config (h4096 f32: the resident W_h alone is 256 MB) must
+    fail the PREFLIGHT budget pass — not Mosaic compilation."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.analysis import memory_budget_pass, pallas_vmem_estimates
+    from paddle_tpu.ops.pallas.lstm import lstm_seq_fi
+
+    def estimates(b, t, e, d, dt):
+        args = (np.zeros((b, t, e), dt), np.zeros((b, t), np.float32),
+                np.zeros((e, 4 * d), dt), np.zeros((4 * d,), np.float32),
+                np.zeros((d, 4 * d), dt), np.zeros((3, d), dt),
+                np.zeros((b, d), dt), np.zeros((b, d), np.float32))
+        est = pallas_vmem_estimates(
+            lambda *a: lstm_seq_fi(*a, False, True, True), *args)
+        assert est, "no pallas_call found in the fused-input LSTM trace"
+        return {"total_bytes": 0, "zero": 0, "dp": 1,
+                "pallas_vmem": [{"kernel": k, "bytes": v} for k, v in est]}
+
+    bench = estimates(64, 100, 128, 512, jnp.bfloat16)
+    assert memory_budget_pass(bench, name="lstm_fi", vmem_mb=128.0) == []
+
+    big = estimates(64, 100, 128, 4096, jnp.float32)
+    found = memory_budget_pass(big, name="lstm_fi", vmem_mb=128.0)
+    assert len(found) == 1 and found[0].rule == "GL-P-MEM"
+    assert found[0].anchor == "vmem:_fwd_fi_kernel"
 
 
 def test_opt_state_bytes_agree_with_zero_census():

@@ -258,6 +258,219 @@ def test_lstm_seq_matches_reference_fwd_and_vjp(rng_np):
                                        rtol=2e-5, atol=2e-5)
 
 
+def test_lstm_seq_fi_matches_reference_fwd_and_vjp(rng_np):
+    """Fused-input kernel (x @ W_x inside the time loop) vs the hoisted-
+    projection oracle, both remat modes, both directions."""
+    from paddle_tpu.ops.pallas.lstm import lstm_seq_fi, lstm_seq_fi_reference
+
+    B, T, E, D = 2, 5, 6, 8
+    x = jnp.asarray(rng_np.normal(size=(B, T, E)).astype(np.float32) * .4)
+    wx = jnp.asarray(rng_np.normal(size=(E, 4 * D)).astype(np.float32) * .3)
+    b = jnp.asarray(rng_np.normal(size=(4 * D,)).astype(np.float32) * .1)
+    wh = jnp.asarray(rng_np.normal(size=(D, 4 * D)).astype(np.float32) * .3)
+    peep = jnp.asarray(rng_np.normal(size=(3, D)).astype(np.float32) * .2)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([5, 3])[:, None]).astype(np.float32))
+    h0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+    c0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+
+    for reverse in (False, True):
+        for remat in (False, True):
+            def k_loss(x, wx, b, wh, peep, h0, c0):
+                hs, (hT, cT) = lstm_seq_fi(x, mask, wx, b, wh, peep, h0,
+                                           c0, reverse, True, remat)
+                return (jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+                        + 0.5 * jnp.sum(cT))
+
+            def r_loss(x, wx, b, wh, peep, h0, c0):
+                hs, (hT, cT) = lstm_seq_fi_reference(x, mask, wx, b, wh,
+                                                     peep, h0, c0, reverse)
+                return (jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+                        + 0.5 * jnp.sum(cT))
+
+            args = (x, wx, b, wh, peep, h0, c0)
+            assert abs(float(k_loss(*args) - r_loss(*args))) < 1e-4
+            gk = jax.grad(k_loss, argnums=tuple(range(7)))(*args)
+            gr = jax.grad(r_loss, argnums=tuple(range(7)))(*args)
+            for a, bb in zip(gk, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                           rtol=3e-5, atol=3e-5)
+
+
+def test_lstm_seq_remat_bit_identical_to_stored_gates(rng_np):
+    """remat is a pure memory knob: the recomputed-gates backward must
+    reproduce the stored-residual gradients BIT-identically (the
+    recomputation round-trips through the io dtype)."""
+    from paddle_tpu.ops.pallas.lstm import lstm_seq
+
+    B, T, D = 2, 5, 8
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 4 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 4 * D)).astype(np.float32) * .3)
+    peep = jnp.asarray(rng_np.normal(size=(3, D)).astype(np.float32) * .2)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([5, 3])[:, None]).astype(np.float32))
+    h0 = jnp.zeros((B, D))
+    c0 = jnp.zeros((B, D))
+
+    for reverse in (False, True):
+        def grads(remat):
+            def loss(xw, wh, peep):
+                hs, (hT, cT) = lstm_seq(xw, mask, wh, peep, h0, c0,
+                                        reverse, True, remat)
+                return jnp.sum(hs * mask[:, :, None]) + jnp.sum(cT)
+            return jax.grad(loss, argnums=(0, 1, 2))(xw, wh, peep)
+
+        for a, bb in zip(grads(False), grads(True)):
+            assert np.array_equal(np.asarray(a), np.asarray(bb))
+
+
+def test_bilstm_seq_matches_reference_fwd_and_vjp(rng_np):
+    """One-residency bidirectional kernel vs the composed fused-input
+    references (fwd + rev), forward and gradients, both remat modes."""
+    from paddle_tpu.ops.pallas.lstm import bilstm_seq, bilstm_seq_reference
+
+    B, T, E, D = 2, 5, 6, 8
+    x = jnp.asarray(rng_np.normal(size=(B, T, E)).astype(np.float32) * .4)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([5, 3])[:, None]).astype(np.float32))
+
+    def w(scale, *shape):
+        return jnp.asarray(rng_np.normal(size=shape).astype(np.float32)
+                           * scale)
+
+    wxf, wxb = w(.3, E, 4 * D), w(.3, E, 4 * D)
+    bf, bb_ = w(.1, 4 * D), w(.1, 4 * D)
+    whf, whb = w(.3, D, 4 * D), w(.3, D, 4 * D)
+    pf, pb = w(.2, 3, D), jnp.zeros((3, D), jnp.float32)
+    h0 = w(.2, B, D)
+    c0 = w(.2, B, D)
+
+    for remat in (False, True):
+        def k_loss(x, wxf, whf, wxb, whb):
+            hf, hb, (hTf, cTf), (hTb, cTb) = bilstm_seq(
+                x, mask, wxf, bf, whf, pf, wxb, bb_, whb, pb,
+                h0, c0, h0, c0, True, remat)
+            return (jnp.sum((hf + 2 * hb) * mask[:, :, None])
+                    + jnp.sum(hTf) + jnp.sum(cTb))
+
+        def r_loss(x, wxf, whf, wxb, whb):
+            hf, hb, (hTf, cTf), (hTb, cTb) = bilstm_seq_reference(
+                x, mask, wxf, bf, whf, pf, wxb, bb_, whb, pb,
+                h0, c0, h0, c0)
+            return (jnp.sum((hf + 2 * hb) * mask[:, :, None])
+                    + jnp.sum(hTf) + jnp.sum(cTb))
+
+        args = (x, wxf, whf, wxb, whb)
+        assert abs(float(k_loss(*args) - r_loss(*args))) < 1e-4
+        gk = jax.grad(k_loss, argnums=tuple(range(5)))(*args)
+        gr = jax.grad(r_loss, argnums=tuple(range(5)))(*args)
+        for a, bb in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=3e-5, atol=3e-5)
+
+
+def test_gru_seq_fi_matches_reference_fwd_and_vjp(rng_np):
+    from paddle_tpu.ops.pallas.gru import gru_seq_fi, gru_seq_fi_reference
+
+    B, T, E, D = 2, 5, 6, 8
+    x = jnp.asarray(rng_np.normal(size=(B, T, E)).astype(np.float32) * .4)
+    wx = jnp.asarray(rng_np.normal(size=(E, 3 * D)).astype(np.float32) * .3)
+    b = jnp.asarray(rng_np.normal(size=(3 * D,)).astype(np.float32) * .1)
+    wh = jnp.asarray(rng_np.normal(size=(D, 2 * D)).astype(np.float32) * .3)
+    whc = jnp.asarray(rng_np.normal(size=(D, D)).astype(np.float32) * .3)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([3, 5])[:, None]).astype(np.float32))
+    h0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+
+    for reverse in (False, True):
+        for remat in (False, True):
+            def k_loss(x, wx, b, wh, whc, h0):
+                hs, hT = gru_seq_fi(x, mask, wx, b, wh, whc, h0,
+                                    reverse, True, remat)
+                return jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+
+            def r_loss(x, wx, b, wh, whc, h0):
+                hs, hT = gru_seq_fi_reference(x, mask, wx, b, wh, whc,
+                                              h0, reverse)
+                return jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+
+            args = (x, wx, b, wh, whc, h0)
+            assert abs(float(k_loss(*args) - r_loss(*args))) < 1e-4
+            gk = jax.grad(k_loss, argnums=tuple(range(6)))(*args)
+            gr = jax.grad(r_loss, argnums=tuple(range(6)))(*args)
+            for a, bb in zip(gk, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                           rtol=3e-5, atol=3e-5)
+
+
+def test_gru_seq_remat_bit_identical_to_stored_gates(rng_np):
+    from paddle_tpu.ops.pallas.gru import gru_seq
+
+    B, T, D = 2, 5, 8
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 3 * D)).astype(np.float32) * .4)
+    wh = jnp.asarray(rng_np.normal(size=(D, 2 * D)).astype(np.float32) * .3)
+    whc = jnp.asarray(rng_np.normal(size=(D, D)).astype(np.float32) * .3)
+    mask = jnp.asarray((np.arange(T)[None] <
+                        np.asarray([5, 3])[:, None]).astype(np.float32))
+    h0 = jnp.zeros((B, D))
+
+    for reverse in (False, True):
+        def grads(remat):
+            def loss(xw, wh, whc):
+                hs, hT = gru_seq(xw, mask, wh, whc, h0, reverse, True,
+                                 remat)
+                return jnp.sum(hs * mask[:, :, None]) + jnp.sum(hT)
+            return jax.grad(loss, argnums=(0, 1, 2))(xw, wh, whc)
+
+        for a, bb in zip(grads(False), grads(True)):
+            assert np.array_equal(np.asarray(a), np.asarray(bb))
+
+
+def test_bilstm_layer_node_matches_composed_pair(rng_np):
+    """layer.bilstm (ops/rnn.bilstm_fused unfused composition on CPU)
+    must equal the explicit fc+lstmemory+concat build over the SAME
+    parameter values — the checkpoint/ablation contract of the node."""
+    import paddle_tpu as paddle
+    from paddle_tpu.config.topology import Topology
+    from paddle_tpu.layers import activation as act_mod
+    from paddle_tpu.layers import api as layer
+    from paddle_tpu.layers import base, data_type
+
+    B, T, E, D = 3, 6, 8, 4
+    base.reset_name_counters()
+    x = layer.data(name="x", type=data_type.dense_vector_sequence(E))
+    node = layer.bilstm(input=x, size=D, name="bi")
+    topo = Topology(node)
+    params = paddle.parameters.create(topo)
+    feed = {"x": SequenceBatch(
+        data=rng_np.normal(size=(B, T, E)).astype(np.float32),
+        length=np.asarray([6, 4, 1], np.int32))}
+    vals, _ = topo.forward(params.as_dict(), {}, feed, False,
+                           jax.random.key(0))
+    got = vals[node.name]
+    assert got.data.shape == (B, T, 2 * D)
+
+    # composed build with the node's weights copied in by name
+    base.reset_name_counters()
+    x2 = layer.data(name="x", type=data_type.dense_vector_sequence(E))
+    fw = layer.lstmemory(input=layer.fc(
+        input=x2, size=4 * D, act=act_mod.LinearActivation(),
+        name="bi_fw_transform"), name="bi_fw")
+    bw = layer.lstmemory(input=layer.fc(
+        input=x2, size=4 * D, act=act_mod.LinearActivation(),
+        name="bi_bw_transform"), name="bi_bw", reverse=True)
+    cat = layer.concat(input=[fw, bw])
+    topo2 = Topology(cat)
+    params2 = paddle.parameters.create(topo2)
+    for n in params2.names():
+        params2[n] = np.asarray(params[n])
+    vals2, _ = topo2.forward(params2.as_dict(), {}, feed, False,
+                             jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(got.data),
+                               np.asarray(vals2[cat.name].data),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_gru_seq_matches_reference_fwd_and_vjp(rng_np):
     from paddle_tpu.ops.pallas.gru import gru_seq, gru_seq_reference
 

@@ -51,6 +51,7 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
     has_hbm = any("hbm_gbps" in r for r in shown)
     has_wait = any("input_wait_ms" in r for r in shown)
     has_stall = any("host_stall_ms" in r for r in shown)
+    has_pad = any("padding_ratio" in r for r in shown)
     hdr = ["step", "pass", "loss", "step ms", "ex/s"]
     if has_tok:
         hdr.append("tok/s")
@@ -61,6 +62,8 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
         hdr.append("in-wait ms")
     if has_stall:
         hdr.append("stall ms")
+    if has_pad:
+        hdr.append("pad %")
     print("| " + " | ".join(hdr) + " |")
     print("|" + "---|" * len(hdr))
     for r in shown:
@@ -80,6 +83,12 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
                        + (" ⚠" if _host_bound(r) else ""))
         if has_stall:
             row.append(_fmt(r.get("host_stall_ms")))
+        if has_pad:
+            # ⚠ = padding-bound feed: >25% of the fed timesteps are
+            # padding — bucket the reader by length (--seq_buckets)
+            pr = r.get("padding_ratio")
+            row.append((_fmt(pr * 100, 1) if pr is not None else "-")
+                       + (" ⚠" if _padding_bound(r) else ""))
         print("| " + " | ".join(row) + " |")
 
     n = len(steps)
@@ -103,12 +112,27 @@ def step_table(steps: list[dict], last: int | None = None) -> None:
               f"20% of step time): steps {ids}{more} · worst wait "
               f"{_fmt(max(waits))} ms — the input pipeline is starving "
               f"the device; raise --prefetch or vectorize the reader.")
+    padded = [r for r in steps if _padding_bound(r)]
+    if padded:
+        worst = max(r["padding_ratio"] for r in padded)
+        print(f"\n**⚠ {len(padded)}/{n} steps padding-bound** (>25% of "
+              f"fed timesteps are padding, worst "
+              f"{_fmt(worst * 100, 1)}%) — bucket the reader by length "
+              f"(--seq_buckets / reader.bucket_by_length) so the "
+              f"recurrent sweep stops burning flops on pad rows.")
 
 
 def _host_bound(r: dict) -> bool:
     """input wait exceeding 20% of step time = the device idled on input."""
     wait, ms = r.get("input_wait_ms"), r.get("step_ms")
     return bool(wait and ms and wait > 0.2 * ms)
+
+
+def _padding_bound(r: dict) -> bool:
+    """>25% padded timesteps = a quarter of the recurrent flops/bytes
+    ran on padding; the reader should bucket by length."""
+    pr = r.get("padding_ratio")
+    return bool(pr is not None and pr > 0.25)
 
 
 def _census_by_kind(comm: dict) -> dict:
