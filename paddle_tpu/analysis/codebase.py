@@ -56,6 +56,8 @@ THREADED_MODULES = (
     "paddle_tpu/resilience/elastic.py",
     "paddle_tpu/resilience/supervisor.py",
     "paddle_tpu/trainer/checkpoint.py",
+    "paddle_tpu/telemetry/tracing.py",
+    "paddle_tpu/telemetry/introspect.py",
 )
 
 

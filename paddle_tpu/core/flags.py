@@ -272,6 +272,35 @@ define("preflight_rendezvous", "", "shared directory where preflight "
                                    "a different program aborts preflight "
                                    "instead of deadlocking in the first "
                                    "collective")
+# live introspection & span tracing (telemetry/tracing.py,
+# telemetry/introspect.py): the per-process status server, the span
+# ring behind its /trace endpoint, and the --profile_steps windowed
+# device capture.  All off by default — tracing disabled is a no-op
+# guard (bit-identical trajectory, asserted).
+define("status_port", 0, "serve /metrics /healthz /snapshot /trace on "
+                         "this port while training/serving (0 = off; "
+                         "distributed.launch --status_port_base stamps "
+                         "base+rank per process)")
+define("trace_spans", False, "record phase spans (trainer step "
+                             "feed/compute/fence, prefetch producer, "
+                             "serving request lifecycle, fleet "
+                             "router, elastic rebuilds) into the "
+                             "trace ring served at /trace")
+define("trace_ring_size", 8192, "completed spans kept in the trace "
+                                "ring (oldest dropped first)")
+define("trace_dir", "", "dump this host's span ring as a Chrome trace "
+                        "to <trace_dir>/trace-host<k>.json when a "
+                        "train() call ends (merge the per-rank files "
+                        "with tools/trace_merge.py; empty = no dump)")
+define("profile_steps", "", "capture a jax.profiler device trace over "
+                            "dispatch steps A:B of the train loop "
+                            "(half-open, e.g. '2:4'), bracketed by "
+                            "step annotations so host spans line up "
+                            "with the device timeline; emits one "
+                            "'profile' telemetry record")
+define("profile_dir", "", "output directory for the --profile_steps "
+                          "capture (empty = <tmpdir>/paddle_tpu_"
+                          "profile_host<k>)")
 
 # -- env passthroughs read directly (see declare_env above) --------------------
 declare_env("PADDLE_TPU_COORDINATOR",

@@ -61,10 +61,14 @@ import threading
 import time
 
 
-def _substitute(cmd: list[str], rank: int, nproc: int, port: int) -> list[str]:
-    return [a.replace("{rank}", str(rank))
-             .replace("{nproc}", str(nproc))
-             .replace("{port}", str(port)) for a in cmd]
+def _substitute(cmd: list[str], rank: int, nproc: int, port: int,
+                status_port: int | None = None) -> list[str]:
+    out = [a.replace("{rank}", str(rank))
+            .replace("{nproc}", str(nproc))
+            .replace("{port}", str(port)) for a in cmd]
+    if status_port is not None:
+        out = [a.replace("{status_port}", str(status_port)) for a in out]
+    return out
 
 
 def _free_port() -> int:
@@ -144,7 +148,8 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
                  serving: bool = False,
                  membership_path: str | None = None,
                  drain_signal: int | None = None,
-                 grace_s: float = 5.0) -> int:
+                 grace_s: float = 5.0,
+                 status_port_base: int | None = None) -> int:
     """Spawn ``nproc`` local ranks of ``cmd``; returns the exit code.
 
     Default (static fleet): first failure wins — as soon as any rank
@@ -213,13 +218,22 @@ def launch_local(cmd: list[str], nproc: int, *, env=None,
             spawn_ignore = None
     try:
         for rank in range(nproc):
-            argv = _substitute(list(cmd), rank, nproc, port)
+            # per-rank introspection port: base + rank, stamped both as
+            # the {status_port} command template and as the child's
+            # PADDLE_TPU_STATUS_PORT (the --status_port flag's env
+            # override), so every rank's /metrics lands on its own port
+            rank_status = (status_port_base + rank
+                           if status_port_base else None)
+            argv = _substitute(list(cmd), rank, nproc, port,
+                               status_port=rank_status)
             if serving:
                 child_env = serving_env(rank, nproc, base_env=env)
             else:
                 child_env = rank_env(
                     rank, nproc, port, base_env=env,
                     epoch=membership.epoch if membership else 0)
+            if rank_status is not None:
+                child_env["PADDLE_TPU_STATUS_PORT"] = str(rank_status)
             if membership_path:
                 child_env["PADDLE_TPU_MEMBERSHIP"] = membership_path
             if not serving and log_dir and \
@@ -439,6 +453,12 @@ def main(argv=None) -> int:
     p.add_argument("--grace", type=float, default=5.0,
                    help="seconds between forwarded SIGTERM and SIGKILL "
                         "when reaping")
+    p.add_argument("--status_port_base", type=int, default=None,
+                   help="arm each rank's introspection server on port "
+                        "base+rank (PADDLE_TPU_STATUS_PORT stamped per "
+                        "child; {status_port} substituted in the "
+                        "command) — scrape rank k's /metrics at "
+                        "http://127.0.0.1:<base+k>/metrics")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --); {rank}/{nproc}/"
                         "{port} are substituted per process")
@@ -459,7 +479,8 @@ def main(argv=None) -> int:
                         membership_path=args.membership,
                         drain_signal=signal.SIGUSR1 if args.drain
                         else None,
-                        grace_s=args.grace)
+                        grace_s=args.grace,
+                        status_port_base=args.status_port_base)
 
 
 if __name__ == "__main__":

@@ -237,14 +237,19 @@ class DevicePrefetcher:
 
     # -- producer (worker thread) ---------------------------------------------
     def _produce(self) -> None:
+        from paddle_tpu.telemetry.tracing import get_tracer
+
+        tracer = get_tracer()  # spans land in this worker's own lane
         try:
             for batch in self._reader():
                 if self._stop.is_set():
                     return
                 with self._mesh_lock:
                     mesh = self._mesh
-                item = _convert(batch, self._feeder, mesh,
-                                self._remainder)
+                with tracer.span("prefetch", cat="reader",
+                                 staged=self._q.qsize()):
+                    item = _convert(batch, self._feeder, mesh,
+                                    self._remainder)
                 if item is None:
                     continue
                 if not _guarded_put(self._q, item, self._stop):

@@ -387,7 +387,11 @@ class ElasticCoordinator:
         from paddle_tpu.distributed import multihost as mh
         from paddle_tpu.parallel import mesh as mesh_mod
         from paddle_tpu.parallel import zero as zero_mod
+        from paddle_tpu.telemetry.tracing import get_tracer
 
+        tracer = get_tracer()
+        tk_elastic = tracer.begin("elastic", cat="elastic",
+                                  kind=event.kind)
         t0 = time.perf_counter()
         old_mesh = trainer.mesh.mesh
         old_dp = old_mesh.shape.get("data", 1)
@@ -407,7 +411,9 @@ class ElasticCoordinator:
         host_state = None
         if source == "live":
             try:
-                host_state = self._gather_live(params, opt_state, states)
+                with tracer.span("gather", cat="elastic"):
+                    host_state = self._gather_live(params, opt_state,
+                                                   states)
             except ElasticError as e:
                 log.warning("elastic: live re-placement unavailable (%s); "
                             "falling back to the newest cursor "
@@ -416,20 +422,24 @@ class ElasticCoordinator:
         if source == "live" and drain_checkpoint is not None:
             # persist the drain boundary BEFORE the risky rebuild: a
             # crash mid-reshard resumes here instead of losing the pass
+            # (the trainer's callback opens its own "drain" span)
             drain_checkpoint(host_state[0], host_state[1], host_state[2])
 
         # the mesh swap: every cached-mesh consumer is invalidated here
-        new_ctx = mesh_mod.resize_data_axis(trainer.mesh, new_dp,
-                                            devices=devices)
-        respec = zero_mod.respec_report(
-            opt_state, old_mesh, new_ctx.mesh) if trainer.zero else {}
-        trainer.mesh = new_ctx
-        mesh_mod.set_mesh(new_ctx)
-        trainer._train_step = None
-        trainer._eval_step = None
-        trainer._compiled_sigs.clear()
-        trainer._telemetry_costs.clear()  # per-signature MFU/census costs
-        trainer._ensure_built()
+        with tracer.span("reshard", cat="elastic", old_dp=int(old_dp),
+                         new_dp=int(new_dp)):
+            new_ctx = mesh_mod.resize_data_axis(trainer.mesh, new_dp,
+                                                devices=devices)
+            respec = zero_mod.respec_report(
+                opt_state, old_mesh, new_ctx.mesh) if trainer.zero else {}
+            trainer.mesh = new_ctx
+            mesh_mod.set_mesh(new_ctx)
+            trainer._train_step = None
+            trainer._eval_step = None
+            trainer._compiled_sigs.clear()
+            trainer._telemetry_costs.clear()  # per-sig MFU/census costs
+        with tracer.span("rebuild", cat="elastic"):
+            trainer._ensure_built()
 
         replay_cursor = None
         if source == "live":
@@ -484,6 +494,7 @@ class ElasticCoordinator:
                 recovery_ms, run="elastic")
             if r.active:
                 r.emit(dict(rec))
+        tracer.end(tk_elastic, new_dp=int(new_dp), source=source)
         log.warning("elastic: mesh rebuilt data=%d (epoch %d) in %.1f ms; "
                     "%s", new_dp, self.epoch, recovery_ms,
                     "replaying from cursor %s" % (replay_cursor,)

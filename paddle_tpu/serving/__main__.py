@@ -48,6 +48,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="serve through a local fleet of N replica "
                         "engines behind the FleetRouter (default: one "
                         "bare engine)")
+    p.add_argument("--status_port", type=int, default=None,
+                   help="serve /metrics /healthz /snapshot /trace on "
+                        "this port while the loop runs (default: the "
+                        "status_port flag / PADDLE_TPU_STATUS_PORT — "
+                        "what `launch --serving --status_port_base N` "
+                        "stamps per replica; 0 = off)")
     return p
 
 
@@ -95,6 +101,21 @@ def main(argv=None) -> int:
               f"{os.environ.get('PADDLE_TPU_NREPLICAS', '?')}",
               file=sys.stderr)
 
+    # live introspection (--status_port / the launcher's per-replica
+    # PADDLE_TPU_STATUS_PORT): the replica's /metrics is what the
+    # FleetRouter-side aggregator (scrape_replicas) folds into the
+    # fleet summary
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.telemetry import introspect as introspect_mod
+
+    if args.status_port is not None:
+        _flags.set("status_port", int(args.status_port))
+    status = introspect_mod.server_from_flags(
+        registry=metrics.get_registry())
+    if status is not None:
+        print(f"serving: introspection on http://127.0.0.1:"
+              f"{status.port}", file=sys.stderr, flush=True)
+
     # synchronous per-line loop: submit, drain, print — deterministic
     # output order for scripted callers; a long-lived front-end would
     # eng.start() and stream results instead
@@ -116,6 +137,8 @@ def main(argv=None) -> int:
                   flush=True)
     eng.emit_summary()
     metrics.get_registry().flush()
+    if status is not None:
+        status.stop()
     return 0
 
 

@@ -346,15 +346,21 @@ class ServingEngine:
             self._finish(a)
             worked = True
 
+        from paddle_tpu.telemetry.tracing import get_tracer
+
+        tracer = get_tracer()
         admitted = sched.admit(now=now)
         if admitted:
             t0 = time.perf_counter()
+            tk = tracer.begin("serve_prefill", cat="serving",
+                              batch=len(admitted))
             batch = sched.prefill_batch(admitted)
             toks, self.cache.k, self.cache.v = self._prefill(
                 self.params, self._base_key, self.cache.k, self.cache.v,
                 *_dev(batch, "ids", "seq_lens", "page_table", "rids",
                       "temps"))
             toks = np.asarray(toks)
+            tracer.end(tk)
             t1 = time.perf_counter()
             hist = reg.histogram("serve_prefill_ms",
                                  "prefill pass wall ms (per admitted batch)")
@@ -378,11 +384,14 @@ class ServingEngine:
         if batch is not None:
             live = batch.pop("live")
             t0 = time.perf_counter()
+            tk = tracer.begin("serve_decode", cat="serving",
+                              batch=len(live))
             toks, self.cache.k, self.cache.v = self._decode(
                 self.params, self._base_key, self.cache.k, self.cache.v,
                 *_dev(batch, "ids", "positions", "seq_lens", "page_table",
                       "rids", "gens", "temps"))
             toks = np.asarray(toks)
+            tracer.end(tk)
             reg.histogram(
                 "serve_decode_step_ms",
                 "one continuous-batching decode step, wall ms").observe(
@@ -405,6 +414,25 @@ class ServingEngine:
         ttft_ms = (a.t_first - a.request.arrival) * 1e3
         tpot_ms = ((now - a.t_first) / max(n - 1, 1)) * 1e3
         total_ms = (now - a.request.arrival) * 1e3
+        from paddle_tpu.telemetry.tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            # the request's lifecycle, reconstructed retrospectively at
+            # retire time from its own timestamps: one parent "request"
+            # span with queue → prefill → decode children, so a merged
+            # timeline shows per-request phases next to the batch-level
+            # serve_prefill/serve_decode spans
+            rid = a.request.id
+            parent = tracer.add_span(
+                "request", a.request.arrival, now, cat="serving",
+                request=rid, finish=a.finished, tokens=n)
+            tracer.add_span("queue", a.request.arrival, a.t_admit,
+                            cat="serving", parent_id=parent, request=rid)
+            tracer.add_span("prefill", a.t_admit, a.t_first,
+                            cat="serving", parent_id=parent, request=rid)
+            tracer.add_span("decode", a.t_first, now, cat="serving",
+                            parent_id=parent, request=rid)
         self.registry.histogram(
             "serve_tpot_ms", "mean per-token decode latency").observe(
                 tpot_ms)
@@ -434,7 +462,10 @@ class ServingEngine:
         for name in _LAT_HISTS:
             h = self.registry.get(name)
             s = h.summary() if h is not None else None
-            if s:
+            if s and s.get("count"):
+                # zero-observation histograms are skipped, not rolled
+                # up: an engine that served nothing must not report
+                # p50/p99/max quantiles of an empty distribution
                 summary[name] = {k: s[k] for k in
                                  ("count", "p50", "p99", "max")}
         self.registry.emit(

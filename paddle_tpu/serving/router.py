@@ -340,12 +340,16 @@ class FleetRouter:
 
     # -- routing ---------------------------------------------------------------
     def _route(self) -> bool:
+        from paddle_tpu.telemetry.tracing import get_tracer
+
+        tracer = get_tracer()
         worked = False
         while True:
             with self._lock:
                 req = self._pending.popleft() if self._pending else None
             if req is None:
                 break
+            tk = tracer.begin("route", cat="fleet", request=req.id)
             if req.deadline is not None and self._clock() >= req.deadline:
                 self._finish_local(
                     req, "deadline",
@@ -353,6 +357,7 @@ class FleetRouter:
                     "exhausted in queue)", count="deadline_expired",
                     counter="fleet_deadline_expired",
                     help="requests that timed out before admission")
+                tracer.end(tk, outcome="deadline")
                 worked = True
                 continue
             target = self._pick()
@@ -365,12 +370,14 @@ class FleetRouter:
                         count="dispatch_errors",
                         counter="fleet_dispatch_errors",
                         help="dispatches a replica refused outright")
+                    tracer.end(tk, outcome="no_replicas")
                     worked = True
                     continue
                 # nothing routable right now (all draining) — the head
                 # stays the head; deadline scan happens next round
                 with self._lock:
                     self._pending.appendleft(req)
+                tracer.cancel(tk)  # nothing was routed: not a span
                 break
             idx, rep = target
             req.attempts += 1
@@ -384,10 +391,12 @@ class FleetRouter:
                     f"dispatch: {e}", count="dispatch_errors",
                     counter="fleet_dispatch_errors",
                     help="dispatches a replica refused outright")
+                tracer.end(tk, outcome="rejected", replica=idx)
                 worked = True
                 continue
             with self._lock:
                 self._inflight[req.id] = req
+            tracer.end(tk, outcome="dispatched", replica=idx)
             worked = True
         return worked
 
@@ -413,6 +422,11 @@ class FleetRouter:
         """Re-dispatch a dead replica's in-flight requests to survivors
         (RetryPolicy-bounded), preserving FIFO order at the queue head —
         the task-re-queue rule."""
+        from paddle_tpu.telemetry.tracing import get_tracer
+
+        tracer = get_tracer()
+        tk = tracer.begin("failover", cat="fleet", replica=idx,
+                          reason=reason)
         with self._lock:
             mine = sorted((r for r in self._inflight.values()
                            if r.replica == idx), key=lambda r: r.id)
@@ -437,12 +451,13 @@ class FleetRouter:
             requeued.append(r)
         from paddle_tpu.telemetry import safe_inc
 
-        with self._lock:
-            # requeued work goes to the FRONT in id order: it was
-            # admitted before anything still pending
-            self._pending.extendleft(reversed(requeued))
-            self._counts["failovers"] += 1
-            self._counts["requeued"] += len(requeued)
+        with tracer.span("requeue", cat="fleet", count=len(requeued)):
+            with self._lock:
+                # requeued work goes to the FRONT in id order: it was
+                # admitted before anything still pending
+                self._pending.extendleft(reversed(requeued))
+                self._counts["failovers"] += 1
+                self._counts["requeued"] += len(requeued)
         safe_inc("fleet_failovers", "replica deaths failed over",
                  registry=self.registry)
         for _ in requeued:
@@ -455,6 +470,7 @@ class FleetRouter:
                 {"event": "replica_down", "replica": idx,
                  "reason": reason, "requeued": len(requeued),
                  "failed": len(mine) - len(requeued)}, kind="fleet")
+        tracer.end(tk, requeued=len(requeued))
 
     def _finish_local(self, req: _FleetReq, finish: str, msg: str, *,
                       count: str, counter: str, help: str) -> None:
@@ -544,13 +560,18 @@ class FleetRouter:
             enforce(not self._swapping, "a weight swap is already "
                     "in progress")
             self._swapping = True
+        from paddle_tpu.telemetry.tracing import get_tracer
+
         report: dict[int, str] = {}
         swapped: list[tuple[int, object, object]] = []
+        tk_swap = None
         try:
             for idx, rep in enumerate(self.replicas):
                 if self.health.is_dead(idx):
                     report[idx] = "dead: skipped"
                     continue
+                tk_swap = get_tracer().begin("swap", cat="fleet",
+                                             replica=idx)
                 with self._lock:
                     self._draining.add(idx)
                 self._wait_drained(idx)
@@ -579,8 +600,15 @@ class FleetRouter:
                     self._held.discard(idx)
                     self._draining.discard(idx)
                 report[idx] = "swapped"
+                get_tracer().end(tk_swap, outcome="swapped")
                 log.info("fleet: replica %d swapped to %s", idx, path)
         except BaseException as e:
+            # the failing replica's swap span must not stay open on this
+            # thread's stack, or every later span here (a retried swap,
+            # a deterministic pump's route/failover spans) would be
+            # mis-parented under the phantom swap; cancel is a no-op
+            # for a token end() already closed
+            get_tracer().cancel(tk_swap)
             for idx, rep, old in reversed(swapped):
                 rep.swap_params(rep.cfg, old)
             with self._lock:
@@ -668,3 +696,50 @@ class FleetRouter:
             return
         self.registry.emit({"event": "summary", **self.stats()},
                            kind="fleet")
+
+    # -- replica /metrics aggregation ------------------------------------------
+    def scrape_replicas(self, urls: list[str],
+                        timeout: float = 5.0) -> dict:
+        """Scrape each replica's introspection ``/metrics`` endpoint
+        (``--status_port`` on the replica processes — ``distributed.
+        launch --serving --status_port_base N`` stamps one port per
+        replica) and fold them into ONE fleet view: counters and
+        occupancy gauges summed across replicas, per-label series
+        preserved.  Returns the rollup and emits it as a
+        ``kind="fleet"`` ``event="scrape"`` record, so the fleet
+        summary stream carries the live replica metrics alongside the
+        router's own books.  A replica that cannot be scraped is
+        reported, not fatal — the scrape is observability, and a dead
+        endpoint is itself a signal."""
+        from paddle_tpu.telemetry.introspect import (
+            aggregate_prometheus,
+            scrape,
+        )
+
+        texts, errors = [], {}
+        for url in urls:
+            try:
+                texts.append(scrape(url, timeout=timeout))
+            except (OSError, ValueError) as e:
+                errors[url] = f"{type(e).__name__}: {e}"[:200]
+        agg = aggregate_prometheus(texts)
+        # flatten to {name: total-over-labels} for the record; the
+        # full labeled map goes back to the caller
+        totals: dict[str, float] = {}
+        for (name, _labels), val in agg.items():
+            totals[name] = totals.get(name, 0.0) + val
+        rollup = {
+            "replicas_scraped": len(texts),
+            "scrape_errors": errors,
+            "serve_tokens": totals.get("serve_tokens", 0.0),
+            "serve_requests": totals.get("serve_requests", 0.0),
+            "serve_active_slots": totals.get("serve_active_slots", 0.0),
+            "serve_free_pages": totals.get("serve_free_pages", 0.0),
+            "totals": {k: v for k, v in sorted(totals.items())
+                       if k.startswith(("serve_", "fleet_"))},
+        }
+        if self.registry.active:
+            self.registry.emit({"event": "scrape", **rollup},
+                               kind="fleet")
+        return {**rollup, "series": {f"{n}{dict(l) or ''}": v
+                                     for (n, l), v in sorted(agg.items())}}

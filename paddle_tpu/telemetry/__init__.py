@@ -7,7 +7,12 @@ this package holds the implementation:
   accounting used by the collective wrappers;
 - ``sinks``        — JsonlSink / MemorySink / LoggingSink;
 - ``step_metrics`` — StepTelemetry, the per-step record builder behind
-  ``SGD.train`` and ``trainer/cli.py``.
+  ``SGD.train`` and ``trainer/cli.py``;
+- ``tracing``      — Span/Tracer phase timeline (Chrome-trace export)
+  + the ``--profile_steps`` ProfileWindow;
+- ``introspect``   — the per-process ``--status_port`` HTTP server
+  (/metrics /healthz /snapshot /trace) + the Prometheus scrape
+  helpers the fleet aggregator uses.
 """
 
 from paddle_tpu.telemetry.registry import (  # noqa: F401
@@ -34,4 +39,12 @@ from paddle_tpu.telemetry.sinks import (  # noqa: F401
 from paddle_tpu.telemetry.step_metrics import (  # noqa: F401
     StepTelemetry,
     tokens_in_feed,
+)
+from paddle_tpu.telemetry.tracing import (  # noqa: F401
+    ProfileWindow,
+    Span,
+    Tracer,
+    configure_tracing,
+    get_tracer,
+    parse_profile_steps,
 )

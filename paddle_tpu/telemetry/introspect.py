@@ -1,0 +1,448 @@
+"""Per-process live introspection server — scrape a *running* rank.
+
+Every observability surface before this PR was post-hoc: step records
+land in JSONL, the flight ring is dumped only on crash, serve
+histograms are scraped from files.  This module makes the same state
+inspectable while the process runs, over a dependency-free stdlib HTTP
+server (one daemon thread; request handling is thread-per-connection,
+and every shared structure it reads — the registry, the tracer ring,
+the flight ring — is already lock-guarded):
+
+- ``/metrics``   Prometheus text exposition rendered from the
+  :class:`~paddle_tpu.telemetry.registry.MetricsRegistry`: counters and
+  gauges with their label sets, histograms as cumulative ``_bucket`` /
+  ``_sum`` / ``_count`` series.  Histogram series with zero
+  observations are SKIPPED (an empty histogram has no quantiles — a
+  NaN row would poison a Prometheus scrape).
+- ``/healthz``   JSON liveness: newest heartbeat age/tag from the
+  flight ring, per-loop liveness verdicts from registered probes
+  (``add_health``: the serve loop, the fleet pump), the elastic
+  membership epoch, pid/host/uptime.  Returns 503 when any registered
+  probe says dead — a load balancer can act on it.
+- ``/snapshot``  JSON: the flight ring (records + heartbeats — the
+  crash dump, inspectable BEFORE the crash), the collective census
+  (:func:`~paddle_tpu.telemetry.registry.census_by_kind`), and the
+  full registry snapshot with interpolated histogram percentiles.
+- ``/trace``     drain the span ring as a Chrome trace (see
+  :mod:`~paddle_tpu.telemetry.tracing`); ``/trace?keep=1`` peeks
+  without draining.
+
+Wiring: ``--status_port N`` (``PADDLE_TPU_STATUS_PORT``) arms the
+server in ``SGD.train`` and the serving CLI; ``distributed.launch
+--status_port_base N`` stamps ``N + rank`` into each child's
+environment (and substitutes ``{status_port}`` in the command line), so
+every rank of a fleet serves on its own port.  Port 0 binds an
+ephemeral port — :meth:`IntrospectionServer.start` returns the real
+one.
+
+The scrape side lives here too: :func:`scrape` (GET a URL),
+:func:`parse_prometheus` (text -> {(name, labels): value}) and
+:func:`aggregate_prometheus` (sum counters/gauges across replicas) —
+what ``FleetRouter.scrape_replicas`` uses to fold per-replica
+``/metrics`` into the fleet summary, and what tests use as the
+"tiny exposition parser".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from paddle_tpu.core import logger as log
+
+
+# -- Prometheus text exposition ------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    body = ",".join(
+        f'{_prom_name(str(k))}="{_escape(str(v))}"'
+        for k, v in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_prometheus(registry) -> str:
+    """The registry's pull-side state in Prometheus text exposition
+    format (version 0.0.4).  Empty histogram series are skipped — no
+    samples beats NaN quantiles on the scraper's side."""
+    from paddle_tpu.telemetry.registry import Counter, Gauge, Histogram
+
+    lines: list[str] = []
+    snap = registry.snapshot()
+    for name in sorted(snap):
+        metric = registry.get(name)
+        series = snap[name]
+        pname = _prom_name(name)
+        if isinstance(metric, Counter):
+            lines.append(f"# HELP {pname} {metric.help or name}")
+            lines.append(f"# TYPE {pname} counter")
+            for s in series:
+                labels = {k: v for k, v in s.items() if k != "value"}
+                lines.append(
+                    f"{pname}{_prom_labels(labels)} {_num(s['value'])}")
+        elif isinstance(metric, Gauge):
+            lines.append(f"# HELP {pname} {metric.help or name}")
+            lines.append(f"# TYPE {pname} gauge")
+            for s in series:
+                labels = {k: v for k, v in s.items() if k != "value"}
+                lines.append(
+                    f"{pname}{_prom_labels(labels)} {_num(s['value'])}")
+        elif isinstance(metric, Histogram):
+            live = [s for s in series if s.get("count")]
+            if not live:
+                continue  # zero observations: no samples, not NaNs
+            lines.append(f"# HELP {pname} {metric.help or name}")
+            lines.append(f"# TYPE {pname} histogram")
+            for s in live:
+                labels = {k: v for k, v in s.items()
+                          if k not in ("count", "sum", "avg", "min",
+                                       "max", "p50", "p90", "p99",
+                                       "buckets")}
+                cum = 0
+                for edge, cnt in s["buckets"].items():
+                    cum += cnt
+                    le = {"le": edge if edge != "+Inf" else "+Inf"}
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_prom_labels({**labels, **le})} {cum}")
+                lines.append(
+                    f"{pname}_sum{_prom_labels(labels)} {_num(s['sum'])}")
+                lines.append(
+                    f"{pname}_count{_prom_labels(labels)} {s['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def _num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def parse_prometheus(text: str) -> dict[tuple, float]:
+    """Text exposition -> {(metric name, sorted label tuple): value} —
+    the tiny parser tests and the fleet aggregator share.  Comment and
+    blank lines are skipped; a malformed sample line raises (a torn
+    scrape must not read as a clean one)."""
+    out: dict[tuple, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            body, val = rest.rsplit("}", 1)
+            labels = []
+            for part in _split_labels(body):
+                k, v = part.split("=", 1)
+                labels.append((k, v.strip('"')))
+            out[(name, tuple(sorted(labels)))] = float(val)
+        else:
+            name, val = line.rsplit(None, 1)
+            out[(name, ())] = float(val)
+    return out
+
+
+def _split_labels(body: str) -> list[str]:
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, cur, quoted = [], "", False
+    for ch in body:
+        if ch == '"':
+            quoted = not quoted
+        if ch == "," and not quoted:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        parts.append(cur)
+    return parts
+
+
+def aggregate_prometheus(texts: list[str]) -> dict[tuple, float]:
+    """Sum samples across replica scrapes (counters add; gauges add
+    too, which is the right fleet semantic for the occupancy gauges —
+    fleet_queue_depth, serve_active_slots, serve_free_pages are
+    per-replica quantities whose fleet view is the sum)."""
+    out: dict[tuple, float] = {}
+    for text in texts:
+        for key, val in parse_prometheus(text).items():
+            out[key] = out.get(key, 0.0) + val
+    return out
+
+
+def scrape(url: str, timeout: float = 5.0) -> str:
+    """GET a text endpoint (the fleet aggregator's fetch)."""
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", errors="replace")
+
+
+# -- the server ----------------------------------------------------------------
+
+
+class IntrospectionServer:
+    """One per process; binds ``host:port`` and serves the four
+    endpoints from a daemon thread.
+
+    :param registry: MetricsRegistry (default: the process-global one).
+    :param tracer: Tracer for ``/trace`` (default: the global tracer).
+    :param flight: FlightRecorder for ``/healthz``/``/snapshot``
+        (default: the process-global ring).
+    :param port: TCP port; 0 = ephemeral (``start()`` returns the real
+        one, exposed as ``.port``).
+    """
+
+    def __init__(self, registry=None, tracer=None, flight=None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        if registry is None:
+            from paddle_tpu.telemetry.registry import get_default_registry
+
+            registry = get_default_registry()
+        self.registry = registry
+        self.tracer = tracer
+        self.flight = flight
+        self.host = host
+        self._requested_port = int(port)
+        # _httpd/_port are written by start() (consumer) and read by the
+        # serve thread and stop(); every access holds _lock (the
+        # GL-THREAD audited contract)
+        self._lock = threading.Lock()
+        self._httpd = None
+        self._port: int | None = None
+        self._thread: threading.Thread | None = None
+        self._started_at = time.time()
+        self._health: dict[str, object] = {}
+        self._scrapes = 0
+
+    # -- liveness probes -------------------------------------------------------
+    def add_health(self, name: str, probe) -> None:
+        """Register a liveness probe (zero-arg callable -> truthy =
+        alive) surfaced under ``/healthz`` ``loops``; any dead probe
+        turns the endpoint 503.  The trainer registers nothing (its
+        liveness IS the heartbeat age); serving registers its loop."""
+        with self._lock:
+            self._health[str(name)] = probe
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the bound port.
+        Idempotent — a second start() returns the live port."""
+        from http.server import ThreadingHTTPServer
+
+        handler = _make_handler(self)
+        # check-and-create under ONE lock hold: two racing start()s must
+        # not both bind (fixed port: EADDRINUSE for the loser; port 0:
+        # an orphaned socket whose serve thread never stops)
+        with self._lock:
+            if self._httpd is not None:
+                return self._port
+            httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                        handler)
+            httpd.daemon_threads = True
+            self._httpd = httpd
+            self._port = httpd.server_address[1]
+            self._started_at = time.time()
+        self._thread = threading.Thread(
+            target=self._serve, name="paddle-tpu-introspect", daemon=True)
+        self._thread.start()
+        log.info("introspection server on http://%s:%d (/metrics /healthz "
+                 "/snapshot /trace)", self.host, self._port)
+        return self._port
+
+    def _serve(self) -> None:
+        with self._lock:
+            httpd = self._httpd
+        if httpd is not None:
+            httpd.serve_forever(poll_interval=0.1)
+
+    def stop(self) -> None:
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+        t, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if t is not None:
+            t.join(timeout=5.0)
+
+    @property
+    def port(self) -> int | None:
+        with self._lock:
+            return self._port
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- endpoint payloads (also the in-process API) ---------------------------
+    def metrics_text(self) -> str:
+        return render_prometheus(self.registry)
+
+    def healthz(self) -> tuple[int, dict]:
+        """(http status, payload).  503 when a registered loop probe
+        reports dead — heartbeat AGE is reported, not judged (the
+        stale threshold is the watchdog's call, not the scraper's)."""
+        import os
+
+        with self._lock:
+            probes = dict(self._health)
+            scrapes = self._scrapes
+        loops = {}
+        ok = True
+        for name, probe in sorted(probes.items()):
+            try:
+                alive = bool(probe())
+            except Exception as e:
+                log.warning("introspection health probe %r raised "
+                            "(%s: %s); reporting dead", name,
+                            type(e).__name__, e)
+                alive = False
+            loops[name] = alive
+            ok = ok and alive
+        payload: dict = {
+            "ok": ok,
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "scrapes": scrapes,
+            "loops": loops,
+        }
+        from paddle_tpu.telemetry.registry import host_index
+
+        payload["host"] = host_index()
+        from paddle_tpu.distributed.multihost import rendezvous_epoch
+
+        payload["elastic_epoch"] = rendezvous_epoch()
+        if self.flight is not None:
+            beats = self.flight.heartbeats
+            if beats:
+                hb = beats[-1]
+                payload["heartbeat"] = {
+                    "age_s": round(time.time() - hb["ts"], 3),
+                    "tag": hb.get("tag", ""),
+                    **{k: v for k, v in hb.items()
+                       if k not in ("ts", "tag")},
+                }
+        return (200 if ok else 503), payload
+
+    def snapshot(self) -> dict:
+        from paddle_tpu.telemetry.registry import (
+            census_by_kind,
+            comm_snapshot,
+        )
+
+        out: dict = {
+            "census": census_by_kind(comm_snapshot(self.registry)),
+            "metrics": self.registry.snapshot(),
+        }
+        if self.flight is not None:
+            out["flight"] = {"records": self.flight.records,
+                             "heartbeats": self.flight.heartbeats}
+        return out
+
+    def trace(self, drain: bool = True) -> dict:
+        tracer = self.tracer
+        if tracer is None:
+            from paddle_tpu.telemetry.tracing import get_tracer
+
+            tracer = get_tracer()
+        return tracer.chrome_trace(drain=drain)
+
+    def _count_scrape(self) -> None:
+        with self._lock:
+            self._scrapes += 1
+
+
+def _make_handler(srv: IntrospectionServer):
+    """Build the request-handler class over a closed-over server ref
+    (the stdlib handler is instantiated per connection by the HTTP
+    server, so state rides the closure, not handler attributes)."""
+    from http.server import BaseHTTPRequestHandler
+
+    from paddle_tpu.telemetry.sinks import json_default
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "paddle-tpu-introspect/1"
+
+        def log_message(self, fmt, *args):  # stderr -> the glog logger
+            log.debug("introspect: " + fmt, *args)
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, payload) -> None:
+            self._send(code, json.dumps(
+                payload, default=json_default).encode(),
+                "application/json")
+
+        def do_GET(self):  # noqa: N802 - stdlib handler contract
+            path, _, query = self.path.partition("?")
+            srv._count_scrape()
+            try:
+                if path == "/metrics":
+                    self._send(200, srv.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
+                elif path == "/healthz":
+                    code, payload = srv.healthz()
+                    self._send_json(code, payload)
+                elif path == "/snapshot":
+                    self._send_json(200, srv.snapshot())
+                elif path == "/trace":
+                    keep = "keep=1" in query
+                    self._send_json(200, srv.trace(drain=not keep))
+                elif path in ("/", ""):
+                    self._send_json(200, {
+                        "endpoints": ["/metrics", "/healthz", "/snapshot",
+                                      "/trace"]})
+                else:
+                    self._send_json(404, {"error": f"no route {path}"})
+            except Exception as e:
+                # a scrape must never kill the serving thread pool; the
+                # error goes back to the scraper AND the log
+                log.warning("introspection handler failed on %s "
+                            "(%s: %s)", path, type(e).__name__, e)
+                try:
+                    self._send_json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
+                except OSError as e2:
+                    log.debug("introspect: error reply failed too (%s)",
+                              e2)
+
+    return Handler
+
+
+def server_from_flags(registry=None, flight=None) -> IntrospectionServer | None:
+    """Build-and-start an introspection server when ``--status_port`` /
+    ``PADDLE_TPU_STATUS_PORT`` is armed (the one wiring point
+    ``SGD.train`` and the serving CLI share); None when the flag is 0.
+    Port -1 means "ephemeral" (tests: real scrapes, no port race)."""
+    from paddle_tpu.core import flags
+
+    port = int(flags.get("status_port") or 0)
+    if port == 0:
+        return None
+    srv = IntrospectionServer(registry=registry, flight=flight,
+                              port=max(port, 0))
+    srv.start()
+    return srv

@@ -86,13 +86,22 @@ from typing import Any
 # xla-memory-analysis), total_bytes, dp, zero and the per-pallas_call
 # pallas_vmem footprints — rendered as a budget table by
 # tools/metrics_to_md.py.  No new record kinds.
-SCHEMA = "paddle_tpu.metrics/10"
+# /11 added the live-introspection stream (telemetry/tracing.py,
+# telemetry/introspect.py): record kind "profile" — one per
+# --profile_steps windowed jax.profiler capture, carrying
+# start_step/end_step, trace_dir, wall_ms and (with --trace_spans) the
+# tracer's per-phase duration summary {phase: {count, total_ms, p50_ms,
+# p99_ms, max_ms}} rendered by tools/metrics_to_md.py's "Trace spans"
+# table.  Histogram summaries became None-safe at zero observations
+# (min/max clamp to 0 instead of leaking ±inf into JSON).
+SCHEMA = "paddle_tpu.metrics/11"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted
 # kind missing here — or an entry here nothing produces — is drift.
 RECORD_KINDS = ("step", "bench", "fault", "recovery", "serve",
-                "serve_summary", "elastic_event", "preflight", "fleet")
+                "serve_summary", "elastic_event", "preflight", "fleet",
+                "profile")
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)
@@ -225,16 +234,23 @@ class Histogram(_Metric):
             return self._percentile_of(h, q)
 
     def summary(self, **labels) -> dict | None:
-        h = self._series.get(_label_key(labels))
-        if h is None:
-            return None
-        pct = ({f"p{q}": self._percentile_of(h, q) for q in (50, 90, 99)}
-               if h.count else {"p50": 0.0, "p90": 0.0, "p99": 0.0})
-        return {"count": h.count, "sum": h.total,
-                "avg": h.total / h.count if h.count else 0.0,
-                "min": h.min, "max": h.max, **pct,
-                "buckets": dict(zip([str(e) for e in self.bucket_edges]
-                                    + ["+Inf"], h.buckets))}
+        with self._lock():
+            h = self._series.get(_label_key(labels))
+            if h is None:
+                return None
+            pct = ({f"p{q}": self._percentile_of(h, q)
+                    for q in (50, 90, 99)}
+                   if h.count else {"p50": 0.0, "p90": 0.0, "p99": 0.0})
+            # zero observations: min/max are the ±inf init sentinels —
+            # clamp to 0 so an empty histogram's summary stays JSON-safe
+            # (Infinity is not JSON) and SLO checks read 0, not -inf
+            return {"count": h.count, "sum": h.total,
+                    "avg": h.total / h.count if h.count else 0.0,
+                    "min": h.min if h.count else 0.0,
+                    "max": h.max if h.count else 0.0, **pct,
+                    "buckets": dict(zip(
+                        [str(e) for e in self.bucket_edges]
+                        + ["+Inf"], h.buckets))}
 
     def snapshot(self) -> list[dict]:
         with self._lock():
@@ -363,7 +379,12 @@ def host_index() -> int:
     ``jax.distributed.is_initialized()`` ever flipping true, so the real
     gate is "has a backend already been created" — by emit/dump time in
     a train loop it always has, and ``process_index`` is then correct
-    and free."""
+    and free.  One exception: a LOCAL fleet (``distributed.launch`` on
+    a CPU/dev box) runs each rank as its own single-process jax world,
+    where ``process_index()`` is a constant 0 on every rank — there the
+    launcher's ``PADDLE_TPU_TRAINER_ID`` stamp is the identity, or
+    every rank's trace/flight dump would land on ``*-host0`` and
+    clobber its peers'."""
     try:
         import jax
 
@@ -373,7 +394,11 @@ def host_index() -> int:
         from jax._src import xla_bridge
 
         if xla_bridge._backends:  # initialized already: reading is safe
-            return jax.process_index()
+            if jax.process_count() > 1:
+                return jax.process_index()
+            # single-process backend: a launcher-stamped fleet identity
+            # (local ranks) outranks the backend's constant 0 — fall
+            # through to the env read
     except (ImportError, AttributeError, RuntimeError):
         # jax absent/too old, or a backend probe that refuses before
         # init — the env-var fallback below is the answer either way

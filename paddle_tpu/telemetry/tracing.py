@@ -1,0 +1,563 @@
+"""Span tracing — the live timeline behind the introspection server.
+
+The reference dumped ``paddle/utils/Stat.h`` timer aggregates to the log
+at pass end; ``core/stat.py`` reproduces those aggregates but, like
+them, throws the *timeline* away — by the time an operator asks "what
+was the fleet doing at second 43" only averages remain.  This module
+keeps the timeline: a :class:`Tracer` records :class:`Span`\\ s (named,
+categorized, nested intervals) into a bounded ring, cheap enough to
+stay on in production and exactly ``None`` overhead when disabled (the
+``--trace_spans`` flag; ``span()`` returns a shared no-op context
+manager without allocating, so a disabled run's trajectory and event
+stream are bit-identical to an untraced one — asserted in
+``tests/test_introspect.py``).
+
+Instrumented phase boundaries (all behind the same flag):
+
+- trainer step loop — ``step`` spans with nested ``feed`` / ``compute``
+  / ``fence`` / ``checkpoint`` / ``guard_rescue`` children;
+- ``DevicePrefetcher`` producer — ``prefetch`` spans on the worker
+  thread (they land in their own lane: spans carry the thread name);
+- ``ServingEngine`` — live ``serve_prefill`` / ``serve_decode`` batch
+  spans plus a per-request retrospective ``request`` span with
+  ``queue`` / ``prefill`` / ``decode`` children reconstructed from the
+  request's own timestamps at retire time;
+- ``FleetRouter`` — ``failover`` (with nested ``requeue``), ``route``
+  and per-replica ``swap`` spans;
+- ``ElasticCoordinator`` — an ``elastic`` span with ``drain`` /
+  ``gather`` / ``reshard`` / ``rebuild`` children around a live mesh
+  rebuild.
+
+Span identity is DETERMINISTIC: ``span_id = rank * 2**32 + seq`` where
+``seq`` is the per-tracer allocation counter — two runs of the same
+single-threaded program allocate the same ids, and a fleet's merged
+timeline (``tools/trace_merge.py``) never collides across ranks.  The
+clock is injectable (``Tracer(clock=...)``) so tests drive spans from a
+fake clock and assert exact durations.
+
+Export is Chrome-trace-event JSON (``chrome_trace()`` / ``dump()``),
+loadable in Perfetto / ``chrome://tracing``: one complete ("ph": "X")
+event per span, ``pid`` = rank (the lane), ``tid`` = thread.  The
+introspection server's ``/trace`` endpoint drains the ring through the
+same exporter, and ``tools/trace_merge.py`` merges per-rank dumps into
+one fleet timeline.
+
+:class:`ProfileWindow` brackets a ``--profile_steps A:B`` window of the
+train loop with ``jax.profiler`` device tracing, wrapping each step's
+dispatch in a ``jax.profiler.TraceAnnotation`` so the host-side step
+spans line up with the device timeline in xprof, and emits one
+``kind="profile"`` telemetry record (schema /11) carrying the window,
+the trace directory and the tracer's per-phase duration summary.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+# spans the ring keeps by default; at ~120 bytes/span this is ~1 MB
+DEFAULT_RING = 8192
+
+# rank multiplier for deterministic span ids: ids never collide across
+# ranks in a merged timeline, and (rank, seq) is recoverable from the id
+_RANK_STRIDE = 1 << 32
+
+
+class Span:
+    """One completed named interval."""
+
+    __slots__ = ("name", "cat", "span_id", "parent_id", "rank", "thread",
+                 "t_start", "t_end", "args")
+
+    def __init__(self, name, cat, span_id, parent_id, rank, thread,
+                 t_start, t_end, args):
+        self.name = name
+        self.cat = cat
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.rank = rank
+        self.thread = thread
+        self.t_start = t_start      # tracer-clock seconds
+        self.t_end = t_end
+        self.args = args
+
+    @property
+    def dur_ms(self) -> float:
+        return (self.t_end - self.t_start) * 1e3
+
+    def to_event(self) -> dict:
+        """One Chrome-trace complete event (timestamps in microseconds,
+        the trace-event unit)."""
+        args = {"id": self.span_id}
+        if self.parent_id is not None:
+            args["parent"] = self.parent_id
+        if self.args:
+            args.update(self.args)
+        return {
+            "name": self.name, "cat": self.cat, "ph": "X",
+            "ts": round(self.t_start * 1e6, 3),
+            "dur": round((self.t_end - self.t_start) * 1e6, 3),
+            "pid": self.rank, "tid": self.thread, "args": args,
+        }
+
+
+class _OpenSpan:
+    """Token handed out by :meth:`Tracer.begin`; closed by ``end`` /
+    ``cancel`` (or used as a context manager via :meth:`Tracer.span`)."""
+
+    __slots__ = ("tracer", "name", "cat", "span_id", "parent_id",
+                 "t_start", "args", "_done")
+
+    def __init__(self, tracer, name, cat, span_id, parent_id, t_start,
+                 args):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t_start = t_start
+        self.args = args
+        self._done = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.end(self)
+        return False
+
+
+class _NullSpan:
+    """The disabled-tracer fast path: one shared, allocation-free
+    context manager.  ``span()`` on a disabled tracer returns this very
+    object, so tracing-off call sites cost a method call and an
+    attribute read — nothing that could perturb a trajectory."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Thread-aware span recorder over a bounded ring.
+
+    :param enabled: record spans (False = every entry point is a no-op).
+    :param rank: the ``pid`` lane of exported events and the high bits
+        of every span id; default: the telemetry host index.
+    :param clock: seconds-returning monotonic clock (injectable so tests
+        drive spans deterministically); default ``time.perf_counter``.
+    :param capacity: completed spans kept (oldest dropped first).
+    """
+
+    def __init__(self, enabled: bool = False, rank: int | None = None,
+                 clock=None, capacity: int = DEFAULT_RING):
+        if rank is None:
+            from paddle_tpu.telemetry.registry import host_index
+
+            rank = host_index()
+        self.rank = int(rank)
+        self.clock = clock or time.perf_counter
+        self._enabled = bool(enabled)
+        self._lock = threading.RLock()
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=max(int(capacity), 1))
+        self._seq = 0
+        self._stack = threading.local()  # per-thread open-span stack
+        self._dropped = 0
+
+    # -- configuration ---------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: bool | None = None, clock=None,
+                  rank: int | None = None) -> "Tracer":
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+            if clock is not None:
+                self.clock = clock
+            if rank is not None:
+                self.rank = int(rank)
+        return self
+
+    # -- recording -------------------------------------------------------------
+    def _tstack(self) -> list:
+        s = getattr(self._stack, "open", None)
+        if s is None:
+            s = self._stack.open = []
+        return s
+
+    def _next_id(self) -> int:
+        with self._lock:
+            sid = self.rank * _RANK_STRIDE + self._seq
+            self._seq += 1
+        return sid
+
+    def begin(self, name: str, cat: str = "phase", **args) -> _OpenSpan | None:
+        """Open a span (returns None when disabled).  The span nests
+        under this THREAD's innermost open span."""
+        if not self._enabled:
+            return None
+        stack = self._tstack()
+        parent = stack[-1].span_id if stack else None
+        tok = _OpenSpan(self, name, cat, self._next_id(), parent,
+                        self.clock(), args)
+        stack.append(tok)
+        return tok
+
+    def end(self, tok: _OpenSpan | None, **args) -> Span | None:
+        """Close a span opened by :meth:`begin` (None token = no-op, so
+        call sites don't re-check the enabled flag)."""
+        if tok is None or tok._done:
+            return None
+        tok._done = True
+        t_end = self.clock()
+        stack = self._tstack()
+        if tok in stack:
+            # closing a non-top token truncates the stack above it:
+            # anything still open there was abandoned by an exception
+            # path, and leaving it would mis-parent the rest of the run
+            del stack[stack.index(tok):]
+        if args:
+            tok.args.update(args)
+        span = Span(tok.name, tok.cat, tok.span_id, tok.parent_id,
+                    self.rank, threading.current_thread().name,
+                    tok.t_start, t_end, tok.args)
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(span)
+        return span
+
+    def cancel(self, tok: _OpenSpan | None) -> None:
+        """Discard an open span without recording it (e.g. the feed pull
+        that turned out to be the end-of-pass sentinel)."""
+        if tok is None or tok._done:
+            return
+        tok._done = True
+        stack = self._tstack()
+        if tok in stack:
+            del stack[stack.index(tok):]
+
+    def span(self, name: str, cat: str = "phase", **args):
+        """Context-manager form.  Disabled tracers return one shared
+        no-op object — the hot-loop guard the bit-identical-trajectory
+        test pins down."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return self.begin(name, cat, **args)
+
+    def add_span(self, name: str, t_start: float, t_end: float,
+                 cat: str = "phase", parent_id: int | None = None,
+                 **args) -> int | None:
+        """Record a RETROSPECTIVE span from explicit clock readings (the
+        serving engine reconstructs a request's queue/prefill/decode
+        phases from its own timestamps at retire time).  Returns the
+        span id (usable as ``parent_id`` for children), or None when
+        disabled."""
+        if not self._enabled:
+            return None
+        sid = self._next_id()
+        span = Span(name, cat, sid, parent_id, self.rank,
+                    threading.current_thread().name,
+                    float(t_start), float(t_end), args)
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(span)
+        return sid
+
+    # -- reading ---------------------------------------------------------------
+    @property
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def seq_watermark(self) -> int:
+        """The next seq this tracer will allocate — a stable "spans
+        from here on" marker.  Positional ring indices are invalidated
+        by a concurrent ``/trace`` drain or a ring wrap; the seq
+        embedded in every span id is not."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    def drain(self) -> list[Span]:
+        """Pop every completed span (the ``/trace`` endpoint's read —
+        each scrape gets the ring once, so a polling scraper streams
+        the timeline instead of re-downloading it)."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+        return out
+
+    # -- export ----------------------------------------------------------------
+    def chrome_trace(self, spans: list[Span] | None = None,
+                     drain: bool = False) -> dict:
+        """Chrome-trace-event JSON dict (Perfetto / chrome://tracing
+        loadable): the spans as complete events plus process/thread
+        metadata naming this rank's lane."""
+        if spans is None:
+            spans = self.drain() if drain else self.spans
+        events = [{
+            "name": "process_name", "ph": "M", "pid": self.rank, "tid": 0,
+            "args": {"name": f"rank {self.rank}"},
+        }]
+        threads = []
+        for s in spans:
+            if s.thread not in threads:
+                threads.append(s.thread)
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": s.rank,
+                    "tid": s.thread, "args": {"name": s.thread}})
+            events.append(s.to_event())
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"rank": self.rank, "spans": len(spans),
+                              "dropped": self.dropped}}
+
+    def dump(self, path: str, drain: bool = False) -> str:
+        """Write :meth:`chrome_trace` to ``path`` (parent dirs created)
+        — the per-rank file ``tools/trace_merge.py`` consumes."""
+        import json
+        import os
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(drain=drain), f)
+        return path
+
+    def phase_summary(self, spans: list[Span] | None = None) -> dict:
+        """{span name: {count, total_ms, p50_ms, p99_ms, max_ms}} over
+        the current ring — the "Trace spans" table of
+        ``tools/metrics_to_md.py`` and the ``profile`` record's span
+        attachment.  Percentiles are exact (computed from the raw
+        durations, not histogram buckets)."""
+        by_name: dict[str, list[float]] = {}
+        for s in (self.spans if spans is None else spans):
+            by_name.setdefault(s.name, []).append(s.dur_ms)
+        out = {}
+        for name, durs in sorted(by_name.items()):
+            durs.sort()
+            out[name] = {
+                "count": len(durs),
+                "total_ms": round(sum(durs), 3),
+                "p50_ms": round(_pctl(durs, 50.0), 3),
+                "p99_ms": round(_pctl(durs, 99.0), 3),
+                "max_ms": round(durs[-1], 3),
+            }
+        return out
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    """Interpolated percentile over pre-sorted values."""
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    rank = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (rank - lo)
+
+
+# -- the process-global tracer -------------------------------------------------
+
+_default: Tracer | None = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer every built-in instrumentation point
+    uses; created on first use with ``--trace_spans`` /
+    ``PADDLE_TPU_TRACE_SPANS`` deciding whether it records."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            from paddle_tpu.core import flags
+
+            _default = Tracer(enabled=bool(flags.get("trace_spans")),
+                              capacity=int(flags.get("trace_ring_size")))
+        return _default
+
+
+def configure_tracing(enabled: bool | None = None, clock=None,
+                      rank: int | None = None) -> Tracer:
+    """Flip the global tracer's switches (tests, notebooks).  The
+    trainer re-reads the ``trace_spans`` flag at ``train()`` entry via
+    this, so a flag set after import still takes effect."""
+    return get_tracer().configure(enabled=enabled, clock=clock, rank=rank)
+
+
+# -- windowed device profiling (--profile_steps A:B) ---------------------------
+
+
+def parse_profile_steps(spec: str | None) -> tuple[int, int] | None:
+    """``"A:B"`` -> (A, B), the half-open dispatch-step window
+    [A, B) to capture; None/empty = no profiling.  A bare ``"N"`` means
+    one step, [N, N+1)."""
+    if not spec:
+        return None
+    s = str(spec).strip()
+    if ":" in s:
+        a, b = s.split(":", 1)
+        lo, hi = int(a), int(b)
+    else:
+        lo, hi = int(s), int(s) + 1
+    if lo < 0 or hi <= lo:
+        raise ValueError(
+            f"--profile_steps must be 'A:B' with 0 <= A < B, got {spec!r}")
+    return lo, hi
+
+
+class ProfileWindow:
+    """Bracket dispatch steps [start, stop) of a train loop with a
+    ``jax.profiler`` trace, so the capture holds exactly the steps the
+    operator asked for instead of a whole run's worth of profile data.
+
+    The trainer calls :meth:`maybe_start` before dispatching step ``n``
+    and :meth:`maybe_stop` after; :meth:`annotation` wraps the dispatch
+    in a ``jax.profiler.TraceAnnotation`` while the window is open, so
+    the device timeline carries host step markers that line up with the
+    tracer's ``step`` spans.  :meth:`close` stops a window left open by
+    a run shorter than B.  One ``kind="profile"`` record (schema /11)
+    is emitted when the window closes: the step range, the trace
+    directory and the tracer's per-phase duration summary.
+
+    Profiling must never kill training: start/stop failures are logged
+    and the window deactivates itself.
+    """
+
+    def __init__(self, spec: str | None, trace_dir: str | None = None,
+                 registry=None, tracer: Tracer | None = None):
+        self.window = parse_profile_steps(spec)
+        self.trace_dir = trace_dir
+        self.registry = registry
+        self.tracer = tracer
+        self.active = False
+        self.emitted: dict | None = None
+        self._t0 = 0.0
+        self._span_floor = 0
+
+    def _resolve_dir(self) -> str:
+        if self.trace_dir:
+            return self.trace_dir
+        import os
+        import tempfile
+
+        from paddle_tpu.telemetry.registry import host_index
+
+        return os.path.join(tempfile.gettempdir(),
+                            f"paddle_tpu_profile_host{host_index()}")
+
+    def maybe_start(self, step: int) -> bool:
+        if self.window is None or self.active or step != self.window[0]:
+            return False
+        import jax
+
+        from paddle_tpu.core import logger as log
+
+        self.trace_dir = self._resolve_dir()
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:
+            log.warning("--profile_steps: start_trace failed (%s: %s); "
+                        "profiling disabled for this run",
+                        type(e).__name__, e)
+            self.window = None
+            return False
+        self.active = True
+        self._t0 = time.perf_counter()
+        if self.tracer is not None:
+            # a SEQ watermark, not a ring index: a mid-window /trace
+            # drain or ring wrap shifts positions but not span ids
+            self._span_floor = self.tracer.seq_watermark()
+        return True
+
+    def annotation(self, step: int):
+        """A device-trace step marker while the window is open (a no-op
+        context manager outside it)."""
+        if not self.active:
+            return _NULL_SPAN
+        import jax
+
+        return jax.profiler.TraceAnnotation(f"train_step_{step}")
+
+    def maybe_stop(self, step: int, fence=None) -> dict | None:
+        """Close the window once ``step`` (the NEXT step to dispatch)
+        reaches B; returns the emitted profile record.  ``fence`` — an
+        array from the window's last step — is blocked on before the
+        trace stops, so the capture holds the device work it brackets
+        (dispatch is async; values are untouched, only timing)."""
+        if not self.active or step < self.window[1]:
+            return None
+        if fence is not None:
+            import jax
+
+            from paddle_tpu.core import logger as log
+
+            try:
+                jax.block_until_ready(fence)
+            except Exception as e:
+                log.debug("--profile_steps: fence before stop_trace "
+                          "failed (%s); capture may truncate the last "
+                          "step", e)
+        return self.close()
+
+    def close(self) -> dict | None:
+        if not self.active:
+            return None
+        import jax
+
+        from paddle_tpu.core import logger as log
+
+        self.active = False
+        wall_ms = (time.perf_counter() - self._t0) * 1e3
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("--profile_steps: stop_trace failed (%s: %s); the "
+                        "device capture may be incomplete",
+                        type(e).__name__, e)
+        rec = {
+            "start_step": self.window[0], "end_step": self.window[1],
+            "steps": self.window[1] - self.window[0],
+            "trace_dir": self.trace_dir,
+            "wall_ms": round(wall_ms, 3),
+        }
+        if self.tracer is not None and self.tracer.enabled:
+            # summarize only spans recorded DURING the window (seq at
+            # or past the start watermark), so the profile record's
+            # phase table matches the device capture even when a
+            # /trace scrape drained the ring mid-window
+            spans = [s for s in self.tracer.spans
+                     if s.span_id % _RANK_STRIDE >= self._span_floor]
+            rec["spans"] = self.tracer.phase_summary(spans)
+        if self.registry is None:
+            from paddle_tpu.telemetry.registry import get_default_registry
+
+            self.registry = get_default_registry()
+        if self.registry.active:
+            rec = self.registry.emit(rec, kind="profile")
+        log.info("--profile_steps: captured steps [%d, %d) to %s "
+                 "(%.1f ms)", self.window[0], self.window[1],
+                 self.trace_dir, wall_ms)
+        self.emitted = rec
+        return rec

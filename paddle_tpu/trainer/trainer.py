@@ -296,6 +296,8 @@ class SGD:
         from paddle_tpu import metrics as metrics_mod
         from paddle_tpu.distributed import multihost as mh
         from paddle_tpu.telemetry import StepTelemetry
+        from paddle_tpu.telemetry import introspect as introspect_mod
+        from paddle_tpu.telemetry import tracing as tracing_mod
 
         if sync_period is None:
             sync_period = flags.get("sync_period")
@@ -315,6 +317,12 @@ class SGD:
             registry=metrics_registry, run="train",
             flight=mh.flight_recorder(),
             cost_cache=self._telemetry_costs)
+        # span tracing (--trace_spans): flag-on arms the global tracer;
+        # a tracer a test already enabled stays enabled (never disarmed
+        # here).  With tracing off, every span call site below resolves
+        # to a shared no-op — the bit-identical-trajectory guarantee.
+        if flags.get("trace_spans"):
+            tracing_mod.configure_tracing(enabled=True)
         prev_debug_nans = jax.config.jax_debug_nans
         if flags.get("debug_nans"):
             # the documented jax nan-checking traps at the originating op;
@@ -372,6 +380,16 @@ class SGD:
 
         if elastic is not None:
             elastic.bind(self, checkpoint_dir)
+        # live introspection (--status_port / PADDLE_TPU_STATUS_PORT):
+        # /metrics /healthz /snapshot /trace served for the duration of
+        # this train() call — the flight ring becomes inspectable
+        # BEFORE a crash, not only in its post-mortem dump.  Started
+        # HERE, immediately before the try whose finally stops it: a
+        # build failure above must not leak a bound port into a
+        # supervisor-retried train() (EADDRINUSE on the retry).
+        status_server = introspect_mod.server_from_flags(
+            registry=self._telemetry.registry,
+            flight=mh.flight_recorder())
         try:
             self._train_loop(reader, num_passes, event_handler, feeder,
                              params, states, opt_state, checkpoint_dir,
@@ -385,6 +403,23 @@ class SGD:
             jax.config.update("jax_debug_nans", prev_debug_nans)
             if watchdog is not None:
                 watchdog.stop()
+            profile_window = getattr(self, "_profile_window", None)
+            if profile_window is not None:
+                # a run shorter than the window's B (or an abort inside
+                # it) still stops the device trace and emits the record
+                profile_window.close()
+                self._profile_window = None
+            if status_server is not None:
+                status_server.stop()
+            trace_dir = flags.get("trace_dir")
+            if trace_dir and tracing_mod.get_tracer().enabled:
+                # the per-rank Chrome trace tools/trace_merge.py folds
+                # into one fleet timeline (same host-index stamp as the
+                # flight dump, so lanes line up across artifacts)
+                from paddle_tpu.telemetry import host_index
+
+                tracing_mod.get_tracer().dump(os.path.join(
+                    trace_dir, f"trace-host{host_index()}.json"))
             if prev["installed"] and prev["handler"] is not None:
                 signal.signal(signal.SIGTERM, prev["handler"])
 
@@ -544,6 +579,24 @@ class SGD:
                      sync_period)
             sync_period = 1
         telem = self._telemetry
+        # phase spans (tracing.py; no-ops when --trace_spans is off) +
+        # the --profile_steps windowed device capture, keyed by the
+        # DISPATCH step counter (fence-time counters lag under deferred
+        # fencing, so the window brackets what actually runs)
+        from paddle_tpu.telemetry import tracing as tracing_mod
+
+        tracer = tracing_mod.get_tracer()
+        prev_window = getattr(self, "_profile_window", None)
+        if prev_window is not None:
+            # an elastic replay re-enters _run_passes: a window the
+            # aborted entry left open must stop its device trace first
+            prev_window.close()
+        profile = self._profile_window = tracing_mod.ProfileWindow(
+            flags.get("profile_steps"),
+            trace_dir=flags.get("profile_dir") or None,
+            registry=telem.registry if telem is not None else None,
+            tracer=tracer)
+        dispatched = {"n": 0}
         # the staleness watchdog reads the global flight ring, so the
         # loop must heartbeat even with telemetry inactive (a ring
         # append — cheap enough to pay unconditionally)
@@ -620,6 +673,11 @@ class SGD:
             def flush_pending():
                 if not pending:
                     return
+                # the deferred-fence drain: nested under the current
+                # step span when a batch triggered it, top-level for
+                # the end-of-pass / elastic-drain backlog flushes
+                tk_fence = tracer.begin("fence", cat="trainer",
+                                        steps=len(pending))
                 t_f0 = _time.perf_counter()
                 host_vals = jax.device_get(
                     [(p["cost"], p["metrics"]) for p in pending])
@@ -663,6 +721,7 @@ class SGD:
                         p["pass_id"], p["batch_id"], cost_f, metrics_f,
                         self))
                 pending.clear()
+                tracer.end(tk_fence)
                 window["t0"] = _time.perf_counter()
 
             # mid-pass resume: fast-forward the reader past the batches
@@ -714,11 +773,13 @@ class SGD:
                                  batch_id=batch_id)
                 save = (ckpt.save_checkpoint if writer is None
                         else writer.save)
-                save(checkpoint_dir, pass_id,
-                     {n: np.asarray(params[n]) for n in params},
-                     opt_state=opt_state, states=dict(states),
-                     batch_id=batch_id,
-                     meta=cursor_meta(batch_id))
+                with tracer.span("checkpoint", cat="trainer",
+                                 pass_id=pass_id, batch_id=batch_id):
+                    save(checkpoint_dir, pass_id,
+                         {n: np.asarray(params[n]) for n in params},
+                         opt_state=opt_state, states=dict(states),
+                         batch_id=batch_id,
+                         meta=cursor_meta(batch_id))
 
             def drain_checkpoint(host_params, host_opt, host_states):
                 # elastic drain boundary: persist the exact state the
@@ -735,12 +796,16 @@ class SGD:
                                     "checkpoint synchronously", e)
                 flight.heartbeat("checkpoint", pass_id=pass_id,
                                  batch_id=batch_id)
-                ckpt.save_checkpoint(
-                    checkpoint_dir, pass_id,
-                    {n: np.asarray(v) for n, v in host_params.items()},
-                    opt_state=host_opt, states=dict(host_states),
-                    batch_id=batch_id,
-                    meta=cursor_meta(batch_id, {"elastic_drain": True}))
+                with tracer.span("drain", cat="elastic",
+                                 pass_id=pass_id, batch_id=batch_id):
+                    ckpt.save_checkpoint(
+                        checkpoint_dir, pass_id,
+                        {n: np.asarray(v)
+                         for n, v in host_params.items()},
+                        opt_state=host_opt, states=dict(host_states),
+                        batch_id=batch_id,
+                        meta=cursor_meta(batch_id,
+                                         {"elastic_drain": True}))
 
             def maybe_elastic():
                 # elastic drain point (once per batch boundary): consume
@@ -773,10 +838,21 @@ class SGD:
                             int(out.replay_cursor.get("batch_id", 0)),
                             params, opt_state, states)
 
+            tk_step = None
             try:
                 batch_id = skip
                 feed_it = iter(feeds) if feeds is not None else None
                 while True:
+                    # one "step" span per batch, with feed / compute /
+                    # fence / checkpoint / guard_rescue children — the
+                    # timeline the /trace endpoint and trace_merge
+                    # render.  Both tokens are canceled (not recorded)
+                    # when the pull turns out to be the end-of-pass
+                    # sentinel.
+                    tk_step = tracer.begin("step", cat="trainer",
+                                           pass_id=pass_id,
+                                           batch_id=batch_id)
+                    tk_feed = tracer.begin("feed", cat="trainer")
                     if v2_order:
                         # input_wait_ms covers the reader pull AND the
                         # conversion — the same accounting as the feed
@@ -786,6 +862,8 @@ class SGD:
                         try:
                             data_batch = next(raw_it)
                         except StopIteration:
+                            tracer.cancel(tk_feed)
+                            tracer.cancel(tk_step)
                             pass_complete = True
                             break
                         event_handler(v2_event.BeginIteration(pass_id,
@@ -802,6 +880,8 @@ class SGD:
                             try:
                                 fb = next(feed_it)
                             except StopIteration:
+                                tracer.cancel(tk_feed)
+                                tracer.cancel(tk_step)
                                 pass_complete = True
                                 break
                             examples, feed, wait_ms = (
@@ -810,6 +890,7 @@ class SGD:
                                                    fb.total_timesteps)
                         event_handler(v2_event.BeginIteration(pass_id,
                                                               batch_id))
+                    tracer.end(tk_feed)
                     sig = _feed_signature(feed)
                     new_sig = sig not in self._compiled_sigs
                     if new_sig:
@@ -857,19 +938,29 @@ class SGD:
                         # legitimate heartbeat silence
                         flight.heartbeat("compiling", pass_id=pass_id,
                                          batch_id=batch_id)
+                    n_disp = dispatched["n"]
+                    profile.maybe_start(n_disp)
                     t_step0 = _time.perf_counter()
                     with stat.timer("forwardBackward+update"):
-                        params, opt_state, states, cost, metrics = \
-                            self._train_step(params, opt_state, states,
-                                             feed, step_key)
+                        tk_compute = tracer.begin("compute", cat="trainer")
+                        with profile.annotation(n_disp):
+                            params, opt_state, states, cost, metrics = \
+                                self._train_step(params, opt_state,
+                                                 states, feed, step_key)
+                        tracer.end(tk_compute)
+                    dispatched["n"] = n_disp + 1
+                    profile.maybe_stop(n_disp + 1, fence=cost)
                     if guard is not None:
                         cost_now = float(jax.device_get(cost))
                         if not np.isfinite(cost_now):
-                            params, opt_state, states = \
-                                guard.handle_nonfinite(
-                                    cost_now, pass_id, batch_id, prev_snap,
-                                    restore_fn_for(prev_snap[1],
-                                                   prev_snap[2]))
+                            with tracer.span("guard_rescue", cat="trainer",
+                                             policy=nan_policy):
+                                params, opt_state, states = \
+                                    guard.handle_nonfinite(
+                                        cost_now, pass_id, batch_id,
+                                        prev_snap,
+                                        restore_fn_for(prev_snap[1],
+                                                       prev_snap[2]))
                             # the poisoned update never happened: no
                             # events, no step record — but the batch and
                             # its RNG key stay consumed, so a later
@@ -877,9 +968,11 @@ class SGD:
                             batch_id += 1
                             if preempted["flag"]:
                                 flush_pending()
+                                tracer.end(tk_step)
                                 break
                             maybe_cursor_checkpoint()
                             maybe_elastic()
+                            tracer.end(tk_step)
                             continue
                         params = guard.after_finite_step(prev_snap[0],
                                                          params)
@@ -919,11 +1012,20 @@ class SGD:
                     if len(pending) >= sync_period or preempted["flag"]:
                         flush_pending()
                     if preempted["flag"]:
+                        tracer.end(tk_step)
                         break
                     maybe_cursor_checkpoint()
                     maybe_elastic()
+                    tracer.end(tk_step)
                 flush_pending()  # end-of-pass backlog
             finally:
+                # an exception mid-batch (elastic replay, a supervisor-
+                # retryable fault) must not leave the in-flight step
+                # token on this thread's span stack, or every span of
+                # the NEXT attempt would be mis-parented under it —
+                # cancel truncates the stack from the token up
+                # (idempotent for a cleanly ended one)
+                tracer.cancel(tk_step)
                 # preemption-drain / early exit: stop the prefetch worker
                 # and drop staged feeds, so the checkpoint below sits on a
                 # consistent batch boundary and no thread leaks

@@ -413,6 +413,55 @@ def _memory_budget_table(records: list[dict]) -> None:
                   f"| {_fmt(k.get('bytes', 0) / 1e6)} |")
 
 
+def trace_table(profiles: list[dict]) -> None:
+    """Render the schema /11 live-introspection stream: one block per
+    ``--profile_steps`` capture (``kind="profile"``) with the tracer's
+    per-phase duration table — p50/p99/total per phase name — and a
+    loud flag on any fence or queue phase consuming more than 20% of
+    the step phase's total time (the host is stalling on the device
+    fence, or requests are parked in admission: the deferred-fencing /
+    admission knobs are the lever)."""
+    if not profiles:
+        return
+    print("\n## Trace spans (windowed device profiles)\n")
+    for r in profiles:
+        window = f"steps [{r.get('start_step', '?')}, " \
+                 f"{r.get('end_step', '?')})"
+        print(f"**profile** · {window} · wall "
+              f"{_fmt(r.get('wall_ms'))} ms · trace "
+              f"`{r.get('trace_dir', '-')}`\n")
+        spans = r.get("spans") or {}
+        if not spans:
+            print("_no spans recorded in the window (run with "
+                  "--trace_spans for the phase table)_")
+            continue
+        step_total = (spans.get("step") or {}).get("total_ms", 0.0)
+        print("| phase | count | p50 ms | p99 ms | total ms "
+              "| of step |")
+        print("|---|---|---|---|---|---|")
+        hot = []
+        for name, s in spans.items():
+            share = (s.get("total_ms", 0.0) / step_total
+                     if step_total else None)
+            cell = f"{share * 100:.1f}%" if share is not None else "-"
+            flagged = (share is not None and share > 0.2
+                       and ("fence" in name or "queue" in name))
+            if flagged:
+                cell += " ⚠"
+                hot.append((name, share))
+            print(f"| {name} | {s.get('count', '-')} "
+                  f"| {_fmt(s.get('p50_ms'))} | {_fmt(s.get('p99_ms'))} "
+                  f"| {_fmt(s.get('total_ms'))} | {cell} |")
+        for name, share in hot:
+            what = ("the deferred-fence drain is eating the step — "
+                    "raise --sync_period or shrink the readback"
+                    if "fence" in name else
+                    "requests sit in admission — grow pages/slots or "
+                    "shed earlier")
+            print(f"\n**⚠ `{name}` is {share * 100:.0f}% of step "
+                  f"time** — {what}.")
+
+
 MFU_TARGET_PCT = 50.0  # the ROADMAP north-star floor
 
 
@@ -475,6 +524,7 @@ def main(argv: list[str]) -> int:
     elastics = [r for r in records if r.get("kind") == "elastic_event"]
     fleets = [r for r in records if r.get("kind") == "fleet"]
     preflights = [r for r in records if r.get("kind") == "preflight"]
+    profiles = [r for r in records if r.get("kind") == "profile"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -492,10 +542,11 @@ def main(argv: list[str]) -> int:
     fleet_table(fleets)
     serving_table(serves, serve_summaries)
     preflight_table(preflights)
+    trace_table(profiles)
     bench_table(bench)
     if not steps and not bench and not faults and not recoveries \
             and not serves and not serve_summaries and not elastics \
-            and not fleets and not preflights:
+            and not fleets and not preflights and not profiles:
         print("_no step, fault, serve or bench records found_")
     return 0
 
