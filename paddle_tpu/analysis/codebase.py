@@ -58,6 +58,7 @@ THREADED_MODULES = (
     "paddle_tpu/trainer/checkpoint.py",
     "paddle_tpu/telemetry/tracing.py",
     "paddle_tpu/telemetry/introspect.py",
+    "paddle_tpu/telemetry/goodput.py",
 )
 
 

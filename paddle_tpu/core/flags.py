@@ -301,6 +301,20 @@ define("profile_steps", "", "capture a jax.profiler device trace over "
 define("profile_dir", "", "output directory for the --profile_steps "
                           "capture (empty = <tmpdir>/paddle_tpu_"
                           "profile_host<k>)")
+define("goodput_ledger", False, "classify every wall-clock second of "
+                                "the run into productive compute vs. "
+                                "named badput buckets (input_wait, "
+                                "fence, recompile, checkpoint, "
+                                "guard_rescue, restart, elastic, "
+                                "idle), folded from the trace-span "
+                                "ring; arms --trace_spans; emits one "
+                                "'ledger' record at run end and sets "
+                                "the goodput_fraction gauge")
+define("ledger_dir", "", "append this run's closing ledger record to "
+                         "<ledger_dir>/ledger.jsonl (render with "
+                         "tools/goodput_report.py; empty = no file, "
+                         "the record still lands in the telemetry "
+                         "stream)")
 
 # -- env passthroughs read directly (see declare_env above) --------------------
 declare_env("PADDLE_TPU_COORDINATOR",

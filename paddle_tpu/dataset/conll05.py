@@ -90,3 +90,22 @@ def train():
     """The reference only distributes the test split freely; a train split
     is provided here for the sequence_tagging demo parity."""
     return _reader("train", TRAIN_SENTENCES)
+
+
+# length-quantization table for the default batching below (sentences
+# are 5..19 tokens; every slot of a sample shares the sentence length)
+SEQ_BUCKETS = (8, 12, 16, 20)
+
+
+def bucketed_batches(reader, batch_size: int, seed: int = 0,
+                     size_multiple: int = 1):
+    """Default batching for the CoNLL05 sample readers: length-bucketed
+    via ``reader.bucket_by_length`` with :data:`SEQ_BUCKETS` — pair it
+    with ``SGD.train(seq_buckets=conll05.SEQ_BUCKETS)`` so the feeder
+    pads each batch to its bucket ceiling and every bucket is one jit
+    signature (the coarser demo-scale twin of
+    ``models.sequence_tagging.srl_bucketed_batches``)."""
+    from paddle_tpu.reader.decorator import bucket_by_length
+
+    return bucket_by_length(reader, batch_size, buckets=SEQ_BUCKETS,
+                            seed=seed, size_multiple=size_multiple)

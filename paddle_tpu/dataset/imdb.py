@@ -52,3 +52,22 @@ def test(word_idx=None):
         yield from _synthetic("test", TEST_SIZE)
 
     return reader
+
+
+# length-quantization table for the default batching below (reviews
+# are 20..119 tokens; the scalar label probes as length 1 and never
+# drives the bucket choice)
+SEQ_BUCKETS = (32, 64, 96, 128)
+
+
+def bucketed_batches(reader, batch_size: int, seed: int = 0,
+                     size_multiple: int = 1):
+    """Default batching for the IMDB sample readers: length-bucketed
+    via ``reader.bucket_by_length`` with :data:`SEQ_BUCKETS`, so a
+    batch of short reviews stops padding to the 119-token tail.  Pair
+    with ``SGD.train(seq_buckets=imdb.SEQ_BUCKETS)`` to pin one jit
+    signature per bucket."""
+    from paddle_tpu.reader.decorator import bucket_by_length
+
+    return bucket_by_length(reader, batch_size, buckets=SEQ_BUCKETS,
+                            seed=seed, size_multiple=size_multiple)

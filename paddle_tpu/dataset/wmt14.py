@@ -68,3 +68,26 @@ def get_dict(dict_size: int, reverse: bool = True):
         src = {v: k for k, v in src.items()}
         trg = {v: k for k, v in trg.items()}
     return src, trg
+
+
+# length-quantization table for the default batching below: sentence
+# cores are 3..14 words, +2 brackets on src — two ceilings keep the
+# padded-timestep waste low at two jit signatures
+SEQ_BUCKETS = (8, 16)
+
+
+def bucketed_batches(reader, batch_size: int, seed: int = 0,
+                     size_multiple: int = 1):
+    """Default batching for the WMT14 sample readers: length-bucketed
+    via ``reader.bucket_by_length`` with :data:`SEQ_BUCKETS`, so a
+    batch pads to its bucket ceiling instead of the stream max.  Feed
+    the same table to ``SGD.train(seq_buckets=wmt14.SEQ_BUCKETS)`` (or
+    ``--seq_buckets``) so the feeder pads to the ceilings too and every
+    bucket stays one jit signature::
+
+        batches = wmt14.bucketed_batches(wmt14.train(30000), 64)
+    """
+    from paddle_tpu.reader.decorator import bucket_by_length
+
+    return bucket_by_length(reader, batch_size, buckets=SEQ_BUCKETS,
+                            seed=seed, size_multiple=size_multiple)

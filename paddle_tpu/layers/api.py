@@ -1612,6 +1612,74 @@ def bilstm(input: LayerOutput, size: int, name: str | None = None,
                        attrs={"reversed_field": True})
 
 
+def bigru(input: LayerOutput, size: int, name: str | None = None,
+          param_attr: ParamAttr | None = None, bias_attr=None,
+          inner_param_attr: ParamAttr | None = None,
+          inner_bias_attr=None) -> LayerOutput:
+    """Bidirectional GRU (input projections included) as ONE layer node,
+    lowering to ``ops/rnn.bigru_fused``: with the ``fused_kernels`` flag
+    on (on TPU) both directions run in a single Pallas program over one
+    residency of all six weight matrices (``bigru_seq``) — the composed
+    fc + grumemory pair pays the input/weight streaming twice;
+    otherwise the exact unfused composition.
+
+    Parameter naming mirrors the composed ``simple_gru2`` form:
+    ``<name>_fw_transform.w0``/``.wbias`` (the 3*size input projection)
+    and ``<name>_fw.w0``/``.wbias`` (the grumemory-convention [D, 3D]
+    recurrent weight — [:, :2D] gates, [:, 2D:] candidate — plus the
+    3*size gate bias), same for ``_bw``.  Output is the [fw, bw]
+    feature concat (size 2*size)."""
+    name = name or gen_name("bigru")
+    d = size
+    use_proj_bias = bias_attr is not False
+    use_inner_bias = inner_bias_attr is not False
+
+    def dir_specs(suffix):
+        proj_w = _wspec(param_attr, f"{name}_{suffix}_transform", "w0",
+                        (input.size, 3 * d), I.xavier())
+        specs = [proj_w]
+        proj_b = None
+        if use_proj_bias:
+            proj_b = _wspec(
+                bias_attr if isinstance(bias_attr, ParamAttr) else None,
+                f"{name}_{suffix}_transform", "wbias", (3 * d,),
+                I.constant(0.0))
+            specs.append(proj_b)
+        w = _wspec(inner_param_attr, f"{name}_{suffix}", "w0", (d, 3 * d),
+                   I.paddle_default())
+        specs.append(w)
+        wb = None
+        if use_inner_bias:
+            wb = _wspec(
+                inner_bias_attr if isinstance(inner_bias_attr, ParamAttr)
+                else None,
+                f"{name}_{suffix}", "wbias", (3 * d,), I.constant(0.0))
+            specs.append(wb)
+        return specs, proj_w, proj_b, w, wb
+
+    fw_specs, fw_pw, fw_pb, fw_w, fw_wb = dir_specs("fw")
+    bw_specs, bw_pw, bw_pb, bw_w, bw_wb = dir_specs("bw")
+
+    def fwd(ctx, params, states, x):
+        def bundle(proj_w, proj_b, w, wb):
+            bias = params[proj_b.name] if proj_b is not None else None
+            if wb is not None:
+                gate_b = params[wb.name]
+                bias = gate_b if bias is None else bias + gate_b
+            full = params[w.name]
+            return (params[proj_w.name], bias, full[:, : 2 * d],
+                    full[:, 2 * d:])
+
+        return rnn_ops.bigru_fused(
+            x, bundle(fw_pw, fw_pb, fw_w, fw_wb),
+            bundle(bw_pw, bw_pb, bw_w, bw_wb))
+
+    return LayerOutput(name=name, layer_type="bigru", size=2 * d,
+                       parents=(input,),
+                       param_specs=tuple(fw_specs + bw_specs), fn=fwd,
+                       attrs={"reversed_field": True})
+
+
 # ---------------------------------------------------------------------------
 # output / decoding layers
 # ---------------------------------------------------------------------------

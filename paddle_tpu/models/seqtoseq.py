@@ -9,11 +9,14 @@ a GRU step conditioned on a Bahdanau attention context.  Training builds the
 per-timestep cross-entropy cost; generation builds a compiled beam search
 (one ``lax.scan``, top-k pruning — see ``layers/recurrent_group.py``).
 
-Perf routing: the encoder GRUs lower through ``ops/rnn.gru_fused`` (the
-persistent Pallas sequence kernel), which under the ``fused_kernels``
-flag on TPU also enables REMAT mode — the [T, B, 3D] u/r/c residual
-slab is recomputed in the reverse kernel instead of round-tripping
-through HBM.  Pad waste on ragged WMT batches is the reader's job:
+Perf routing: the encoder's paired fw/bw GRUs lower through ONE
+``layer.bigru`` node (``ops/rnn.bigru_fused``): under the
+``fused_kernels`` flag on TPU both directions run in a single Pallas
+program over one weight residency (``bigru_seq``, remat mode — the
+[T, B, 3D] u/r/c residual slab is recomputed in the reverse kernel
+instead of round-tripping through HBM); on CPU / flag-off the node is
+the exact composed two-pass twin.  Pad waste on ragged WMT batches is
+the reader's job:
 batch with ``reader.bucket_by_length`` + ``seq_buckets`` so source /
 target feeds pad only to their bucket ceilings."""
 
@@ -55,12 +58,15 @@ def seqtoseq_net(source_dict_dim: int, target_dict_dim: int,
     # param_attrs) so a generation topology built later in the SAME process
     # still finds the trained values by name — auto gen_name() counters keep
     # incrementing across topologies and would orphan the encoder weights
-    src_forward = networks.simple_gru(
-        input=src_embedding, size=encoder_size, name="src_gru_fw")
-    src_backward = networks.simple_gru(
-        input=src_embedding, size=encoder_size, reverse=True,
+    # both encoder directions through ONE bigru node: on TPU with
+    # fused_kernels the paired fw/bw recurrences share a single weight
+    # residency (ops/pallas/gru.bigru_seq); on CPU / flag-off the node
+    # lowers to the exact composed two-pass twin — same trajectory
+    encoded_vector = layer.bigru(
+        input=src_embedding, size=encoder_size, name="src_gru")
+    src_backward = layer.slice(
+        input=encoded_vector, start=encoder_size, end=2 * encoder_size,
         name="src_gru_bw")
-    encoded_vector = layer.concat(input=[src_forward, src_backward])
 
     encoded_proj = mixed(
         size=decoder_size, name="encoded_proj",

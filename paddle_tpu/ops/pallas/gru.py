@@ -510,6 +510,205 @@ def gru_seq_fi_reference(x, mask, w_x, b, w_h, w_hc, h0, reverse=False):
     return gru_seq_reference(xw, mask, w_h, w_hc, h0, reverse)
 
 
+# ---------------------------------------------------------------------------
+# fused bidirectional entry: both directions over ONE weight residency
+# ---------------------------------------------------------------------------
+
+
+def _bigru_fwd_kernel(xf_ref, xb_ref, mf_ref, mb_ref,
+                      wxf_ref, bf_ref, whf_ref, whcf_ref,
+                      wxb_ref, bb_ref, whb_ref, whcb_ref,
+                      h0f_ref, h0b_ref, *rest, d, emit_gates=True):
+    """One grid pass computes BOTH directions (the GRU sibling of
+    ``lstm._bi_fwd_kernel``): at step i the forward recurrence advances
+    array index i while the reverse recurrence advances index T-1-i via
+    its own block index maps, so the fwd/rev passes share a single
+    residency of all six weight matrices instead of paying the weight
+    streaming twice."""
+    if emit_gates:
+        (hsf_ref, urcf_ref, hTf_ref,
+         hsb_ref, urcb_ref, hTb_ref, hf_scr, hb_scr) = rest
+    else:
+        (hsf_ref, hTf_ref, hsb_ref, hTb_ref, hf_scr, hb_scr) = rest
+        urcf_ref = urcb_ref = None
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        hf_scr[...] = h0f_ref[...].astype(hf_scr.dtype)
+        hb_scr[...] = h0b_ref[...].astype(hb_scr.dtype)
+
+    def one_dir(x_ref, m_ref, wx_ref, b_ref, wh_ref, whc_ref,
+                h_scr, hs_ref, urc_ref, hT_ref):
+        h = h_scr[...]
+        xw = jnp.dot(x_ref[0].astype(wx_ref.dtype), wx_ref[...],
+                     preferred_element_type=jnp.float32,
+                     precision=_prec(wx_ref)) + b_ref[...].astype(jnp.float32)
+        u, r, c, hf = _gru_gates(xw, h, wh_ref, whc_ref, d)
+        h_new = u * hf + (1.0 - u) * c
+        m = m_ref[0]
+        h_new = m * h_new + (1.0 - m) * hf
+        h_scr[...] = h_new.astype(h_scr.dtype)
+        hs_ref[0] = h_new.astype(hs_ref.dtype)
+        if urc_ref is not None:
+            urc_ref[0] = jnp.concatenate([u, r, c], axis=-1).astype(
+                urc_ref.dtype)
+
+        @pl.when(t == nt - 1)
+        def _final():
+            hT_ref[...] = h_new.astype(hT_ref.dtype)
+
+    one_dir(xf_ref, mf_ref, wxf_ref, bf_ref, whf_ref, whcf_ref,
+            hf_scr, hsf_ref, urcf_ref, hTf_ref)
+    one_dir(xb_ref, mb_ref, wxb_ref, bb_ref, whb_ref, whcb_ref,
+            hb_scr, hsb_ref, urcb_ref, hTb_ref)
+
+
+def _bigru_fwd_call(x, mask, w_x_f, b_f, w_h_f, w_hc_f,
+                    w_x_b, b_b, w_h_b, w_hc_b, h0f, h0b,
+                    *, interpret, emit_gates):
+    t, bsz, e = x.shape
+    d = w_hc_f.shape[0]
+    dd3 = 3 * d
+    io_dtype = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+    kernel = functools.partial(_bigru_fwd_kernel, d=d, emit_gates=emit_gates)
+    fwd = lambda i: (i, 0, 0)             # noqa: E731
+    rev = lambda i: (t - 1 - i, 0, 0)     # noqa: E731
+    res = lambda i: (0, 0)                # noqa: E731
+
+    def dir_outs(step):
+        specs = [pl.BlockSpec((1, bsz, d), step)]
+        shapes = [jax.ShapeDtypeStruct((t, bsz, d), io_dtype)]
+        if emit_gates:
+            specs.append(pl.BlockSpec((1, bsz, dd3), step))
+            shapes.append(jax.ShapeDtypeStruct((t, bsz, dd3), io_dtype))
+        specs.append(pl.BlockSpec((bsz, d), res))
+        shapes.append(jax.ShapeDtypeStruct((bsz, d), jnp.float32))
+        return specs, shapes
+
+    f_specs, f_shapes = dir_outs(fwd)
+    b_specs, b_shapes = dir_outs(rev)
+    out = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, bsz, e), fwd),                      # x (fwd view)
+            pl.BlockSpec((1, bsz, e), rev),                      # x (rev view)
+            pl.BlockSpec((1, bsz, 1), fwd),                      # mask fwd
+            pl.BlockSpec((1, bsz, 1), rev),                      # mask rev
+            pl.BlockSpec((e, dd3), res), pl.BlockSpec((1, dd3), res),
+            pl.BlockSpec((d, 2 * d), res), pl.BlockSpec((d, d), res),
+            pl.BlockSpec((e, dd3), res), pl.BlockSpec((1, dd3), res),
+            pl.BlockSpec((d, 2 * d), res), pl.BlockSpec((d, d), res),
+            pl.BlockSpec((bsz, d), res), pl.BlockSpec((bsz, d), res),
+        ],
+        out_specs=f_specs + b_specs,
+        out_shape=f_shapes + b_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((bsz, d), w_h_f.dtype),
+            pltpu.VMEM((bsz, d), w_h_b.dtype),
+        ],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(x, x, mask, mask, w_x_f, b_f.reshape(1, dd3), w_h_f, w_hc_f,
+      w_x_b, b_b.reshape(1, dd3), w_h_b, w_hc_b, h0f, h0b)
+    k = 3 if emit_gates else 2
+    f_out, b_out = out[:k], out[k:]
+    if emit_gates:
+        hsf, urcf, hTf = f_out
+        hsb, urcb, hTb = b_out
+    else:
+        (hsf, hTf), urcf = f_out, None
+        (hsb, hTb), urcb = b_out, None
+    return (hsf, urcf, hTf), (hsb, urcb, hTb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(12, 13))
+def bigru_seq(x, mask, w_x_f, b_f, w_h_f, w_hc_f,
+              w_x_b, b_b, w_h_b, w_hc_b, h0f, h0b,
+              interpret=False, remat=False):
+    """Fused bidirectional GRU: forward and reverse recurrences run in
+    ONE pallas program over a single residency of both directions'
+    weights, streaming x once (the composed form pays the x/weight
+    traffic twice).  x: [B, T, E]; per direction w_x: [E, 3D], b: [3D],
+    w_h: [D, 2D], w_hc: [D, D]; h0: [B, D].  Returns (hs_f, hs_b, hT_f,
+    hT_b); concatenate hs_f/hs_b on the feature axis for the BiGRU
+    output."""
+    x_t = jnp.swapaxes(x, 0, 1)
+    f_out, b_out = _bigru_fwd_call(
+        x_t, _mask3(mask), w_x_f, b_f, w_h_f, w_hc_f,
+        w_x_b, b_b, w_h_b, w_hc_b, h0f, h0b,
+        interpret=interpret, emit_gates=False)
+    hsf, _, hTf = f_out
+    hsb, _, hTb = b_out
+    return jnp.swapaxes(hsf, 0, 1), jnp.swapaxes(hsb, 0, 1), hTf, hTb
+
+
+def _bigru_seq_fwd(x, mask, w_x_f, b_f, w_h_f, w_hc_f,
+                   w_x_b, b_b, w_h_b, w_hc_b, h0f, h0b, interpret, remat):
+    x_t = jnp.swapaxes(x, 0, 1)
+    f_out, b_out = _bigru_fwd_call(
+        x_t, _mask3(mask), w_x_f, b_f, w_h_f, w_hc_f,
+        w_x_b, b_b, w_h_b, w_hc_b, h0f, h0b,
+        interpret=interpret, emit_gates=not remat)
+    hsf, urcf, hTf = f_out
+    hsb, urcb, hTb = b_out
+    out = (jnp.swapaxes(hsf, 0, 1), jnp.swapaxes(hsb, 0, 1), hTf, hTb)
+    res = (x_t, mask, w_x_f, b_f, w_h_f, w_hc_f, w_x_b, b_b, w_h_b,
+           w_hc_b, h0f, h0b, hsf, urcf, hsb, urcb)
+    return out, res
+
+
+def _bigru_seq_bwd(interpret, remat, res, cts):
+    from paddle_tpu.ops.pallas import mxu_precision
+
+    (x_t, mask, w_x_f, b_f, w_h_f, w_hc_f, w_x_b, b_b, w_h_b, w_hc_b,
+     h0f, h0b, hsf, urcf, hsb, urcb) = res
+    d_hsf, d_hsb, d_hTf, d_hTb = cts
+
+    def one_dir(w_x, b, w_h, w_hc, h0, hs, urc, d_hs, d_hT, reverse):
+        xw_t = _project_xw(x_t, w_x, b) if remat else None
+        dxw, dwh, dwhc, dh0 = _gru_dxw_bwd(
+            xw_t, mask, w_h, w_hc, h0, hs, urc,
+            jnp.swapaxes(d_hs, 0, 1).astype(jnp.float32),
+            d_hT.astype(jnp.float32), reverse, interpret, remat)
+        prec = mxu_precision(w_x)
+        dg_c = dxw.astype(w_x.dtype)
+        dwx = jnp.einsum("tbe,tbg->eg", x_t.astype(w_x.dtype), dg_c,
+                         preferred_element_type=jnp.float32, precision=prec)
+        db = jnp.sum(dxw, axis=(0, 1))
+        dx = jnp.einsum("tbg,eg->tbe", dg_c, w_x,
+                        preferred_element_type=jnp.float32, precision=prec)
+        return (dx, dwx.astype(w_x.dtype), db.astype(b.dtype),
+                dwh.astype(w_h.dtype), dwhc.astype(w_hc.dtype),
+                dh0.astype(h0.dtype))
+
+    dxf, dwxf, dbf, dwhf, dwhcf, dh0f = one_dir(
+        w_x_f, b_f, w_h_f, w_hc_f, h0f, hsf, urcf, d_hsf, d_hTf, False)
+    dxb, dwxb, dbb, dwhb, dwhcb, dh0b = one_dir(
+        w_x_b, b_b, w_h_b, w_hc_b, h0b, hsb, urcb, d_hsb, d_hTb, True)
+    dx = jnp.swapaxes(dxf + dxb, 0, 1).astype(x_t.dtype)
+    return (dx, None, dwxf, dbf, dwhf, dwhcf, dwxb, dbb, dwhb, dwhcb,
+            dh0f, dh0b)
+
+
+bigru_seq.defvjp(_bigru_seq_fwd, _bigru_seq_bwd)
+
+
+def bigru_seq_reference(x, mask, w_x_f, b_f, w_h_f, w_hc_f,
+                        w_x_b, b_b, w_h_b, w_hc_b, h0f, h0b):
+    """Pure-jnp oracle of :func:`bigru_seq`: the two fused-input
+    references composed (forward + reverse), same return contract."""
+    hs_f, hT_f = gru_seq_fi_reference(
+        x, mask, w_x_f, b_f, w_h_f, w_hc_f, h0f, False)
+    hs_b, hT_b = gru_seq_fi_reference(
+        x, mask, w_x_b, b_b, w_h_b, w_hc_b, h0b, True)
+    return hs_f, hs_b, hT_f, hT_b
+
+
 def gru_seq_reference(xw, mask, w_h, w_hc, h0, reverse=False):
     """Pure-jnp oracle of :func:`gru_seq`: the same cell and freeze-mask
     semantics as an explicit f32 scan.  Returns (hs [B, T, D], h_T)."""

@@ -362,6 +362,62 @@ def gru_fi(x: SequenceBatch, w_x: jax.Array, b: jax.Array | None,
             hT.astype(out_dtype))
 
 
+def bigru_fused(x: SequenceBatch, fw: tuple, bw: tuple):
+    """Bidirectional GRU over raw inputs: ONE kernel runs both
+    directions over a single residency of all six weight matrices when
+    the fused routing is on (``ops/pallas/gru.bigru_seq``); otherwise
+    the exact unfused composition (two projections + two pre-projected
+    passes).  ``fw``/``bw`` are (w_x [E, 3D], bias [3D] | None,
+    w_h [D, 2D], w_hc [D, D]) per direction.  Returns the concatenated
+    SequenceBatch [B, T, 2D] (forward features first)."""
+    from paddle_tpu.core import dtype as dt
+    from paddle_tpu.ops.math import matmul
+    from paddle_tpu.ops.pallas import default_interpret
+    from paddle_tpu.ops.pallas.gru import bigru_seq
+
+    w_x_f, b_f, w_h_f, w_hc_f = fw
+    w_x_b, b_b, w_h_b, w_hc_b = bw
+    d = w_hc_f.shape[0]
+    b_, t = x.batch_size, x.max_len
+    init = jnp.zeros((b_, d), jnp.float32)
+    use_kernel = (fused_input_on()
+                  and _fused_fits(b_, d, 3, *dt.cast_for_matmul(
+                      x.data, w_x_f, w_h_f, w_hc_f,
+                      w_x_b, w_h_b, w_hc_b)[1:]))
+    if not use_kernel:
+        def one(w_x, bias, w_h, w_hc, reverse):
+            xw = matmul(x.data.reshape(b_ * t, -1), w_x)
+            if bias is not None:
+                xw = xw + bias
+            out, _ = gru_fused(
+                SequenceBatch(xw.reshape(b_, t, 3 * d), x.length), w_h,
+                w_hc, init, reverse=reverse)
+            return out
+
+        f = one(w_x_f, b_f, w_h_f, w_hc_f, False)
+        r = one(w_x_b, b_b, w_h_b, w_hc_b, True)
+        return SequenceBatch(
+            data=jnp.concatenate([f.data, r.data], axis=-1),
+            length=x.length)
+
+    data, wxf, whf, whcf, wxb, whb, whcb = dt.cast_for_matmul(
+        x.data, w_x_f, w_h_f, w_hc_f, w_x_b, w_h_b, w_hc_b)
+    mask = x.mask().astype(jnp.float32)
+
+    def prep(bias):
+        return (jnp.zeros((3 * d,), jnp.float32) if bias is None
+                else bias.astype(jnp.float32))
+
+    hs_f, hs_b, _, _ = bigru_seq(
+        data, mask, wxf, prep(b_f), whf, whcf, wxb, prep(b_b), whb, whcb,
+        init.astype(whf.dtype), init.astype(whb.dtype),
+        default_interpret(), True)
+    out_dtype = x.data.dtype
+    return SequenceBatch(
+        data=jnp.concatenate([hs_f, hs_b], axis=-1).astype(out_dtype),
+        length=x.length)
+
+
 def gru(
     x: SequenceBatch,  # [B, T, Din]
     w_x: jax.Array,  # [Din, 3D]

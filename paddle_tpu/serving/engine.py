@@ -439,12 +439,41 @@ class ServingEngine:
         self.registry.counter(
             "serve_requests", "completed requests").inc(
                 1.0, reason=a.finished)
+        # per-request cost attribution, from the request's OWN
+        # timestamps (no new clocks): the wall seconds of each
+        # lifecycle phase it occupied, plus its KV-page
+        # occupancy-seconds (pages held × admitted residency).  These
+        # are occupancy figures — a batched prefill charges its wall to
+        # every member — so summed attribution measures demand, the way
+        # replica-seconds do.  The goodput ledger folds the counters
+        # below into the run's closing cost-per-token split, and the
+        # fleet router rolls them up across replicas.
+        queue_s = max(0.0, a.t_admit - a.request.arrival)
+        prefill_s = max(0.0, a.t_first - a.t_admit)
+        decode_s = max(0.0, now - a.t_first)
+        pages = self.cache.pages_needed(a.prompt_len + n)
+        kv_page_s = pages * max(0.0, now - a.t_admit)
+        reg = self.registry
+        reg.counter("serve_queue_s",
+                    "summed request queue-seconds").inc(queue_s)
+        reg.counter("serve_prefill_compute_s",
+                    "summed prefill-phase occupancy seconds").inc(prefill_s)
+        reg.counter("serve_decode_compute_s",
+                    "summed decode-phase occupancy seconds").inc(decode_s)
+        reg.counter("serve_kv_page_s",
+                    "summed KV-page occupancy-seconds").inc(kv_page_s)
         rec = {
             "request": a.request.id, "prompt_tokens": a.prompt_len,
             "new_tokens": n, "finish": a.finished,
             "queue_wait_ms": round((a.t_admit - a.request.arrival) * 1e3, 3),
             "ttft_ms": round(ttft_ms, 3), "tpot_ms": round(tpot_ms, 3),
             "total_ms": round(total_ms, 3),
+            "queue_s": round(queue_s, 6),
+            "prefill_s": round(prefill_s, 6),
+            "decode_s": round(decode_s, 6),
+            "kv_page_s": round(kv_page_s, 6),
+            "cost_per_token_s": round((prefill_s + decode_s) / n, 9)
+                                if n else None,
         }
         if self.registry.active:
             self.registry.emit(rec, kind="serve")

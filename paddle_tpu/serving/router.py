@@ -738,6 +738,27 @@ class FleetRouter:
             "totals": {k: v for k, v in sorted(totals.items())
                        if k.startswith(("serve_", "fleet_"))},
         }
+        # fleet-wide cost-per-token split from the engines' per-request
+        # cost accumulators (serving/engine.py _finish): summed
+        # occupancy-seconds over summed tokens, one figure per phase
+        tokens = rollup["serve_tokens"]
+        if tokens > 0:
+            prefill = totals.get("serve_prefill_compute_s", 0.0)
+            decode = totals.get("serve_decode_compute_s", 0.0)
+            queue = totals.get("serve_queue_s", 0.0)
+            rollup["cost_per_token_s"] = round((prefill + decode) / tokens, 9)
+            rollup["cost_per_token_prefill_s"] = round(prefill / tokens, 9)
+            rollup["cost_per_token_decode_s"] = round(decode / tokens, 9)
+            rollup["cost_per_token_queue_s"] = round(queue / tokens, 9)
+            rollup["kv_page_s"] = round(
+                totals.get("serve_kv_page_s", 0.0), 6)
+        # goodput_fraction is a FRACTION, not a volume: the aggregate
+        # summed it across replicas like any gauge, so the fleet view
+        # divides back to the per-replica mean instead of reporting a
+        # nonsense >1 "total fraction"
+        if "goodput_fraction" in totals and texts:
+            rollup["goodput_fraction"] = round(
+                totals["goodput_fraction"] / len(texts), 6)
         if self.registry.active:
             self.registry.emit({"event": "scrape", **rollup},
                                kind="fleet")

@@ -330,6 +330,15 @@ class IntrospectionServer:
         from paddle_tpu.distributed.multihost import rendezvous_epoch
 
         payload["elastic_epoch"] = rendezvous_epoch()
+        # the goodput ledger's closing fraction (telemetry/goodput.py),
+        # when one has been taken — absent otherwise, so scrapers can
+        # tell "no ledger" from "goodput 0"
+        g = self.registry.get("goodput_fraction") \
+            if self.registry is not None else None
+        if g is not None:
+            frac = g.value()
+            if frac is not None:
+                payload["goodput_fraction"] = round(frac, 6)
         if self.flight is not None:
             beats = self.flight.heartbeats
             if beats:

@@ -94,14 +94,26 @@ from typing import Any
 # p99_ms, max_ms}} rendered by tools/metrics_to_md.py's "Trace spans"
 # table.  Histogram summaries became None-safe at zero observations
 # (min/max clamp to 0 instead of leaking ±inf into JSON).
-SCHEMA = "paddle_tpu.metrics/11"
+# /12 added the goodput ledger (telemetry/goodput.py): record kind
+# "ledger" — one per run close, classifying every wall-clock second
+# into productive compute vs. named badput buckets (input_wait, fence,
+# recompile, checkpoint_save, checkpoint_restore, guard_rescue,
+# restart, elastic_drain, elastic_reshard, idle) folded from existing
+# tracewire spans and resilience counters, plus the serving cost
+# split (prefill/decode compute-seconds, queue-seconds, KV-page
+# occupancy-seconds, cost_per_token).  The "serve" record gained
+# queue_s/prefill_s/decode_s/kv_page_s/cost_per_token fields and the
+# fleet rollup gained cost-per-token components; rendered by
+# tools/goodput_report.py and metrics_to_md.py's "Goodput" table,
+# regression-guarded by tools/bench_sentinel.py.
+SCHEMA = "paddle_tpu.metrics/12"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted
 # kind missing here — or an entry here nothing produces — is drift.
 RECORD_KINDS = ("step", "bench", "fault", "recovery", "serve",
                 "serve_summary", "elastic_event", "preflight", "fleet",
-                "profile")
+                "profile", "ledger")
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)

@@ -323,6 +323,17 @@ class SGD:
         # to a shared no-op — the bit-identical-trajectory guarantee.
         if flags.get("trace_spans"):
             tracing_mod.configure_tracing(enabled=True)
+        # goodput ledger (--goodput_ledger): a fold over the span ring,
+        # so arming it arms tracing too.  Started before the build so
+        # pre-step-0 wall (build, placement) lands in "idle" instead of
+        # silently missing from the account.
+        self._goodput_ledger = None
+        if flags.get("goodput_ledger"):
+            from paddle_tpu.telemetry import goodput as goodput_mod
+
+            tracing_mod.configure_tracing(enabled=True)
+            self._goodput_ledger = goodput_mod.GoodputLedger(
+                registry=self._telemetry.registry).start()
         prev_debug_nans = jax.config.jax_debug_nans
         if flags.get("debug_nans"):
             # the documented jax nan-checking traps at the originating op;
@@ -409,6 +420,16 @@ class SGD:
                 # it) still stops the device trace and emits the record
                 profile_window.close()
                 self._profile_window = None
+            ledger = getattr(self, "_goodput_ledger", None)
+            if ledger is not None and ledger.started:
+                # close the wall-clock account (idle absorbs whatever
+                # no span covered) and emit the ledger record BEFORE
+                # the status server stops, so a last /healthz scrape
+                # sees the final goodput_fraction
+                ledger_dir = flags.get("ledger_dir")
+                ledger.finish(path=os.path.join(ledger_dir, "ledger.jsonl")
+                              if ledger_dir else None)
+                self._goodput_ledger = None
             if status_server is not None:
                 status_server.stop()
             trace_dir = flags.get("trace_dir")
@@ -431,10 +452,16 @@ class SGD:
         guard's rollback path.  The restore wall time lands in the
         ``checkpoint_restore_ms`` gauge (the recovery-time observable)."""
         from paddle_tpu.distributed import multihost as mh
+        from paddle_tpu.telemetry.tracing import get_tracer
         from paddle_tpu.trainer.checkpoint import load_checkpoint
 
         path, manifest = found
         t0 = _time.perf_counter()
+        # tracer-clock twin of t0 for the retrospective "restore" span
+        # below (the goodput ledger's checkpoint_restore bucket) — same
+        # measurement window, the tracer's timeline
+        tracer = get_tracer()
+        tk0 = tracer.clock() if tracer.enabled else 0.0
         # heartbeat-free phases look like hangs to the staleness
         # watchdog; mark the restore so a slow load stays a sign of life
         mh.flight_recorder().heartbeat("restore", path=path)
@@ -460,6 +487,9 @@ class SGD:
             rng.set_state(np.asarray(manifest["meta"]["rng"],
                                      dtype=np.uint32))
         mh.flight_recorder().heartbeat("restored", path=path)
+        if tracer.enabled:
+            tracer.add_span("restore", tk0, tracer.clock(), cat="trainer",
+                            path=path)
         if self._telemetry is not None:
             self._telemetry.registry.gauge(
                 "checkpoint_restore_ms",
@@ -722,6 +752,12 @@ class SGD:
                         self))
                 pending.clear()
                 tracer.end(tk_fence)
+                ledger = getattr(self, "_goodput_ledger", None)
+                if ledger is not None:
+                    # the flush cadence is the ledger's fold cadence:
+                    # frequent enough that the span ring can't wrap a
+                    # whole fold interval on any realistic run
+                    ledger.fold()
                 window["t0"] = _time.perf_counter()
 
             # mid-pass resume: fast-forward the reader past the batches
@@ -942,7 +978,11 @@ class SGD:
                     profile.maybe_start(n_disp)
                     t_step0 = _time.perf_counter()
                     with stat.timer("forwardBackward+update"):
-                        tk_compute = tracer.begin("compute", cat="trainer")
+                        # compile=True marks the dispatch that built a
+                        # new executable — the goodput ledger books the
+                        # whole span as "recompile", not "compute"
+                        tk_compute = tracer.begin("compute", cat="trainer",
+                                                  compile=new_sig)
                         with profile.annotation(n_disp):
                             params, opt_state, states, cost, metrics = \
                                 self._train_step(params, opt_state,

@@ -1,0 +1,67 @@
+"""Bench regression sentinel (tools/bench_sentinel.py) — the CI gate
+that diffs two BENCH_r*.json artifacts and fails on a >threshold
+regression in any shared metric, direction-aware (throughput down OR
+latency/cost up)."""
+
+import importlib.util
+import os
+
+import pytest
+
+pytestmark = pytest.mark.sentinel
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(scope="module")
+def sentinel():
+    spec = importlib.util.spec_from_file_location(
+        "bench_sentinel", os.path.join(_REPO, "tools", "bench_sentinel.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_real_rounds_r07_to_r08_pass_at_release_threshold(sentinel):
+    """The shipped round-over-round artifacts are the no-regression
+    baseline: r07 → r08 must exit 0 at the release threshold."""
+    assert sentinel.main([os.path.join(_REPO, "BENCH_r07.json"),
+                          os.path.join(_REPO, "BENCH_r08.json"),
+                          "--threshold", "0.30"]) == 0
+
+
+def test_seeded_regression_fixture_trips_nonzero(sentinel, tmp_path, capsys):
+    base, cand = sentinel.write_regression_fixture(str(tmp_path))
+    assert sentinel.main([base, cand, "--threshold", "0.10"]) == 1
+    out = capsys.readouterr().out
+    assert "toy_train_samples_per_sec" in out
+    assert "toy_p99_ttft_ms" in out
+    # the clean direction stays clean
+    assert sentinel.main([base, base]) == 0
+
+
+def test_self_test_flag_exits_zero(sentinel):
+    assert sentinel.main(["--self-test"]) == 0
+
+
+def test_direction_awareness(sentinel):
+    assert sentinel.lower_is_better("serve_p99_ttft_ms", "ms")
+    assert sentinel.lower_is_better("cost_per_token_s", "s/token")
+    assert not sentinel.lower_is_better("train_samples_per_sec", "samples/s")
+    assert not sentinel.lower_is_better("mfu_pct", "%")
+
+
+def test_compare_flags_only_crossing_metrics(sentinel, tmp_path):
+    base, cand = sentinel.write_regression_fixture(str(tmp_path))
+    result = sentinel.compare(sentinel.load_metrics(base),
+                              sentinel.load_metrics(cand), threshold=0.10)
+    assert set(result["regressions"]) == {"toy_train_samples_per_sec",
+                                          "toy_p99_ttft_ms"}
+    # the small mfu improvement is not a regression
+    assert "toy_mfu_pct" not in result["regressions"]
+
+
+def test_bad_usage_exits_two(sentinel, tmp_path):
+    assert sentinel.main([]) == 2
+    assert sentinel.main([str(tmp_path / "missing_a.json"),
+                         str(tmp_path / "missing_b.json")]) == 2

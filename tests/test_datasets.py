@@ -142,3 +142,39 @@ def test_mq2007_formats_consistent():
         if i > 300:
             break
     assert good / total > 0.75, good / total
+
+
+def test_bucketed_batches_quantize_to_tables():
+    """The default bucketed entry points pad every batch to a ceiling
+    from the module's SEQ_BUCKETS table — no batch mixes lengths above
+    its ceiling, so one jit signature per bucket holds downstream."""
+    from paddle_tpu.dataset import imdb
+
+    for mod, reader in ((wmt14, wmt14.train(1000)),
+                        (conll05, conll05.train()),
+                        (imdb, imdb.train())):
+        batches = list(mod.bucketed_batches(reader, 16)())
+        assert batches, mod.__name__
+        seen_ceilings = set()
+        for batch in batches:
+            longest = max(
+                max((len(f) for f in sample if hasattr(f, "__len__")),
+                    default=1)
+                for sample in batch)
+            ceiling = next(b for b in mod.SEQ_BUCKETS if longest <= b)
+            seen_ceilings.add(ceiling)
+        # the tables fit the length distributions: >1 bucket in use
+        assert len(seen_ceilings) > 1, (mod.__name__, seen_ceilings)
+
+
+def test_bucketed_batches_deterministic_and_lossless():
+    n_samples = sum(len(b) for b in
+                    conll05.bucketed_batches(conll05.train(), 16)())
+    # remainder="drop" only drops sub-batch remainders per bucket
+    assert n_samples <= conll05.TRAIN_SENTENCES
+    assert n_samples >= conll05.TRAIN_SENTENCES - 16 * len(conll05.SEQ_BUCKETS)
+    a = [tuple(map(tuple, s)) for b in
+         conll05.bucketed_batches(conll05.train(), 16, seed=7)() for s in b]
+    b = [tuple(map(tuple, s)) for b in
+         conll05.bucketed_batches(conll05.train(), 16, seed=7)() for s in b]
+    assert a == b

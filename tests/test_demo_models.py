@@ -82,7 +82,7 @@ def test_srl_tagger_learns():
         update_equation=paddle.optimizer.Adam(learning_rate=5e-3),
     )
     feeding = {name: i for i, name in enumerate(feed_order)}
-    reader = paddle.reader.batch(conll05.train(), batch_size=32)
+    reader = conll05.bucketed_batches(conll05.train(), batch_size=32)
     costs = []
 
     def handler(e):

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Bench regression sentinel — the pre-merge bench gate.
+
+Diffs two bench artifacts and exits nonzero when any tracked metric
+regressed beyond the threshold, so a perf claim is a *checked* claim:
+
+    python tools/bench_sentinel.py BENCH_r07.json BENCH_r08.json
+    python tools/bench_sentinel.py old/ledger.jsonl new/ledger.jsonl \
+        --threshold 0.05
+    python tools/bench_sentinel.py --self-test
+
+Accepted artifacts (either side, mixable):
+
+- ``BENCH_*.json`` — the round snapshots ``bench.py`` tails into
+  ``{"n", "cmd", "rc", "tail"}``; every ``kind="bench"`` record in the
+  tail contributes its ``metric``/``value``/``unit``;
+- a ``ledger.jsonl`` / telemetry ``metrics.jsonl`` — JSONL of schema
+  records; ``kind="bench"`` rows contribute as above, the LAST
+  ``kind="ledger"`` row contributes ``goodput_fraction`` and the
+  serving ``cost_per_token_*`` split (telemetry/goodput.py).
+
+Regression direction is inferred per metric: time-like units (ms/s)
+and latency/cost/padding/badput names regress UPWARD, throughput-like
+metrics (tok/s, samples/s, speedups, MFU, goodput fraction) regress
+DOWNWARD.  Metrics present on only one side are reported but never
+fatal (rounds add benches; the gate judges the intersection).
+
+``--threshold`` is the tolerated relative change (default 0.10).
+``--metrics a,b`` restricts the tracked set; default = every shared
+metric.  Exit codes: 0 clean, 1 regression(s), 2 usage/parse error.
+
+``--self-test`` seeds a synthetic pair (one halved throughput metric)
+in a temp dir and verifies the sentinel flags it — the fixture
+``tests/test_bench_sentinel.py`` wires into tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# tolerated relative change before a tracked metric fails the gate
+DEFAULT_THRESHOLD = 0.10
+
+_LOWER_IS_BETTER_UNITS = {"ms", "s", "seconds", "s/token"}
+_LOWER_IS_BETTER_TOKENS = ("ttft", "tpot", "latency", "cost_per_token",
+                           "padded", "badput", "_ms", "ms_per",
+                           "queue_wait", "recovery")
+
+
+def lower_is_better(name: str, unit: str | None) -> bool:
+    """Direction of regression for one metric: True when an INCREASE is
+    the regression (latencies, costs, padding waste)."""
+    if unit and unit.lower() in _LOWER_IS_BETTER_UNITS:
+        return True
+    n = name.lower()
+    return any(tok in n for tok in _LOWER_IS_BETTER_TOKENS)
+
+
+def _ledger_metrics(rec: dict) -> dict[str, dict]:
+    out = {"goodput_fraction": {"value": rec.get("goodput_fraction"),
+                                "unit": "frac"}}
+    serving = rec.get("serving") or {}
+    for k in ("cost_per_token_s", "cost_per_token_prefill_s",
+              "cost_per_token_decode_s", "cost_per_token_queue_s"):
+        if serving.get(k) is not None:
+            out[k] = {"value": serving[k], "unit": "s/token"}
+    return {k: v for k, v in out.items()
+            if isinstance(v["value"], (int, float))}
+
+
+def load_metrics(path: str) -> dict[str, dict]:
+    """{metric name: {"value", "unit"}} from one artifact (see module
+    docstring for the accepted shapes).  Raises ValueError when the
+    file yields no metrics at all — a gate diffing nothing against
+    nothing must not pass silently."""
+    with open(path) as f:
+        text = f.read()
+    lines: list[str] = []
+    stripped = text.lstrip()
+    if stripped.startswith("{") and '"tail"' in stripped.split("\n", 1)[0] \
+            or _is_bench_snapshot(stripped):
+        snap = json.loads(text)
+        lines = str(snap.get("tail", "")).splitlines()
+    else:
+        lines = text.splitlines()
+    out: dict[str, dict] = {}
+    ledger_last: dict | None = None
+    for line in lines:
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        kind = rec.get("kind")
+        if kind == "bench" and isinstance(rec.get("metric"), str) \
+                and isinstance(rec.get("value"), (int, float)):
+            out[rec["metric"]] = {"value": float(rec["value"]),
+                                  "unit": rec.get("unit")}
+        elif kind == "ledger":
+            ledger_last = rec
+    if ledger_last is not None:
+        out.update(_ledger_metrics(ledger_last))
+    if not out:
+        raise ValueError(
+            f"{path}: no bench or ledger metrics found (expected a "
+            f"BENCH_*.json snapshot or a JSONL of kind=bench/ledger "
+            f"records)")
+    return out
+
+
+def _is_bench_snapshot(stripped: str) -> bool:
+    if not stripped.startswith("{"):
+        return False
+    try:
+        head = json.loads(stripped.split("\n", 1)[0].rstrip().rstrip(","))
+    except ValueError:
+        try:
+            head = json.loads(stripped)
+        except ValueError:
+            return False
+    return isinstance(head, dict) and "tail" in head
+
+
+def compare(base: dict[str, dict], cand: dict[str, dict],
+            threshold: float = DEFAULT_THRESHOLD,
+            metrics: list[str] | None = None) -> dict:
+    """Judge candidate vs. base.  Returns {"rows": [...], "regressions":
+    [names], "only_base": [...], "only_cand": [...]}."""
+    shared = sorted(set(base) & set(cand))
+    if metrics:
+        missing = [m for m in metrics if m not in shared]
+        if missing:
+            raise ValueError(
+                f"tracked metric(s) not present on both sides: {missing}")
+        shared = [m for m in shared if m in metrics]
+    rows, regressions = [], []
+    for name in shared:
+        b, c = base[name]["value"], cand[name]["value"]
+        unit = cand[name].get("unit") or base[name].get("unit")
+        lower = lower_is_better(name, unit)
+        rel = (c - b) / abs(b) if b else (0.0 if c == b else float("inf"))
+        regressed = (rel > threshold) if lower else (rel < -threshold)
+        if regressed:
+            regressions.append(name)
+        rows.append({"metric": name, "base": b, "cand": c,
+                     "unit": unit, "rel_change": rel,
+                     "direction": "lower_better" if lower
+                                  else "higher_better",
+                     "regressed": regressed})
+    return {"rows": rows, "regressions": regressions,
+            "only_base": sorted(set(base) - set(cand)),
+            "only_cand": sorted(set(cand) - set(base)),
+            "threshold": threshold}
+
+
+def render(result: dict, base_path: str, cand_path: str) -> str:
+    lines = [f"bench_sentinel: {base_path} -> {cand_path} "
+             f"(threshold {result['threshold']:.0%})",
+             f"{'metric':44s} {'base':>12s} {'cand':>12s} "
+             f"{'change':>8s}  verdict"]
+    for r in result["rows"]:
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        arrow = "↓ better" if r["direction"] == "lower_better" \
+            else "↑ better"
+        lines.append(
+            f"{r['metric'][:44]:44s} {r['base']:12.4g} {r['cand']:12.4g} "
+            f"{r['rel_change']:+7.1%}  {verdict} ({arrow})")
+    for name in result["only_base"]:
+        lines.append(f"{name[:44]:44s} {'—':>12s} {'—':>12s} "
+                     f"{'':8s}  base-only (not judged)")
+    for name in result["only_cand"]:
+        lines.append(f"{name[:44]:44s} {'—':>12s} {'—':>12s} "
+                     f"{'':8s}  new (not judged)")
+    n = len(result["regressions"])
+    lines.append(f"bench_sentinel: {len(result['rows'])} tracked, "
+                 f"{n} regression(s)"
+                 + (f": {', '.join(result['regressions'])}" if n else ""))
+    return "\n".join(lines)
+
+
+def write_regression_fixture(dirpath: str) -> tuple[str, str]:
+    """Seed a (base, candidate) BENCH pair where the candidate halves
+    one throughput metric and doubles one latency metric — the
+    self-test / tier-1 fixture.  Returns the two paths."""
+    os.makedirs(dirpath, exist_ok=True)
+
+    def snap(path, rows):
+        tail = "\n".join(json.dumps({"kind": "bench", **r}) for r in rows)
+        with open(path, "w") as f:
+            json.dump({"n": len(rows), "cmd": "self-test", "rc": 0,
+                       "tail": tail}, f)
+        return path
+
+    base = snap(os.path.join(dirpath, "BENCH_base.json"), [
+        {"metric": "toy_train_samples_per_sec", "value": 100.0,
+         "unit": "samples/s"},
+        {"metric": "toy_p99_ttft_ms", "value": 50.0, "unit": "ms"},
+        {"metric": "toy_mfu_pct", "value": 40.0, "unit": "%"},
+    ])
+    cand = snap(os.path.join(dirpath, "BENCH_regressed.json"), [
+        {"metric": "toy_train_samples_per_sec", "value": 50.0,
+         "unit": "samples/s"},
+        {"metric": "toy_p99_ttft_ms", "value": 100.0, "unit": "ms"},
+        {"metric": "toy_mfu_pct", "value": 41.0, "unit": "%"},
+    ])
+    return base, cand
+
+
+def self_test() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench_sentinel_") as d:
+        base, cand = write_regression_fixture(d)
+        rc = main([base, cand, "--threshold", "0.10"])
+        if rc == 0:
+            print("bench_sentinel --self-test: FAILED — seeded "
+                  "regression not flagged", file=sys.stderr)
+            return 1
+        # and the clean direction must stay clean
+        rc_clean = main([base, base])
+        if rc_clean != 0:
+            print("bench_sentinel --self-test: FAILED — identical "
+                  "artifacts flagged", file=sys.stderr)
+            return 1
+    print("bench_sentinel --self-test: ok (seeded regression flagged, "
+          "identical pair clean)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-test" in argv:
+        return self_test()
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 2
+    threshold = DEFAULT_THRESHOLD
+    metrics = None
+    as_json = False
+    if "--threshold" in argv:
+        i = argv.index("--threshold")
+        threshold = float(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    if "--metrics" in argv:
+        i = argv.index("--metrics")
+        metrics = [m for m in argv[i + 1].split(",") if m]
+        argv = argv[:i] + argv[i + 2:]
+    if "--json" in argv:
+        as_json = True
+        argv.remove("--json")
+    if len(argv) != 2:
+        print("bench_sentinel: need exactly BASE and CANDIDATE artifacts "
+              f"(got {argv})", file=sys.stderr)
+        return 2
+    base_path, cand_path = argv
+    try:
+        base = load_metrics(base_path)
+        cand = load_metrics(cand_path)
+        result = compare(base, cand, threshold=threshold, metrics=metrics)
+    except (OSError, ValueError) as e:
+        print(f"bench_sentinel: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(render(result, base_path, cand_path))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

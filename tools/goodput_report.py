@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Render a goodput ledger (``ledger.jsonl``) as a wall-clock account.
+
+Every run with ``--goodput_ledger`` appends one ``kind="ledger"``
+record (telemetry/goodput.py) to ``<ledger_dir>/ledger.jsonl``; this
+tool renders each record as a badput-attribution table with a bar per
+bucket, the serving cost-per-token split when the run served, and —
+with two or more records in the file — a run-over-run goodput trend
+line, so "where did the wall-clock go" is one command away::
+
+    python tools/goodput_report.py runs/ledger.jsonl
+    python tools/goodput_report.py metrics.jsonl   # any record stream
+
+Exit codes: 0 rendered, 2 no ledger records found / unreadable input.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+BAR_WIDTH = 40
+
+
+def load_ledgers(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "ledger" or (
+                    "buckets_s" in rec and "wall_s" in rec):
+                out.append(rec)
+    return out
+
+
+def _bar(share: float) -> str:
+    n = int(round(share * BAR_WIDTH))
+    return "█" * n + "·" * (BAR_WIDTH - n)
+
+
+def render_one(rec: dict, index: int | None = None) -> None:
+    wall = float(rec.get("wall_s") or 0.0)
+    frac = rec.get("goodput_fraction")
+    head = f"ledger[{index}]" if index is not None else "ledger"
+    ts = rec.get("ts")
+    host = rec.get("host")
+    extras = []
+    if host is not None:
+        extras.append(f"host {host}")
+    if ts is not None:
+        extras.append(f"ts {ts:.0f}")
+    print(f"{head}: wall {wall:.3f} s"
+          + (f", goodput {frac * 100:.1f}%" if frac is not None else "")
+          + (f" ({', '.join(extras)})" if extras else ""))
+    buckets = rec.get("buckets_s") or {}
+    width = max((len(k) for k in buckets), default=10)
+    for name, secs in buckets.items():
+        share = secs / wall if wall else 0.0
+        print(f"  {name:{width}s} {secs:10.3f} s {share * 100:6.1f}% "
+              f"{_bar(share)}")
+    if rec.get("spans_dropped"):
+        print(f"  (ring dropped {rec['spans_dropped']} spans — the "
+              f"account may undercount classified buckets into idle)")
+    serving = rec.get("serving") or {}
+    if serving:
+        print(f"  serving: {serving.get('tokens', 0):.0f} tokens, "
+              f"cost/token {serving.get('cost_per_token_s', 0):.6g} s "
+              f"(prefill {serving.get('cost_per_token_prefill_s', 0):.6g}"
+              f" + decode {serving.get('cost_per_token_decode_s', 0):.6g}"
+              f"), queue/token "
+              f"{serving.get('cost_per_token_queue_s', 0):.6g} s, "
+              f"KV occupancy {serving.get('kv_page_s', 0):.3f} page·s")
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 2
+    try:
+        ledgers = load_ledgers(argv[0])
+    except OSError as e:
+        print(f"goodput_report: {e}", file=sys.stderr)
+        return 2
+    if not ledgers:
+        print(f"goodput_report: no ledger records in {argv[0]} "
+              f"(runs write them with --goodput_ledger)", file=sys.stderr)
+        return 2
+    for i, rec in enumerate(ledgers):
+        if i:
+            print()
+        render_one(rec, index=i if len(ledgers) > 1 else None)
+    if len(ledgers) > 1:
+        fracs = [r.get("goodput_fraction") for r in ledgers]
+        trend = " -> ".join(f"{f * 100:.1f}%" if f is not None else "?"
+                            for f in fracs)
+        print(f"\ngoodput trend over {len(ledgers)} runs: {trend}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -462,6 +462,53 @@ def trace_table(profiles: list[dict]) -> None:
                   f"time** — {what}.")
 
 
+def goodput_table(ledgers: list[dict]) -> None:
+    """Render the schema /12 goodput ledger (``kind="ledger"``,
+    telemetry/goodput.py): the wall-clock account — one row per badput
+    bucket with its share of wall — plus the serving cost-per-token
+    split when the run served.  Buckets above 10% of wall are flagged:
+    they are the lever the ledger exists to point at."""
+    if not ledgers:
+        return
+    print("\n## Goodput\n")
+    for r in ledgers:
+        wall = r.get("wall_s") or 0.0
+        frac = r.get("goodput_fraction")
+        print(f"**ledger** · wall {_fmt(wall)} s · goodput "
+              f"**{frac * 100:.1f}%**" if frac is not None
+              else f"**ledger** · wall {_fmt(wall)} s")
+        buckets = r.get("buckets_s") or {}
+        if buckets:
+            print("\n| bucket | seconds | of wall |")
+            print("|---|---|---|")
+            hot = []
+            for name, secs in buckets.items():
+                share = secs / wall if wall else 0.0
+                cell = f"{share * 100:.1f}%"
+                if share > 0.10 and name not in ("compute",):
+                    cell += " ⚠"
+                    hot.append((name, share))
+                print(f"| {name} | {_fmt(secs, 3)} | {cell} |")
+            if hot:
+                names = ", ".join(f"`{n}` ({s * 100:.0f}%)"
+                                  for n, s in hot)
+                print(f"\n**⚠ badput over 10% of wall-clock:** {names} "
+                      f"— the levers this ledger points at.")
+        serving = r.get("serving") or {}
+        if serving.get("cost_per_token_s") is not None:
+            print("\n| cost per token | seconds |")
+            print("|---|---|")
+            for k, label in (("cost_per_token_s", "total (compute)"),
+                             ("cost_per_token_prefill_s", "prefill"),
+                             ("cost_per_token_decode_s", "decode"),
+                             ("cost_per_token_queue_s", "queue")):
+                if serving.get(k) is not None:
+                    print(f"| {label} | {serving[k]:.6g} |")
+            print(f"\n_{_fmt(serving.get('tokens', 0), 0)} tokens · "
+                  f"KV-page occupancy "
+                  f"{_fmt(serving.get('kv_page_s'))} page·s_")
+
+
 MFU_TARGET_PCT = 50.0  # the ROADMAP north-star floor
 
 
@@ -525,6 +572,7 @@ def main(argv: list[str]) -> int:
     fleets = [r for r in records if r.get("kind") == "fleet"]
     preflights = [r for r in records if r.get("kind") == "preflight"]
     profiles = [r for r in records if r.get("kind") == "profile"]
+    ledgers = [r for r in records if r.get("kind") == "ledger"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -543,10 +591,12 @@ def main(argv: list[str]) -> int:
     serving_table(serves, serve_summaries)
     preflight_table(preflights)
     trace_table(profiles)
+    goodput_table(ledgers)
     bench_table(bench)
     if not steps and not bench and not faults and not recoveries \
             and not serves and not serve_summaries and not elastics \
-            and not fleets and not preflights and not profiles:
+            and not fleets and not preflights and not profiles \
+            and not ledgers:
         print("_no step, fault, serve or bench records found_")
     return 0
 

@@ -21,8 +21,15 @@ The rank of each file comes from its own metadata (``otherData.rank``,
 the tracer's stamp) with the filename's ``host<k>`` as the fallback;
 on a collision (two files claiming one rank — e.g. scrapes of the same
 rank at two times) later files are offset to a free lane and a warning
-names them.  Prints a per-rank span census; exits 2 when no input
-yields any event.
+names them.  Prints a per-rank span census.
+
+A rank that produced a trace but recorded zero spans (tracing armed
+late, ring drained by a /trace scrape) is TOLERATED: its lane merges
+with a 0-span census row and the merge still succeeds.  Exit 2 only
+when NO input yields any span event — the message then names which
+files were empty (parsed, zero spans) vs. missing (named on the
+command line but absent on disk), so "forgot --trace_spans" and
+"wrong log dir" read differently.
 """
 
 from __future__ import annotations
@@ -66,9 +73,12 @@ def rank_of(path: str, trace: dict) -> int | None:
 def merge(files: list[str], label: str = "rank") -> dict:
     """Fold trace files into one trace-event dict with one pid lane per
     rank.  Returns the merged trace; ``otherData.lanes`` maps pid ->
-    source file."""
+    source file and ``otherData.empty`` lists inputs that parsed but
+    held zero span events (their lanes still exist — a rank with an
+    armed-late tracer shows as an empty lane, not a hole)."""
     events: list[dict] = []
     lanes: dict[int, str] = {}
+    empty: list[str] = []
     next_free = 0
     for path in files:
         with open(path) as f:
@@ -86,20 +96,26 @@ def merge(files: list[str], label: str = "rank") -> dict:
             rank = next_free
         lanes[rank] = path
         have_name = False
+        n_spans = 0
         for e in src:
             e = dict(e)
             e["pid"] = rank
+            if e.get("ph") == "X":
+                n_spans += 1
             if e.get("ph") == "M" and e.get("name") == "process_name":
                 e["args"] = {"name": f"{label} {rank}"}
                 have_name = True
             events.append(e)
+        if not n_spans:
+            empty.append(path)
         if not have_name:
             events.append({"name": "process_name", "ph": "M",
                            "pid": rank, "tid": 0,
                            "args": {"name": f"{label} {rank}"}})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"lanes": {str(k): v
-                                    for k, v in sorted(lanes.items())}}}
+                                    for k, v in sorted(lanes.items())},
+                          "empty": empty}}
 
 
 def census(merged: dict) -> dict[int, int]:
@@ -128,22 +144,44 @@ def main(argv: list[str] | None = None) -> int:
         label = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
     files = find_trace_files(argv)
+    # explicit file arguments that don't exist are MISSING (wrong path,
+    # rank never dumped), distinct from files that parse to zero spans
+    # (tracing armed late / ring drained) — the exit-2 message names
+    # each group so the two failure modes read differently
+    missing = [f for f in files if not os.path.exists(f)]
+    files = [f for f in files if os.path.exists(f)]
     if not files:
-        print(f"trace_merge: no trace files under {argv}",
-              file=sys.stderr)
+        if missing:
+            print(f"trace_merge: no trace files — missing: "
+                  f"{', '.join(missing)}", file=sys.stderr)
+        else:
+            print(f"trace_merge: no trace files under {argv}",
+                  file=sys.stderr)
         return 2
     merged = merge(files, label=label)
     counts = census(merged)
+    empty = merged["otherData"].get("empty", [])
     if not counts:
-        print("trace_merge: inputs contained no span events",
-              file=sys.stderr)
+        parts = []
+        if empty:
+            parts.append(f"empty (parsed, zero spans): {', '.join(empty)}")
+        if missing:
+            parts.append(f"missing: {', '.join(missing)}")
+        print("trace_merge: inputs contained no span events — "
+              + "; ".join(parts or ["no inputs"]), file=sys.stderr)
         return 2
     with open(out_path, "w") as f:
         json.dump(merged, f)
+    # zero-span lanes are tolerated: they merged, they just census 0
+    for pid in merged["otherData"]["lanes"]:
+        counts.setdefault(int(pid), 0)
     total = sum(counts.values())
     lanes = ", ".join(f"{label} {k}: {v}" for k, v in sorted(counts.items()))
     print(f"trace_merge: {total} spans across {len(counts)} lane(s) "
           f"({lanes}) -> {out_path}")
+    if missing:
+        print(f"trace_merge: warning — named but missing: "
+              f"{', '.join(missing)}", file=sys.stderr)
     return 0
 
 
