@@ -50,6 +50,7 @@ from paddle_tpu.analysis.codebase import (  # noqa: F401
     run_codebase,
 )
 from paddle_tpu.analysis.program import (  # noqa: F401
+    collective_bytes_from_jaxpr,
     collective_sequence_from_hlo_text,
     collective_sequence_from_jaxpr,
     compare_collective_lowerings,
@@ -58,12 +59,22 @@ from paddle_tpu.analysis.program import (  # noqa: F401
     host_sync_pass,
     recompile_hazard_pass,
 )
+from paddle_tpu.analysis.cost import (  # noqa: F401
+    HW_PROFILES,
+    HwProfile,
+    cost_budget_pass,
+    cost_report,
+    hw_profile,
+    zero_collective_bytes,
+)
 from paddle_tpu.analysis.memory import (  # noqa: F401
     activation_peak_bytes,
     memory_budget_pass,
     memory_report,
     opt_state_bytes_per_device,
     pallas_vmem_estimates,
+    serving_budget_pass,
+    serving_memory_report,
 )
 from paddle_tpu.analysis.sharding import (  # noqa: F401
     sharding_flow_pass,

@@ -277,6 +277,53 @@ def memory_report(params, opt_state, states, feed, mesh=None, *,
     return report
 
 
+def serving_memory_report(cfg, serving, params=None) -> dict:
+    """Static per-device byte accounting of the SERVING path: the paged
+    KV pool (k AND v, each ``layers × heads × pages × page_size ×
+    head_dim`` at the model dtype) next to the servable params — the
+    same artifact :func:`memory_report` computes for training, so an
+    oversized pool is a preflight failure, not an OOM at the first
+    admission.  ``cfg`` is a TransformerConfig, ``serving`` a
+    ``ServingConfig``; ``params`` (optional pytree) adds the weights."""
+    import numpy as np
+
+    itemsize = int(np.dtype(cfg.dtype).itemsize)
+    per_pool = (int(cfg.num_layers) * int(cfg.num_heads)
+                * int(serving.num_pages) * int(serving.page_size)
+                * int(cfg.head_dim) * itemsize)
+    kv = 2 * per_pool  # k and v pools
+    p_bytes = tree_bytes(params) if params is not None else 0
+    return {
+        "kv_pool_bytes": kv,
+        "params_bytes": p_bytes,
+        "num_pages": int(serving.num_pages),
+        "page_size": int(serving.page_size),
+        "dtype": np.dtype(cfg.dtype).name,
+        "total_bytes": kv + p_bytes,
+    }
+
+
+def serving_budget_pass(report: dict, name: str = "serving", *,
+                        hbm_gb: float = 0.0) -> list[Finding]:
+    """GL-P-MEM finding when the KV pool + params exceed ``--hbm_gb``
+    (0 = report only) — sized per :func:`serving_memory_report`."""
+    findings: list[Finding] = []
+    budget = float(hbm_gb) * 1e9
+    total = report.get("total_bytes", 0)
+    if budget > 0 and total > budget:
+        findings.append(Finding(
+            "GL-P-MEM", _pname(name), 0, "kv-pool-budget",
+            f"static serving footprint {total / 1e9:.3f} GB (KV pool "
+            f"{report.get('kv_pool_bytes', 0) / 1e9:.3f} GB at "
+            f"{report.get('num_pages', 0)} pages × "
+            f"{report.get('page_size', 0)} tokens, params "
+            f"{report.get('params_bytes', 0) / 1e9:.3f} GB) exceeds the "
+            f"--hbm_gb budget {float(hbm_gb):.3f} GB — shrink num_pages/"
+            f"page_size or the resident model before the pool OOMs at "
+            f"first admission"))
+    return finalize(findings)
+
+
 def memory_budget_pass(report: dict, name: str = "train_step", *,
                        hbm_gb: float = 0.0,
                        vmem_mb: float = 128.0) -> list[Finding]:

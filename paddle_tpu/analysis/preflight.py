@@ -30,6 +30,11 @@ dtype), lowers it once, and runs:
   exchanged with every peer and a rank that traced a different program
   aborts preflight with a named diff instead of deadlocking in the
   first collective;
+- ``GL-P-COST``    static roofline estimate (per-op-class FLOPs/bytes,
+  pallas VMEM compute, collective wire model) under the ``--hw_profile``
+  machine table — predicted step_ms / MFU%% land in the telemetry
+  record and a config under ``--mfu_floor`` fails with a named
+  bottleneck;
 - ``GL-P-RECOMPILE`` over the probe-signature set (the step's own feed
   signature plus any caller-supplied set, e.g. a resumed run's
   ``SGD._compiled_sigs``);
@@ -44,10 +49,10 @@ mismatch`` perturbs the GSPMD sequence, ``rank_divergence`` perturbs
 every non-zero rank's program fingerprint — so the regression tests
 can prove each check fires through the real CLI.
 
-One ``kind="preflight"`` telemetry record (schema /9) is emitted per
-run with the per-rule counts, the unsuppressed finding ids and the
-GL-P-MEM memory report (rendered as a budget table by
-``tools/metrics_to_md.py``).
+One ``kind="preflight"`` telemetry record (schema /13) is emitted per
+run with the per-rule counts, the unsuppressed finding ids, the
+GL-P-MEM memory report and the GL-P-COST cost report (rendered as
+budget / static-cost tables by ``tools/metrics_to_md.py``).
 """
 
 from __future__ import annotations
@@ -62,6 +67,10 @@ from paddle_tpu.analysis.diverge import (
     divergence_pass,
     exchange_fingerprints,
     program_fingerprint,
+)
+from paddle_tpu.analysis.cost import (
+    cost_budget_pass,
+    cost_report,
 )
 from paddle_tpu.analysis.memory import (
     memory_budget_pass,
@@ -96,15 +105,18 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
                       name: str = "train_step",
                       min_donate_bytes: int = 1 << 20,
                       hbm_gb: float = 0.0, vmem_mb: float = 128.0,
+                      hw_profile: str = "auto", mfu_floor: float = 0.0,
                       shard_min_bytes: int = 1 << 20,
                       include_eval: bool = True,
                       rendezvous_dir: str = "", rank: int = 0,
                       nproc: int = 1, rendezvous_epoch: int = 0,
-                      report_out: dict | None = None) -> list[Finding]:
+                      report_out: dict | None = None,
+                      cost_out: dict | None = None) -> list[Finding]:
     """Build the configured train step and run every applicable program
     pass; returns the raw findings (caller applies the baseline).
-    ``report_out`` (a dict) receives the GL-P-MEM memory report for the
-    telemetry record."""
+    ``report_out`` (a dict) receives the GL-P-MEM memory report and
+    ``cost_out`` the GL-P-COST roofline report for the telemetry
+    record."""
     import jax
 
     from paddle_tpu.core import parameters as _params_mod
@@ -194,6 +206,26 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
     findings += memory_budget_pass(report, name=name, hbm_gb=hbm_gb,
                                    vmem_mb=vmem_mb)
 
+    # GL-P-COST: the static roofline.  Reuses the one trace (step_jx),
+    # the GL-P-MEM params accounting (the analytic ZeRO collective
+    # schedule needs the gradient payload) and — when the lowering
+    # succeeded — XLA's own per-signature cost analysis.
+    try:
+        cost = cost_report(step_jx, profile=hw_profile, mesh=mesh,
+                           zero=zero,
+                           params_bytes=report.get("params_bytes", 0),
+                           lowered=lowered, compiled=compiled)
+    except ValueError as e:  # unknown --hw_profile: a config error
+        cost = None
+        findings.append(Finding(
+            "GL-P-COST", f"<program:{name}>", 0, "hw-profile",
+            str(e)))
+    if cost is not None:
+        if cost_out is not None:
+            cost_out.update(cost)
+        findings += cost_budget_pass(cost, name=name,
+                                     mfu_floor=mfu_floor)
+
     # GL-P-SHARD: sharding flow of the program that will actually run —
     # only meaningful with a live data axis (dp == 1 has no resharding)
     if dp > 1:
@@ -280,11 +312,13 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
 
 def emit_preflight_record(findings, suppressed, *, registry=None,
                           run: str = "preflight", config: str = "",
-                          memory: dict | None = None) -> dict:
-    """One schema/9 ``kind="preflight"`` record: per-rule counts, the
+                          memory: dict | None = None,
+                          cost: dict | None = None) -> dict:
+    """One schema/13 ``kind="preflight"`` record: per-rule counts, the
     unsuppressed finding ids, clean flag — plus the GL-P-MEM ``memory``
-    budget report — rendered by ``tools/metrics_to_md.py``'s Preflight
-    tables."""
+    budget report and the GL-P-COST ``cost`` roofline (predicted
+    step_ms / MFU%% / bottleneck) — rendered by
+    ``tools/metrics_to_md.py``'s Preflight / Static cost tables."""
     from paddle_tpu import metrics as metrics_mod
 
     reg = registry or metrics_mod.get_registry()
@@ -302,6 +336,8 @@ def emit_preflight_record(findings, suppressed, *, registry=None,
     }
     if memory:
         rec["memory"] = dict(memory)
+    if cost:
+        rec["cost"] = dict(cost)
     if reg.active:
         return reg.emit(rec, kind="preflight")
     return rec
@@ -313,22 +349,28 @@ def run_preflight(topology, optimizer, feed, mesh=None, *,
                   baseline_path: str | None = None, registry=None,
                   config: str = "", name: str = "train_step",
                   hbm_gb: float = 0.0, vmem_mb: float = 128.0,
+                  hw_profile: str = "auto", mfu_floor: float = 0.0,
                   include_eval: bool = True,
                   rendezvous_dir: str = "", rank: int = 0, nproc: int = 1,
                   rendezvous_epoch: int = 0,
+                  cost_out: dict | None = None,
                   ) -> tuple[list[Finding], list[Finding]]:
     """The full `trainer --preflight` flow: build + analyze + baseline +
     telemetry.  Returns (unsuppressed, suppressed)."""
     report: dict = {}
+    cost: dict = {}
     raw = trainer_preflight(
         topology, optimizer, feed, mesh, zero=zero,
         compute_dtype=compute_dtype, sync_period=sync_period,
         inject=inject, name=name, hbm_gb=hbm_gb, vmem_mb=vmem_mb,
+        hw_profile=hw_profile, mfu_floor=mfu_floor,
         include_eval=include_eval, rendezvous_dir=rendezvous_dir,
         rank=rank, nproc=nproc, rendezvous_epoch=rendezvous_epoch,
-        report_out=report)
+        report_out=report, cost_out=cost)
+    if cost_out is not None:
+        cost_out.update(cost)
     unsup, sup, _stale = apply_baseline(
         raw, load_baseline(baseline_path), full_run=False)
     emit_preflight_record(unsup, sup, registry=registry, config=config,
-                          memory=report)
+                          memory=report, cost=cost)
     return unsup, sup

@@ -312,6 +312,23 @@ def collective_sequence_from_jaxpr(fn_or_jaxpr, *args) -> list[str]:
             if e.primitive.name in _JAXPR_COLLECTIVES]
 
 
+def collective_bytes_from_jaxpr(fn_or_jaxpr, *args) -> list[dict]:
+    """Ordered ``{"kind", "payload_bytes"}`` per collective primitive in
+    the program — the payload is the operand bytes one device holds
+    (GL-P-COST's wire model scales it by the ring factor for the axis
+    size).  Same normalization as :func:`collective_sequence_from_jaxpr`."""
+    from paddle_tpu.analysis.memory import _aval_bytes
+
+    jaxpr = jaxpr_of(fn_or_jaxpr, *args)
+    out = []
+    for e in _walk_eqns(jaxpr.jaxpr):
+        if e.primitive.name in _JAXPR_COLLECTIVES:
+            out.append({
+                "kind": _JAXPR_COLLECTIVES[e.primitive.name],
+                "payload_bytes": sum(_aval_bytes(v) for v in e.invars)})
+    return out
+
+
 _HLO_RS_SLICE_RE = re.compile(r"\sdynamic-slice\([^)]*%[\w.-]*all-reduce")
 
 

@@ -265,6 +265,14 @@ define("vmem_mb", 128.0, "per-kernel VMEM budget for the GL-P-MEM "
                          "preflight check: each pallas_call's static "
                          "block footprint must fit (0 = no gate; v5e "
                          "cores carry 128 MB)")
+define("hw_profile", "auto", "hardware profile for the GL-P-COST static "
+                             "roofline (peak FLOP/s, HBM and per-link "
+                             "ICI bandwidth): v5p | cpu-testbed | auto "
+                             "(resolve from the attached devices)")
+define("mfu_floor", 0.0, "minimum predicted MFU%% for the GL-P-COST "
+                         "preflight gate: a config whose static roofline "
+                         "falls below this fails preflight with a named "
+                         "bottleneck (0 = report only, no gate)")
 define("preflight_rendezvous", "", "shared directory where preflight "
                                    "ranks exchange program fingerprints "
                                    "(GL-P-DIVERGE); with "

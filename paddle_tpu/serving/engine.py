@@ -127,6 +127,19 @@ class ServingEngine:
                 >= s.max_prompt_len + s.max_new_tokens,
                 "max_concurrent_tokens is below one max-size request's "
                 "reservation — nothing could ever be admitted")
+        # GL-P-MEM serving path: with an --hbm_gb budget set, the static
+        # KV pool + params bytes must fit BEFORE the pools are allocated
+        # — an oversized pool fails here, not at the first admission
+        from paddle_tpu.analysis.memory import (serving_budget_pass,
+                                                serving_memory_report)
+        from paddle_tpu.core import flags as _flags
+
+        hbm_gb = float(_flags.get("hbm_gb"))
+        if hbm_gb > 0:
+            found = serving_budget_pass(
+                serving_memory_report(cfg, s, params), hbm_gb=hbm_gb)
+            enforce(not found,
+                    found[0].message if found else "")
         self.params = params
         self.registry = registry or metrics_mod.get_registry()
         self.cache = PagedKVCache(

@@ -106,7 +106,14 @@ from typing import Any
 # fleet rollup gained cost-per-token components; rendered by
 # tools/goodput_report.py and metrics_to_md.py's "Goodput" table,
 # regression-guarded by tools/bench_sentinel.py.
-SCHEMA = "paddle_tpu.metrics/12"
+# /13 extended the "preflight" record with the GL-P-COST static
+# roofline (graftlint v3): a ``cost`` dict carrying the predicted
+# step_ms / mfu_pct / compute_ms / comm_ms / overlap_headroom_ms, the
+# per-op-class FLOPs+bytes breakdown (by_class), per-pallas_call
+# compute, the collective wire model (collectives) and the named
+# ``bottleneck`` under the selected --hw_profile — rendered by
+# tools/metrics_to_md.py's "Static cost" table.  No new record kinds.
+SCHEMA = "paddle_tpu.metrics/13"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted

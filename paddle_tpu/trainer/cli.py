@@ -442,17 +442,25 @@ def cmd_preflight(args, parsed) -> int:
     rank = int(os.environ.get("PADDLE_TPU_TRAINER_ID", "0"))
     nproc = int(os.environ.get("PADDLE_TPU_NPROC", "1"))
     epoch = int(os.environ.get("PADDLE_TPU_RENDEZVOUS_EPOCH", "0"))
+    cost: dict = {}
     unsup, sup = run_preflight(
         topo, opt, feed, mesh, zero=zero, compute_dtype=compute_dtype,
         sync_period=sync_period, inject=_flags.get("preflight_inject"),
         config=os.path.basename(args.config),
         hbm_gb=_flags.get("hbm_gb"), vmem_mb=_flags.get("vmem_mb"),
+        hw_profile=_flags.get("hw_profile"),
+        mfu_floor=_flags.get("mfu_floor"),
         rendezvous_dir=_flags.get("preflight_rendezvous"),
-        rank=rank, nproc=nproc, rendezvous_epoch=epoch)
+        rank=rank, nproc=nproc, rendezvous_epoch=epoch, cost_out=cost)
     for f in unsup:
         print(f.render())
     if sup:
         print(f"({len(sup)} finding(s) suppressed by baseline)")
+    if cost:
+        print(f"preflight cost [{cost.get('profile')}]: predicted step "
+              f"{cost.get('step_ms', 0.0):.2f} ms, MFU "
+              f"{cost.get('mfu_pct', 0.0):.1f}%, bottleneck "
+              f"{cost.get('bottleneck', '?')}")
     if unsup:
         print(f"preflight: {len(unsup)} unsuppressed finding(s) — "
               f"fix the program or baseline them with a reason")

@@ -23,6 +23,7 @@ import subprocess
 import sys
 import textwrap
 
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.lint
@@ -553,20 +554,29 @@ def test_preflight_cli_clean_config_exits_zero(tmp_path):
     recs = [json.loads(line) for line in open(jsonl)]
     pf = [r for r in recs if r.get("kind") == "preflight"]
     assert pf and pf[0]["clean"] is True
-    assert pf[0]["schema"] == "paddle_tpu.metrics/12"
+    assert pf[0]["schema"] == "paddle_tpu.metrics/13"
     # the schema/9 GL-P-MEM memory report rode along
     mem = pf[0]["memory"]
     assert mem["params_bytes"] > 0 and mem["opt_state_bytes"] > 0
     assert mem["total_bytes"] >= mem["params_bytes"] + mem["opt_state_bytes"]
     assert mem["activation_source"] in ("jaxpr-liveness",
                                         "xla-memory-analysis")
-    # and metrics_to_md renders it, budget table included
+    # the schema/13 GL-P-COST roofline rode along: predicted step_ms /
+    # MFU / named bottleneck, with the matmul class carrying the FLOPs
+    cost = pf[0]["cost"]
+    assert cost["step_ms"] > 0 and 0 < cost["mfu_pct"] <= 100
+    assert cost["bottleneck"]
+    assert cost["by_class"]["matmul"]["flops"] > 0
+    assert cost["flops_source"] in ("jaxpr-walk", "xla-cost-analysis")
+    assert "predicted step" in out.stdout
+    # and metrics_to_md renders it, budget + static-cost tables included
     md = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "metrics_to_md.py"),
          jsonl], capture_output=True, text=True)
     assert md.returncode == 0
     assert "Preflight (static analysis)" in md.stdout
     assert "Memory budget (GL-P-MEM" in md.stdout
+    assert "Static cost (GL-P-COST" in md.stdout
 
 
 def test_preflight_cli_catches_injected_host_sync(tmp_path):
@@ -1091,3 +1101,113 @@ def test_divergence_pass_shape_only_drift_names_the_line():
     assert len(found) == 1
     assert "line[0]" in found[0].message
     assert "f32[64,64]" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# GL-P-COST: the static roofline cost model (analysis/cost.py)
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_hw_profile_table_and_auto(self):
+        from paddle_tpu.analysis import HW_PROFILES, hw_profile
+
+        assert {"v5p", "cpu-testbed"} <= set(HW_PROFILES)
+        v5p = hw_profile("v5p")
+        assert v5p.peak_flops > 1e14 and v5p.hbm_gb == 95.0
+        # auto on the CPU testbed resolves to the calibrated profile
+        assert hw_profile("auto").name == "cpu-testbed"
+
+    def test_unknown_profile_is_clean_error_not_keyerror(self):
+        from paddle_tpu.analysis import hw_profile
+
+        with pytest.raises(ValueError) as ei:
+            hw_profile("v9000")
+        # names the table so the fix is obvious; never a raw KeyError
+        assert "v9000" in str(ei.value)
+        assert "v5p" in str(ei.value) and "cpu-testbed" in str(ei.value)
+
+    def test_cost_report_charges_matmul_exactly(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis import cost_report
+
+        def f(x, w):
+            return jnp.sum(x @ w)
+
+        x = np.zeros((8, 32), np.float32)
+        w = np.zeros((32, 16), np.float32)
+        rep = cost_report(f, x, w, profile="v5p")
+        # 2·M·N·K for the single dot
+        assert rep["by_class"]["matmul"]["flops"] == 2 * 8 * 16 * 32
+        assert rep["flops_source"] == "jaxpr-walk"
+        assert rep["step_ms"] > 0 and 0 < rep["mfu_pct"] <= 100
+        assert set(rep["by_class"]) == {"matmul", "conv", "elementwise",
+                                        "reduce", "gather", "layout"}
+        assert rep["bottleneck"]
+
+    def test_collective_wire_model_and_zero_schedule(self):
+        from paddle_tpu.analysis import zero_collective_bytes
+        from paddle_tpu.analysis.cost import collective_wire_bytes
+
+        # ring all-reduce: 2(n-1)/n of the payload crosses each link
+        assert collective_wire_bytes("all_reduce", 8 * 10 ** 9, 8) == (
+            pytest.approx(2 * 7 / 8 * 8e9))
+        assert collective_wire_bytes("all_gather", 1e9, 4) == (
+            pytest.approx(3 / 4 * 1e9))
+        assert collective_wire_bytes("all_reduce", 1e9, 1) == 0.0
+        # analytic ZeRO schedule when the trace has no collectives
+        assert zero_collective_bytes(100, 1, 0) == []
+        assert [c["kind"] for c in zero_collective_bytes(100, 8, 0)] == [
+            "all_reduce"]
+        assert [c["kind"] for c in zero_collective_bytes(100, 8, 1)] == [
+            "reduce_scatter", "all_gather"]
+
+    def test_dp_mesh_scales_work_and_can_bind_on_collectives(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis import cost_report
+
+        def f(x, w):
+            return jnp.sum(x @ w)
+
+        x = np.zeros((8, 32), np.float32)
+        w = np.zeros((32, 16), np.float32)
+
+        class Shim:  # plan_search's _MeshShim shape
+            shape = {"data": 8}
+            axis_names = ("data",)
+
+        solo = cost_report(f, x, w, profile="v5p")
+        # tiny compute + a fat analytic all-reduce: collective-bound
+        dp = cost_report(f, x, w, profile="v5p", mesh=Shim(), zero=0,
+                         params_bytes=10 ** 9)
+        assert dp["dp"] == 8
+        # GSPMD global-shape trace: per-device flops are 1/dp
+        assert dp["flops"] == solo["flops"] // 8
+        assert dp["comm_ms"] > 0 and dp["bottleneck"] == "collective-bound"
+        assert dp["overlap_headroom_ms"] < 0
+
+    def test_mfu_floor_finding_round_trips_analysis_json(self):
+        """GL-P-COST findings survive the exact ``--json`` wire format
+        (vars + fid) the analysis CLI emits — fid stable, fields intact."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis import Finding, cost_report
+        from paddle_tpu.analysis.cost import cost_budget_pass
+
+        def f(x):
+            return jnp.sum(x * 2.0)  # elementwise-only: terrible MFU
+
+        rep = cost_report(f, np.zeros((64,), np.float32), profile="v5p")
+        found = cost_budget_pass(rep, name="train_step", mfu_floor=99.0)
+        assert len(found) == 1
+        f0 = found[0]
+        assert f0.rule == "GL-P-COST" and f0.anchor == "mfu-floor"
+        assert "bottleneck" in f0.message
+        wire = json.loads(json.dumps(vars(f0) | {"fid": f0.fid}))
+        back = Finding(**{k: v for k, v in wire.items() if k != "fid"})
+        assert back.fid == wire["fid"] == f0.fid
+        assert back == f0
+        # floor 0 = report-only: no finding
+        assert cost_budget_pass(rep, mfu_floor=0.0) == []

@@ -22,12 +22,21 @@ def sentinel():
     return mod
 
 
-def test_real_rounds_r07_to_r08_pass_at_release_threshold(sentinel):
-    """The shipped round-over-round artifacts are the no-regression
-    baseline: r07 → r08 must exit 0 at the release threshold."""
-    assert sentinel.main([os.path.join(_REPO, "BENCH_r07.json"),
-                          os.path.join(_REPO, "BENCH_r08.json"),
-                          "--threshold", "0.30"]) == 0
+def _newest_rounds() -> list[str]:
+    """The two newest checked-in BENCH_r*.json by round number — the
+    gate tracks new rounds automatically instead of pinning r07→r08."""
+    import glob
+
+    rounds = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    return rounds[-2:]
+
+
+def test_newest_rounds_pass_at_release_threshold(sentinel):
+    """The two newest shipped round-over-round artifacts are the
+    no-regression baseline: they must exit 0 at the release threshold."""
+    rounds = _newest_rounds()
+    assert len(rounds) == 2, "need two checked-in BENCH_r*.json rounds"
+    assert sentinel.main([*rounds, "--threshold", "0.30"]) == 0
 
 
 def test_seeded_regression_fixture_trips_nonzero(sentinel, tmp_path, capsys):

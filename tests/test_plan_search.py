@@ -1,0 +1,69 @@
+"""tools/plan_search.py — the config-space feasibility pruner: ranking
+determinism and the checked-in PLAN.json artifact (the full --enumerate
+sweep is minutes of tracing and runs standalone, not in tier-1)."""
+
+import importlib.util
+import json
+import os
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _mod():
+    spec = importlib.util.spec_from_file_location(
+        "plan_search", os.path.join(_REPO, "tools", "plan_search.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tie_key_prefers_the_simpler_plan():
+    """Statically indistinguishable variants must rank deterministically:
+    smaller dp, lower zero, default lowering, fused on, no buckets, no
+    remat — and bigger batch last among true ties."""
+    ps = _mod()
+    base = {"score_chip_ms_per_example": 1.0, "dp": 1, "zero": 0,
+            "lowering": "auto", "fused_kernels": True, "seq_buckets": "",
+            "remat": False, "batch": 16}
+    assert ps._tie_key(base) < ps._tie_key(base | {"dp": 8,
+                                                   "lowering": "gspmd"})
+    assert ps._tie_key(base) < ps._tie_key(base | {"fused_kernels": False})
+    assert ps._tie_key(base) < ps._tie_key(base | {"remat": True})
+    assert ps._tie_key(base | {"batch": 32}) < ps._tie_key(base)
+    # cost dominates all tie-breaks
+    cheap = base | {"score_chip_ms_per_example": 0.5, "dp": 8,
+                    "zero": 1, "remat": True}
+    assert ps._tie_key(cheap) < ps._tie_key(base)
+
+
+def test_mesh_shim_quacks_enough_for_the_static_models():
+    ps = _mod()
+    shim = ps._MeshShim(8)
+    assert dict(shim.shape) == {"data": 8}
+    assert shim.axis_names == ("data",)
+
+
+def test_checked_in_plan_meets_the_acceptance_grid():
+    """The persisted artifact of the last full sweep: ≥48 grid points,
+    GL-P-MEM pruning actually engaged, and at least one family's top
+    choice rediscovers the hand-picked bench config."""
+    path = os.path.join(_REPO, "PLAN.json")
+    assert os.path.exists(path), "run tools/plan_search.py --enumerate"
+    plan = json.load(open(path))
+    assert plan["schema"] == "paddle_tpu.plan/1"
+    assert plan["grid_points"] >= 48
+    assert plan["pruned"] >= 1
+    fams = plan["families"]
+    assert set(fams) == {"transformer", "resnet50", "lstm"}
+    assert any(f["top_matches_bench"] for f in fams.values())
+    for f in fams.values():
+        top = f["top"]
+        assert top and top["step_ms"] > 0
+        assert top["score_chip_ms_per_example"] > 0
+        # the ranked list is sorted by the deterministic key
+        scores = [p["score_chip_ms_per_example"] for p in f["ranked"]]
+        assert scores == sorted(scores)
+    # pruned points carry the GL-P-MEM verdict they were cut by
+    pruned = [p for f in fams.values() for p in f["pruned_points"]]
+    assert pruned and all(p["pruned"].startswith("GL-P-MEM")
+                          for p in pruned)

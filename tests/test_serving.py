@@ -317,7 +317,7 @@ class TestServeTelemetry:
         serves = [r for r in sink.records if r.get("kind") == "serve"]
         assert len(serves) == 3
         for r in serves:
-            assert r["schema"] == "paddle_tpu.metrics/12"
+            assert r["schema"] == "paddle_tpu.metrics/13"
             for f in ("queue_wait_ms", "ttft_ms", "tpot_ms", "total_ms"):
                 assert r[f] >= 0.0
             assert r["new_tokens"] == 4
@@ -602,3 +602,61 @@ class TestCliLoop:
             input=lines, env=env, capture_output=True, text=True,
             timeout=300)
         assert out2.stdout == out.stdout
+
+
+class TestKvPoolPreflightGate:
+    """GL-P-MEM's serving path: the static KV page-pool accounting that
+    fails engine construction instead of OOMing at first admission."""
+
+    def test_serving_memory_report_exact_bytes(self):
+        from paddle_tpu.analysis import serving_memory_report
+
+        cfg = small_cfg()  # 2 layers, 2 heads, head_dim 16, f32
+        scfg = ServingConfig(page_size=8, num_pages=32)
+        rep = serving_memory_report(cfg, scfg)
+        # k AND v pools: 2 · L·H·pages·page_size·head_dim·itemsize
+        assert rep["kv_pool_bytes"] == 2 * 2 * 2 * 32 * 8 * 16 * 4
+        assert rep["dtype"] == "float32"
+        assert rep["total_bytes"] == rep["kv_pool_bytes"]
+        params = T.init_params(cfg, jax.random.key(0))
+        with_p = serving_memory_report(cfg, scfg, params)
+        assert with_p["params_bytes"] > 0
+        assert with_p["total_bytes"] == (rep["kv_pool_bytes"]
+                                         + with_p["params_bytes"])
+
+    def test_budget_pass_names_the_pool_and_clean_under_budget(self):
+        from paddle_tpu.analysis import (serving_budget_pass,
+                                         serving_memory_report)
+
+        cfg = small_cfg()
+        rep = serving_memory_report(cfg, ServingConfig(page_size=8,
+                                                       num_pages=32))
+        found = serving_budget_pass(rep, hbm_gb=1e-6)
+        assert len(found) == 1
+        f = found[0]
+        assert f.rule == "GL-P-MEM" and f.anchor == "kv-pool-budget"
+        assert "pages" in f.message and "first admission" in f.message
+        # generous budget or report-only (0): clean
+        assert serving_budget_pass(rep, hbm_gb=64.0) == []
+        assert serving_budget_pass(rep, hbm_gb=0.0) == []
+
+    def test_engine_construction_fails_preflight_not_oom(self):
+        from paddle_tpu.core import flags
+        from paddle_tpu.core.enforce import EnforceError
+
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(1))
+        old = flags.get("hbm_gb")
+        try:
+            flags.set("hbm_gb", 1e-6)
+            with pytest.raises(EnforceError, match="kv-pool|KV pool"):
+                ServingEngine(cfg, params, ServingConfig(
+                    max_slots=2, page_size=4, num_pages=32,
+                    max_prompt_len=16, max_new_tokens=8))
+            # under budget (or unset): constructs fine
+            flags.set("hbm_gb", 0.0)
+            ServingEngine(cfg, params, ServingConfig(
+                max_slots=2, page_size=4, num_pages=32,
+                max_prompt_len=16, max_new_tokens=8))
+        finally:
+            flags.set("hbm_gb", old)

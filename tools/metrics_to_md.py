@@ -354,7 +354,8 @@ def serving_table(serves: list[dict], summaries: list[dict]) -> None:
                   "tokens if TTFT p99 matters more than memory._")
 
 
-def preflight_table(records: list[dict]) -> None:
+def preflight_table(records: list[dict],
+                    steps: list[dict] | None = None) -> None:
     """Render the schema /7 static-analysis stream: one row per
     ``trainer --preflight`` / analysis run, with a loud flag on any run
     that was not clean — a program that failed its preflight must not
@@ -381,6 +382,7 @@ def preflight_table(records: list[dict]) -> None:
               f"({ids}); fix them or baseline them with a reason "
               f"before trusting the run.")
     _memory_budget_table([r for r in records if r.get("memory")])
+    _static_cost_table([r for r in records if r.get("cost")], steps or [])
 
 
 def _memory_budget_table(records: list[dict]) -> None:
@@ -411,6 +413,57 @@ def _memory_budget_table(records: list[dict]) -> None:
         for cfg, k in vmem:
             print(f"| {cfg or '-'} | {k.get('kernel')} "
                   f"| {_fmt(k.get('bytes', 0) / 1e6)} |")
+
+
+def _measured_for(run: str, steps: list[dict]) -> tuple:
+    """Median measured (step_ms, mfu_pct) of the step records that match
+    a preflight record's run — a single-run stream matches regardless of
+    the name (the common local flow: preflight, then train, one file)."""
+    runs = {r.get("run", "train") for r in steps}
+    mine = [r for r in steps
+            if r.get("run", "train") == run or len(runs) == 1]
+    ms = sorted(r["step_ms"] for r in mine
+                if isinstance(r.get("step_ms"), (int, float)))
+    mfu = sorted(r["mfu_pct"] for r in mine
+                 if isinstance(r.get("mfu_pct"), (int, float))
+                 and r["mfu_pct"] > 0)
+    return (ms[len(ms) // 2] if ms else None,
+            mfu[len(mfu) // 2] if mfu else None)
+
+
+def _static_cost_table(records: list[dict], steps: list[dict]) -> None:
+    """The schema /13 GL-P-COST roofline table: predicted step_ms / MFU
+    per preflighted config vs the measured medians when a matching step
+    stream exists, ⚠-flagging rows under the MFU target with the named
+    bottleneck — the static claim and the measured truth side by side."""
+    if not records:
+        return
+    print("\n### Static cost (GL-P-COST roofline)\n")
+    print("| config | profile | pred step ms | pred MFU % | meas step ms "
+          "| meas MFU % | bottleneck |")
+    print("|---|---|---|---|---|---|---|")
+    below = []
+    for r in records:
+        c = r["cost"]
+        meas_ms, meas_mfu = _measured_for(r.get("run", "preflight"), steps)
+        mfu = c.get("mfu_pct")
+        cell = _fmt(mfu)
+        bottleneck = c.get("bottleneck", "-")
+        if isinstance(mfu, (int, float)) and mfu < MFU_TARGET_PCT:
+            cell += " ⚠"
+            below.append((r.get("config") or "-", mfu, bottleneck))
+        print(f"| {r.get('config') or '-'} | {c.get('profile', '-')} "
+              f"| {_fmt(c.get('step_ms'))} | {cell} "
+              f"| {_fmt(meas_ms) if meas_ms is not None else '-'} "
+              f"| {_fmt(meas_mfu) if meas_mfu is not None else '-'} "
+              f"| {bottleneck} |")
+    if below:
+        names = "; ".join(f"{cfg} ({mfu:.1f}%, {b})"
+                          for cfg, mfu, b in below)
+        print(f"\n**⚠ {len(below)} config(s) predicted below the "
+              f"{MFU_TARGET_PCT:.0f}% MFU target:** {names} — the named "
+              f"bottleneck is where the next batching/fusion/sharding "
+              f"change should land.")
 
 
 def trace_table(profiles: list[dict]) -> None:
@@ -589,7 +642,7 @@ def main(argv: list[str]) -> int:
     elastic_table(elastics)
     fleet_table(fleets)
     serving_table(serves, serve_summaries)
-    preflight_table(preflights)
+    preflight_table(preflights, steps)
     trace_table(profiles)
     goodput_table(ledgers)
     bench_table(bench)
