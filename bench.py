@@ -826,6 +826,35 @@ def bench_serving_fleet(records):
         records.append(r)
 
 
+def bench_serving_prefix(records):
+    """Per-token serving cost ablation (tools/bench_serving_prefix.py in
+    a subprocess): a 2-replica fleet on a shared-system-prompt trace,
+    prefix cache on vs off at the same offered QPS (recompute-FLOPs
+    saved + p99 TTFT), plus the long-prompt chunked-prefill row.  Greedy
+    tokens must be byte-identical across every arm."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_serving_prefix.py")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run([sys.executable, script], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench_serving_prefix subprocess failed: "
+                           f"{out.stderr[-400:]}")
+    for line in out.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        r = json.loads(line)
+        for k in ("schema", "ts", "host", "kind"):
+            r.pop(k, None)
+        records.append(r)
+
+
 def bench_transformer(records):
     """124M GPT-2-shape LM, bs 8x1024, mixed precision, flash attention,
     dots-remat — the modern-workload flagship row."""
@@ -935,7 +964,7 @@ def main() -> None:
             bench_lstm_ablation, bench_nmt, bench_nmt_ablation, bench_ctr,
             bench_crnn, bench_saturation, bench_input_pipeline,
             bench_input_bucketing, bench_transformer, bench_zero,
-            bench_serving, bench_serving_fleet)
+            bench_serving, bench_serving_fleet, bench_serving_prefix)
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything.  --prefetch=0|N
     # sets the input-pipeline ablation depth (0 = sync row only).

@@ -277,14 +277,21 @@ def memory_report(params, opt_state, states, feed, mesh=None, *,
     return report
 
 
-def serving_memory_report(cfg, serving, params=None) -> dict:
+def serving_memory_report(cfg, serving, params=None, cache=None) -> dict:
     """Static per-device byte accounting of the SERVING path: the paged
     KV pool (k AND v, each ``layers × heads × pages × page_size ×
     head_dim`` at the model dtype) next to the servable params — the
     same artifact :func:`memory_report` computes for training, so an
     oversized pool is a preflight failure, not an OOM at the first
     admission.  ``cfg`` is a TransformerConfig, ``serving`` a
-    ``ServingConfig``; ``params`` (optional pytree) adds the weights."""
+    ``ServingConfig``; ``params`` (optional pytree) adds the weights.
+
+    ``cache`` (optional, a live :class:`PagedKVCache`) adds the RUNTIME
+    occupancy view the refcounted allocator makes non-trivial: with
+    prefix caching on, mapped pages overcount residency (shared pages
+    appear in many page tables), so the byte figures below are
+    unique-resident — each physical page counted once regardless of how
+    many sequences or cache entries reference it."""
     import numpy as np
 
     itemsize = int(np.dtype(cfg.dtype).itemsize)
@@ -293,7 +300,7 @@ def serving_memory_report(cfg, serving, params=None) -> dict:
                 * int(cfg.head_dim) * itemsize)
     kv = 2 * per_pool  # k and v pools
     p_bytes = tree_bytes(params) if params is not None else 0
-    return {
+    report = {
         "kv_pool_bytes": kv,
         "params_bytes": p_bytes,
         "num_pages": int(serving.num_pages),
@@ -301,6 +308,15 @@ def serving_memory_report(cfg, serving, params=None) -> dict:
         "dtype": np.dtype(cfg.dtype).name,
         "total_bytes": kv + p_bytes,
     }
+    if cache is not None:
+        page_bytes = kv // max(int(serving.num_pages), 1)
+        res = cache.resident_report()
+        report.update(res)
+        report["page_bytes"] = page_bytes
+        report["unique_resident_bytes"] = res["unique_pages"] * page_bytes
+        report["shared_saved_bytes"] = (
+            res["shared_saved_pages"] * page_bytes)
+    return report
 
 
 def serving_budget_pass(report: dict, name: str = "serving", *,

@@ -363,6 +363,63 @@ def forward_prefill(cfg: TransformerConfig, params: dict, ids: jax.Array,
     return last @ params["embed"].T, ks, vs
 
 
+def forward_prefill_chunk(cfg: TransformerConfig, params: dict,
+                          ids: jax.Array, starts: jax.Array,
+                          seq_lens: jax.Array, page_table: jax.Array,
+                          k_cache, v_cache):
+    """Incremental prompt pass over the paged cache — the chunked-
+    prefill / cached-prefix-tail twin of :func:`forward_prefill`.
+
+    ids [B, C] right-padded chunk tokens, starts [B] the absolute
+    position of each row's first token, seq_lens [B] valid NEW tokens
+    this pass (0 = idle row), page_table [B, max_pages], k_cache/v_cache
+    [L, H, P, page_size, Dh].  Each block writes the chunk's K/V into
+    the mapped pages, then attends the chunk queries causally over the
+    WHOLE resident context — earlier chunks and any shared cached
+    prefix included — so a prompt split across passes (or riding a
+    prefix-cache hit) computes the same math as one full prefill.
+    Returns (last-valid logits [B, V], k_cache', v_cache'): the row
+    whose chunk completes its prompt samples its first token from these
+    logits; mid-prompt rows' logits are discarded by the caller."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "serving prefill/decode cover the dense-FFN transformer; "
+            "quantized/MoE decode is future work")
+    from paddle_tpu.ops.pallas import paged_attention as pa
+
+    b, c = ids.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    # padding of offset rows can index past max_seq_len — clip (valid
+    # positions satisfy starts + t < max_prompt_len <= max_seq_len)
+    pos = jnp.clip(starts[:, None] + jnp.arange(c)[None, :], 0,
+                   cfg.max_seq_len - 1)
+    x = params["embed"][ids] + params["pos_embed"][pos]
+
+    def block(x, layer_kv):
+        layer, kc, vc = layer_kv
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = (h @ layer["wq"]).reshape(b, c, nh, hd)
+        k = (h @ layer["wk"]).reshape(b, c, nh, hd)
+        v = (h @ layer["wv"]).reshape(b, c, nh, hd)
+        kcs, vcs = pa.write_prefill_kv(kc[None], vc[None], k[None],
+                                       v[None], page_table, seq_lens,
+                                       starts=starts)
+        kc, vc = kcs[0], vcs[0]
+        a = pa.paged_prefill_attention(q, kc, vc, page_table, starts,
+                                       seq_lens)
+        x = x + a.reshape(b, c, nh * hd) @ layer["wo"]
+        h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+        h = jax.nn.gelu(h @ layer["w_in"] + layer["b_in"])
+        return x + h @ layer["w_out"] + layer["b_out"], (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(
+        block, x, (params["blocks"], k_cache, v_cache))
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    last = jnp.take_along_axis(
+        x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    return last @ params["embed"].T, k_cache, v_cache
+
+
 def forward_decode(cfg: TransformerConfig, params: dict, ids: jax.Array,
                    positions: jax.Array, seq_lens: jax.Array,
                    page_table: jax.Array, k_cache, v_cache,

@@ -80,23 +80,77 @@ def write_decode_kv(k_pages, v_pages, k, v, page_table, positions):
     return k_pages, v_pages
 
 
-def write_prefill_kv(k_pages, v_pages, ks, vs, page_table, seq_lens):
-    """Scatter a whole prefilled prompt batch into the stacked pools.
+def write_prefill_kv(k_pages, v_pages, ks, vs, page_table, seq_lens,
+                     starts=None):
+    """Scatter a prefilled prompt batch into the stacked pools.
 
     ks/vs: [L, B, T, H, D] (padded prompts); k_pages/v_pages:
     [L, H, P, page_size, D]; page_table: [B, max_pages]; seq_lens: [B].
-    Positions at or past ``seq_lens`` are redirected to the null page."""
+    Positions at or past ``seq_lens`` are redirected to the null page.
+
+    ``starts`` [B] (chunked prefill / cached-prefix tails) offsets row
+    ``b``'s writes to absolute positions ``starts[b] + [0, seq_lens[b])``
+    — the same scatter, shifted; None keeps the from-zero behaviour
+    bit-identically."""
     _, b, t, _, _ = ks.shape
     ps = k_pages.shape[3]
     t_idx = jnp.arange(t)
     valid = t_idx[None, :] < seq_lens[:, None]  # [B, T]
-    page_slot = jnp.broadcast_to(t_idx[None, :] // ps, (b, t))
+    pos = (jnp.broadcast_to(t_idx[None, :], (b, t)) if starts is None
+           else starts[:, None] + t_idx[None, :])
+    # mask the page slot BEFORE the gather: an offset row's padding can
+    # point past the table row (starts + t >= max_pages * page_size)
+    page_slot = jnp.where(valid, pos // ps, 0)
     pages = jnp.where(valid,
                       jnp.take_along_axis(page_table, page_slot, axis=1), 0)
-    offs = jnp.broadcast_to(t_idx[None, :] % ps, (b, t))
+    offs = pos % ps
     k_pages = k_pages.at[:, :, pages, offs].set(ks.transpose(0, 3, 1, 2, 4))
     v_pages = v_pages.at[:, :, pages, offs].set(vs.transpose(0, 3, 1, 2, 4))
     return k_pages, v_pages
+
+
+def paged_prefill_attention(q, k_pages, v_pages, page_table, starts,
+                            seq_lens, scale=None):
+    """Chunk-prefill attention: queries over the whole resident paged
+    context (prefix caching + chunked prefill's compute path).
+
+    q: [B, C, H, D] — row ``b``'s queries sit at absolute positions
+    ``starts[b] + t`` and attend causally over positions ``[0,
+    starts[b] + t]`` of the paged cache: earlier chunks AND any shared
+    cached prefix included.  The chunk's own K/V must already be written
+    (``write_prefill_kv`` with ``starts``).  ``seq_lens`` [B] is the
+    valid NEW tokens per row; rows with 0 produce zeros, query positions
+    past it produce garbage the caller discards.  Returns [B, C, H, D].
+
+    Pure jnp (gather + einsum) by design: it is the production CPU path
+    and, under jit, lowers to an XLA gather + batched matmul on TPU —
+    chunked prefill is bound by the chunk's dense matmuls, while the
+    per-step decode hot loop keeps the Pallas kernel above."""
+    h, _, ps, d = k_pages.shape
+    b, c, _, _ = q.shape
+    maxp = page_table.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    # [H, B, maxp, ps, D] -> [B, H, maxp*ps, D]
+    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
+        b, h, maxp * ps, d)
+    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
+        b, h, maxp * ps, d)
+    s = jnp.einsum("bchd,bhkd->bhck", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    qpos = starts[:, None] + jnp.arange(c)[None, :]   # [B, C] absolute
+    kpos = jnp.arange(maxp * ps)
+    # causal over ABSOLUTE positions: every key at or before the query
+    # was written by the prefix/chunks already resident — stale pages
+    # past the write frontier sit strictly above qpos and are masked
+    mask = kpos[None, None, None, :] <= qpos[:, None, :, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhck,bhkd->bhcd", p / jnp.maximum(l, 1e-30),
+                     v.astype(jnp.float32))
+    out = jnp.where(seq_lens[:, None, None, None] > 0, out, 0.0)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 # -- reference implementation --------------------------------------------------

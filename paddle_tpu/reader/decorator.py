@@ -366,6 +366,11 @@ def bucket_by_length(reader, batch_size,
             log.info("bucket_by_length: dropped %d tail samples not "
                      "divisible by %d", dropped, m)
 
+    # advertise the table on the reader so SGD.train's feeder picks it
+    # up by DEFAULT (train(seq_buckets=None) and no --seq_buckets flag):
+    # the dataset bucketed_batches helpers (wmt14/conll05/imdb) then
+    # bucket end-to-end without the caller repeating the table
+    batch_reader.seq_buckets = buckets
     return batch_reader
 
 

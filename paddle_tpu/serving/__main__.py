@@ -43,6 +43,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--page_size", type=int, default=16)
     p.add_argument("--num_pages", type=int, default=64)
     p.add_argument("--max_prompt_len", type=int, default=32)
+    p.add_argument("--prefix_cache", action="store_true",
+                   help="share full KV pages across requests with a "
+                        "common prompt prefix (copy-on-write, LRU "
+                        "eviction under page pressure); greedy tokens "
+                        "are identical on/off")
+    p.add_argument("--prefill_chunk_tokens", type=int, default=0,
+                   help="split long-prompt prefill into chunks of this "
+                        "many tokens interleaved with decode steps "
+                        "(0 = whole-prompt prefill, today's behavior)")
     p.add_argument("--metrics_jsonl", default=None)
     p.add_argument("--replicas", type=int, default=1,
                    help="serve through a local fleet of N replica "
@@ -85,7 +94,9 @@ def main(argv=None) -> int:
     scfg = ServingConfig(
         max_slots=args.slots, page_size=args.page_size,
         num_pages=args.num_pages, max_prompt_len=args.max_prompt_len,
-        max_new_tokens=args.max_new_tokens, seed=args.seed)
+        max_new_tokens=args.max_new_tokens, seed=args.seed,
+        prefix_cache=args.prefix_cache,
+        prefill_chunk_tokens=args.prefill_chunk_tokens)
     if args.replicas > 1:
         from paddle_tpu.serving.fleet import build_local_fleet
 

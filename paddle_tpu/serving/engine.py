@@ -19,7 +19,9 @@ Telemetry rides the shared :class:`MetricsRegistry`: histograms
 ``serve_tokens`` / ``serve_loop_crashes`` (background loops that died —
 pending ``results()`` callers get the loop's exception re-raised
 instead of blocking forever), gauges ``serve_active_slots`` /
-``serve_free_pages``,
+``serve_free_pages``; with ``--prefix_cache`` / ``--prefill_chunk_tokens``
+also counters ``serve_prefix_hit_tokens`` / ``serve_prefill_flops_saved``
+/ ``serve_prefill_chunks`` and gauge ``serve_cached_pages``,
 one ``kind="serve"`` record per completed request and a
 ``kind="serve_summary"`` record (TTFT/TPOT p50/p99) from
 :meth:`emit_summary` — rendered by ``tools/metrics_to_md.py``'s
@@ -127,6 +129,8 @@ class ServingEngine:
                 >= s.max_prompt_len + s.max_new_tokens,
                 "max_concurrent_tokens is below one max-size request's "
                 "reservation — nothing could ever be admitted")
+        enforce(s.prefill_chunk_tokens >= 0,
+                "prefill_chunk_tokens must be >= 0 (0 = chunking off)")
         # GL-P-MEM serving path: with an --hbm_gb budget set, the static
         # KV pool + params bytes must fit BEFORE the pools are allocated
         # — an oversized pool fails here, not at the first admission
@@ -144,8 +148,14 @@ class ServingEngine:
         self.registry = registry or metrics_mod.get_registry()
         self.cache = PagedKVCache(
             cfg.num_layers, cfg.num_heads, cfg.head_dim, s.num_pages,
-            s.page_size, s.max_slots, s.max_pages_per_seq, dtype=cfg.dtype)
+            s.page_size, s.max_slots, s.max_pages_per_seq, dtype=cfg.dtype,
+            prefix_cache=s.prefix_cache)
         self.scheduler = Scheduler(s, self.cache)
+        # 2·params is the standard per-token forward-FLOPs estimate —
+        # what a prefix-cache hit's skipped recompute is booked at
+        self._param_count = sum(
+            int(x.size) for x in jax.tree.leaves(params))
+        self._chunk_passes = 0  # incremental prefill passes this engine ran
         self._base_key = jax.random.key(s.seed)
         self._lock = threading.Lock()
         self._incoming: collections.deque[Request] = collections.deque()
@@ -162,11 +172,6 @@ class ServingEngine:
         import dataclasses
 
         import jax
-        import jax.numpy as jnp
-
-        from paddle_tpu.models import transformer as T
-        from paddle_tpu.ops.pallas import paged_attention as pa
-        from paddle_tpu.serving import sampling
 
         cfg, attn_impl = self.cfg, self.serving.attn_impl
         # prefill runs cfg.attn_impl — but a TRAINING config may name a
@@ -181,25 +186,8 @@ class ServingEngine:
         # donating the cache lets XLA update pages in place; CPU has no
         # donation and would warn every call
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
-
-        def prefill(params, base_key, kc, vc, ids, lens, table, rids,
-                    temps):
-            logits, ks, vs = T.forward_prefill(cfg, params, ids, lens)
-            kc, vc = pa.write_prefill_kv(kc, vc, ks, vs, table, lens)
-            keys = sampling.request_keys(
-                base_key, rids, jnp.zeros_like(rids))
-            return sampling.sample_tokens(logits, keys, temps), kc, vc
-
-        def decode(params, base_key, kc, vc, ids, positions, lens, table,
-                   rids, gens, temps):
-            logits, kc, vc = T.forward_decode(
-                cfg, params, ids, positions, lens, table, kc, vc,
-                attn_impl=attn_impl)
-            keys = sampling.request_keys(base_key, rids, gens)
-            return sampling.sample_tokens(logits, keys, temps), kc, vc
-
-        self._prefill = jax.jit(prefill, donate_argnums=donate)
-        self._decode = jax.jit(decode, donate_argnums=donate)
+        (self._prefill, self._prefill_chunk,
+         self._decode) = _serving_fns(cfg, attn_impl, donate)
 
     # -- public API -----------------------------------------------------------
     def check_request(self, prompt,
@@ -363,7 +351,7 @@ class ServingEngine:
 
         tracer = get_tracer()
         admitted = sched.admit(now=now)
-        if admitted:
+        if admitted and not self.serving.incremental_prefill:
             t0 = time.perf_counter()
             tk = tracer.begin("serve_prefill", cat="serving",
                               batch=len(admitted))
@@ -393,6 +381,10 @@ class ServingEngine:
                 sched.append_token(a, int(toks[j]))
             worked = True
 
+        if self.serving.incremental_prefill:
+            if self._prefill_incremental(admitted, tracer, reg):
+                worked = True
+
         batch = sched.decode_batch()
         if batch is not None:
             live = batch.pop("live")
@@ -419,7 +411,82 @@ class ServingEngine:
                       len(sched.active))
         reg.gauge("serve_free_pages", "KV-cache pages on the free list").set(
             self.cache.allocator.free_pages)
+        if self.cache.prefix is not None:
+            # free + cached(unique, incl. mapped) + active-only pages ==
+            # num_pages - 1: the refcounted-allocator identity
+            # tests/test_serving.py asserts
+            reg.gauge("serve_cached_pages",
+                      "pages referenced by the prefix cache (LRU-"
+                      "reclaimable once no sequence maps them)").set(
+                          self.cache.prefix.cached_pages)
         return worked
+
+    def _prefill_incremental(self, admitted, tracer, reg) -> bool:
+        """The flag-on prefill path (prefix cache / chunked prefill):
+        book admissions (queue wait, cache-hit savings), then run ONE
+        offset prefill pass over up to ``prefill_batch`` mid-prefill
+        sequences — each advances by at most ``prefill_chunk_tokens``
+        (its whole uncached tail when chunking is off) — interleaved
+        with the decode pass that follows in the same engine iteration.
+        A row whose prompt completes samples its first token from the
+        pass's logits, and its full prompt pages are registered in the
+        prefix cache for later requests to share."""
+        sched = self.scheduler
+        for a in admitted:
+            reg.histogram(
+                "serve_queue_wait_ms",
+                "request wait between arrival and admission").observe(
+                    (a.t_admit - a.request.arrival) * 1e3)
+            if a.cached_tokens:
+                reg.counter(
+                    "serve_prefix_hit_tokens",
+                    "prompt tokens served from the prefix cache").inc(
+                        a.cached_tokens)
+                reg.counter(
+                    "serve_prefill_flops_saved",
+                    "prefill FLOPs not recomputed on prefix-cache hits "
+                    "(2·params per token estimate)").inc(
+                        2.0 * self._param_count * a.cached_tokens)
+        batch = sched.prefill_chunk_batch()
+        if batch is None:
+            return bool(admitted)
+        rows, takes = batch.pop("rows"), batch.pop("takes")
+        t0 = time.perf_counter()
+        tk = tracer.begin("serve_prefill", cat="serving",
+                          batch=len(rows), chunked=True)
+        toks, self.cache.k, self.cache.v = self._prefill_chunk(
+            self.params, self._base_key, self.cache.k, self.cache.v,
+            *_dev(batch, "ids", "starts", "seq_lens", "page_table",
+                  "rids", "temps"))
+        toks = np.asarray(toks)
+        tracer.end(tk)
+        t1 = time.perf_counter()
+        reg.histogram("serve_prefill_ms",
+                      "prefill pass wall ms (per admitted batch)").observe(
+                          (t1 - t0) * 1e3)
+        reg.counter("serve_prefill_chunks",
+                    "incremental prefill passes (chunk or cached "
+                    "tail)").inc(len(rows))
+        with self._lock:
+            # emit_summary reads this from the caller's thread while the
+            # background loop writes it (the GL-THREAD audited contract)
+            self._chunk_passes += 1
+        for j, a in enumerate(rows):
+            a.prefilled += takes[j]
+            a.prefill_chunks += 1
+            if a.prefilled >= a.prompt_len:
+                # the pass's last-valid logits are this row's first-
+                # token logits: its prompt is fully resident now
+                a.t_first = t1
+                reg.histogram(
+                    "serve_ttft_ms", "time to first token").observe(
+                        (t1 - a.request.arrival) * 1e3)
+                reg.counter("serve_tokens", "tokens generated").inc(1)
+                sched.append_token(a, int(toks[j]))
+                if self.cache.prefix is not None:
+                    self.cache.prefix.insert(
+                        a.request.prompt, self.cache.slot_pages(a.slot))
+        return True
 
     def _finish(self, a) -> None:
         now = time.perf_counter()
@@ -487,6 +554,8 @@ class ServingEngine:
             "kv_page_s": round(kv_page_s, 6),
             "cost_per_token_s": round((prefill_s + decode_s) / n, 9)
                                 if n else None,
+            "cached_tokens": a.cached_tokens,
+            "prefill_chunks": a.prefill_chunks,
         }
         if self.registry.active:
             self.registry.emit(rec, kind="serve")
@@ -510,13 +579,87 @@ class ServingEngine:
                 # p50/p99/max quantiles of an empty distribution
                 summary[name] = {k: s[k] for k in
                                  ("count", "p50", "p99", "max")}
-        self.registry.emit(
-            {"summary": summary,
-             "rejected_admissions": self.scheduler.rejected_admissions},
-            kind="serve_summary")
+        rec = {"summary": summary,
+               "rejected_admissions": self.scheduler.rejected_admissions}
+        if self.cache.prefix is not None:
+            p = self.cache.prefix
+            denom = max(p.hits + p.misses, 1)
+            rec["prefix"] = {
+                "hits": p.hits, "misses": p.misses,
+                "hit_tokens": p.hit_tokens,
+                "prompt_tokens": p.prompt_tokens,
+                "hit_rate": round(p.hit_tokens /
+                                  max(p.prompt_tokens, 1), 4),
+                "request_hit_rate": round(p.hits / denom, 4),
+                "evictions": p.evictions, "inserts": p.inserts,
+                "cached_pages": p.cached_pages,
+                "flops_saved": 2.0 * self._param_count * p.hit_tokens,
+            }
+        if self.serving.incremental_prefill:
+            with self._lock:
+                rec["prefill_chunks"] = self._chunk_passes
+        self.registry.emit(rec, kind="serve_summary")
 
 
 def _dev(batch: dict, *names):
     import jax.numpy as jnp
 
     return [jnp.asarray(batch[n]) for n in names]
+
+
+# (cfg, attn_impl, donate) -> (prefill, prefill_chunk, decode).  The
+# jitted serving closures are fully determined by this key — params,
+# caches and batches all arrive as arguments — so engines built on the
+# same config (every fleet replica, a restarted engine, a weight swap)
+# share ONE set of jit objects and their compiled executables instead
+# of paying XLA again per engine.  Populated under _FN_LOCK from
+# whatever thread constructs the engine; the tuples are immutable.
+_FN_MEMO: dict = {}
+_FN_LOCK = threading.Lock()
+
+
+def _serving_fns(cfg, attn_impl, donate):
+    key = (cfg, attn_impl, donate)
+    with _FN_LOCK:
+        fns = _FN_MEMO.get(key)
+        if fns is not None:
+            return fns
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.ops.pallas import paged_attention as pa
+    from paddle_tpu.serving import sampling
+
+    def prefill(params, base_key, kc, vc, ids, lens, table, rids,
+                temps):
+        logits, ks, vs = T.forward_prefill(cfg, params, ids, lens)
+        kc, vc = pa.write_prefill_kv(kc, vc, ks, vs, table, lens)
+        keys = sampling.request_keys(
+            base_key, rids, jnp.zeros_like(rids))
+        return sampling.sample_tokens(logits, keys, temps), kc, vc
+
+    def decode(params, base_key, kc, vc, ids, positions, lens, table,
+               rids, gens, temps):
+        logits, kc, vc = T.forward_decode(
+            cfg, params, ids, positions, lens, table, kc, vc,
+            attn_impl=attn_impl)
+        keys = sampling.request_keys(base_key, rids, gens)
+        return sampling.sample_tokens(logits, keys, temps), kc, vc
+
+    def prefill_chunk(params, base_key, kc, vc, ids, starts, lens,
+                      table, rids, temps):
+        logits, kc, vc = T.forward_prefill_chunk(
+            cfg, params, ids, starts, lens, table, kc, vc)
+        keys = sampling.request_keys(
+            base_key, rids, jnp.zeros_like(rids))
+        return sampling.sample_tokens(logits, keys, temps), kc, vc
+
+    fns = (jax.jit(prefill, donate_argnums=donate),
+           jax.jit(prefill_chunk, donate_argnums=donate),
+           jax.jit(decode, donate_argnums=donate))
+    with _FN_LOCK:
+        # a racing builder may have won; keep the first so every engine
+        # shares one executable cache
+        return _FN_MEMO.setdefault(key, fns)

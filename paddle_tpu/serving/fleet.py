@@ -85,6 +85,15 @@ class LocalReplica:
     def check(self, prompt, max_new_tokens=None):
         return self.engine.check_request(prompt, max_new_tokens)
 
+    def prefix_peek(self, prompt) -> int:
+        """Tokens of this prompt already resident in the replica's
+        prefix cache — the router's cache-affinity signal.  Pure read:
+        no LRU touch, no hit/miss stats."""
+        if self._dead is not None:
+            return 0
+        prefix = self.engine.cache.prefix
+        return 0 if prefix is None else prefix.peek(prompt)
+
     def submit(self, prompt, max_new_tokens, temperature,
                request_id: int) -> None:
         if self._dead is not None:
@@ -111,11 +120,18 @@ class LocalReplica:
 
     def probe(self) -> HealthProbe:
         sched = self.engine.scheduler
+        cache = self.engine.cache
+        free = cache.allocator.free_pages
+        if cache.prefix is not None:
+            # cached-but-unmapped pages are reclaimable on demand (LRU
+            # eviction runs before OutOfPages), so a warm cache must not
+            # look like memory pressure to shed_free_page_frac
+            free += cache.prefix.reclaimable_pages()
         return HealthProbe(
             replica=self.index, alive=self._dead is None,
             queued=self.engine.queued() + len(sched.queue),
             active=len(sched.active),
-            free_pages=self.engine.cache.allocator.free_pages,
+            free_pages=free,
             total_pages=self.serving.num_pages - 1,
             progress=self._progress, last_beat=self._last_beat,
             reason=self._dead or "")

@@ -360,7 +360,7 @@ class FleetRouter:
                 tracer.end(tk, outcome="deadline")
                 worked = True
                 continue
-            target = self._pick()
+            target = self._pick(req)
             if target is None:
                 if self.health.alive_count(len(self.replicas)) == 0:
                     # a fleet with no survivors can never serve this —
@@ -400,9 +400,15 @@ class FleetRouter:
             worked = True
         return worked
 
-    def _pick(self):
+    def _pick(self, req=None):
         """Least-loaded alive, non-draining replica; ties break to the
-        lowest index — deterministic given the books."""
+        lowest index — deterministic given the books.  When the request
+        is given and replicas expose ``prefix_peek`` (prefix caching
+        on), cache affinity dominates: the replica with the longest
+        resident prefix for this prompt wins, so repeat system prompts
+        land where their KV pages already live.  ``prefix_peek`` is
+        side-effect-free (no LRU touch, no stats), so routing probes
+        never skew cache telemetry or eviction order."""
         with self._lock:
             load: dict[int, int] = {}
             for r in self._inflight.values():
@@ -412,7 +418,20 @@ class FleetRouter:
         for i, rep in enumerate(self.replicas):
             if self.health.is_dead(i) or i in draining:
                 continue
-            key = (load.get(i, 0), i)
+            affinity = 0
+            peek = getattr(rep, "prefix_peek", None)
+            if req is not None and peek is not None:
+                try:
+                    affinity = int(peek(req.prompt))
+                except Exception:
+                    # a sick replica must not stall routing: account the
+                    # failed probe, fall back to load-only placement
+                    from paddle_tpu.telemetry import safe_inc
+                    safe_inc("fleet_affinity_probe_errors",
+                             "prefix_peek probes that raised during "
+                             "routing", registry=self.registry)
+                    affinity = 0
+            key = (-affinity, load.get(i, 0), i)
             if best is None or key < best[0]:
                 best = (key, i, rep)
         return None if best is None else (best[1], best[2])

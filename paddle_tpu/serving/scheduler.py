@@ -53,11 +53,28 @@ class ServingConfig:
     # engine and never join mid-flight — every batch decodes until its
     # LAST member finishes (what a batch `Inference` loop would do)
     static_batching: bool = False
+    # -- per-token serving cost (both off = the prior engine bit-for-
+    #    bit; greedy-sampled tokens are identical either way) --
+    # share full KV pages between requests with a common prompt prefix
+    # (refcounted copy-on-write pages + the PrefixCache trie): a hit
+    # maps resident pages into the new slot's table row and prefills
+    # only the uncached tail
+    prefix_cache: bool = False
+    # > 0: prefill at most this many prompt tokens per request per
+    # step, interleaved with decode steps, so a long prompt stops
+    # stalling the decode batch's TTFT; 0 = whole prompt in one pass
+    prefill_chunk_tokens: int = 0
 
     @property
     def max_pages_per_seq(self) -> int:
         return -(-(self.max_prompt_len + self.max_new_tokens)
                  // self.page_size)
+
+    @property
+    def incremental_prefill(self) -> bool:
+        """True when prompts are prefilled through the offset chunk path
+        (prefix cache and/or chunking) instead of one from-zero pass."""
+        return self.prefix_cache or self.prefill_chunk_tokens > 0
 
 
 @dataclasses.dataclass
@@ -92,6 +109,9 @@ class _Active:
     finished: str | None = None  # finish reason once known
     t_admit: float = 0.0
     t_first: float = 0.0
+    cached_tokens: int = 0       # prompt tokens mapped from the prefix cache
+    prefilled: int = 0           # prompt tokens whose K/V are resident
+    prefill_chunks: int = 0      # incremental prefill passes run
 
     @property
     def prompt_len(self) -> int:
@@ -187,14 +207,20 @@ class Scheduler:
                 self.rejected_admissions += 1
                 break
             slot = free[0]
+            covered = 0
             try:
-                self.cache.assign(slot, reserve)
+                if s.prefix_cache and self.cache.prefix is not None:
+                    _, covered = self.cache.assign_with_prefix(
+                        slot, reserve, req.prompt)
+                else:
+                    self.cache.assign(slot, reserve)
             except OutOfPages:
                 self.rejected_admissions += 1
                 break
             self.queue.popleft()
             a = _Active(request=req, slot=slot, reserved_tokens=reserve,
-                        t_admit=now)
+                        t_admit=now, cached_tokens=covered,
+                        prefilled=covered)
             self.slots[slot] = a
             admitted.append(a)
         return admitted
@@ -227,8 +253,9 @@ class Scheduler:
         """Fixed-shape arrays for one decode step over all live
         sequences, or None when there are none.  Idle/finished slots ride
         along masked (seq_len 0, null-page table row) so the jitted step
-        has a single compile signature."""
-        live = self.live
+        has a single compile signature.  Sequences still mid-prefill
+        (incremental path: no token sampled yet) are not decoded."""
+        live = [a for a in self.live if a.generated]
         if not live:
             return None
         n = self.serving.max_slots
@@ -238,17 +265,28 @@ class Scheduler:
         rids = np.zeros((n,), np.int32)
         gens = np.zeros((n,), np.int32)
         temps = np.zeros((n,), np.float32)
+        decoding = set()
         for a in live:
             i = a.slot
+            decoding.add(i)
             ids[i] = a.generated[-1]
             positions[i] = a.next_position
             seq_lens[i] = a.next_position + 1
             rids[i] = a.request.id
             gens[i] = len(a.generated)
             temps[i] = a.request.temperature
+        table = self.cache.page_table.copy()
+        for i in range(n):
+            # write_decode_kv's idle-row contract is "all-zero table row
+            # → null page", which mid-prefill slots (mapped pages, no
+            # token yet) would silently break: their masked write at
+            # position 0 would corrupt the first prompt page.  Free
+            # slots are already zeroed, so flag-off this is a no-op.
+            if i not in decoding:
+                table[i, :] = 0
         return {
             "ids": ids, "positions": positions, "seq_lens": seq_lens,
-            "page_table": self.cache.page_table.copy(),
+            "page_table": table,
             "rids": rids, "gens": gens, "temps": temps, "live": live,
         }
 
@@ -271,3 +309,52 @@ class Scheduler:
             temps[j] = a.request.temperature
         return {"ids": ids, "seq_lens": lens, "page_table": table,
                 "rids": rids, "temps": temps}
+
+    # -- incremental prefill (prefix cache / chunked) --------------------------
+    def prefilling(self) -> list[_Active]:
+        """Sequences admitted but not yet fully prompt-resident — the
+        incremental-prefill work list, slot order (deterministic)."""
+        return [a for a in self.slots
+                if a is not None and not a.finished
+                and a.prefilled < a.prompt_len]
+
+    def prefill_chunk_batch(self) -> dict | None:
+        """Fixed-shape arrays for one incremental prefill pass (the
+        flag-on twin of :meth:`prefill_batch`), or None when nothing is
+        mid-prefill: up to ``prefill_batch`` rows, each advancing by at
+        most ``prefill_chunk_tokens`` of its remaining prompt (the whole
+        uncached tail when chunking is off).  Rows carry an absolute
+        ``starts`` offset; ``seq_lens`` is the valid NEW tokens this
+        pass.  ``takes``/``rows`` let the engine advance bookkeeping and
+        sample first tokens for rows whose prompt completes."""
+        s = self.serving
+        rows = self.prefilling()[:s.prefill_batch]
+        if not rows:
+            return None
+        c = (min(s.prefill_chunk_tokens, s.max_prompt_len)
+             if s.prefill_chunk_tokens > 0 else s.max_prompt_len)
+        nb = s.prefill_batch
+        ids = np.zeros((nb, c), np.int32)
+        starts = np.zeros((nb,), np.int32)
+        lens = np.zeros((nb,), np.int32)
+        table = np.zeros((nb, self.cache.max_pages_per_seq), np.int32)
+        rids = np.zeros((nb,), np.int32)
+        temps = np.zeros((nb,), np.float32)
+        takes: list[int] = []
+        for j, a in enumerate(rows):
+            take = min(c, a.prompt_len - a.prefilled)
+            # shared (cached-prefix) pages are read-only: privatise any
+            # page this chunk would write — a no-op under page-granular
+            # sharing (writes land past the shared prefix), kept as the
+            # explicit copy-on-write guard
+            self.cache.cow_for_write(a.slot, a.prefilled, take)
+            ids[j, :take] = a.request.prompt[a.prefilled:a.prefilled + take]
+            starts[j] = a.prefilled
+            lens[j] = take
+            table[j] = self.cache.page_table[a.slot]
+            rids[j] = a.request.id
+            temps[j] = a.request.temperature
+            takes.append(take)
+        return {"ids": ids, "starts": starts, "seq_lens": lens,
+                "page_table": table, "rids": rids, "temps": temps,
+                "rows": rows, "takes": takes}

@@ -113,7 +113,18 @@ from typing import Any
 # compute, the collective wire model (collectives) and the named
 # ``bottleneck`` under the selected --hw_profile — rendered by
 # tools/metrics_to_md.py's "Static cost" table.  No new record kinds.
-SCHEMA = "paddle_tpu.metrics/13"
+# /14 added prefix caching + chunked prefill to the serving path: the
+# "serve" record gained cached_tokens (prompt tokens mapped from the
+# prefix cache instead of recomputed) and prefill_chunks (incremental
+# prefill passes this request took); "serve_summary" gained a "prefix"
+# dict (hits/misses/hit_tokens/prompt_tokens/hit_rate/
+# request_hit_rate/evictions/inserts/cached_pages/flops_saved) and a
+# top-level prefill_chunks when either flag is on.  New counters
+# serve_prefix_hit_tokens / serve_prefill_flops_saved /
+# serve_prefill_chunks and gauge serve_cached_pages.  No new record
+# kinds; flag-off runs emit the /13 field set plus the two zero-valued
+# serve fields.
+SCHEMA = "paddle_tpu.metrics/14"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted

@@ -340,9 +340,15 @@ class SGD:
             # the finite-cost check below remains as a cheap backstop
             jax.config.update("jax_debug_nans", True)
         self._ensure_built()
-        # seq_buckets (None = the flag): length-quantization table for the
-        # feeder's sequence slots — set it to the SAME table the reader's
-        # bucket_by_length stage uses so every bucket is one jit signature
+        # seq_buckets (None = the reader's own table, then the flag):
+        # length-quantization table for the feeder's sequence slots —
+        # it must be the SAME table the reader's bucket_by_length stage
+        # used so every bucket is one jit signature.  bucket_by_length
+        # readers (the dataset bucketed_batches helpers) carry theirs as
+        # reader.seq_buckets, so bucketed input pads to bucket ceilings
+        # by default, no repeated knob.
+        if seq_buckets is None:
+            seq_buckets = getattr(reader, "seq_buckets", None)
         feeder = self._default_feeder(feeding, seq_buckets)
         params = self.mesh.replicate(self._params_dict())
         states = self.mesh.replicate(self.states)

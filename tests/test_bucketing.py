@@ -164,6 +164,36 @@ def test_train_with_buckets_bounds_signatures_and_reports_padding():
     assert any(r["padding_ratio"] > 0 for r in steps)
 
 
+def test_real_dataset_reader_buckets_by_default():
+    """The dataset ``bucketed_batches`` helpers (wmt14/conll05/imdb)
+    are the DEFAULT length-bucketed path: the reader carries its table
+    (``reader.seq_buckets``), SGD.train's feeder picks it up without
+    ``seq_buckets=...`` being repeated, and every step record still
+    carries the schema/10 padding_ratio field."""
+    import itertools
+
+    from paddle_tpu import metrics as metrics_mod
+    from paddle_tpu.dataset import imdb
+
+    from paddle_tpu.parallel.mesh import get_mesh
+
+    reader = imdb.bucketed_batches(
+        lambda: itertools.islice(imdb.train()(), 32), 8,
+        size_multiple=get_mesh().num_replicas)
+    assert reader.seq_buckets == imdb.SEQ_BUCKETS
+    trainer = _lstm_text_trainer(vocab=imdb.VOCAB_SIZE)
+    sink = metrics_mod.MemorySink()
+    reg = metrics_mod.MetricsRegistry("test_bucketing_imdb")
+    reg.add_sink(sink)
+    trainer.train(reader=reader, num_passes=1, metrics_registry=reg)
+    steps = [r for r in sink.records if r.get("kind") == "step"]
+    assert steps and all("padding_ratio" in r for r in steps)
+    assert all(0.0 <= r["padding_ratio"] < 1.0 for r in steps)
+    # the feeder padded to bucket ceilings, not one stream-max shape:
+    # at most one signature per table entry
+    assert len(trainer._compiled_sigs) <= len(imdb.SEQ_BUCKETS)
+
+
 def test_metrics_to_md_flags_padding_bound_steps(tmp_path, capsys):
     import json
     import sys

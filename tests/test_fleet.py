@@ -199,6 +199,83 @@ class TestShedding:
         assert s["deadline_expired"] == 1 and s["requests_lost"] == 0
 
 
+class TestPrefixCacheFleet:
+    def _requests(self, rng, n=6):
+        head = list(rng.integers(1, 64, size=4))
+        return [(head + list(rng.integers(1, 64, size=1 + (i % 3))),
+                 3 + (i % 3), 0.0) for i in range(n)]
+
+    def test_prefix_cache_identity_across_failover(self, model, rng_np):
+        """The fleet acceptance property composed with the tentpole:
+        --prefix_cache on/off and a mid-run replica loss all produce
+        byte-identical greedy tokens."""
+        reqs = self._requests(rng_np)
+        runs = {}
+        for name, scfg_kw, chaos in (
+                ("off", {}, None),
+                ("on", {"prefix_cache": True}, None),
+                ("on_failover", {"prefix_cache": True},
+                 "replica_loss@3:replica=1")):
+            reg = MetricsRegistry(f"fleet_prefix_{name}")
+            chaos_s = (ChaosSchedule(chaos, registry=reg)
+                       if chaos else None)
+            router = build_local_fleet(
+                *model, small_scfg(**scfg_kw), n=3, registry=reg,
+                chaos=chaos_s)
+            rids = [router.submit(p, max_new_tokens=n, temperature=t)
+                    for p, n, t in reqs]
+            router.run_until_idle()
+            res = {r.id: r for r in router.results()}
+            assert set(res) == set(rids)
+            runs[name] = [res[r].tokens for r in rids]
+        assert runs["off"] == runs["on"] == runs["on_failover"]
+
+    def test_router_affinity_prefers_warm_replica(self, model, rng_np):
+        """Cache-aware routing: a repeat prompt lands on the replica
+        whose prefix cache is warm (prefix_peek), instead of pure
+        least-loaded round-robin spreading it cold."""
+        prompt = list(rng_np.integers(1, 64, size=9))
+        router = build_local_fleet(
+            *model, small_scfg(prefix_cache=True, max_prompt_len=12),
+            n=3, registry=MetricsRegistry("fleet_affinity"))
+        router.submit(prompt, max_new_tokens=3, temperature=0.0)
+        router.run_until_idle()
+        router.results()
+        warm = [i for i, rep in enumerate(router.replicas)
+                if rep.engine.cache.prefix.cached_pages > 0]
+        assert len(warm) == 1  # exactly one replica computed the prompt
+        rep = router.replicas[warm[0]]
+        assert rep.prefix_peek(prompt) == 8  # 2 full pages of 4
+        before_hits = rep.engine.cache.prefix.hits
+        for _ in range(3):  # repeats must all ride the warm cache
+            router.submit(prompt, max_new_tokens=3, temperature=0.0)
+            router.run_until_idle()
+        router.results()
+        assert rep.engine.cache.prefix.hits == before_hits + 3
+        others = [r for i, r in enumerate(router.replicas)
+                  if i != warm[0]]
+        assert all(r.engine.cache.prefix.cached_pages == 0
+                   for r in others)
+
+    def test_probe_counts_reclaimable_pages_as_free(self, model, rng_np):
+        """A warm (idle) cache must not read as memory pressure: the
+        health probe's free_pages includes reclaimable cached pages, so
+        shed_free_page_frac only fires on pages active sequences pin."""
+        prompt = list(rng_np.integers(1, 64, size=9))
+        router = build_local_fleet(
+            *model, small_scfg(prefix_cache=True, max_prompt_len=12),
+            n=1, registry=MetricsRegistry("fleet_probe"))
+        router.submit(prompt, max_new_tokens=3, temperature=0.0)
+        router.run_until_idle()
+        router.results()
+        rep = router.replicas[0]
+        probe = rep.probe()
+        assert rep.engine.cache.prefix.cached_pages == 2
+        assert rep.engine.cache.allocator.free_pages == \
+            probe.total_pages - 2
+        assert probe.free_pages == probe.total_pages  # fully idle
+
+
 class TestWeightSwap:
     def test_rolling_swap_serves_continuously(self, model, tmp_path):
         """Requests stream in while the swap rolls replica by replica:

@@ -8,7 +8,7 @@ The acceptance properties of the ledger:
   wall-clock exactly;
 - ``fold()`` is incremental over ring snapshots (each span classified
   once, new spans picked up on the next fold);
-- the closing record is a schema/13 ``kind="ledger"`` emission, sets
+- the closing record is a schema/14 ``kind="ledger"`` emission, sets
   the ``goodput_fraction`` gauge, and appends to ledger.jsonl;
 - a REAL 50-step CPU chaos run (nan-skip + one elastic 8→4 reshard +
   prefetch-starved reader) through the trainer produces a ledger whose
@@ -151,7 +151,7 @@ def test_finish_emits_ledger_record_gauge_and_jsonl(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     rec = led.finish(path=path)
     assert rec["kind"] == "ledger"
-    assert rec["schema"].endswith("/13")
+    assert rec["schema"].endswith("/14")
     assert reg.get("goodput_fraction").value() == pytest.approx(0.75)
     recs = [r for r in sink.records if r.get("kind") == "ledger"]
     assert len(recs) == 1

@@ -4,6 +4,8 @@
 import importlib.util
 import pathlib
 
+import pytest
+
 
 def _load():
     p = pathlib.Path(__file__).parent.parent / "__graft_entry__.py"
@@ -13,6 +15,8 @@ def _load():
     return m
 
 
+@pytest.mark.slow  # ~51s compile grid; the 2-device variant below keeps
+# every dry-run phase (dp/sp/tp, MoE ep, pipeline, v2, scaling) in tier-1
 def test_dryrun_multichip_8():
     _load().dryrun_multichip(8)
 

@@ -215,6 +215,8 @@ class TestEmptyHistograms:
         class _Eng:  # just the summary path, no engine build
             registry = reg
             scheduler = type("S", (), {"rejected_admissions": 0})()
+            cache = type("C", (), {"prefix": None})()
+            serving = type("V", (), {"incremental_prefill": False})()
 
         from paddle_tpu.serving.engine import ServingEngine
 
@@ -394,7 +396,7 @@ def test_profile_steps_window_emits_record(tmp_path):
     assert len(prof) == 1
     rec = prof[0]
     assert rec["start_step"] == 1 and rec["end_step"] == 3
-    assert rec["schema"] == "paddle_tpu.metrics/13"
+    assert rec["schema"] == "paddle_tpu.metrics/14"
     assert rec["trace_dir"] == str(tmp_path / "prof")
     assert os.path.isdir(rec["trace_dir"])  # the device capture landed
     assert rec["spans"]["compute"]["count"] == 2  # the window's steps

@@ -108,3 +108,192 @@ class TestPagedKVCache:
         assert c.pages_needed(1) == 1
         assert c.pages_needed(4) == 1
         assert c.pages_needed(5) == 2
+
+
+class TestRefcounts:
+    def test_retain_then_free_keeps_page_allocated(self):
+        a = PageAllocator(8)
+        (p,) = a.alloc(1)
+        a.retain([p])
+        assert a.refcount(p) == 2
+        a.free([p])
+        assert a.refcount(p) == 1  # still allocated, one owner left
+        assert p not in [a.alloc(1)[0] for _ in range(a.free_pages)]
+        a.free([p] * 1)
+        assert a.refcount(p) == 0
+
+    def test_refcount_never_negative(self):
+        a = PageAllocator(4)
+        (p,) = a.alloc(1)
+        a.free([p])
+        with pytest.raises(Exception):
+            a.free([p])
+        with pytest.raises(Exception):
+            a.retain([p])  # retain of an unallocated page is an error
+        assert a.refcount(p) == 0
+
+    def test_conservation_with_sharing_fuzz(self):
+        """free + live (unique) == pool under random alloc/retain/free
+        churn — the refcounted conservation law."""
+        rng = np.random.default_rng(11)
+        a = PageAllocator(33)
+        refs: list[int] = []  # one entry per outstanding reference
+        for _ in range(800):
+            r = rng.random()
+            if refs and r < 0.40:
+                a.free([refs.pop(int(rng.integers(len(refs))))])
+            elif refs and r < 0.55:
+                p = refs[int(rng.integers(len(refs)))]
+                a.retain([p])
+                refs.append(p)
+            elif a.can_alloc(1):
+                refs.extend(a.alloc(int(rng.integers(1, 4)) if
+                                    a.can_alloc(3) else 1))
+            assert a.free_pages + a.live_pages == 32
+            for p in set(refs):
+                assert a.refcount(p) == refs.count(p)
+
+
+def _prefix_cache(num_pages=16, max_slots=4, max_pages=8):
+    return PagedKVCache(num_layers=1, num_heads=1, head_dim=4,
+                        num_pages=num_pages, page_size=4,
+                        max_slots=max_slots, max_pages_per_seq=max_pages,
+                        prefix_cache=True)
+
+
+class TestPrefixCache:
+    def test_match_only_full_pages_and_never_whole_prompt(self):
+        c = _prefix_cache()
+        prompt = list(range(10))  # 2 full pages + tail of 2
+        c.assign_with_prefix(0, tokens=12, prompt=prompt)
+        c.prefix.insert(prompt, c.slot_pages(0))
+        assert c.prefix.cached_pages == 2
+        # exact same prompt: match covers the 2 full pages, tail stays
+        assert c.prefix.peek(prompt) == 8
+        # a prompt of exactly 8 tokens may only match 1 page: the last
+        # token must be prefilled to produce first-token logits
+        assert c.prefix.peek(prompt[:8]) == 4
+        # divergence after the first page stops the walk
+        assert c.prefix.peek(prompt[:4] + [99] * 6) == 4
+        assert c.prefix.peek([99] * 10) == 0
+
+    def test_assign_with_prefix_shares_pages_and_counts_tokens(self):
+        c = _prefix_cache()
+        p1 = list(range(10))
+        pages1, cov1 = c.assign_with_prefix(0, 12, p1)
+        assert cov1 == 0
+        c.prefix.insert(p1, c.slot_pages(0))
+        pages2, cov2 = c.assign_with_prefix(1, 12, p1)
+        assert cov2 == 8
+        assert pages2[:2] == pages1[:2]      # physically shared head
+        assert pages2[2] != pages1[2]        # private tail
+        assert c.allocator.refcount(pages1[0]) == 3  # slot0+slot1+cache
+        rep = c.resident_report()
+        assert rep["shared_saved_pages"] == 2
+        assert rep["free_pages"] + rep["unique_pages"] == 15
+
+    def test_release_keeps_cached_pages_resident(self):
+        c = _prefix_cache()
+        prompt = list(range(10))
+        pages, _ = c.assign_with_prefix(0, 12, prompt)
+        c.prefix.insert(prompt, c.slot_pages(0))
+        free_before = c.allocator.free_pages
+        c.release(0)
+        # slot refs dropped; the 2 cached pages survive, the private
+        # tail page is freed
+        assert c.allocator.free_pages == free_before + 1
+        assert c.allocator.refcount(pages[0]) == 1
+        assert c.prefix.peek(prompt) == 8  # still matchable
+
+    def test_lru_eviction_order_and_oop_only_when_unique_exhausted(self):
+        c = _prefix_cache(num_pages=9, max_pages=8)  # 8 usable
+        old = [1, 2, 3, 4, 9]
+        new = [5, 6, 7, 8, 9]
+        c.assign_with_prefix(0, 5, old)
+        c.prefix.insert(old, c.slot_pages(0))
+        c.release(0)
+        c.assign_with_prefix(1, 5, new)
+        c.prefix.insert(new, c.slot_pages(1))
+        c.release(1)
+        # 4 pages held: 2 cached prefixes (1 page each) + nothing live.
+        assert c.prefix.cached_pages == 2
+        assert c.allocator.free_pages == 6
+        # an admission needing 7 pages evicts the LRU entry (old) first
+        c.assign(2, tokens=28)
+        assert c.prefix.evictions == 1
+        assert c.prefix.peek(old + [0]) == 0      # old evicted
+        assert c.prefix.peek(new + [0]) == 4      # newer survived
+        # now the pool is truly full of unique mapped pages + 1 cached:
+        # a request the cold pool couldn't take raises even after the
+        # last cached page is reclaimed
+        with pytest.raises(OutOfPages):
+            c.assign(3, tokens=8)  # needs 2, only 1 reclaimable
+        assert c.prefix.cached_pages == 0  # eviction drained the cache
+        c.assign(3, tokens=4)  # 1 page — fits via the evicted page
+
+    def test_eviction_is_leaf_first(self):
+        c = _prefix_cache()
+        prompt = list(range(12))  # 3 full pages, chain in the trie
+        c.assign_with_prefix(0, 13, prompt)
+        c.prefix.insert(prompt, c.slot_pages(0))
+        c.release(0)
+        assert c.prefix.cached_pages == 3
+        leaves = c.prefix.reclaimable()
+        assert len(leaves) == 1  # only the chain tail is a candidate
+        assert c.prefix.evict_until(c.allocator.free_pages + 1)
+        assert c.prefix.cached_pages == 2
+        assert c.prefix.peek(prompt) == 8  # interior pages still walk
+
+
+class TestCopyOnWrite:
+    def test_cow_copies_shared_page_and_repoints_row(self):
+        c = _prefix_cache()
+        import jax.numpy as jnp
+
+        prompt = list(range(10))
+        pages1, _ = c.assign_with_prefix(0, 12, prompt)
+        c.k = c.k.at[:, :, pages1[0]].set(7.0)  # recognizable contents
+        c.prefix.insert(prompt, c.slot_pages(0))
+        pages2, _ = c.assign_with_prefix(1, 12, prompt)
+        assert pages2[0] == pages1[0]
+        got = c.cow_page(1, 0)
+        assert got != pages1[0]
+        assert c.slot_pages(1)[0] == got
+        assert c.page_table[1, 0] == got
+        assert bool(jnp.all(c.k[:, :, got] == 7.0))  # contents copied
+        # slot 0 and the cache still share the original
+        assert c.allocator.refcount(pages1[0]) == 2
+        assert c.allocator.refcount(got) == 1
+
+    def test_cow_noop_on_private_page(self):
+        c = _prefix_cache()
+        prompt = list(range(10))
+        pages, _ = c.assign_with_prefix(0, 12, prompt)
+        free = c.allocator.free_pages
+        c.cow_for_write(0, 9, 3)  # pages 2 covered; private already
+        assert c.slot_pages(0) == pages
+        assert c.allocator.free_pages == free
+
+    def test_divergence_after_shared_prefix_stays_isolated(self):
+        """Two sequences sharing a cached prefix write different
+        suffixes; the shared pages' contents stay byte-identical and
+        each divergence lands in a private page."""
+        import jax.numpy as jnp
+
+        c = _prefix_cache()
+        prompt = list(range(10))
+        pages1, _ = c.assign_with_prefix(0, 12, prompt)
+        c.prefix.insert(prompt, c.slot_pages(0))
+        pages2, _ = c.assign_with_prefix(1, 12, prompt)
+        shared = pages1[:2]
+        before = np.asarray(c.k[:, :, shared])
+        # each writer privatises then writes its own tail page region
+        c.cow_for_write(0, 10, 2)
+        c.cow_for_write(1, 10, 2)
+        t1, t2 = c.slot_pages(0)[2], c.slot_pages(1)[2]
+        assert t1 != t2
+        c.k = c.k.at[:, :, t1].set(1.0)
+        c.k = c.k.at[:, :, t2].set(2.0)
+        assert np.array_equal(np.asarray(c.k[:, :, shared]), before)
+        assert bool(jnp.all(c.k[:, :, t1] == 1.0))
+        assert bool(jnp.all(c.k[:, :, t2] == 2.0))

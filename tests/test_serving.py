@@ -317,7 +317,7 @@ class TestServeTelemetry:
         serves = [r for r in sink.records if r.get("kind") == "serve"]
         assert len(serves) == 3
         for r in serves:
-            assert r["schema"] == "paddle_tpu.metrics/13"
+            assert r["schema"] == "paddle_tpu.metrics/14"
             for f in ("queue_wait_ms", "ttft_ms", "tpot_ms", "total_ms"):
                 assert r[f] >= 0.0
             assert r["new_tokens"] == 4
@@ -355,6 +355,174 @@ class TestServeTelemetry:
         assert "## Serving latency" in out
         assert "TTFT" in out and "TPOT" in out
         assert "admission attempts" in out
+
+
+class TestPrefixCacheAndChunkedPrefill:
+    """The perf tentpole's correctness contract: prefix caching and
+    chunked prefill are pure optimizations — greedy tokens identical in
+    every flag combination, warm or cold — and the refcounted page
+    accounting stays conservative throughout."""
+
+    def _setup(self, rng_np, n_prompts=4, shared_head=8):
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(3))
+        head = list(rng_np.integers(1, 64, size=shared_head))
+        prompts = [head + list(rng_np.integers(1, 64, size=4))
+                   for _ in range(n_prompts)]
+        prompts.append(list(rng_np.integers(1, 64, size=3)))  # no prefix
+        return cfg, params, prompts
+
+    def _run(self, cfg, params, prompts, registry=None, repeats=1, **kw):
+        scfg = ServingConfig(max_slots=4, page_size=4, num_pages=64,
+                             max_prompt_len=16, max_new_tokens=6,
+                             prefill_batch=4, seed=0, **kw)
+        eng = ServingEngine(cfg, params, scfg, registry=registry)
+        out = []
+        for _ in range(repeats):
+            out.append([r.tokens for r in
+                        eng.generate(prompts, temperature=0.0)])
+        return eng, out
+
+    def test_greedy_tokens_identical_across_all_flag_modes(self, rng_np):
+        cfg, params, prompts = self._setup(rng_np)
+        _, (base,) = self._run(cfg, params, prompts)
+        # the prefix-only arm rides the warm-cache test's cold pass;
+        # chunk 3 is the page-misaligned chunk boundary
+        for kw in ({"prefill_chunk_tokens": 4},
+                   {"prefill_chunk_tokens": 3},
+                   {"prefix_cache": True, "prefill_chunk_tokens": 4}):
+            _, (got,) = self._run(cfg, params, prompts, **kw)
+            assert got == base, f"tokens diverged with {kw}"
+
+    def test_warm_cache_identity_stats_and_page_conservation(self, rng_np):
+        cfg, params, prompts = self._setup(rng_np)
+        _, (base,) = self._run(cfg, params, prompts)
+        reg = MetricsRegistry("serve_prefix")
+        sink = MemorySink()
+        reg.add_sink(sink)
+        eng, (cold, warm) = self._run(cfg, params, prompts, registry=reg,
+                                      repeats=2, prefix_cache=True)
+        assert cold == base and warm == base
+        p = eng.cache.prefix
+        # warm round: 4 prompts share an 8-token (2-page) head; the
+        # 3-token prompt has no full page to match
+        assert p.hits >= 4 and p.hit_tokens >= 4 * 8
+        assert reg.counter("serve_prefix_hit_tokens").value() >= 4 * 8
+        assert reg.counter("serve_prefill_flops_saved").value() > 0
+        # refcounted conservation: free + unique == pool - 1, with
+        # cached pages resident and reclaimable after all releases
+        rep = eng.cache.resident_report()
+        assert rep["free_pages"] + rep["unique_pages"] == 63
+        assert rep["cached_pages"] > 0
+        assert rep["reclaimable_pages"] == rep["cached_pages"]
+        # serve records carry the /14 fields
+        serves = [r for r in sink.records if r.get("kind") == "serve"]
+        assert sum(r["cached_tokens"] for r in serves) == p.hit_tokens
+        eng.emit_summary()
+        summ = [r for r in sink.records
+                if r.get("kind") == "serve_summary"][-1]
+        pre = summ["prefix"]
+        assert pre["hit_tokens"] == p.hit_tokens
+        assert 0.0 < pre["hit_rate"] <= 1.0
+        assert pre["cached_pages"] == p.cached_pages
+        assert pre["flops_saved"] > 0
+
+    def test_chunked_prefill_interleaves_with_decode(self, rng_np):
+        """A long prompt admitted behind a decoding sequence advances
+        chunk-by-chunk while the resident sequence keeps decoding —
+        TTFT for the long prompt no longer blocks the decode stream."""
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(3))
+        short = list(rng_np.integers(1, 64, size=4))
+        long_p = list(rng_np.integers(1, 64, size=16))
+        reg = MetricsRegistry("serve_chunk")
+        sink = MemorySink()
+        reg.add_sink(sink)
+        eng = ServingEngine(cfg, params, ServingConfig(
+            max_slots=2, page_size=4, num_pages=64, max_prompt_len=16,
+            max_new_tokens=6, prefill_batch=2, seed=0,
+            prefill_chunk_tokens=4), registry=reg)
+        eng.submit(short, max_new_tokens=6, temperature=0.0)
+        eng.step()  # short's first chunk == its whole prompt
+        eng.submit(long_p, max_new_tokens=6, temperature=0.0)
+        interleaved = 0
+        for _ in range(30):
+            if not eng.step():
+                break
+            live = {a.request.id: a for a in eng.scheduler.live}
+            if (0 in live and live[0].generated
+                    and 1 in live and not live[1].generated):
+                interleaved += 1
+        assert interleaved > 0, "decode never ran beside a mid-prefill row"
+        res = {r.id: r.tokens for r in eng.results()}
+        # chunk accounting: the long prompt took ceil(16/4) = 4 passes
+        serves = [r for r in sink.records if r.get("kind") == "serve"]
+        chunks = {r["request"]: r["prefill_chunks"] for r in serves}
+        assert chunks[1] == 4 and chunks[0] == 1
+        assert reg.counter("serve_prefill_chunks").value() >= 5.0
+        # identity vs the whole-prompt engine
+        eng2 = ServingEngine(cfg, params, ServingConfig(
+            max_slots=2, page_size=4, num_pages=64, max_prompt_len=16,
+            max_new_tokens=6, prefill_batch=2, seed=0))
+        eng2.submit(short, max_new_tokens=6, temperature=0.0)
+        eng2.submit(long_p, max_new_tokens=6, temperature=0.0)
+        eng2.run_until_idle()
+        ref = {r.id: r.tokens for r in eng2.results()}
+        assert res == ref
+
+    def test_admission_under_pressure_evicts_cached_prefixes(self, rng_np):
+        """A warm cache under page pressure: LRU cached prefixes are
+        reclaimed instead of blocking admissions, OutOfPages never
+        surfaces while reclaimable pages exist, and every request
+        completes."""
+        cfg = small_cfg()
+        params = T.init_params(cfg, jax.random.key(3))
+        heads = [list(rng_np.integers(1, 64, size=8)) for _ in range(3)]
+        prompts = [h + list(rng_np.integers(1, 64, size=2))
+                   for h in heads for _ in range(2)]
+        # pool of 11 usable pages; each request reserves
+        # ceil((10 + 4)/4) = 4; three 2-page prefixes want caching, so
+        # a full cache (6 pages) + two active rows (8, minus shared
+        # heads) overflows the pool and forces LRU reclaim
+        reg = MetricsRegistry("serve_evict")
+        eng = ServingEngine(cfg, params, ServingConfig(
+            max_slots=2, page_size=4, num_pages=12, max_prompt_len=16,
+            max_new_tokens=4, prefill_batch=2, seed=0,
+            prefix_cache=True), registry=reg)
+        results = eng.generate(prompts, max_new_tokens=4,
+                               temperature=0.0)
+        assert len(results) == 6
+        assert all(len(r.tokens) == 4 for r in results)
+        p = eng.cache.prefix
+        assert p.evictions > 0, "pressure never reclaimed a cached page"
+        rep = eng.cache.resident_report()
+        assert rep["free_pages"] + rep["unique_pages"] == 11
+        # identical tokens with the cache off
+        eng2 = ServingEngine(cfg, params, ServingConfig(
+            max_slots=2, page_size=4, num_pages=12, max_prompt_len=16,
+            max_new_tokens=4, prefill_batch=2, seed=0))
+        ref = eng2.generate(prompts, max_new_tokens=4, temperature=0.0)
+        assert [r.tokens for r in results] == [r.tokens for r in ref]
+
+    def test_serving_memory_report_counts_unique_resident_bytes(
+            self, rng_np):
+        from paddle_tpu.analysis.memory import serving_memory_report
+
+        cfg, params, prompts = self._setup(rng_np, n_prompts=3)
+        scfg = ServingConfig(max_slots=4, page_size=4, num_pages=64,
+                             max_prompt_len=16, max_new_tokens=6,
+                             prefill_batch=4, seed=0, prefix_cache=True)
+        eng = ServingEngine(cfg, params, scfg)
+        eng.generate(prompts, temperature=0.0)  # populate the cache
+        rep = serving_memory_report(cfg, scfg, cache=eng.cache)
+        page_bytes = rep["page_bytes"]
+        assert page_bytes * scfg.num_pages == rep["kv_pool_bytes"]
+        assert rep["unique_resident_bytes"] == (
+            rep["unique_pages"] * page_bytes)
+        assert rep["cached_pages"] > 0
+        # all slots idle: unique resident == cached pages exactly
+        assert rep["unique_pages"] == rep["cached_pages"]
+        assert rep["free_pages"] + rep["unique_pages"] == 63
 
 
 class TestStrictInference:

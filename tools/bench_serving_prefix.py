@@ -1,0 +1,202 @@
+"""Per-token serving cost ablation: prefix caching + chunked prefill
+through the paged KV engine, measured at FLEET level.
+
+Workload A (cache ablation): a shared-system-prompt trace — every
+request is the SAME 48-token system prompt plus a unique ragged tail,
+the dominant production shape prefix caching targets.  Both arms run
+the identical 2-replica fleet, trace, arrival times and sampling seed
+with chunked prefill on; the only difference is ``--prefix_cache``.
+Cache-on maps the resident system-prompt pages into each new request's
+page table and prefills only the tail, so the row reports the
+recompute-FLOPs-saved fraction (deterministic, from the
+``serve_prefill_flops_saved`` counter) next to the wall p99 TTFT at the
+same offered QPS.  Greedy tokens must be byte-identical across arms AND
+against the flags-off engine (today's trajectory).
+
+Workload B (chunking row): a long-prompt + short-prompt mix replayed
+with ``--prefill_chunk_tokens`` off and on — chunking interleaves the
+long prompt's prefill with resident decode steps instead of stalling
+them behind one monolithic pass.
+
+Standalone: ``python tools/bench_serving_prefix.py [--long]`` (CPU-safe:
+the jnp paged paths serve; Pallas is the TPU fast path).  ``bench.py``
+shells out to this script so the rows ride the normal bench stream.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _repo not in sys.path:
+        sys.path.insert(0, _repo)
+
+import numpy as np
+
+SYSTEM_PROMPT_LEN = 48  # 3 full pages of 16 — the shareable head
+
+
+def make_shared_prefix_trace(n_requests: int, seed: int = 0,
+                             rate_per_s: float = 120.0):
+    """(prompt, max_new, arrival_s): one fixed system prompt + unique
+    ragged tails, Poisson arrivals."""
+    rng = np.random.default_rng(seed)
+    head = rng.integers(1, 255, size=SYSTEM_PROMPT_LEN).tolist()
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, size=n_requests))
+    out = []
+    for i in range(n_requests):
+        tail = rng.integers(1, 255, size=int(rng.integers(4, 13))).tolist()
+        out.append((head + tail, int(rng.integers(4, 17)),
+                    float(arrivals[i])))
+    return out
+
+
+def make_long_prompt_trace(n_requests: int, seed: int = 0,
+                           rate_per_s: float = 60.0):
+    """Alternating long (96-token) and short prompts — the shape where
+    a monolithic prefill stalls the decode stream."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_per_s, size=n_requests))
+    out = []
+    for i in range(n_requests):
+        plen = 96 if i % 2 == 0 else int(rng.integers(4, 17))
+        prompt = rng.integers(1, 255, size=plen).tolist()
+        out.append((prompt, int(rng.integers(4, 13)), float(arrivals[i])))
+    return out
+
+
+def run_fleet_mode(cfg, params, trace, seed: int = 0, n_replicas: int = 2,
+                   **scfg_kw):
+    """Replay the trace (real sleeps) through a local fleet; returns
+    (tokens_per_sec, p99_ttft_ms, results, registry)."""
+    from paddle_tpu.serving.fleet import build_local_fleet
+    from paddle_tpu.serving.scheduler import ServingConfig
+    from paddle_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry("bench_serving_prefix")
+    scfg = ServingConfig(
+        max_slots=4, page_size=16, num_pages=128, max_prompt_len=112,
+        max_new_tokens=16, prefill_batch=4, seed=seed, **scfg_kw)
+    router = build_local_fleet(cfg, params, scfg, n=n_replicas,
+                               registry=reg)
+    # pay every compile signature before timing; a 3-token prompt has
+    # no full page, so nothing lands in the prefix cache
+    for rep in router.replicas:
+        rep.engine.generate([[255, 255, 255]] * 2, max_new_tokens=2)
+
+    t0 = time.perf_counter()
+    for prompt, max_new, arrival in trace:
+        while time.perf_counter() - t0 < arrival:
+            if not router.pump():
+                time.sleep(2e-4)
+        router.submit(prompt, max_new_tokens=max_new, temperature=0.0)
+    router.run_until_idle()
+    elapsed = time.perf_counter() - t0
+    results = sorted(router.results(), key=lambda r: r.id)
+    total = sum(len(r.tokens) for r in results)
+    ttfts = sorted(r.metrics["ttft_ms"] for r in results)
+    p99 = ttfts[min(int(round(0.99 * (len(ttfts) - 1))), len(ttfts) - 1)]
+    return total / elapsed, p99, results, reg
+
+
+def _tokens(results):
+    return [r.tokens for r in results]
+
+
+def run_bench(n_requests: int = 24, seed: int = 0,
+              pairs: int = 3) -> list[dict]:
+    import jax
+
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, embed_dim=64,
+        mlp_dim=128, max_seq_len=160, remat=False)
+    params = T.init_params(cfg, jax.random.key(seed))
+    param_count = sum(int(x.size) for x in jax.tree.leaves(params))
+
+    # ---- workload A: shared system prompt, cache on vs off ----------------
+    trace = make_shared_prefix_trace(n_requests, seed=seed)
+    # flags-off identity reference (today's monolithic-prefill path)
+    _, _, plain_res, _ = run_fleet_mode(cfg, params, trace, seed=seed)
+    runs = [(run_fleet_mode(cfg, params, trace, seed=seed,
+                            prefix_cache=True, prefill_chunk_tokens=16),
+             run_fleet_mode(cfg, params, trace, seed=seed,
+                            prefill_chunk_tokens=16))
+            for _ in range(pairs)]
+    # median pair by TTFT ratio (both runs of a pair share background
+    # load; the FLOPs split is deterministic across pairs)
+    runs.sort(key=lambda ab: ab[0][1] / max(ab[1][1], 1e-9))
+    ((on_tps, on_p99, on_res, on_reg),
+     (off_tps, off_p99, off_res, off_reg)) = runs[len(runs) // 2]
+
+    same = (_tokens(on_res) == _tokens(off_res) == _tokens(plain_res))
+    prompt_tokens = sum(r.metrics["prompt_tokens"] for r in on_res)
+    total_prefill_flops = 2.0 * param_count * prompt_tokens
+    flops_saved = on_reg.counter("serve_prefill_flops_saved").value()
+    saved_frac = flops_saved / max(total_prefill_flops, 1e-9)
+    hit_tokens = int(on_reg.counter("serve_prefix_hit_tokens").value())
+
+    base_cfg = (f"2L/64d transformer, 2-replica fleet, {n_requests} "
+                f"Poisson arrivals, {SYSTEM_PROMPT_LEN}-token shared "
+                f"system prompt, page 16, chunk 16")
+    rows = [
+        {"metric": "serving_prefix_cache_on_tokens_per_sec",
+         "value": round(on_tps, 1), "unit": "tok/s",
+         "p99_ttft_ms": round(on_p99, 1), "hit_tokens": hit_tokens,
+         "config": base_cfg + ", prefix_cache on", "vs_baseline": 0},
+        {"metric": "serving_prefix_cache_off_tokens_per_sec",
+         "value": round(off_tps, 1), "unit": "tok/s",
+         "p99_ttft_ms": round(off_p99, 1),
+         "config": base_cfg + ", prefix_cache off", "vs_baseline": 0},
+        {"metric": "serving_prefix_cache_prefill_flops_saved",
+         "value": round(saved_frac * 100.0, 1), "unit": "%",
+         "hit_tokens": hit_tokens, "prompt_tokens": prompt_tokens,
+         "p99_ttft_ratio_off_over_on":
+             round(off_p99 / max(on_p99, 1e-9), 2),
+         "tokens_identical": bool(same),
+         "config": base_cfg, "vs_baseline": 0},
+    ]
+
+    # ---- workload B: long prompts, chunking off vs on ---------------------
+    ltrace = make_long_prompt_trace(max(n_requests // 2, 8), seed=seed)
+    lruns = [(run_fleet_mode(cfg, params, ltrace, seed=seed,
+                             prefill_chunk_tokens=32),
+              run_fleet_mode(cfg, params, ltrace, seed=seed))
+             for _ in range(pairs)]
+    lruns.sort(key=lambda ab: ab[0][1] / max(ab[1][1], 1e-9))
+    ((ck_tps, ck_p99, ck_res, _),
+     (mono_tps, mono_p99, mono_res, _)) = lruns[len(lruns) // 2]
+    lsame = _tokens(ck_res) == _tokens(mono_res)
+    lcfg = ("2L/64d transformer, 2-replica fleet, alternating 96-token/"
+            "short prompts, page 16")
+    rows.append(
+        {"metric": "serving_chunked_prefill_p99_ttft_ms",
+         "value": round(ck_p99, 1), "unit": "ms",
+         "monolithic_p99_ttft_ms": round(mono_p99, 1),
+         "chunked_tokens_per_sec": round(ck_tps, 1),
+         "monolithic_tokens_per_sec": round(mono_tps, 1),
+         "tokens_identical": bool(lsame),
+         "config": lcfg + ", chunk 32 vs whole-prompt",
+         "vs_baseline": 0})
+    return rows
+
+
+def main() -> None:
+    long = "--long" in sys.argv
+    rows = (run_bench(n_requests=48, pairs=3) if long
+            else run_bench(n_requests=24))
+    from paddle_tpu.telemetry import JsonlSink, MetricsRegistry
+
+    reg = MetricsRegistry("bench_serving_prefix")
+    reg.add_sink(JsonlSink(sys.stdout))
+    for r in rows:
+        reg.emit(r, kind="bench")
+
+
+if __name__ == "__main__":
+    main()

@@ -326,7 +326,15 @@ def serving_table(serves: list[dict], summaries: list[dict]) -> None:
     print("\n## Serving latency\n")
     if serves:
         toks = sum(r.get("new_tokens", 0) for r in serves)
-        print(f"**{len(serves)} requests** · {toks} generated tokens\n")
+        cached = sum(r.get("cached_tokens", 0) for r in serves)
+        chunks = sum(r.get("prefill_chunks", 0) for r in serves)
+        extra = ""
+        if cached:
+            extra += f" · {cached} prompt tokens from prefix cache"
+        if chunks:
+            extra += f" · {chunks} prefill chunks"
+        print(f"**{len(serves)} requests** · {toks} generated "
+              f"tokens{extra}\n")
         print("| metric | count | p50 ms | p99 ms | max ms |")
         print("|---|---|---|---|---|")
         for field, label in (("ttft_ms", "TTFT"), ("tpot_ms", "TPOT"),
@@ -347,6 +355,23 @@ def serving_table(serves: list[dict], summaries: list[dict]) -> None:
                 print(f"| {name} | {h.get('count', '-')} "
                       f"| {_fmt(h.get('p50'))} | {_fmt(h.get('p99'))} "
                       f"| {_fmt(h.get('max'))} |")
+        p = s.get("prefix")
+        if p:
+            print("\n_prefix cache (schema /14):_\n")
+            print("| prefix_hit_rate | hit tokens | prompt tokens "
+                  "| prefill_chunks | evictions | cached pages "
+                  "| recompute FLOPs saved |")
+            print("|---|---|---|---|---|---|---|")
+            print(f"| {p.get('hit_rate', 0):.2%} "
+                  f"| {p.get('hit_tokens', 0)} "
+                  f"| {p.get('prompt_tokens', 0)} "
+                  f"| {s.get('prefill_chunks', 0)} "
+                  f"| {p.get('evictions', 0)} "
+                  f"| {p.get('cached_pages', 0)} "
+                  f"| {p.get('flops_saved', 0):,.3g} |")
+        elif s.get("prefill_chunks"):
+            print(f"\n_{s['prefill_chunks']} incremental prefill "
+                  "passes (chunked prefill on, prefix cache off)._")
         if s.get("rejected_admissions"):
             print(f"\n_⚠ {s['rejected_admissions']} admission attempts "
                   "blocked on pages/budget — requests queued while the "
