@@ -55,6 +55,9 @@ THREADED_MODULES = (
     "paddle_tpu/serving/health.py",
     "paddle_tpu/resilience/elastic.py",
     "paddle_tpu/resilience/supervisor.py",
+    "paddle_tpu/deploy/controller.py",
+    "paddle_tpu/deploy/autoscaler.py",
+    "paddle_tpu/deploy/arbiter.py",
     "paddle_tpu/trainer/checkpoint.py",
     "paddle_tpu/telemetry/tracing.py",
     "paddle_tpu/telemetry/introspect.py",

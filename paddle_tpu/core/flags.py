@@ -214,6 +214,9 @@ define("checkpoint_batch_period", 0, "also checkpoint every N batches "
                                      "mid-pass (0 = per-pass only); the "
                                      "manifest cursor lets resume replay "
                                      "from the exact batch boundary")
+define("checkpoint_keep", 3, "retention GC: keep the newest N checkpoints "
+                             "(0 = keep everything); the newest VALID one "
+                             "and any pinned mid-export are never deleted")
 define("chaos", "", "deterministic fault-injection schedule, e.g. "
                     "'reader_error@3,nan@5,sigterm@7' (see "
                     "resilience/chaos.py; TESTING ONLY)")

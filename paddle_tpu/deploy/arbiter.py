@@ -1,0 +1,120 @@
+"""PoolArbiter — one accelerator pool, two tenants.
+
+Training and serving traditionally own disjoint hardware, so the
+diurnal serving curve strands capacity: peak traffic sheds while
+trainer hosts idle, and overnight the serving fleet idles while the
+trainer is compute-bound.  The arbiter makes the pool elastic in both
+directions over machinery that already exists:
+
+- **borrow** (:meth:`acquire_serving_host`): serving pressure moves one
+  host from the training mesh to the serving fleet.  The trainer side
+  is a planned shrink through
+  :meth:`~paddle_tpu.resilience.elastic.ElasticCoordinator.
+  post_host_loss` — the trainer drains to a batch boundary, reshards
+  its data-parallel degree down, and keeps stepping; never below
+  ``min_trainer_hosts``.
+- **return** (:meth:`release_serving_host`): sustained serving idle
+  gives the host back via ``post_scale_up`` (reshard up at the next
+  boundary).
+
+The arbiter only does bookkeeping + coordinator posts; actually
+starting/retiring the serving replica is the caller's job (the
+autoscaler drives both ends).  Every shift lands in the ledger and as a
+``kind="autoscale"`` record (``pool_borrow`` / ``pool_return``) so the
+bench can plot the pool sloshing against the QPS ramp.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from paddle_tpu.core import logger as log
+from paddle_tpu.core.enforce import enforce
+
+
+class PoolArbiter:
+    """See the module doc.  ``elastic`` is an optional
+    :class:`ElasticCoordinator`; without one the arbiter still
+    arbitrates counts (serving-only pools)."""
+
+    def __init__(self, total_hosts: int, serving_hosts: int = 1,
+                 min_trainer_hosts: int = 1, elastic=None,
+                 devices_per_host: int = 1, registry=None):
+        from paddle_tpu import metrics as metrics_mod
+
+        enforce(total_hosts >= 1, f"empty pool: total_hosts {total_hosts}")
+        enforce(0 <= serving_hosts <= total_hosts,
+                f"serving_hosts {serving_hosts} outside the pool of "
+                f"{total_hosts}")
+        enforce(min_trainer_hosts >= 0,
+                f"negative min_trainer_hosts {min_trainer_hosts}")
+        self.total_hosts = total_hosts
+        self.min_trainer_hosts = min_trainer_hosts
+        self.devices_per_host = devices_per_host
+        self.elastic = elastic
+        self.registry = registry or metrics_mod.get_registry()
+        # pool split: mutated by acquire/release from autoscaler and
+        # API threads — every access holds _lock (GL-THREAD)
+        self._lock = threading.Lock()
+        self._serving_hosts = serving_hosts
+        self._shifts: list[dict] = []
+
+    def acquire_serving_host(self, reason: str) -> bool:
+        """Borrow one host from the training mesh for serving.  Returns
+        ``False`` (no side effects) when the trainer is at its floor —
+        the autoscaler holds instead of scaling."""
+        with self._lock:
+            trainer = self.total_hosts - self._serving_hosts
+            if trainer <= self.min_trainer_hosts:
+                return False
+            self._serving_hosts += 1
+            trainer -= 1
+        self._shift("pool_borrow", trainer, reason)
+        if self.elastic is not None:
+            self.elastic.post_host_loss(
+                new_data_parallel=max(trainer * self.devices_per_host, 1),
+                reason=f"pool arbiter: serving borrow ({reason})")
+        return True
+
+    def release_serving_host(self, reason: str) -> bool:
+        """Give one serving host back to the training mesh.  Returns
+        ``False`` when serving holds no borrowable host."""
+        with self._lock:
+            if self._serving_hosts <= 0:
+                return False
+            self._serving_hosts -= 1
+            trainer = self.total_hosts - self._serving_hosts
+        self._shift("pool_return", trainer, reason)
+        if self.elastic is not None:
+            self.elastic.post_scale_up(
+                new_data_parallel=trainer * self.devices_per_host,
+                reason=f"pool arbiter: serving return ({reason})")
+        return True
+
+    def _shift(self, event: str, trainer_hosts: int, reason: str) -> None:
+        from paddle_tpu.telemetry import safe_inc
+
+        rec = {"event": event, "reason": reason,
+               "trainer_hosts": trainer_hosts,
+               "serving_hosts": self.total_hosts - trainer_hosts}
+        with self._lock:
+            self._shifts.append(dict(rec))
+        safe_inc("pool_shifts", "hosts moved between training and "
+                 "serving", registry=self.registry, event=event)
+        log.info("pool arbiter: %s (%s) — trainer %d / serving %d",
+                 event, reason, trainer_hosts, rec["serving_hosts"])
+        if self.registry.active:
+            self.registry.emit(rec, kind="autoscale")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            serving = self._serving_hosts
+        return {"total_hosts": self.total_hosts,
+                "serving_hosts": serving,
+                "trainer_hosts": self.total_hosts - serving,
+                "min_trainer_hosts": self.min_trainer_hosts}
+
+    def shifts(self) -> list[dict]:
+        """Every pool shift, in order — the diurnal-curve evidence."""
+        with self._lock:
+            return [dict(s) for s in self._shifts]

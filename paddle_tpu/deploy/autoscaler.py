@@ -1,0 +1,346 @@
+"""SLO autoscaler — replica count tracks offered load.
+
+The Gemma-on-TPU serving study (PAPERS arxiv 2605.25645) shows the
+QPS/SLO/cost frontier is only reachable when replica count follows
+offered load; a fixed fleet either sheds at peak or burns accelerators
+at trough.  :class:`SloAutoscaler` closes that loop over the
+:class:`~paddle_tpu.serving.router.FleetRouter`: each control round
+folds the fleet's observed signals — p99 TTFT, queue depth, shed
+counters, the free-KV-page watermark (the same rollup shape
+``scrape_replicas`` produces for subprocess fleets; in-process fleets
+read the router's books directly via :func:`rollup_from_router`) —
+through a **hysteresis-banded** :class:`AutoscalePolicy`:
+
+- **scale up fast**: ANY signal crossing its HIGH edge (p99 over SLO, a
+  shed since the last round, queue depth per replica at the admission
+  edge, free pages under the watermark) adds a replica after a short
+  ``cooldown_up_s``, via :meth:`FleetRouter.add_replica` — the newcomer
+  clones a survivor's served weights, so it joins on the current
+  servable;
+- **scale down slow**: only when EVERY signal sits below its LOW edge
+  (a strictly lower band — the hysteresis gap keeps a load hovering at
+  one edge from flapping the fleet) for ``idle_hold_s`` sustained
+  seconds, and ``cooldown_down_s`` has passed, the least-loaded victim
+  is retired via :meth:`FleetRouter.remove_replica` — its in-flight
+  work re-queues through the failover path, so scale-down never loses
+  a request;
+- **clamped**: never below ``min_replicas`` or above ``max_replicas``;
+  with a :class:`~paddle_tpu.deploy.arbiter.PoolArbiter` attached,
+  scale-up must first borrow a host from the training mesh (and
+  scale-down returns it) — the one-pool story.
+
+Deterministic by construction: decisions are a pure function of the
+(rollup, clock) stream — the injectable ``clock`` makes the policy
+edge/cooldown tests wall-clock-free, and the same probe trace replays
+the same action sequence (asserted in ``tests/test_deploy.py``).
+
+One ``kind="autoscale"`` record per ACTION (scale_up / scale_down,
+with the triggering signals and the apply latency ``scale_ms``);
+holds are returned to the caller but not emitted — a quiet fleet must
+not flood the stream.  A background ``start()`` loop follows the
+serving crash contract: a loop death is stored, counted
+(``serve_loop_crashes``) and re-raised from the next :meth:`step`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from paddle_tpu.core import logger as log
+from paddle_tpu.core.enforce import enforce
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """The hysteresis band edges and pacing knobs (pure data — the
+    decision procedure lives in :meth:`SloAutoscaler.step`).
+
+    A zero on any ``up_*`` edge disables that breach signal; the shed
+    counter is always armed (a shed IS the SLO saying no).  The down
+    band must sit strictly below the up band — ``__post_init__``
+    enforces the gap, because an inverted or touching band turns
+    hysteresis into oscillation."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # HIGH edges: breach any → scale up (fast)
+    up_p99_ttft_ms: float = 0.0        # p99 TTFT above this = breach
+    up_queue_per_replica: float = 4.0  # pending+inflight per alive replica
+    up_free_page_frac: float = 0.0     # fleet free pages BELOW this = breach
+    # LOW edges: all must hold (sustained) → scale down (slow)
+    down_p99_ttft_ms: float = 0.0      # 0 = ignore p99 for idleness
+    down_queue_per_replica: float = 0.5
+    idle_hold_s: float = 5.0           # sustained idle before a down
+    # pacing
+    cooldown_up_s: float = 1.0
+    cooldown_down_s: float = 5.0
+
+    def __post_init__(self):
+        enforce(1 <= self.min_replicas <= self.max_replicas,
+                f"replica clamp inverted: min {self.min_replicas} > "
+                f"max {self.max_replicas}")
+        enforce(self.down_queue_per_replica < self.up_queue_per_replica,
+                "hysteresis band inverted: down_queue_per_replica "
+                f"{self.down_queue_per_replica} must sit strictly below "
+                f"up_queue_per_replica {self.up_queue_per_replica}")
+        if self.up_p99_ttft_ms and self.down_p99_ttft_ms:
+            enforce(self.down_p99_ttft_ms < self.up_p99_ttft_ms,
+                    "hysteresis band inverted: down_p99_ttft_ms "
+                    f"{self.down_p99_ttft_ms} must sit strictly below "
+                    f"up_p99_ttft_ms {self.up_p99_ttft_ms}")
+
+
+def rollup_from_router(router) -> dict:
+    """The autoscaler's signal rollup read straight from an in-process
+    router's books + last probe round — the same shape
+    :func:`rollup_from_scrape` builds for subprocess fleets."""
+    s = router.stats()
+    probes = router.last_probes()
+    free = sum(p.free_pages for p in probes)
+    cap = sum(p.total_pages for p in probes)
+    h = router.registry.get("serve_ttft_ms")
+    p99 = h.percentile(99) if h is not None else None
+    return {
+        "p99_ttft_ms": p99,
+        "queue_depth": s["pending"] + s["inflight"],
+        "shed": s["shed"],
+        "alive": s["alive_replicas"],
+        "free_page_frac": (free / cap) if cap else None,
+    }
+
+
+def rollup_from_scrape(router, urls: list[str], timeout: float = 5.0,
+                       retry=None) -> dict:
+    """Signal rollup for a subprocess fleet: fold the replicas'
+    ``/metrics`` endpoints through :meth:`FleetRouter.scrape_replicas`
+    (retry-once + ``fleet_scrape_errors`` accounting included) into the
+    policy's signal shape.  Signals a text scrape cannot carry (p99
+    TTFT percentiles, pool capacity) come back ``None`` — the policy
+    treats an absent signal as no-signal, so queue depth and shed
+    counters still drive the band."""
+    r = router.scrape_replicas(urls, timeout=timeout, retry=retry)
+    totals = r.get("totals", {})
+    return {
+        "p99_ttft_ms": None,
+        "queue_depth": int(totals.get(
+            "fleet_queue_depth", r.get("serve_active_slots", 0.0))),
+        "shed": int(totals.get("fleet_shed", 0.0)),
+        "alive": int(r.get("replicas_scraped", 0)),
+        "free_page_frac": None,
+        "scrape_errors": len(r.get("scrape_errors", {})),
+    }
+
+
+def _decide(p: AutoscalePolicy, now: float, sig: dict,
+            last_action_t: float | None, idle_since: float | None,
+            seen_shed: int):
+    """The banded decision: a pure function of (policy, signals, clock,
+    control state) → ``(action, reason, idle_since', seen_shed')`` —
+    no clock reads, no I/O, so the same (rollup, clock) stream replays
+    the same action sequence."""
+    alive = max(int(sig.get("alive") or 0), 0)
+    per = (sig.get("queue_depth", 0) / alive) if alive else float("inf")
+    p99 = sig.get("p99_ttft_ms")
+    frac = sig.get("free_page_frac")
+    shed = int(sig.get("shed") or 0)
+    shed_delta = shed - seen_shed
+    seen_shed = max(shed, seen_shed)
+
+    breach = None
+    if shed_delta > 0:
+        breach = f"{shed_delta} request(s) shed since last round"
+    elif p.up_p99_ttft_ms and p99 is not None and p99 > p.up_p99_ttft_ms:
+        breach = f"p99 TTFT {p99:.1f}ms over SLO {p.up_p99_ttft_ms}ms"
+    elif alive and per >= p.up_queue_per_replica:
+        breach = (f"queue depth {per:.1f}/replica at the admission "
+                  f"edge {p.up_queue_per_replica}")
+    elif p.up_free_page_frac and frac is not None \
+            and frac < p.up_free_page_frac:
+        breach = (f"free KV pages {frac:.0%} under watermark "
+                  f"{p.up_free_page_frac:.0%}")
+
+    idle = (per <= p.down_queue_per_replica
+            and (not p.down_p99_ttft_ms or p99 is None
+                 or p99 < p.down_p99_ttft_ms))
+
+    if breach is not None:
+        if alive >= p.max_replicas:
+            return ("hold", f"{breach}; clamped at max_replicas "
+                            f"{p.max_replicas}", None, seen_shed)
+        if last_action_t is not None \
+                and now - last_action_t < p.cooldown_up_s:
+            return ("hold", f"{breach}; in cooldown "
+                            f"({p.cooldown_up_s}s)", None, seen_shed)
+        return "scale_up", breach, None, seen_shed
+    if not idle:
+        return "hold", "inside the hysteresis band", None, seen_shed
+    if idle_since is None:
+        idle_since = now
+    held = now - idle_since
+    if alive <= p.min_replicas:
+        return ("hold", f"idle but clamped at min_replicas "
+                        f"{p.min_replicas}", idle_since, seen_shed)
+    if held < p.idle_hold_s:
+        return ("hold", f"idle {held:.1f}s < hold {p.idle_hold_s}s",
+                idle_since, seen_shed)
+    if last_action_t is not None \
+            and now - last_action_t < p.cooldown_down_s:
+        return ("hold", f"idle but in cooldown ({p.cooldown_down_s}s)",
+                idle_since, seen_shed)
+    return "scale_down", f"idle {held:.1f}s sustained", idle_since, seen_shed
+
+
+class SloAutoscaler:
+    """See the module doc.  ``factory`` builds new replicas for
+    ``add_replica`` (default: :func:`~paddle_tpu.serving.fleet.
+    clone_replica` with the router's registry); ``rollup`` supplies the
+    signal dict per round (default: :func:`rollup_from_router`);
+    ``arbiter`` gates scale-up on pool capacity."""
+
+    def __init__(self, router, policy: AutoscalePolicy | None = None,
+                 factory=None, arbiter=None, registry=None,
+                 clock=time.monotonic, rollup=None):
+        from paddle_tpu import metrics as metrics_mod
+
+        self.router = router
+        self.policy = policy or AutoscalePolicy()
+        self.arbiter = arbiter
+        self.registry = registry or getattr(
+            router, "registry", None) or metrics_mod.get_registry()
+        self._clock = clock
+        self._rollup = rollup or (lambda: rollup_from_router(router))
+        if factory is None:
+            from paddle_tpu.serving.fleet import clone_replica
+
+            def factory(index, source):
+                return clone_replica(index, source,
+                                     registry=self.registry)
+        self._factory = factory
+        # control state: read/written by step() from both the public
+        # API and the background loop thread — every access holds _lock
+        # (the GL-THREAD audited contract)
+        self._lock = threading.Lock()
+        self._last_action_t: float | None = None
+        self._idle_since: float | None = None
+        self._seen_shed = 0
+        self._actions: list[dict] = []
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._loop_error: BaseException | None = None
+
+    # -- the control round -----------------------------------------------------
+    def step(self) -> dict:
+        """One control round: read the rollup, decide through the band,
+        apply the action.  Returns the round's record (``event`` is
+        ``scale_up`` / ``scale_down`` / ``hold``).  Raises when the
+        background loop has crashed — a dead autoscaler must fail the
+        caller, not silently hold forever."""
+        err = self._loop_error_now()
+        if err is not None:
+            raise RuntimeError(
+                "autoscaler loop crashed; step refused") from err
+        sig = self._rollup()
+        now = self._clock()
+        with self._lock:
+            state = (self._last_action_t, self._idle_since,
+                     self._seen_shed)
+            action, reason, idle_since, seen_shed = _decide(
+                self.policy, now, sig, *state)
+            self._idle_since = idle_since
+            self._seen_shed = seen_shed
+        rec = {
+            "event": action, "reason": reason,
+            "alive": sig.get("alive"),
+            "queue_depth": sig.get("queue_depth"),
+            "p99_ttft_ms": sig.get("p99_ttft_ms"),
+            "free_page_frac": sig.get("free_page_frac"),
+        }
+        if action == "scale_up":
+            if self.arbiter is not None and \
+                    not self.arbiter.acquire_serving_host(reason):
+                rec.update(event="hold",
+                           reason=f"{reason}; pool exhausted — trainer "
+                                  "at its floor")
+                return rec
+            t0 = time.perf_counter()
+            idx = self.router.add_replica(self._factory)
+            rec.update(replica=idx,
+                       scale_ms=round((time.perf_counter() - t0) * 1e3, 2))
+            self._applied(now, rec)
+        elif action == "scale_down":
+            victim = self.router.pick_victim()
+            if victim is None:
+                rec.update(event="hold", reason="no retirable replica")
+                return rec
+            t0 = time.perf_counter()
+            out = self.router.remove_replica(
+                victim, reason=f"autoscaler: {reason}")
+            rec.update(replica=victim, requeued=out["requeued"],
+                       scale_ms=round((time.perf_counter() - t0) * 1e3, 2))
+            if self.arbiter is not None:
+                self.arbiter.release_serving_host(reason)
+            self._applied(now, rec)
+        return rec
+
+    # _decide lives at module level: a pure function of (policy,
+    # signals, clock, control state), so the same stream replays the
+    # same action sequence — and the lock discipline stays visible in
+    # step() where the state is read and written
+
+    def _applied(self, now: float, rec: dict) -> None:
+        from paddle_tpu.telemetry import safe_inc
+
+        with self._lock:
+            self._last_action_t = now
+            self._idle_since = None
+            self._actions.append(dict(rec))
+        safe_inc("autoscale_actions", "autoscaler scale actions taken",
+                 registry=self.registry, action=rec["event"])
+        log.info("autoscaler: %s replica %s (%s)", rec["event"],
+                 rec.get("replica"), rec["reason"])
+        if self.registry.active:
+            self.registry.emit(dict(rec), kind="autoscale")
+
+    def history(self) -> list[dict]:
+        """Every action taken (scale_up/scale_down), in order — the
+        determinism tests compare two runs' histories."""
+        with self._lock:
+            return [dict(a) for a in self._actions]
+
+    # -- background loop (the crash contract) ----------------------------------
+    def start(self, poll_s: float = 0.25) -> None:
+        enforce(self._thread is None, "autoscaler already started")
+        with self._lock:
+            self._loop_error = None
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(poll_s,), name="slo-autoscaler",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+
+    def _loop(self, poll_s: float) -> None:
+        try:
+            while not self._stop.wait(poll_s):
+                self.step()
+        except BaseException as e:
+            with self._lock:
+                self._loop_error = e
+            from paddle_tpu.telemetry import safe_inc
+
+            safe_inc("serve_loop_crashes",
+                     "serving background loops that died",
+                     registry=self.registry)
+            log.error("autoscaler loop crashed (%s: %s); the fleet will "
+                      "not scale until restarted", type(e).__name__, e)
+
+    def _loop_error_now(self) -> BaseException | None:
+        with self._lock:
+            return self._loop_error

@@ -1,0 +1,213 @@
+"""DeploymentController — checkpoints become served weights, hands-free.
+
+The reference's pserver fleets absorbed trainer updates while serving
+(PAPER.md §pserver); here the loop is explicit and auditable.  The
+controller watches a trainer checkpoint directory and, for each NEW
+cursor-newest sha256-valid checkpoint:
+
+1. **export** — :func:`~paddle_tpu.serving.export.
+   checkpoint_path_to_servable` under an *export pin*
+   (:func:`~paddle_tpu.trainer.checkpoint.export_pin`), so retention GC
+   cannot delete the checkpoint mid-read; transient I/O errors redial
+   through a :class:`~paddle_tpu.resilience.policy.RetryPolicy`;
+2. **pre-verify** — :func:`load_servable` re-hashes the artifact and the
+   config must round-trip; a corrupt export never reaches the fleet;
+3. **roll out** — :meth:`FleetRouter.swap_servable` walks the fleet
+   replica-by-replica while traffic flows: drain, load, swap, then
+   smoke-verify the replica's decode against the model's own greedy
+   continuation; ANY failure rolls every already-swapped replica back
+   to the previous weights and raises ``SwapFailed``;
+4. **account** — one ledger record per attempt (``kind="deploy"``:
+   outcome ``deployed`` / ``rolled_back`` / ``export_failed``, with
+   export/swap/total timings), win or lose.
+
+A rolled-back or failed attempt is retried on the next poll with a
+FRESH export, up to ``max_attempts`` per checkpoint uuid — after that
+the checkpoint is marked bad and skipped, so one poisoned checkpoint
+cannot wedge the rollout pipeline (the next good checkpoint deploys
+over it).  The background ``start()`` loop follows the serving crash
+contract: a loop death is stored, counted (``serve_loop_crashes``) and
+re-raised from the next :meth:`poll`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from paddle_tpu.core import logger as log
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.resilience.policy import RetryPolicy
+from paddle_tpu.serving.export import (
+    checkpoint_path_to_servable,
+    load_servable,
+)
+from paddle_tpu.serving.router import SwapFailed
+
+
+class DeploymentController:
+    """See the module doc.  ``cfg`` is the model config the servable
+    must round-trip to (the fleet's serving config); ``servable_dir``
+    is the export target the fleet swaps from."""
+
+    def __init__(self, ckpt_dir: str, servable_dir: str, router, cfg,
+                 registry=None, clock=time.monotonic,
+                 retry: RetryPolicy | None = None, max_attempts: int = 3):
+        from paddle_tpu import metrics as metrics_mod
+
+        self.ckpt_dir = ckpt_dir
+        self.servable_dir = servable_dir
+        self.router = router
+        self.cfg = cfg
+        self.registry = registry or getattr(
+            router, "registry", None) or metrics_mod.get_registry()
+        self._clock = clock
+        self.retry = retry or RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+            retry_on=(OSError,), scope="deploy_export",
+            registry=self.registry)
+        self.max_attempts = max_attempts
+        # rollout state: poll() runs from both the public API and the
+        # background loop thread — every access holds _lock (GL-THREAD)
+        self._lock = threading.Lock()
+        self._deployed_uuid: str | None = None
+        self._attempts: dict[str, int] = {}
+        self._ledger: list[dict] = []
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._loop_error: BaseException | None = None
+
+    # -- one watch round -------------------------------------------------------
+    def poll(self) -> dict | None:
+        """Look for a new deployable checkpoint; deploy it if found.
+        Returns the attempt's ledger record, or ``None`` when there is
+        nothing to do.  Raises when the background loop has crashed —
+        a dead controller must fail its caller, not skip rollouts
+        silently."""
+        err = self._loop_error_now()
+        if err is not None:
+            raise RuntimeError(
+                "deployment controller loop crashed; poll refused"
+            ) from err
+        from paddle_tpu.trainer.checkpoint import latest_checkpoint
+
+        found = latest_checkpoint(self.ckpt_dir)
+        if found is None:
+            return None
+        path, manifest = found
+        uuid = manifest.get("uuid") or path
+        with self._lock:
+            if uuid == self._deployed_uuid:
+                return None
+            attempt = self._attempts.get(uuid, 0) + 1
+            if attempt > self.max_attempts:
+                return None  # poisoned checkpoint: marked bad, skipped
+            self._attempts[uuid] = attempt
+        return self._deploy(path, uuid, attempt)
+
+    def _deploy(self, path: str, uuid: str, attempt: int) -> dict:
+        from paddle_tpu.telemetry import safe_inc
+        from paddle_tpu.trainer.checkpoint import export_pin
+
+        rec = {"event": "deploy", "checkpoint": path, "uuid": uuid,
+               "servable": self.servable_dir, "attempt": attempt}
+        t_all = time.perf_counter()
+        try:
+            t0 = time.perf_counter()
+            # pin the checkpoint so retention GC cannot rmtree the dir
+            # out from under the export's payload reads
+            with export_pin(path):
+                self.retry.call(checkpoint_path_to_servable, path,
+                                self.servable_dir, self.cfg)
+                # pre-verify: re-hash + config round-trip BEFORE any
+                # replica drains — a torn export stays off the fleet
+                got_cfg, _ = load_servable(self.servable_dir)
+                enforce(got_cfg == self.cfg,
+                        f"servable config drifted from the fleet's: "
+                        f"{got_cfg} != {self.cfg}")
+            rec["export_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            t0 = time.perf_counter()
+            report = self.router.swap_servable(self.servable_dir)
+            rec["swap_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            rec["replicas"] = len(report)
+            rec["outcome"] = "deployed"
+            with self._lock:
+                self._deployed_uuid = uuid
+            safe_inc("deploys_succeeded",
+                     "checkpoints rolled out across the fleet",
+                     registry=self.registry)
+            log.info("deploy: %s rolled out fleet-wide (attempt %d, "
+                     "export %.0fms, swap %.0fms)", path, attempt,
+                     rec["export_ms"], rec["swap_ms"])
+        except SwapFailed as e:
+            # swap_servable already rolled every swapped replica back;
+            # the next poll retries with a fresh export
+            rec["swap_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            rec["outcome"] = "rolled_back"
+            rec["error"] = str(e)
+            safe_inc("deploys_rolled_back",
+                     "rollouts undone by a failed swap or smoke check",
+                     registry=self.registry)
+            log.error("deploy: %s rolled back (attempt %d/%d): %s",
+                      path, attempt, self.max_attempts, e)
+        except Exception as e:
+            rec["outcome"] = "export_failed"
+            rec["error"] = f"{type(e).__name__}: {e}"
+            safe_inc("deploys_export_failed",
+                     "exports that died before reaching the fleet",
+                     registry=self.registry)
+            log.error("deploy: exporting %s failed (attempt %d/%d): %s",
+                      path, attempt, self.max_attempts, e)
+        rec["total_ms"] = round((time.perf_counter() - t_all) * 1e3, 2)
+        with self._lock:
+            self._ledger.append(dict(rec))
+        if self.registry.active:
+            self.registry.emit(dict(rec), kind="deploy")
+        return rec
+
+    def ledger(self) -> list[dict]:
+        """Every deployment attempt, in order, win or lose."""
+        with self._lock:
+            return [dict(r) for r in self._ledger]
+
+    def deployed_uuid(self) -> str | None:
+        with self._lock:
+            return self._deployed_uuid
+
+    # -- background loop (the crash contract) ----------------------------------
+    def start(self, poll_s: float = 0.25) -> None:
+        enforce(self._thread is None, "deployment controller already "
+                                      "started")
+        with self._lock:
+            self._loop_error = None
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, args=(poll_s,), name="deploy-controller",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+
+    def _loop(self, poll_s: float) -> None:
+        try:
+            while not self._stop.wait(poll_s):
+                self.poll()
+        except BaseException as e:
+            with self._lock:
+                self._loop_error = e
+            from paddle_tpu.telemetry import safe_inc
+
+            safe_inc("serve_loop_crashes",
+                     "serving background loops that died",
+                     registry=self.registry)
+            log.error("deployment controller loop crashed (%s: %s); "
+                      "rollouts stopped until restarted",
+                      type(e).__name__, e)
+
+    def _loop_error_now(self) -> BaseException | None:
+        with self._lock:
+            return self._loop_error

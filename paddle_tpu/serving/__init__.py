@@ -29,6 +29,8 @@ CTR/recommender models.
   with RetryAfter, per-request deadlines, zero-downtime weight swap;
 - ``health``     — HealthProbe/FleetHealth: per-replica liveness
   verdicts (crash / hang / stale / membership);
+- ``client``     — backoff_submit: the shared client-side RetryAfter
+  back-off loop (deterministic capped jitter);
 - ``__main__``   — ``python -m paddle_tpu.serving`` stdin CLI loop
   (``--replicas N`` serves through a local fleet).
 
@@ -36,11 +38,13 @@ Attention kernel: ``ops/pallas/paged_attention.py`` (ragged paged
 attention; Pallas on TPU, pure-jnp reference elsewhere).
 """
 
+from paddle_tpu.serving.client import backoff_submit  # noqa: F401
 from paddle_tpu.serving.engine import ServingEngine  # noqa: F401
 from paddle_tpu.serving.fleet import (  # noqa: F401
     FleetConfig,
     LocalReplica,
     build_local_fleet,
+    clone_replica,
     fleet_launch_argv,
 )
 from paddle_tpu.serving.health import FleetHealth, HealthProbe  # noqa: F401
@@ -51,6 +55,7 @@ from paddle_tpu.serving.router import (  # noqa: F401
     SwapFailed,
 )
 from paddle_tpu.serving.export import (  # noqa: F401
+    checkpoint_path_to_servable,
     checkpoint_to_servable,
     export_servable,
     load_servable,

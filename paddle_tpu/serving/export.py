@@ -167,19 +167,29 @@ def load_servable(path: str):
     return cfg, _unflatten(params)
 
 
-def checkpoint_to_servable(ckpt_dir: str, out_dir: str, cfg,
-                           meta: dict | None = None) -> str:
-    """Export the newest VALID trainer checkpoint under ``ckpt_dir`` as a
-    servable.  Parameter names must match ``transformer.init_params``'s
-    flat layout (the trainer saves ``params.npz`` keyed by name)."""
-    from paddle_tpu.trainer.checkpoint import latest_checkpoint, load_checkpoint
+def checkpoint_path_to_servable(path: str, out_dir: str, cfg,
+                                meta: dict | None = None) -> str:
+    """Export ONE specific checkpoint dir as a servable (validated via
+    its manifest first).  The deployment controller uses this form so
+    the checkpoint it decided to roll out is the one exported, even if
+    a newer one lands mid-export."""
+    from paddle_tpu.trainer.checkpoint import load_checkpoint
 
-    found = latest_checkpoint(ckpt_dir)
-    enforce(found is not None, f"no valid checkpoint under {ckpt_dir}")
-    path, manifest = found
-    params, _, _, _ = load_checkpoint(path)
+    params, _, _, manifest = load_checkpoint(path)
     nested = _unflatten(params)
     return export_servable(
         out_dir, cfg, nested,
         meta={**(meta or {}), "checkpoint": path,
               "checkpoint_uuid": manifest.get("uuid")})
+
+
+def checkpoint_to_servable(ckpt_dir: str, out_dir: str, cfg,
+                           meta: dict | None = None) -> str:
+    """Export the newest VALID trainer checkpoint under ``ckpt_dir`` as a
+    servable.  Parameter names must match ``transformer.init_params``'s
+    flat layout (the trainer saves ``params.npz`` keyed by name)."""
+    from paddle_tpu.trainer.checkpoint import latest_checkpoint
+
+    found = latest_checkpoint(ckpt_dir)
+    enforce(found is not None, f"no valid checkpoint under {ckpt_dir}")
+    return checkpoint_path_to_servable(found[0], out_dir, cfg, meta)

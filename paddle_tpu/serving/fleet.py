@@ -202,6 +202,23 @@ def smoke_check(cfg, params, prompt: list[int],
     return list(tokens) == want
 
 
+def clone_replica(index: int, source: LocalReplica,
+                  registry=None, clock=None) -> LocalReplica:
+    """Replica factory for :meth:`FleetRouter.add_replica`: a fresh
+    :class:`LocalReplica` serving the SOURCE's currently-served weights
+    — ``source.engine.params``, not the boot-time params, so a replica
+    added after a rolling weight swap joins on the swapped servable —
+    under the same model/serving config and sampling seed (placement
+    never changes tokens).  Compile-free: engines share the jitted
+    closure memo keyed by config.  The autoscaler passes this (wrapped
+    with its registry/clock) straight through to ``add_replica``."""
+    return LocalReplica(
+        index, source.cfg, source.engine.params, source.serving,
+        registry=registry if registry is not None
+        else source.engine.registry,
+        clock=clock if clock is not None else source._clock)
+
+
 def build_local_fleet(cfg, params, serving, n: int, fleet=None,
                       registry=None, chaos=None,
                       clock=time.monotonic) -> FleetRouter:

@@ -28,6 +28,16 @@ replicas, surviving the failures a single engine cannot:
   via ``load_servable``, swap, smoke-decode, re-admit) while the rest
   of the fleet keeps serving; any failure rolls every already-swapped
   replica back to the old weights and raises :class:`SwapFailed`.
+- **elastic membership** — :meth:`add_replica` grows the fleet while
+  traffic flows (the new replica clones a survivor's served weights, so
+  it joins on the CURRENT servable, post-swap included);
+  :meth:`remove_replica` retires a victim with zero request loss: the
+  victim is marked draining (no new work) and its in-flight requests go
+  back through the failover re-queue path — idempotent fleet-global ids
+  mean the re-dispatch samples identical tokens on a survivor.  Retired
+  replicas stay in place (indices are stable) but are never routed,
+  pumped, probed or swapped again.  ``deploy/autoscaler.py`` drives
+  both off the SLO policy.
 
 Drive it like the engine: a background thread (``start()/stop()``), or
 synchronously (``pump()``/``run_until_idle()``) for deterministic tests
@@ -142,17 +152,43 @@ class FleetRouter:
         self._swapping = False
         self._draining: set[int] = set()     # no NEW work routed there
         self._held: set[int] = set()         # not pumped (mid-swap)
+        self._retired: set[int] = set()      # scaled down, never revived
         self._last_probes: list = []
         self._counts = {
             "submitted": 0, "delivered": 0, "shed": 0, "failovers": 0,
             "requeued": 0, "redial_exhausted": 0, "deadline_expired": 0,
             "duplicates": 0, "swaps": 0, "swap_rollbacks": 0,
-            "dispatch_errors": 0,
+            "dispatch_errors": 0, "replicas_added": 0,
+            "replicas_retired": 0,
         }
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._loop_error: BaseException | None = None
         self._stopped = False  # a stop()ed loop marks the router dead
+
+    # -- membership snapshot ---------------------------------------------------
+    def _reps(self) -> list:
+        """Snapshot of the replica list (``replicas`` grows under
+        ``add_replica`` from a controller thread, so every traversal
+        works off a lock-held copy; indices are stable — replicas are
+        retired in place, never popped)."""
+        with self._lock:
+            return list(self.replicas)
+
+    def _alive_count(self) -> int:
+        """Replicas that can take traffic: not judged dead, not retired
+        by a scale-down."""
+        with self._lock:
+            n = len(self.replicas)
+            retired = set(self._retired)
+        return sum(1 for i in range(n)
+                   if not self.health.is_dead(i) and i not in retired)
+
+    def last_probes(self) -> list:
+        """The most recent pump round's health probes (alive replicas
+        only) — the autoscaler's free-page/occupancy signal source."""
+        with self._lock:
+            return list(self._last_probes)
 
     # -- client API ------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int | None = None,
@@ -165,7 +201,7 @@ class FleetRouter:
         fleet).  ``ttl_s`` (default ``fleet.default_ttl_s``): if the
         request is still unadmitted past its deadline it completes with
         ``finish_reason="deadline"`` instead of blocking the queue."""
-        prompt, n = self.replicas[0].check(prompt, max_new_tokens)
+        prompt, n = self._reps()[0].check(prompt, max_new_tokens)
         err = self._loop_error_now()
         if err is not None:
             raise RuntimeError(
@@ -296,12 +332,14 @@ class FleetRouter:
         self._inject_chaos(rnd)
         with self._lock:
             held = set(self._held)
+            skip = held | self._retired
         # held replicas (mid-swap) are under the swap thread's exclusive
         # control: they are not pumped, so their progress is frozen by
         # DESIGN — judging them would "hang"-kill a healthy replica on
         # every rolling swap.  They rejoin the probe stream on release.
-        probes = [rep.probe() for i, rep in enumerate(self.replicas)
-                  if not self.health.is_dead(i) and i not in held]
+        # Retired replicas (scaled down) left the fleet for good.
+        probes = [rep.probe() for i, rep in enumerate(self._reps())
+                  if not self.health.is_dead(i) and i not in skip]
         for idx, reason in self.health.observe(probes):
             self._failover(idx, reason)
             worked = True
@@ -310,12 +348,12 @@ class FleetRouter:
                                  if not self.health.is_dead(p.replica)]
         if self._route():
             worked = True
-        for i, rep in enumerate(self.replicas):
+        for i, rep in enumerate(self._reps()):
             if self.health.is_dead(i):
                 continue
             with self._lock:
-                held = i in self._held
-            if not held and rep.pump():
+                skip_rep = i in self._held or i in self._retired
+            if not skip_rep and rep.pump():
                 worked = True
         if self._collect():
             worked = True
@@ -331,12 +369,13 @@ class FleetRouter:
     def _inject_chaos(self, rnd: int) -> None:
         if self._chaos is None:
             return
+        reps = self._reps()
         p = self._chaos.take_fleet_fault("replica_loss", rnd)
         if p is not None:
-            self.replicas[p.get("replica", 0)].kill("chaos replica_loss")
+            reps[p.get("replica", 0)].kill("chaos replica_loss")
         p = self._chaos.take_fleet_fault("replica_hang", rnd)
         if p is not None:
-            self.replicas[p.get("replica", 0)].hang()
+            reps[p.get("replica", 0)].hang()
 
     # -- routing ---------------------------------------------------------------
     def _route(self) -> bool:
@@ -362,7 +401,7 @@ class FleetRouter:
                 continue
             target = self._pick(req)
             if target is None:
-                if self.health.alive_count(len(self.replicas)) == 0:
+                if self._alive_count() == 0:
                     # a fleet with no survivors can never serve this —
                     # fail it now rather than pump a dead fleet forever
                     self._finish_local(
@@ -413,9 +452,9 @@ class FleetRouter:
             load: dict[int, int] = {}
             for r in self._inflight.values():
                 load[r.replica] = load.get(r.replica, 0) + 1
-            draining = set(self._draining)
+            draining = self._draining | self._retired
         best = None
-        for i, rep in enumerate(self.replicas):
+        for i, rep in enumerate(self._reps()):
             if self.health.is_dead(i) or i in draining:
                 continue
             affinity = 0
@@ -509,11 +548,11 @@ class FleetRouter:
     # -- result collection -----------------------------------------------------
     def _collect(self) -> bool:
         worked = False
-        for i, rep in enumerate(self.replicas):
+        for i, rep in enumerate(self._reps()):
             if self.health.is_dead(i):
                 continue
             with self._lock:
-                held = i in self._held
+                held = i in self._held or i in self._retired
             if held:
                 continue
             for res in rep.collect():
@@ -557,10 +596,117 @@ class FleetRouter:
             depth = len(self._pending) + len(self._inflight)
         self.registry.gauge(
             "fleet_alive_replicas", "replicas serving traffic").set(
-                self.health.alive_count(len(self.replicas)))
+                self._alive_count())
         self.registry.gauge(
             "fleet_queue_depth",
             "requests pending or in flight across the fleet").set(depth)
+
+    # -- elastic membership (the autoscaler surface) ---------------------------
+    def add_replica(self, factory) -> int:
+        """Grow the fleet by one replica while traffic flows.
+
+        ``factory(index, source_replica)`` builds the new replica handle
+        — ``fleet.clone_replica`` is the in-process implementation: it
+        clones the SOURCE's currently-served weights (not the boot-time
+        params), so a replica added after a rolling weight swap joins on
+        the swapped servable, and the fleet never serves a mix.  The
+        source is the lowest-indexed survivor.  Returns the new index.
+
+        The factory runs under the router lock: construction is
+        compile-free for the in-process shape (replicas share the jitted
+        closure memo) and the pause keeps the membership change atomic
+        against the pump loop."""
+        from paddle_tpu.telemetry import safe_inc
+
+        with self._lock:
+            src = src_idx = None
+            for i, rep in enumerate(self.replicas):
+                if not self.health.is_dead(i) and i not in self._retired:
+                    src, src_idx = rep, i
+                    break
+            enforce(src is not None,
+                    "cannot add a replica: no survivor to clone the "
+                    "served weights from")
+            idx = len(self.replicas)
+            new = factory(idx, src)
+            self.replicas.append(new)
+            self._counts["replicas_added"] += 1
+        safe_inc("fleet_replicas_added",
+                 "replicas added by scale-up", registry=self.registry)
+        log.info("fleet: replica %d added (scale-up, cloned from %d)",
+                 idx, src_idx)
+        if self.registry.active:
+            self.registry.emit(
+                {"event": "replica_added", "replica": idx,
+                 "source": src_idx,
+                 "alive": self._alive_count()}, kind="fleet")
+        return idx
+
+    def remove_replica(self, idx: int,
+                       reason: str = "scale_down") -> dict:
+        """Retire replica ``idx`` with ZERO request loss.
+
+        The victim is marked draining (no new work routes there), its
+        in-flight requests are handed back through the existing failover
+        re-queue path — fleet-global idempotent ids mean a survivor
+        re-serves them with identical tokens — and the replica is
+        retired in place: indices stay stable, but a retired replica is
+        never routed, pumped, probed, collected or swapped again.
+        Refuses to retire the last survivor.  Returns
+        ``{"replica": idx, "requeued": n}``."""
+        from paddle_tpu.telemetry import safe_inc
+
+        with self._lock:
+            enforce(0 <= idx < len(self.replicas),
+                    f"no replica {idx} to remove")
+            enforce(idx not in self._retired,
+                    f"replica {idx} is already retired")
+        dead = self.health.is_dead(idx)
+        enforce(dead or self._alive_count() > 1,
+                "cannot retire the last alive replica — scale down is "
+                "bounded by the fleet's minimum of one survivor")
+        with self._lock:
+            self._draining.add(idx)
+            had = sum(1 for r in self._inflight.values()
+                      if r.replica == idx)
+        if had and not dead:
+            # the drain IS the failover path: re-queue to the front in
+            # id order, RetryPolicy-bounded, duplicate-safe
+            self._failover(idx, f"drained: {reason}")
+        with self._lock:
+            self._retired.add(idx)
+            self._draining.discard(idx)
+            self._held.discard(idx)
+            self._counts["replicas_retired"] += 1
+        safe_inc("fleet_replicas_retired",
+                 "replicas retired by scale-down", registry=self.registry)
+        log.info("fleet: replica %d retired (%s); %d in-flight "
+                 "request(s) re-queued", idx, reason, had)
+        if self.registry.active:
+            self.registry.emit(
+                {"event": "replica_retired", "replica": idx,
+                 "reason": reason, "requeued": had,
+                 "alive": self._alive_count()}, kind="fleet")
+        return {"replica": idx, "requeued": had}
+
+    def pick_victim(self) -> int | None:
+        """The scale-down victim: the least-loaded alive replica, ties
+        to the HIGHEST index (latest added goes first — the autoscaler's
+        LIFO convention keeps replica 0, the clone source, stable)."""
+        with self._lock:
+            load: dict[int, int] = {}
+            for r in self._inflight.values():
+                load[r.replica] = load.get(r.replica, 0) + 1
+            n = len(self.replicas)
+            retired = set(self._retired)
+        best = None
+        for i in range(n):
+            if self.health.is_dead(i) or i in retired:
+                continue
+            key = (load.get(i, 0), -i)
+            if best is None or key < best[0]:
+                best = (key, i)
+        return None if best is None else best[1]
 
     # -- zero-downtime weight swap ---------------------------------------------
     def swap_servable(self, path: str) -> dict[int, str]:
@@ -585,9 +731,14 @@ class FleetRouter:
         swapped: list[tuple[int, object, object]] = []
         tk_swap = None
         try:
-            for idx, rep in enumerate(self.replicas):
+            for idx, rep in enumerate(self._reps()):
                 if self.health.is_dead(idx):
                     report[idx] = "dead: skipped"
+                    continue
+                with self._lock:
+                    retired = idx in self._retired
+                if retired:
+                    report[idx] = "retired: skipped"
                     continue
                 tk_swap = get_tracer().begin("swap", cat="fleet",
                                              replica=idx)
@@ -702,7 +853,7 @@ class FleetRouter:
             inflight = len(self._inflight)
         c.update({
             "pending": pending, "inflight": inflight,
-            "alive_replicas": self.health.alive_count(len(self.replicas)),
+            "alive_replicas": self._alive_count(),
             "requests_lost": c["submitted"] - c["delivered"]
             - pending - inflight,
         })
@@ -717,8 +868,8 @@ class FleetRouter:
                            kind="fleet")
 
     # -- replica /metrics aggregation ------------------------------------------
-    def scrape_replicas(self, urls: list[str],
-                        timeout: float = 5.0) -> dict:
+    def scrape_replicas(self, urls: list[str], timeout: float = 5.0,
+                        retry: RetryPolicy | None = None) -> dict:
         """Scrape each replica's introspection ``/metrics`` endpoint
         (``--status_port`` on the replica processes — ``distributed.
         launch --serving --status_port_base N`` stamps one port per
@@ -728,19 +879,34 @@ class FleetRouter:
         ``kind="fleet"`` ``event="scrape"`` record, so the fleet
         summary stream carries the live replica metrics alongside the
         router's own books.  A replica that cannot be scraped is
-        reported, not fatal — the scrape is observability, and a dead
-        endpoint is itself a signal."""
+        retried once with jittered backoff (``retry``: default a
+        2-attempt deterministic :class:`RetryPolicy` — a GC pause must
+        not read as a dead replica) and then reported, not fatal — the
+        scrape is observability, and a dead endpoint is itself a
+        signal.  Every endpoint that stays unreachable after the retry
+        bumps ``fleet_scrape_errors``, so a partial rollup is never
+        silent."""
+        from paddle_tpu.telemetry import safe_inc
         from paddle_tpu.telemetry.introspect import (
             aggregate_prometheus,
             scrape,
         )
 
+        if retry is None:
+            retry = RetryPolicy(
+                max_attempts=2, base_delay_s=0.05, max_delay_s=0.5,
+                retry_on=(OSError, ValueError), scope="fleet_scrape",
+                registry=self.registry)
         texts, errors = [], {}
         for url in urls:
             try:
-                texts.append(scrape(url, timeout=timeout))
+                texts.append(retry.call(scrape, url, timeout=timeout))
             except (OSError, ValueError) as e:
                 errors[url] = f"{type(e).__name__}: {e}"[:200]
+                safe_inc("fleet_scrape_errors",
+                         "replica /metrics endpoints still unreachable "
+                         "after the scrape retry",
+                         registry=self.registry)
         agg = aggregate_prometheus(texts)
         # flatten to {name: total-over-labels} for the record; the
         # full labeled map goes back to the caller

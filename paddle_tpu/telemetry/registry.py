@@ -124,14 +124,25 @@ from typing import Any
 # serve_prefill_chunks and gauge serve_cached_pages.  No new record
 # kinds; flag-off runs emit the /13 field set plus the two zero-valued
 # serve fields.
-SCHEMA = "paddle_tpu.metrics/14"
+# /15 added the train→serve control plane (paddle_tpu/deploy): record
+# kind "deploy" — one per DeploymentController rollout attempt
+# (checkpoint, uuid, attempt, export_ms/swap_ms/total_ms, outcome
+# deployed|rolled_back|export_failed) — and record kind "autoscale" —
+# one per SloAutoscaler action (scale_up/scale_down with the
+# triggering signals and scale_ms) and per PoolArbiter shift
+# (pool_borrow/pool_return with the trainer/serving host split).  New
+# counters deploys_succeeded / deploys_rolled_back /
+# deploys_export_failed / autoscale_actions{action} /
+# pool_shifts{event} / fleet_replicas_added / fleet_replicas_retired /
+# fleet_scrape_errors / client_backoffs.
+SCHEMA = "paddle_tpu.metrics/15"
 
 # every record kind the schema knows.  The GL-SCHEMA codebase pass
 # (paddle_tpu/analysis) cross-checks this against the tree: an emitted
 # kind missing here — or an entry here nothing produces — is drift.
 RECORD_KINDS = ("step", "bench", "fault", "recovery", "serve",
                 "serve_summary", "elastic_event", "preflight", "fleet",
-                "profile", "ledger")
+                "profile", "ledger", "deploy", "autoscale")
 
 # histogram bucket upper bounds (ms-oriented default; values above the
 # last edge land in the +Inf bucket)

@@ -18,6 +18,7 @@ onto a freshly built optimizer state without pickling treedefs."""
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -33,6 +34,11 @@ from paddle_tpu.core import logger as log
 from paddle_tpu.core.enforce import enforce
 
 MANIFEST = "checkpoint.json"
+# retention-GC exclusion marker: a reader (servable export) drops this
+# file in a checkpoint dir for the duration of its payload reads, and
+# prune_old skips the dir — the marker is NOT in the manifest's file
+# list, so validation ignores it
+EXPORT_PIN = ".exporting"
 
 # end-of-pass checkpoints are "pass-00003"; mid-pass cursor checkpoints
 # (preemption / --checkpoint_batch_period) are "pass-00003-batch-000005",
@@ -343,11 +349,61 @@ def save_checkpoint(ckpt_dir: str, pass_id: int, params: dict,
     return final
 
 
-def _gc_old(ckpt_dir: str, keep_last: int) -> None:
+@contextlib.contextmanager
+def export_pin(path: str):
+    """Pin a checkpoint dir against retention GC for the duration of a
+    read (the deployment controller holds this around servable export,
+    so a concurrent trainer save's :func:`prune_old` cannot rmtree the
+    payload mid-read)."""
+    marker = os.path.join(path, EXPORT_PIN)
+    with open(marker, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        yield path
+    finally:
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+
+
+def prune_old(ckpt_dir: str, keep_last: int = 3) -> list[str]:
+    """Retention GC: delete checkpoints beyond the newest ``keep_last``
+    (by cursor order); returns the removed paths.  ``keep_last <= 0``
+    disables pruning.  Two dirs are NEVER deleted regardless of age:
+
+    - the newest VALID checkpoint (the recovery target — if every
+      younger entry is torn or corrupt, deleting it would leave nothing
+      to resume or deploy from);
+    - any dir pinned mid-export (:func:`export_pin`'s marker) — the
+      deployment controller may be streaming its payload right now.
+    """
     if keep_last <= 0:
-        return
-    for path in checkpoint_entries(ckpt_dir)[:-keep_last]:
+        return []
+    entries = checkpoint_entries(ckpt_dir)
+    if len(entries) <= keep_last:
+        return []  # nothing would be deleted — skip the validity probe
+    keep = set(entries[-keep_last:])
+    newest_valid = latest_checkpoint(ckpt_dir)
+    if newest_valid is not None:
+        keep.add(newest_valid[0])
+    removed = []
+    for path in entries:
+        if path in keep:
+            continue
+        if os.path.exists(os.path.join(path, EXPORT_PIN)):
+            log.info("checkpoint GC: %s pinned mid-export, kept", path)
+            continue
         shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    if removed:
+        log.info("checkpoint GC: pruned %d old checkpoint(s), kept %d",
+                 len(removed), len(entries) - len(removed))
+    return removed
+
+
+def _gc_old(ckpt_dir: str, keep_last: int) -> None:
+    prune_old(ckpt_dir, keep_last)
 
 
 def _validate(path: str) -> dict | None:

@@ -105,6 +105,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint_batch_period", type=int, default=None,
                    help="also checkpoint every N batches mid-pass "
                         "(0 = per-pass only)")
+    p.add_argument("--checkpoint_keep", type=int, default=None,
+                   help="retention GC: keep the newest N checkpoints "
+                        "(0 = keep everything); the newest valid one and "
+                        "any pinned mid-export are never deleted")
     p.add_argument("--nan_policy", default=None,
                    choices=["none", "skip", "rollback"],
                    help="non-finite-loss policy: none (die) | skip "
@@ -590,6 +594,8 @@ def cmd_train(args, parsed) -> int:
             checkpoint_period=args.checkpoint_period,
             checkpoint_batch_period=_resolve(
                 args.checkpoint_batch_period, "checkpoint_batch_period", 0),
+            checkpoint_keep=_resolve(
+                args.checkpoint_keep, "checkpoint_keep", 3),
             nan_policy=_resolve(args.nan_policy, "nan_policy", "none"),
             sync_period=_resolve(args.sync_period, "sync_period", 8),
             prefetch=_resolve(args.prefetch, "prefetch_depth", 2),

@@ -216,7 +216,8 @@ class SGD:
               resume: bool = True, checkpoint_async: bool = False,
               metrics_registry=None, sync_period: int | None = None,
               prefetch: int | None = None, nan_policy: str | None = None,
-              checkpoint_batch_period: int | None = None, elastic=None,
+              checkpoint_batch_period: int | None = None,
+              checkpoint_keep: int | None = None, elastic=None,
               seq_buckets=None):
         """reader yields BATCHES (lists of sample tuples), i.e. the output of
         ``paddle.batch(...)`` exactly as in v2.
@@ -307,6 +308,8 @@ class SGD:
             nan_policy = flags.get("nan_policy")
         if checkpoint_batch_period is None:
             checkpoint_batch_period = flags.get("checkpoint_batch_period")
+        if checkpoint_keep is None:
+            checkpoint_keep = flags.get("checkpoint_keep")
         if event_handler is None:
             event_handler = _default_event_handler
         metrics_mod.configure_from_flags(metrics_registry)
@@ -415,6 +418,7 @@ class SGD:
                              sync_period=sync_period, prefetch=prefetch,
                              nan_policy=nan_policy,
                              checkpoint_batch_period=checkpoint_batch_period,
+                             checkpoint_keep=checkpoint_keep,
                              elastic=elastic)
         finally:
             jax.config.update("jax_debug_nans", prev_debug_nans)
@@ -508,7 +512,7 @@ class SGD:
                     checkpoint_period, resume, preempted,
                     checkpoint_async=False, sync_period=1, prefetch=0,
                     nan_policy="none", checkpoint_batch_period=0,
-                    elastic=None):
+                    checkpoint_keep=3, elastic=None):
         from paddle_tpu.trainer import checkpoint as ckpt
 
         writer = ckpt.AsyncCheckpointer() if (
@@ -547,6 +551,7 @@ class SGD:
                         prefetch=prefetch, start_batch=start_batch,
                         nan_policy=nan_policy,
                         checkpoint_batch_period=checkpoint_batch_period,
+                        checkpoint_keep=checkpoint_keep,
                         elastic=elastic)
                     break
                 except _ElasticReplay as r:
@@ -592,7 +597,7 @@ class SGD:
                     checkpoint_period, preempted, writer,
                     sync_period=1, prefetch=0, start_batch=0,
                     nan_policy="none", checkpoint_batch_period=0,
-                    elastic=None):
+                    checkpoint_keep=3, elastic=None):
         from paddle_tpu.reader.prefetch import (
             DevicePrefetcher,
             SynchronousFeeds,
@@ -820,7 +825,7 @@ class SGD:
                     save(checkpoint_dir, pass_id,
                          {n: np.asarray(params[n]) for n in params},
                          opt_state=opt_state, states=dict(states),
-                         batch_id=batch_id,
+                         keep_last=checkpoint_keep, batch_id=batch_id,
                          meta=cursor_meta(batch_id))
 
             def drain_checkpoint(host_params, host_opt, host_states):
@@ -845,7 +850,7 @@ class SGD:
                         {n: np.asarray(v)
                          for n, v in host_params.items()},
                         opt_state=host_opt, states=dict(host_states),
-                        batch_id=batch_id,
+                        keep_last=checkpoint_keep, batch_id=batch_id,
                         meta=cursor_meta(batch_id,
                                          {"elastic_drain": True}))
 
@@ -1104,7 +1109,7 @@ class SGD:
                         checkpoint_dir, pass_id,
                         {n: np.asarray(params[n]) for n in params},
                         opt_state=opt_state, states=dict(states),
-                        batch_id=batch_id,
+                        keep_last=checkpoint_keep, batch_id=batch_id,
                         meta=cursor_meta(batch_id, {"preempted": True}),
                     )
                     log.info("preempted in pass %d: cursor checkpoint "
@@ -1128,6 +1133,7 @@ class SGD:
                     checkpoint_dir, pass_id,
                     {n: np.asarray(params[n]) for n in params},
                     opt_state=opt_state, states=dict(states),
+                    keep_last=checkpoint_keep,
                     meta={"avg_metrics": avg_metrics,
                           "rng": rng.get_state().tolist()},
                 )

@@ -1,0 +1,684 @@
+"""The train→serve control plane (paddle_tpu/deploy): the SLO
+autoscaler's hysteresis band edges, cooldown suppression, min/max
+clamps and fake-clock determinism; scale-down draining a victim with
+requests in flight through the failover path with zero loss and
+token-identical output; the deployment controller's export→verify→
+swap→ledger loop, including a chaos-corrupted rollout that rolls back
+cleanly and redeploys; checkpoint retention GC that never eats the
+newest valid checkpoint or one pinned mid-export; the pool arbiter's
+trainer floor; the client back-off loop; and the crash contract on
+every background loop."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.deploy import (
+    AutoscalePolicy,
+    DeploymentController,
+    PoolArbiter,
+    SloAutoscaler,
+)
+from paddle_tpu.models import transformer as T
+from paddle_tpu.resilience.chaos import ChaosSchedule
+from paddle_tpu.resilience.policy import RetryPolicy
+from paddle_tpu.serving import ServingConfig
+from paddle_tpu.serving.client import backoff_submit
+from paddle_tpu.serving.fleet import build_local_fleet
+from paddle_tpu.serving.router import RetryAfter
+from paddle_tpu.telemetry import MemorySink, MetricsRegistry
+from paddle_tpu.trainer import checkpoint as ckpt
+
+pytestmark = pytest.mark.deploy
+
+
+def small_cfg(**kw):
+    base = dict(vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+                mlp_dim=64, max_seq_len=64, remat=False)
+    base.update(kw)
+    return T.TransformerConfig(**base)
+
+
+def small_scfg(**kw):
+    base = dict(max_slots=2, page_size=4, num_pages=32, max_prompt_len=8,
+                max_new_tokens=6, prefill_batch=2, seed=0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = small_cfg()
+    return cfg, T.init_params(cfg, jax.random.key(1))
+
+
+def save_model_checkpoint(ckpt_dir, params, pass_id=0, **kw):
+    flat = {}
+
+    def flatten(d, prefix=""):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                flatten(v, f"{prefix}{k}/")
+            else:
+                flat[f"{prefix}{k}"] = np.asarray(v)
+
+    flatten(params)
+    return ckpt.save_checkpoint(ckpt_dir, pass_id, flat, **kw)
+
+
+class FakeRouter:
+    """The autoscaler's router surface without a fleet: counts
+    membership, records actions — the policy tests drive it with a
+    scripted signal stream."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry("deploy_test")
+        self.alive = 1
+        self.added = []
+        self.removed = []
+
+    def add_replica(self, factory):
+        idx = self.alive
+        self.alive += 1
+        self.added.append(idx)
+        return idx
+
+    def pick_victim(self):
+        return self.alive - 1
+
+    def remove_replica(self, idx, reason=""):
+        self.alive -= 1
+        self.removed.append((idx, reason))
+        return {"replica": idx, "requeued": 0}
+
+
+def make_autoscaler(policy, sigs, clk):
+    """An autoscaler over a FakeRouter fed from the mutable ``sigs``
+    dict under the fake clock ``clk`` — alive tracks the fake fleet."""
+    router = FakeRouter()
+
+    def rollup():
+        return {**sigs, "alive": router.alive}
+
+    return router, SloAutoscaler(router, policy, clock=lambda: clk["t"],
+                                 rollup=rollup)
+
+
+BAND = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                       up_queue_per_replica=4.0,
+                       down_queue_per_replica=0.5, idle_hold_s=5.0,
+                       cooldown_up_s=1.0, cooldown_down_s=2.0)
+
+
+class TestAutoscalePolicy:
+    def test_band_inversion_refused(self):
+        with pytest.raises(Exception, match="band inverted"):
+            AutoscalePolicy(up_queue_per_replica=2.0,
+                            down_queue_per_replica=2.0)
+        with pytest.raises(Exception, match="band inverted"):
+            AutoscalePolicy(up_p99_ttft_ms=100.0, down_p99_ttft_ms=100.0)
+        with pytest.raises(Exception, match="clamp inverted"):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+    def test_band_boundaries(self):
+        """The band edges exactly: queue/replica AT the high edge
+        scales up (inclusive — the SLO is already breached there),
+        inside the gap holds in both directions, AT the low edge counts
+        as idle (inclusive) and scales down once sustained."""
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 4, "shed": 0}
+        router, asc = make_autoscaler(BAND, sigs, clk)
+        # 4 queued / 1 alive = 4.0 == up edge -> breach
+        assert asc.step()["event"] == "scale_up"
+        assert router.alive == 2
+        # 7.9/2 = 3.95 just under the edge, above 0.5*2=1 low edge:
+        # inside the band — no action EVER, regardless of time
+        sigs["queue_depth"] = 7
+        for _ in range(10):
+            clk["t"] += 10.0
+            assert asc.step()["event"] == "hold"
+        # 1 queued / 2 alive = 0.5 == low edge -> idle (inclusive);
+        # sustained past idle_hold_s + cooldown -> scale_down
+        sigs["queue_depth"] = 1
+        assert asc.step()["event"] == "hold"  # idle clock starts
+        clk["t"] += BAND.idle_hold_s + 0.1
+        assert asc.step()["event"] == "scale_down"
+        assert router.removed[0][0] == 1
+
+    def test_idle_blip_resets_the_hold_clock(self):
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 0, "shed": 0}
+        router, asc = make_autoscaler(BAND, sigs, clk)
+        router.alive = 2
+        assert asc.step()["event"] == "hold"  # idle since t=0
+        clk["t"] += 4.9  # almost held long enough...
+        sigs["queue_depth"] = 4  # ...but load returns (2/replica: in-band)
+        assert asc.step()["event"] == "hold"
+        sigs["queue_depth"] = 0
+        clk["t"] += 4.9  # idle again, but the clock restarted
+        assert asc.step()["event"] == "hold"
+        clk["t"] += BAND.idle_hold_s
+        assert asc.step()["event"] == "scale_down"
+
+    def test_shed_is_always_a_breach(self):
+        """A shed IS the SLO saying no — the cumulative counter rising
+        between rounds scales up even with a quiet queue."""
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 0, "shed": 3}
+        router, asc = make_autoscaler(BAND, sigs, clk)
+        assert asc.step()["event"] == "scale_up"  # 3 sheds since start
+        clk["t"] += 10.0
+        assert asc.step()["event"] == "hold"  # counter flat now
+        sigs["shed"] = 5
+        clk["t"] += 10.0
+        assert asc.step()["event"] == "scale_up"
+
+    def test_cooldown_suppresses_consecutive_actions(self):
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 50, "shed": 0}
+        router, asc = make_autoscaler(BAND, sigs, clk)
+        assert asc.step()["event"] == "scale_up"
+        rec = asc.step()  # still breached, but inside cooldown_up_s
+        assert rec["event"] == "hold" and "cooldown" in rec["reason"]
+        clk["t"] += BAND.cooldown_up_s + 0.01
+        assert asc.step()["event"] == "scale_up"
+        # the down side: a policy whose down cooldown OUTLASTS the idle
+        # hold — sustained idle alone is not enough until the cooldown
+        # from the last action expires
+        slow = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                               up_queue_per_replica=4.0,
+                               down_queue_per_replica=0.5,
+                               idle_hold_s=1.0, cooldown_up_s=0.5,
+                               cooldown_down_s=10.0)
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 50, "shed": 0}
+        router, asc = make_autoscaler(slow, sigs, clk)
+        assert asc.step()["event"] == "scale_up"  # action at t=0
+        sigs["queue_depth"] = 0
+        clk["t"] = 2.0
+        assert asc.step()["event"] == "hold"  # idle clock starts (t=2)
+        clk["t"] = 3.5  # idle 1.5s >= hold 1.0s, but t < cooldown 10s
+        rec = asc.step()
+        assert rec["event"] == "hold" and "cooldown" in rec["reason"]
+        clk["t"] = 10.5  # cooldown expired, idle still sustained
+        assert asc.step()["event"] == "scale_down"
+
+    def test_min_max_clamps(self):
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 100, "shed": 0}
+        router, asc = make_autoscaler(BAND, sigs, clk)
+        for _ in range(10):
+            clk["t"] += BAND.cooldown_up_s + 0.1
+            asc.step()
+        assert router.alive == BAND.max_replicas
+        rec = asc.step()
+        assert rec["event"] == "hold" and "max_replicas" in rec["reason"]
+        sigs["queue_depth"] = 0
+        for _ in range(10):
+            clk["t"] += BAND.idle_hold_s + BAND.cooldown_down_s + 0.1
+            asc.step()
+        assert router.alive == BAND.min_replicas
+        clk["t"] += BAND.idle_hold_s + BAND.cooldown_down_s + 0.1
+        rec = asc.step()
+        assert rec["event"] == "hold" and "min_replicas" in rec["reason"]
+
+    def test_fake_clock_determinism(self):
+        """The acceptance property: the same (probe, clock) stream
+        replays the SAME action sequence — decisions are a pure
+        function of the stream, not of wall clock or iteration
+        timing."""
+        stream = []
+        rng = np.random.default_rng(7)
+        t = 0.0
+        for _ in range(60):
+            t += float(rng.uniform(0.1, 2.0))
+            stream.append((t, {"queue_depth": int(rng.integers(0, 20)),
+                               "shed": int(rng.integers(0, 3))}))
+        # cumulative shed counter, like the real rollup
+        acc = 0
+        for _, sig in stream:
+            acc += sig["shed"]
+            sig["shed"] = acc
+
+        def replay():
+            clk = {"t": 0.0}
+            sigs = {}
+            router, asc = make_autoscaler(BAND, sigs, clk)
+            history = []
+            for t, sig in stream:
+                clk["t"] = t
+                sigs.update(sig)
+                rec = asc.step()
+                history.append((rec["event"], rec.get("replica"),
+                                rec["reason"]))
+            return history, asc.history()
+
+        h1, a1 = replay()
+        h2, a2 = replay()
+        assert h1 == h2
+        assert [(a["event"], a["replica"]) for a in a1] \
+            == [(a["event"], a["replica"]) for a in a2]
+        assert any(e == "scale_up" for e, _, _ in h1)  # stream not trivial
+
+    def test_arbiter_floor_turns_scale_up_into_hold(self):
+        clk = {"t": 0.0}
+        sigs = {"queue_depth": 100, "shed": 0}
+        router = FakeRouter()
+        arb = PoolArbiter(total_hosts=2, serving_hosts=1,
+                          min_trainer_hosts=1)
+        asc = SloAutoscaler(router, BAND, arbiter=arb,
+                            clock=lambda: clk["t"],
+                            rollup=lambda: {**sigs,
+                                            "alive": router.alive})
+        rec = asc.step()  # breach, but the trainer is at its floor
+        assert rec["event"] == "hold" and "pool exhausted" in rec["reason"]
+        assert router.alive == 1 and arb.snapshot()["serving_hosts"] == 1
+
+    def test_loop_crash_contract(self):
+        router = FakeRouter()
+
+        def boom():
+            raise RuntimeError("rollup died")
+
+        asc = SloAutoscaler(router, BAND, rollup=boom)
+        asc.start(poll_s=0.01)
+        deadline = time.monotonic() + 5.0
+        while asc._loop_error_now() is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        asc.stop()
+        with pytest.raises(RuntimeError, match="loop crashed"):
+            asc.step()
+        assert router.registry.counter(
+            "serve_loop_crashes").value() >= 1.0
+
+
+class TestPoolArbiter:
+    def test_borrow_return_and_floor(self):
+        posts = []
+
+        class Elastic:
+            def post_host_loss(self, **kw):
+                posts.append(("host_loss", kw))
+
+            def post_scale_up(self, **kw):
+                posts.append(("scale_up", kw))
+
+        arb = PoolArbiter(total_hosts=4, serving_hosts=1,
+                          min_trainer_hosts=1, elastic=Elastic(),
+                          devices_per_host=2)
+        assert arb.acquire_serving_host("ramp")  # trainer 3 -> 2
+        assert arb.acquire_serving_host("ramp")  # trainer 2 -> 1
+        assert not arb.acquire_serving_host("ramp")  # at the floor
+        assert arb.snapshot() == {"total_hosts": 4, "serving_hosts": 3,
+                                  "trainer_hosts": 1,
+                                  "min_trainer_hosts": 1}
+        assert arb.release_serving_host("trough")  # trainer 1 -> 2
+        # the trainer mesh saw a planned shrink per borrow (dp counts
+        # DEVICES: hosts * devices_per_host) and a reshard-up on return
+        assert [p[0] for p in posts] == ["host_loss", "host_loss",
+                                        "scale_up"]
+        assert posts[0][1]["new_data_parallel"] == 4  # 2 hosts * 2
+        assert posts[2][1]["new_data_parallel"] == 4
+        events = [s["event"] for s in arb.shifts()]
+        assert events == ["pool_borrow", "pool_borrow", "pool_return"]
+
+
+class TestScaleDrain:
+    def test_drain_victim_with_inflight_zero_loss(self, model, rng_np):
+        """The scale-down acceptance property: retiring a replica with
+        requests IN FLIGHT re-queues them through the failover path —
+        nothing lost, every result token-identical to an undisturbed
+        fleet."""
+        cfg, params = model
+        reqs = [(list(rng_np.integers(1, 64, size=3 + (i % 4))),
+                 3 + (i % 3), 0.0 if i % 2 == 0 else 0.8)
+                for i in range(8)]
+
+        def run(scale_down_after):
+            reg = MetricsRegistry("deploy_drain")
+            router = build_local_fleet(cfg, params, small_scfg(), n=2,
+                                       registry=reg)
+            for p, n, t in reqs:
+                router.submit(p, max_new_tokens=n, temperature=t)
+            removed = None
+            pumps = 0
+            while router.pump() or router.stats()["pending"]:
+                pumps += 1
+                if pumps == scale_down_after and removed is None:
+                    victim = router.pick_victim()
+                    removed = router.remove_replica(victim,
+                                                    reason="test drain")
+            router.run_until_idle()
+            return ({r.id: r.tokens for r in router.results()},
+                    router.stats(), removed)
+
+        base, base_stats, _ = run(scale_down_after=None)
+        got, stats, removed = run(scale_down_after=3)
+        assert removed is not None and removed["requeued"] >= 1
+        assert stats["requests_lost"] == 0
+        assert stats["requeued"] >= removed["requeued"]
+        assert got == base  # drain invisible in the output stream
+        assert stats["alive_replicas"] == 1
+
+    def test_remove_last_replica_refused(self, model):
+        cfg, params = model
+        router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                   registry=MetricsRegistry("one"))
+        with pytest.raises(Exception, match="last alive replica"):
+            router.remove_replica(0)
+
+    def test_added_replica_serves_and_is_counted(self, model, rng_np):
+        from paddle_tpu.serving.fleet import clone_replica
+
+        cfg, params = model
+        reg = MetricsRegistry("deploy_add")
+        router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                   registry=reg)
+        idx = router.add_replica(
+            lambda i, src: clone_replica(i, src, registry=reg))
+        assert idx == 1 and router.stats()["alive_replicas"] == 2
+        for i in range(6):
+            router.submit(list(rng_np.integers(1, 64, size=4)),
+                          max_new_tokens=3)
+        router.run_until_idle()
+        assert len(router.results()) == 6
+        assert router.stats()["requests_lost"] == 0
+        # both replicas took work (the new one is really in rotation)
+        assert reg.counter("fleet_replicas_added").value() == 1.0
+
+
+class TestDeploymentController:
+    def test_rollout_then_noop_then_new_checkpoint(self, model, tmp_path):
+        cfg, params = model
+        reg = MetricsRegistry("deploy_ctl")
+        router = build_local_fleet(cfg, params, small_scfg(), n=2,
+                                   registry=reg)
+        ctl = DeploymentController(
+            str(tmp_path / "ckpts"), str(tmp_path / "servable"),
+            router, cfg, registry=reg)
+        assert ctl.poll() is None  # nothing to deploy yet
+        save_model_checkpoint(str(tmp_path / "ckpts"), params)
+        rec = ctl.poll()
+        assert rec["outcome"] == "deployed" and rec["attempt"] == 1
+        assert rec["export_ms"] > 0 and rec["swap_ms"] > 0
+        assert ctl.deployed_uuid() is not None
+        assert ctl.poll() is None  # same checkpoint: nothing to do
+        assert router.stats()["swaps"] == 1
+        # a NEW checkpoint deploys over the old one
+        save_model_checkpoint(str(tmp_path / "ckpts"), params, pass_id=1)
+        rec2 = ctl.poll()
+        assert rec2["outcome"] == "deployed"
+        assert rec2["uuid"] != rec["uuid"]
+        assert [r["outcome"] for r in ctl.ledger()] \
+            == ["deployed", "deployed"]
+
+    def test_corrupt_rollout_rolls_back_then_redeploys(
+            self, model, tmp_path, rng_np):
+        """The chaos property: a servable corrupted in flight is
+        refused at swap, every replica rolls back to the old weights
+        (still serving, token-identical), and the next poll re-exports
+        and succeeds."""
+        cfg, params = model
+        reg = MetricsRegistry("deploy_chaos")
+        chaos = ChaosSchedule("servable_corrupt@0", registry=reg)
+        router = build_local_fleet(cfg, params, small_scfg(), n=2,
+                                   registry=reg, chaos=chaos)
+        prompt = list(rng_np.integers(1, 64, size=5))
+        router.submit(prompt, max_new_tokens=4)
+        router.run_until_idle()
+        want = router.results()[0].tokens
+        ctl = DeploymentController(
+            str(tmp_path / "ckpts"), str(tmp_path / "servable"),
+            router, cfg, registry=reg)
+        save_model_checkpoint(str(tmp_path / "ckpts"), params)
+        rec = ctl.poll()
+        assert rec["outcome"] == "rolled_back" and rec["attempt"] == 1
+        assert "hash mismatch" in rec["error"]
+        assert ctl.deployed_uuid() is None
+        # the fleet kept serving the old weights, token-identically
+        router.submit(prompt, max_new_tokens=4)
+        router.run_until_idle()
+        assert router.results()[0].tokens == want
+        rec2 = ctl.poll()  # fresh export, chaos spent -> deploys
+        assert rec2["outcome"] == "deployed" and rec2["attempt"] == 2
+        assert reg.counter("deploys_rolled_back").value() == 1.0
+        assert reg.counter("deploys_succeeded").value() == 1.0
+        # same weights: the rollout itself must be token-invisible
+        router.submit(prompt, max_new_tokens=4)
+        router.run_until_idle()
+        assert router.results()[0].tokens == want
+
+    def test_poisoned_checkpoint_skipped_after_max_attempts(
+            self, model, tmp_path):
+        cfg, params = model
+        reg = MetricsRegistry("deploy_poison")
+        chaos = ChaosSchedule(
+            "servable_corrupt@0,servable_corrupt@1", registry=reg)
+        router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                   registry=reg, chaos=chaos)
+        ctl = DeploymentController(
+            str(tmp_path / "ckpts"), str(tmp_path / "servable"),
+            router, cfg, registry=reg, max_attempts=2)
+        save_model_checkpoint(str(tmp_path / "ckpts"), params)
+        assert ctl.poll()["outcome"] == "rolled_back"
+        assert ctl.poll()["outcome"] == "rolled_back"
+        assert ctl.poll() is None  # marked bad: no third attempt
+        # ...but a NEW checkpoint is not blocked by the poisoned one
+        save_model_checkpoint(str(tmp_path / "ckpts"), params, pass_id=1)
+        assert ctl.poll()["outcome"] == "deployed"
+
+    def test_loop_crash_contract(self, model, tmp_path):
+        cfg, params = model
+        router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                   registry=MetricsRegistry("ctl_crash"))
+        ctl = DeploymentController(
+            "/nonexistent", str(tmp_path / "s"), router, cfg)
+        assert ctl.poll() is None  # no checkpoint dir: benign, no crash
+
+        def boom(*a, **kw):
+            raise RuntimeError("watch died")
+
+        ctl2 = DeploymentController(
+            str(tmp_path / "ckpts"), str(tmp_path / "s2"), router, cfg)
+        ctl2.poll = boom  # crash the loop body
+        ctl2.start(poll_s=0.01)
+        deadline = time.monotonic() + 5.0
+        while ctl2._loop_error_now() is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        ctl2.stop()
+        assert ctl2._loop_error_now() is not None
+
+
+class TestRetentionGC:
+    def test_prune_never_deletes_newest_valid(self, tmp_path):
+        """Retention by count must not outrank recoverability: when
+        every younger checkpoint is corrupt, the newest VALID one
+        survives the prune regardless of age."""
+        d = str(tmp_path)
+        for i in range(4):
+            ckpt.save_checkpoint(d, i, {"w": np.full(2, i, np.float32)},
+                                 keep_last=0)  # no GC while arranging
+        for i in (1, 2, 3):  # corrupt everything younger than pass-0
+            with open(os.path.join(d, f"pass-{i:05d}", "params.npz"),
+                      "ab") as f:
+                f.write(b"garbage")
+        removed = ckpt.prune_old(d, keep_last=1)
+        left = sorted(x for x in os.listdir(d) if x.startswith("pass-"))
+        # pass-3 kept by count, pass-0 kept as the newest VALID
+        assert left == ["pass-00000", "pass-00003"]
+        assert [os.path.basename(p) for p in removed] \
+            == ["pass-00001", "pass-00002"]
+        path, manifest = ckpt.latest_checkpoint(d)
+        assert manifest["pass_id"] == 0
+
+    def test_prune_never_deletes_mid_export(self, tmp_path):
+        d = str(tmp_path)
+        for i in range(3):
+            ckpt.save_checkpoint(d, i, {"w": np.zeros(1, np.float32)},
+                                 keep_last=0)
+        oldest = os.path.join(d, "pass-00000")
+        with ckpt.export_pin(oldest):
+            ckpt.prune_old(d, keep_last=1)
+            left = sorted(x for x in os.listdir(d)
+                          if x.startswith("pass-"))
+            # the pinned dir survives mid-export; pass-1 is pruned
+            assert left == ["pass-00000", "pass-00002"]
+            # the pin marker does not break validation
+            assert ckpt._validate(oldest) is not None
+        # pin released: the next prune may take it
+        ckpt.prune_old(d, keep_last=1)
+        left = sorted(x for x in os.listdir(d) if x.startswith("pass-"))
+        assert left == ["pass-00002"]
+
+    def test_save_checkpoint_keep_last_still_prunes(self, tmp_path):
+        d = str(tmp_path)
+        for i in range(5):
+            ckpt.save_checkpoint(d, i, {"w": np.zeros(1, np.float32)},
+                                 keep_last=2)
+        left = sorted(x for x in os.listdir(d) if x.startswith("pass-"))
+        assert left == ["pass-00003", "pass-00004"]
+
+    def test_keep_last_zero_disables(self, tmp_path):
+        d = str(tmp_path)
+        for i in range(4):
+            ckpt.save_checkpoint(d, i, {"w": np.zeros(1, np.float32)},
+                                 keep_last=0)
+        assert len(ckpt.checkpoint_entries(d)) == 4
+        assert ckpt.prune_old(d, keep_last=0) == []
+
+
+class TestClientBackoff:
+    def test_honors_retry_after_with_capped_jitter(self):
+        class SheddingRouter:
+            registry = MetricsRegistry("client_test")
+
+            def __init__(self, sheds):
+                self.sheds = sheds
+                self.calls = 0
+
+            def submit(self, prompt, **kw):
+                self.calls += 1
+                if self.calls <= self.sheds:
+                    raise RetryAfter("test shed", 0.2)
+                return 41 + self.calls
+
+        waits = []
+        r = SheddingRouter(sheds=3)
+        rid = backoff_submit(r, [1, 2], seed=5, wait=waits.append)
+        assert rid == 45 and r.calls == 4
+        assert len(waits) == 3
+        # jitter ±25% around the 0.2s hint, capped
+        assert all(0.15 <= w <= 0.25 for w in waits)
+        # deterministic: the same seed replays the same wait sequence
+        waits2 = []
+        backoff_submit(SheddingRouter(sheds=3), [1, 2], seed=5,
+                       wait=waits2.append)
+        assert waits == waits2
+        assert r.registry.counter("client_backoffs").value() >= 3.0
+
+    def test_gives_up_after_attempts(self):
+        class AlwaysShed:
+            registry = MetricsRegistry("client_test2")
+
+            def submit(self, prompt, **kw):
+                raise RetryAfter("always shed", 0.01)
+
+        with pytest.raises(RetryAfter):
+            backoff_submit(AlwaysShed(), [1], attempts=3,
+                           wait=lambda s: None)
+
+
+class TestScrapeRetry:
+    def test_transient_scrape_error_retried_once(self, model,
+                                                 monkeypatch):
+        """One flaky fetch (GC pause, connection reset) must not read
+        as a dead replica: the retry absorbs it and the rollup is
+        complete, with the retry counted."""
+        from paddle_tpu.resilience.chaos import flaky
+        from paddle_tpu.telemetry import introspect
+
+        cfg, params = model
+        reg = MetricsRegistry("scrape_retry")
+        router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                   registry=reg)
+        real = ("serve_tokens 5.0\nserve_requests 1.0\n"
+                "serve_active_slots 0.0\nserve_free_pages 32.0\n")
+        monkeypatch.setattr(
+            introspect, "scrape",
+            flaky(lambda url, timeout=5.0: real, fail_times=1,
+                  exc=OSError))
+        rollup = router.scrape_replicas(
+            ["http://fake/metrics"],
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                              retry_on=(OSError,), scope="fleet_scrape",
+                              registry=reg, sleep=lambda s: None))
+        assert rollup["replicas_scraped"] == 1
+        assert rollup["scrape_errors"] == {}
+        assert rollup["serve_tokens"] == 5.0
+        assert reg.counter("fleet_scrape_errors").value() == 0.0
+
+    def test_dead_endpoint_counted_not_silent(self, model, monkeypatch):
+        from paddle_tpu.telemetry import introspect
+
+        cfg, params = model
+        reg = MetricsRegistry("scrape_dead")
+        router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                   registry=reg)
+
+        def dead(url, timeout=5.0):
+            raise OSError("connection refused")
+
+        monkeypatch.setattr(introspect, "scrape", dead)
+        rollup = router.scrape_replicas(
+            ["http://fake/metrics"],
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                              retry_on=(OSError,), scope="fleet_scrape",
+                              registry=reg, sleep=lambda s: None))
+        assert rollup["replicas_scraped"] == 0
+        assert list(rollup["scrape_errors"]) == ["http://fake/metrics"]
+        assert reg.counter("fleet_scrape_errors").value() == 1.0
+
+
+class TestTelemetryRendering:
+    def test_deploy_and_autoscale_records_render(self, model, tmp_path):
+        """The /15 stream end to end: a real rollout + autoscale
+        actions land in a JSONL capture that metrics_to_md renders
+        without error (the bench's reporting path)."""
+        from paddle_tpu.serving.fleet import clone_replica
+        from paddle_tpu.telemetry import JsonlSink
+
+        cfg, params = model
+        reg = MetricsRegistry("deploy_md")
+        mem = MemorySink()
+        reg.add_sink(mem)
+        path = tmp_path / "metrics.jsonl"
+        with open(path, "w") as f:
+            reg.add_sink(JsonlSink(f))
+            router = build_local_fleet(cfg, params, small_scfg(), n=1,
+                                       registry=reg)
+            router.add_replica(
+                lambda i, src: clone_replica(i, src, registry=reg))
+            ctl = DeploymentController(
+                str(tmp_path / "ckpts"), str(tmp_path / "servable"),
+                router, cfg, registry=reg)
+            save_model_checkpoint(str(tmp_path / "ckpts"), params)
+            assert ctl.poll()["outcome"] == "deployed"
+            router.remove_replica(1, reason="test idle")
+            arb = PoolArbiter(total_hosts=2, serving_hosts=0,
+                              min_trainer_hosts=1, registry=reg)
+            assert arb.acquire_serving_host("render test")
+        kinds = {r.get("kind") for r in mem.records}
+        assert {"deploy", "autoscale", "fleet"} <= kinds
+        sys.path.insert(0, "tools")
+        try:
+            import metrics_to_md
+            assert metrics_to_md.main([str(path)]) == 0
+        finally:
+            sys.path.remove("tools")

@@ -151,7 +151,7 @@ def test_finish_emits_ledger_record_gauge_and_jsonl(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     rec = led.finish(path=path)
     assert rec["kind"] == "ledger"
-    assert rec["schema"].endswith("/14")
+    assert rec["schema"].endswith("/15")
     assert reg.get("goodput_fraction").value() == pytest.approx(0.75)
     recs = [r for r in sink.records if r.get("kind") == "ledger"]
     assert len(recs) == 1

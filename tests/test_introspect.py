@@ -396,7 +396,7 @@ def test_profile_steps_window_emits_record(tmp_path):
     assert len(prof) == 1
     rec = prof[0]
     assert rec["start_step"] == 1 and rec["end_step"] == 3
-    assert rec["schema"] == "paddle_tpu.metrics/14"
+    assert rec["schema"] == "paddle_tpu.metrics/15"
     assert rec["trace_dir"] == str(tmp_path / "prof")
     assert os.path.isdir(rec["trace_dir"])  # the device capture landed
     assert rec["spans"]["compute"]["count"] == 2  # the window's steps

@@ -1,0 +1,315 @@
+"""Deploy chaos-ramp bench: the train→serve loop end to end, under
+load, churn and one injected rollout fault.
+
+One seeded arrival trace ramps offered QPS 10× (low → 10× → low, the
+diurnal curve compressed).  It is served twice:
+
+1. **baseline** — a fixed fleet at max size, no chaos, no deployments:
+   the reference tokens;
+2. **chaos run** — the fleet starts at ONE replica with the
+   :class:`SloAutoscaler` (backed by a :class:`PoolArbiter` borrowing
+   hosts from a training-mesh ledger) scaling it up the ramp and back
+   down the far side; mid-ramp a trainer checkpoint (same weights)
+   lands and the :class:`DeploymentController` rolls it across the
+   fleet while traffic flows — with a ``servable_corrupt@0`` chaos
+   fault corrupting the FIRST rollout's artifact, forcing a full
+   rollback (the next poll re-exports and succeeds); shed submits
+   retry through ``serving.client.backoff_submit``.
+
+The row is the proof, enforced (RuntimeError, not a number):
+``requests_lost`` must be 0, every request delivered, tokens
+byte-identical to the baseline (greedy trace — neither the swap, the
+failover-drain scale-down, nor the rollback may perturb a single
+token), ≥1 scale-up, ≥1 scale-down, exactly one rolled-back and one
+deployed rollout attempt, and the pool arbiter's borrow/return ledger
+balanced.  Scale/rollout/rollback timings ride the ``autoscale`` /
+``deploy`` telemetry records on stdout (``tools/metrics_to_md.py``
+renders the tables).
+
+Standalone: ``python tools/bench_deploy_chaos.py`` (CPU-safe; the jnp
+reference paged-attention path serves).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _repo not in sys.path:
+        sys.path.insert(0, _repo)
+    _tools = os.path.dirname(os.path.abspath(__file__))
+    if _tools not in sys.path:
+        sys.path.insert(0, _tools)
+
+import numpy as np  # noqa: E402
+
+MAX_REPLICAS = 3
+LOW_QPS = 20.0
+HIGH_QPS = 200.0  # the 10× ramp peak
+CONTROL_PERIOD_S = 0.02  # autoscaler step / controller poll cadence
+
+
+def make_ramp_trace(n_requests: int, seed: int = 0):
+    """(prompt, max_new_tokens, arrival_offset_s) triples — Poisson
+    arrivals whose rate ramps LOW → 10× → LOW in thirds (the diurnal
+    curve compressed to bench scale), ragged prompts and lengths."""
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for i in range(n_requests):
+        frac = i / n_requests
+        rate = HIGH_QPS if 1 / 3 <= frac < 2 / 3 else LOW_QPS
+        t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.integers(4, 13))
+        prompt = rng.integers(1, 255, size=plen).tolist()
+        max_new = int(rng.integers(4, 17))
+        out.append((prompt, max_new, t))
+    return out
+
+
+def _scfg(seed: int):
+    from paddle_tpu.serving.scheduler import ServingConfig
+
+    return ServingConfig(
+        max_slots=4, page_size=16, num_pages=96, max_prompt_len=16,
+        max_new_tokens=32, prefill_batch=4, seed=seed)
+
+
+def run_baseline(cfg, params, trace, seed: int = 0):
+    """The reference run: a fixed fleet at max size, no chaos, no
+    deployments — same trace, same backoff client."""
+    from paddle_tpu.serving.client import backoff_submit
+    from paddle_tpu.serving.fleet import FleetConfig, build_local_fleet
+    from paddle_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry("bench_deploy_baseline")
+    router = build_local_fleet(cfg, params, _scfg(seed), n=MAX_REPLICAS,
+                               registry=reg, fleet=FleetConfig())
+    for rep in router.replicas:
+        rep.engine.generate([[1, 2, 3]] * 2, max_new_tokens=2)
+
+    t0 = time.perf_counter()
+
+    def pump_for(delay_s: float) -> None:
+        end = time.perf_counter() + delay_s
+        while time.perf_counter() < end:
+            if not router.pump():
+                time.sleep(2e-4)
+
+    for prompt, max_new, arrival in trace:
+        while time.perf_counter() - t0 < arrival:
+            if not router.pump():
+                time.sleep(2e-4)
+        backoff_submit(router, prompt, max_new_tokens=max_new,
+                       seed=seed, wait=pump_for)
+    router.run_until_idle()
+    results = router.results()
+    stats = router.stats()
+    if stats["requests_lost"] != 0 or len(results) != len(trace):
+        raise RuntimeError(
+            f"baseline lost requests: {stats['requests_lost']} lost, "
+            f"{len(results)}/{len(trace)} delivered — {stats}")
+    return results
+
+
+def run_chaos(cfg, params, trace, seed: int = 0, sink=None):
+    """The proving run: 1 replica + autoscaler + pool arbiter +
+    deployment controller + one servable_corrupt rollout fault."""
+    from paddle_tpu.deploy import (
+        AutoscalePolicy,
+        DeploymentController,
+        PoolArbiter,
+        SloAutoscaler,
+    )
+    from paddle_tpu.resilience.chaos import ChaosSchedule
+    from paddle_tpu.resilience.elastic import ElasticCoordinator
+    from paddle_tpu.serving.client import backoff_submit
+    from paddle_tpu.serving.fleet import FleetConfig, build_local_fleet
+    from paddle_tpu.telemetry import MetricsRegistry
+    from paddle_tpu.trainer.checkpoint import save_checkpoint
+
+    reg = MetricsRegistry("bench_deploy_chaos")
+    if sink is not None:
+        reg.add_sink(sink)
+    chaos = ChaosSchedule("servable_corrupt@0", registry=reg)
+    router = build_local_fleet(cfg, params, _scfg(seed), n=1,
+                               registry=reg, chaos=chaos,
+                               fleet=FleetConfig())
+    router.replicas[0].engine.generate([[1, 2, 3]] * 2, max_new_tokens=2)
+
+    arbiter = PoolArbiter(
+        total_hosts=4, serving_hosts=1, min_trainer_hosts=1,
+        elastic=ElasticCoordinator(registry=reg), registry=reg)
+    autoscaler = SloAutoscaler(
+        router,
+        AutoscalePolicy(min_replicas=1, max_replicas=MAX_REPLICAS,
+                        up_queue_per_replica=4.0,
+                        down_queue_per_replica=0.5, idle_hold_s=0.3,
+                        cooldown_up_s=0.05, cooldown_down_s=0.2),
+        arbiter=arbiter, registry=reg)
+
+    work = tempfile.mkdtemp(prefix="bench_deploy_chaos_")
+    ckpt_dir = os.path.join(work, "ckpts")
+    controller = DeploymentController(
+        ckpt_dir, os.path.join(work, "servable"), router, cfg,
+        registry=reg)
+
+    flat = {}
+
+    def flatten(d, prefix=""):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                flatten(v, f"{prefix}{k}/")
+            else:
+                flat[f"{prefix}{k}"] = np.asarray(v)
+
+    flatten(params)
+
+    t0 = time.perf_counter()
+    last_control = [0.0]
+
+    def control() -> None:
+        now = time.perf_counter()
+        if now - last_control[0] < CONTROL_PERIOD_S:
+            return
+        last_control[0] = now
+        autoscaler.step()
+        controller.poll()
+
+    def pump_for(delay_s: float) -> None:
+        end = time.perf_counter() + delay_s
+        while time.perf_counter() < end:
+            if not router.pump():
+                time.sleep(2e-4)
+            control()
+
+    try:
+        for i, (prompt, max_new, arrival) in enumerate(trace):
+            while time.perf_counter() - t0 < arrival:
+                if not router.pump():
+                    time.sleep(2e-4)
+                control()
+            backoff_submit(router, prompt, max_new_tokens=max_new,
+                           seed=seed, wait=pump_for)
+            if i == len(trace) // 2:
+                # the mid-ramp checkpoint: SAME weights, so the rollout
+                # must be token-invisible — the swap is what's tested,
+                # not the model
+                save_checkpoint(ckpt_dir, 0, flat)
+            control()
+        # idle out: drain the queue, let the rollout land (attempt 1
+        # rolls back on the chaos corrupt, attempt 2 deploys) and the
+        # autoscaler walk the fleet back down to min
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if not router.pump():
+                time.sleep(2e-4)
+            control()
+            s = router.stats()
+            done = (s["pending"] == 0 and s["inflight"] == 0
+                    and controller.deployed_uuid() is not None
+                    and s["alive_replicas"] == 1)
+            if done:
+                break
+        else:
+            raise RuntimeError(
+                "chaos run did not converge (drained + deployed + "
+                f"scaled back to 1 replica) in time: {router.stats()}, "
+                f"ledger {controller.ledger()}")
+        router.run_until_idle()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return (router.results(), router.stats(), autoscaler.history(),
+            controller.ledger(), arbiter)
+
+
+def run_bench(n_requests: int = 48, seed: int = 0,
+              sink=None) -> list[dict]:
+    import jax
+
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, embed_dim=64,
+        mlp_dim=128, max_seq_len=128, remat=False)
+    params = T.init_params(cfg, jax.random.key(seed))
+    trace = make_ramp_trace(n_requests, seed=seed)
+
+    base_res = run_baseline(cfg, params, trace, seed=seed)
+    res, stats, actions, ledger, arbiter = run_chaos(
+        cfg, params, trace, seed=seed, sink=sink)
+
+    # -- the acceptance properties, enforced ----------------------------------
+    if stats["requests_lost"] != 0 or len(res) != n_requests:
+        raise RuntimeError(
+            f"chaos run lost requests: {stats['requests_lost']} lost, "
+            f"{len(res)}/{n_requests} delivered — {stats}")
+    same = all(a.tokens == b.tokens for a, b in
+               zip(sorted(base_res, key=lambda r: r.id),
+                   sorted(res, key=lambda r: r.id)))
+    if not same:
+        raise RuntimeError(
+            "scale churn / rollout / rollback changed generated tokens "
+            "vs the fixed-fleet baseline — the greedy trace must be "
+            "byte-identical")
+    ups = [a for a in actions if a["event"] == "scale_up"]
+    downs = [a for a in actions if a["event"] == "scale_down"]
+    if not ups or not downs:
+        raise RuntimeError(
+            f"autoscaler did not ride the ramp both ways: "
+            f"{len(ups)} up(s), {len(downs)} down(s) — {actions}")
+    rolled = [r for r in ledger if r["outcome"] == "rolled_back"]
+    deployed = [r for r in ledger if r["outcome"] == "deployed"]
+    if len(rolled) != 1 or len(deployed) != 1:
+        raise RuntimeError(
+            f"expected exactly one rolled-back and one deployed "
+            f"attempt, got {ledger}")
+    shifts = arbiter.shifts()
+    borrows = sum(1 for s in shifts if s["event"] == "pool_borrow")
+    returns = sum(1 for s in shifts if s["event"] == "pool_return")
+    if borrows != len(ups) or returns != len(downs):
+        raise RuntimeError(
+            f"pool ledger out of balance: {borrows} borrow(s) vs "
+            f"{len(ups)} scale-up(s), {returns} return(s) vs "
+            f"{len(downs)} scale-down(s) — {shifts}")
+
+    config = (f"2L/64d transformer, {n_requests} arrivals ramping "
+              f"{LOW_QPS:.0f}→{HIGH_QPS:.0f}→{LOW_QPS:.0f} QPS, fleet "
+              f"1..{MAX_REPLICAS} replicas, mid-ramp rollout, one "
+              f"servable_corrupt")
+    return [{
+        "metric": "deploy_chaos_ramp_p99_scale_up_ms",
+        "value": round(max(a.get("scale_ms", 0.0) for a in ups), 1),
+        "unit": "ms",
+        "scale_ups": len(ups), "scale_downs": len(downs),
+        "rollout_ms": round(deployed[0]["total_ms"], 1),
+        "rollback_ms": round(rolled[0]["total_ms"], 1),
+        "requests_lost": stats["requests_lost"],
+        "shed": stats["shed"],
+        "failovers": stats["failovers"],
+        "tokens_identical": bool(same),
+        "pool_borrows": borrows, "pool_returns": returns,
+        "config": config, "vs_baseline": 0,
+    }]
+
+
+def main() -> None:
+    from paddle_tpu.telemetry import JsonlSink
+
+    sink = JsonlSink(sys.stdout)
+    rows = run_bench(sink=sink)
+    from paddle_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry("bench_deploy_chaos")
+    reg.add_sink(sink)
+    for r in rows:
+        reg.emit(r, kind="bench")
+
+
+if __name__ == "__main__":
+    main()

@@ -275,6 +275,17 @@ def fleet_table(events: list[dict]) -> None:
                           f"REFUSED ({r.get('error', '?')}); rolled "
                           f"back {len(r.get('rolled_back') or [])} "
                           f"replica(s)")
+            elif ev == "replica_added":
+                detail = (f"replica {r.get('replica', '?')} joined "
+                          f"(cloned from replica "
+                          f"{r.get('source', '?')}) — fleet now "
+                          f"{r.get('alive', '?')} alive")
+            elif ev == "replica_retired":
+                detail = (f"replica {r.get('replica', '?')} retired "
+                          f"({r.get('reason', '?')}) — "
+                          f"{r.get('requeued', 0)} request(s) "
+                          f"re-queued, fleet now "
+                          f"{r.get('alive', '?')} alive")
             else:
                 detail = str({k: v for k, v in r.items()
                               if k not in ("event", "kind", "schema",
@@ -301,6 +312,78 @@ def fleet_table(events: list[dict]) -> None:
                   "rejections while the fleet was past its admission "
                   "watermarks — raise capacity or relax the SLO if "
                   "this recurs under normal load._")
+
+
+def deploy_table(deploys: list[dict]) -> None:
+    """Render the schema /15 deployment ledger (``kind="deploy"``,
+    paddle_tpu/deploy/controller.py): one row per rollout attempt with
+    its export/swap/total timings — a rolled-back or failed attempt is
+    flagged loudly, because a fleet that silently stops taking weight
+    pushes is a serving incident, not a detail."""
+    if not deploys:
+        return
+    print("\n## Deployments\n")
+    print("| attempt | checkpoint | outcome | export ms | swap ms "
+          "| total ms |")
+    print("|---|---|---|---|---|---|")
+    bad = []
+    for r in deploys:
+        outcome = r.get("outcome", "-")
+        if outcome != "deployed":
+            bad.append(r)
+            outcome = f"**{outcome}** ⚠"
+        print(f"| {r.get('attempt', '?')} | `{r.get('checkpoint', '-')}` "
+              f"| {outcome} | {_fmt(r.get('export_ms'))} "
+              f"| {_fmt(r.get('swap_ms'))} | {_fmt(r.get('total_ms'))} |")
+    ok = len(deploys) - len(bad)
+    print(f"\n**{len(deploys)} rollout attempt(s)** · {ok} deployed · "
+          f"{len(bad)} failed/rolled back")
+    for r in bad:
+        print(f"\n**⚠ {r.get('outcome')}**: `{r.get('checkpoint')}` "
+              f"(attempt {r.get('attempt', '?')}) — "
+              f"{r.get('error', 'no error recorded')}.  A rollback means "
+              f"the fleet kept serving the PREVIOUS weights; if every "
+              f"attempt for a checkpoint fails it is marked bad and the "
+              f"next checkpoint deploys over it.")
+
+
+def autoscale_table(events: list[dict]) -> None:
+    """Render the schema /15 autoscale stream (``kind="autoscale"``,
+    paddle_tpu/deploy/autoscaler.py + arbiter.py): one row per scale
+    action and per pool shift — the chaos-ramp bench's evidence that
+    the fleet followed the load curve both ways."""
+    if not events:
+        return
+    print("\n## Autoscaling\n")
+    print("| event | detail |")
+    print("|---|---|")
+    ups = downs = 0
+    for r in events:
+        ev = r.get("event", "-")
+        if ev == "scale_up":
+            ups += 1
+            detail = (f"replica {r.get('replica', '?')} added "
+                      f"({r.get('reason', '?')}) in "
+                      f"{_fmt(r.get('scale_ms'))} ms")
+        elif ev == "scale_down":
+            downs += 1
+            detail = (f"replica {r.get('replica', '?')} retired "
+                      f"({r.get('reason', '?')}), "
+                      f"{r.get('requeued', 0)} request(s) re-queued, in "
+                      f"{_fmt(r.get('scale_ms'))} ms")
+        elif ev in ("pool_borrow", "pool_return"):
+            detail = (f"{r.get('reason', '?')} — pool now "
+                      f"{r.get('trainer_hosts', '?')} trainer / "
+                      f"{r.get('serving_hosts', '?')} serving host(s)")
+        else:
+            detail = str({k: v for k, v in r.items()
+                          if k not in ("event", "kind", "schema",
+                                       "ts", "host")})
+        print(f"| {ev} | {detail} |")
+    if ups or downs:
+        print(f"\n**{ups} scale-up(s) · {downs} scale-down(s)** — "
+              f"scale-downs drain through the failover re-queue path, "
+              f"so they never lose requests.")
 
 
 def _pctl(vals: list[float], q: float) -> float:
@@ -651,6 +734,8 @@ def main(argv: list[str]) -> int:
     preflights = [r for r in records if r.get("kind") == "preflight"]
     profiles = [r for r in records if r.get("kind") == "profile"]
     ledgers = [r for r in records if r.get("kind") == "ledger"]
+    deploys = [r for r in records if r.get("kind") == "deploy"]
+    autoscales = [r for r in records if r.get("kind") == "autoscale"]
     bench = [r for r in records
              if r.get("kind") == "bench" or
              ("metric" in r and "kind" not in r)]  # pre-schema bench rows
@@ -666,6 +751,8 @@ def main(argv: list[str]) -> int:
     recovery_table(faults, recoveries)
     elastic_table(elastics)
     fleet_table(fleets)
+    deploy_table(deploys)
+    autoscale_table(autoscales)
     serving_table(serves, serve_summaries)
     preflight_table(preflights, steps)
     trace_table(profiles)
@@ -674,7 +761,7 @@ def main(argv: list[str]) -> int:
     if not steps and not bench and not faults and not recoveries \
             and not serves and not serve_summaries and not elastics \
             and not fleets and not preflights and not profiles \
-            and not ledgers:
+            and not ledgers and not deploys and not autoscales:
         print("_no step, fault, serve or bench records found_")
     return 0
 
