@@ -769,6 +769,39 @@ def bench_zero(records):
         records.append(r)
 
 
+def bench_embedding(records):
+    """Sharded-embedding CTR ablation (tools/bench_embedding.py):
+    replicated-dense vs row-sharded tables + fused TPP lookup on a
+    forced-8-device host mesh, in a SUBPROCESS so the virtual mesh never
+    touches this process's backend.  The row carries the per-device
+    table byte census (runtime == static GL-P-MEM model, checked in the
+    script) alongside ms/step and the trajectory-identity contract."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_embedding.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    env["XLA_FLAGS"] = " ".join(
+        kept + ["--xla_force_host_platform_device_count=8"])
+    out = subprocess.run([sys.executable, script], env=env,
+                         capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench_embedding subprocess failed: "
+                           f"{out.stderr[-400:]}")
+    for line in out.stdout.splitlines():
+        if not line.startswith("{"):
+            continue
+        r = json.loads(line)
+        r.pop("schema", None), r.pop("ts", None), r.pop("host", None)
+        r.pop("kind", None)
+        records.append(r)
+
+
 def bench_serving(records):
     """Serving ablation (tools/bench_serving.py in a subprocess, CPU-safe):
     continuous batching vs naive static batching on the same synthetic
@@ -964,7 +997,8 @@ def main() -> None:
             bench_lstm_ablation, bench_nmt, bench_nmt_ablation, bench_ctr,
             bench_crnn, bench_saturation, bench_input_pipeline,
             bench_input_bucketing, bench_transformer, bench_zero,
-            bench_serving, bench_serving_fleet, bench_serving_prefix)
+            bench_embedding, bench_serving, bench_serving_fleet,
+            bench_serving_prefix)
     # debugging aid: `python bench.py transformer resnet` runs a subset;
     # the driver's no-arg invocation runs everything.  --prefetch=0|N
     # sets the input-pipeline ablation depth (0 = sync row only).

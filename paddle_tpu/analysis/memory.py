@@ -8,14 +8,18 @@ state and activations under the active sharding.  This module computes
 that artifact statically, from nothing but the model/optimizer pytrees,
 the mesh, the active ``zero`` mode and the step's jaxpr:
 
-- **params**: replicated per device at every zero mode shipped today
-  (ZeRO-3 parameter sharding is exactly the item this groundwork
-  serves);
-- **optimizer slots**: full bytes at ``zero=0``, and the
-  :func:`paddle_tpu.parallel.zero.state_specs` layout at ``zero>=1`` —
-  leaves the spec shards cost ``bytes/dp``, indivisible leaves stay
-  full.  This mirrors device placement exactly, so the static number
-  agrees with the runtime census
+- **params**: replicated per device by default; a parameter whose base
+  spec names live mesh axes — the row-sharded embedding tables,
+  ``sharding=("model", None)`` — costs ``bytes/degree``
+  (:func:`params_bytes_per_device`; ZeRO-3 parameter sharding extends
+  the same accounting);
+- **optimizer slots**: at ``zero=0``, full bytes except same-shape slots
+  of base-sharded params (``zeros_like`` slots inherit the table's
+  placement, so sparse momentum shards with its table); at ``zero>=1``
+  the :func:`paddle_tpu.parallel.zero.state_specs` layout — leaves cost
+  ``bytes/placement-degree`` (the data axis composed with any preserved
+  base TP axes), indivisible leaves stay full.  This mirrors device
+  placement exactly, so the static number agrees with the runtime census
   (:func:`paddle_tpu.parallel.zero.state_bytes_per_device`) to dtype
   rounding;
 - **activations**: a liveness walk over the jaxpr — intermediates are
@@ -67,6 +71,43 @@ def tree_bytes(tree) -> int:
     return sum(_leaf_bytes(leaf) for leaf in jax.tree.leaves(tree))
 
 
+def _spec_degree(spec, mesh_sizes: dict) -> int:
+    """How many ways a leaf with base sharding ``spec`` splits across the
+    mesh: the product of the named axes' sizes (axes absent from the mesh
+    count 1).  Accepts a PartitionSpec or a raw tuple like
+    ``("model", None)``; None/() means replicated."""
+    if spec is None:
+        return 1
+    deg = 1
+    for entry in spec:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for a in names:
+            if a is not None:
+                deg *= int(mesh_sizes.get(a, 1))
+    return max(deg, 1)
+
+
+def params_bytes_per_device(params, mesh, param_specs=None) -> int:
+    """Static per-device parameter residency: replicated by default, but a
+    parameter whose base spec names live mesh axes — a row-sharded
+    embedding table carrying ``sharding=("model", None)`` — costs
+    ``bytes/degree``, matching what device placement does (the sharded-
+    table extension of the GL-P-MEM byte model)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None or param_specs is None:
+        return tree_bytes(params)
+    sizes = dict(mesh.shape)
+    leaves = jax.tree.leaves(params)
+    spec_leaves = jax.tree.leaves(param_specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    if len(spec_leaves) != len(leaves):  # layout surprise: stay safe
+        return tree_bytes(params)
+    return sum(-(-_leaf_bytes(l) // _spec_degree(s, sizes))
+               for l, s in zip(leaves, spec_leaves))
+
+
 def opt_state_bytes_per_device(opt_state, params, mesh, zero: int,
                                param_specs=None, axis: str = "data") -> int:
     """Static per-device optimizer-state residency under ``zero``.
@@ -82,10 +123,32 @@ def opt_state_bytes_per_device(opt_state, params, mesh, zero: int,
     from paddle_tpu.parallel import zero as zero_mod
 
     dp = 1
+    sizes = {}
     if mesh is not None:
-        dp = int(dict(mesh.shape).get(axis, 1))
+        sizes = dict(mesh.shape)
+        dp = int(sizes.get(axis, 1))
     if not (zero >= 1 and dp > 1):
-        return tree_bytes(opt_state)
+        # zero off: the data axis doesn't shard slots, but base TP axes
+        # still do — zeros_like slots inherit their parameter's placement,
+        # so a row-sharded embedding table keeps its momentum on the shard
+        if mesh is None or param_specs is None:
+            return tree_bytes(opt_state)
+        slots = (opt_state.get("slots")
+                 if isinstance(opt_state, dict) else None)
+        if not (isinstance(slots, dict) and isinstance(params, dict)
+                and isinstance(param_specs, dict)):
+            return tree_bytes(opt_state)
+        total = tree_bytes(
+            {k: v for k, v in opt_state.items() if k != "slots"})
+        for nm, sl in slots.items():
+            p_shape = tuple(getattr(params.get(nm), "shape", ()))
+            base = param_specs.get(nm)
+            for leaf in jax.tree.leaves(sl):
+                b = _leaf_bytes(leaf)
+                if tuple(getattr(leaf, "shape", ())) == p_shape:
+                    b = -(-b // _spec_degree(base, sizes))
+                total += b
+        return total
     specs = zero_mod.state_specs(opt_state, params, mesh, axis=axis,
                                  param_specs=param_specs)
     leaves = jax.tree.leaves(opt_state)
@@ -98,9 +161,12 @@ def opt_state_bytes_per_device(opt_state, params, mesh, zero: int,
     total = 0
     for leaf, spec in zip(leaves, spec_leaves):
         b = _leaf_bytes(leaf)
-        sharded = (isinstance(spec, P)
-                   and zero_mod.data_dim(spec, axis) is not None)
-        total += b // dp if sharded else b
+        if isinstance(spec, P):
+            # the data axis (ZeRO) composes with any base TP axes the
+            # state spec preserved — divide by the full placement degree
+            total += b // max(_spec_degree(spec, sizes), 1)
+        else:
+            total += b
     return total
 
 
@@ -230,7 +296,8 @@ def memory_report(params, opt_state, states, feed, mesh=None, *,
         dp = int(dict(mesh_obj.shape).get(axis, 1))
     report = {
         "dp": dp, "zero": int(zero),
-        "params_bytes": tree_bytes(params),
+        "params_bytes": params_bytes_per_device(params, mesh_obj,
+                                                param_specs),
         "opt_state_bytes": opt_state_bytes_per_device(
             opt_state, params, mesh_obj, zero, param_specs=param_specs,
             axis=axis),

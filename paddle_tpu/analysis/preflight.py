@@ -198,9 +198,17 @@ def trainer_preflight(topology, optimizer, feed, mesh=None, *,
             log.debug("preflight compile unavailable (%s); jaxpr-level "
                       "checks stand", e)
             compiled = None
+    # per-param base sharding (e.g. row-sharded embedding tables) feeds
+    # the params/slots byte model — aligned leaf-for-leaf with params
+    from jax.sharding import PartitionSpec as _P
+
+    base_specs = {
+        n: (_P(*specs[n].sharding)
+            if n in specs and getattr(specs[n], "sharding", None) else _P())
+        for n in params}
     report = memory_report(params, opt_state, states, feed, mesh,
-                           zero=zero, step=step_jx, args=(),
-                           compiled=compiled)
+                           zero=zero, param_specs=base_specs,
+                           step=step_jx, args=(), compiled=compiled)
     if report_out is not None:
         report_out.update(report)
     findings += memory_budget_pass(report, name=name, hbm_gb=hbm_gb,

@@ -269,18 +269,35 @@ def embedding(
     param_attr: ParamAttr | None = None,
     name: str | None = None,
     padding_idx: int | None = None,
+    pad_rows_to: int | None = None,
 ) -> LayerOutput:
     """≅ embedding_layer (layers.py:1045) / TableProjection.  Sparse-update
-    semantics come from XLA's scatter-add gather gradient (SelectedRows analog)."""
+    semantics come from XLA's scatter-add gather gradient (SelectedRows analog).
+
+    ``pad_rows_to=k`` rounds the table's row count up to a multiple of
+    ``k`` so it can row-shard over a k-way mesh axis
+    (``parallel.embedding.pad_vocab``); the forward then clamps-and-zeros
+    ids outside the *logical* vocab so pad rows are never read and never
+    receive gradient."""
     name = name or gen_name("embedding")
     vocab = input.size
+    rows = vocab if not pad_rows_to else -(-vocab // pad_rows_to) * pad_rows_to
     spec = _wspec(
-        param_attr, name, "w0", (vocab, size), I.paddle_default(0.0, None), sparse=True
+        param_attr, name, "w0", (rows, size), I.paddle_default(0.0, None), sparse=True
     )
 
     def fwd(ctx, params, states, ids):
         table = params[spec.name]
-        return map_data(lambda d: emb_lookup(table, d, padding_idx), ids)
+        if rows == vocab:
+            return map_data(lambda d: emb_lookup(table, d, padding_idx), ids)
+
+        def one(d):
+            di = d.astype(jnp.int32)
+            got = emb_lookup(table, jnp.clip(di, 0, vocab - 1), padding_idx)
+            ok = (di >= 0) & (di < vocab)
+            return jnp.where(ok[..., None], got, jnp.zeros((), got.dtype))
+
+        return map_data(one, ids)
 
     # the reference implements embedding_layer as a mixed layer holding one
     # TableProjection (layers.py:963), so that's the proto shape too
@@ -297,7 +314,7 @@ def embedding(
                 "kind": "proj", "type": "table", "slot": 0,
                 "pname": spec.name, "spec": spec,
                 "input_size": vocab, "output_size": size,
-                "param_dims": [vocab, size], "default_emit_attr": None,
+                "param_dims": [rows, size], "default_emit_attr": None,
                 "proto": {},
             }],
         },

@@ -15,11 +15,20 @@ from paddle_tpu.layers.attr import ParamAttr
 
 def wide_and_deep_ctr(wide_dim: int, categorical_vocab_sizes: list[int],
                       embedding_size: int = 16,
-                      hidden_sizes: tuple[int, ...] = (64, 32)):
+                      hidden_sizes: tuple[int, ...] = (64, 32),
+                      pad_vocab_to: int | None = None,
+                      sparse_update: bool = True):
     """Returns (cost, predict, input_names).
 
     Inputs: one sparse-binary wide vector, one integer id per categorical
-    field, and an integer label in {0, 1}."""
+    field, and an integer label in {0, 1}.
+
+    ``pad_vocab_to=k`` rounds each table's rows up to a multiple of ``k``
+    so the tables row-shard over a k-way ``model`` axis even when the
+    vocab doesn't divide it (out-of-vocab ids clamp-and-zero).
+    ``sparse_update`` marks the tables for the row-lazy optimizer rule
+    (the reference's ``sparse_update=True`` / ``SparseRowMatrix`` path):
+    rows a batch doesn't touch keep parameter and momentum bit-for-bit."""
     wide_in = layer.data(name="wide_input",
                          type=data_type.sparse_binary_vector(wide_dim))
     cat_ins = [
@@ -28,9 +37,10 @@ def wide_and_deep_ctr(wide_dim: int, categorical_vocab_sizes: list[int],
     ]
     embs = [
         layer.embedding(
-            input=c, size=embedding_size,
+            input=c, size=embedding_size, pad_rows_to=pad_vocab_to,
             param_attr=ParamAttr(name=f"emb_{i}",
-                                 sharding=("model", None)))
+                                 sharding=("model", None),
+                                 sparse_update=sparse_update))
         for i, c in enumerate(cat_ins)
     ]
     deep = layer.concat(input=embs) if len(embs) > 1 else embs[0]

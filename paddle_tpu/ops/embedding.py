@@ -15,7 +15,16 @@ import jax.numpy as jnp
 
 
 def lookup(table: jax.Array, ids: jax.Array, padding_idx: int | None = None) -> jax.Array:
-    """table[V, D] gathered by integer ids of any shape -> [..., D]."""
+    """table[V, D] gathered by integer ids of any shape -> [..., D].
+
+    Under the ``fused_kernels`` flag (on-TPU by default) the 2-D case
+    routes through ``tpp.fused_embedding_lookup`` — dedup-once gather on
+    the forward, one scatter-add per *unique* row on the backward (the
+    reference's ``SparseRowMatrix`` row-prefetch contract)."""
+    from paddle_tpu.ops.pallas import tpp
+
+    if table.ndim == 2 and tpp.fused_enabled():
+        return tpp.fused_embedding_lookup(table, ids, padding_idx)
     out = jnp.take(table, ids.astype(jnp.int32), axis=0)
     if padding_idx is not None:
         keep = (ids != padding_idx)[..., None]

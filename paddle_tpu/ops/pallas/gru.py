@@ -25,6 +25,7 @@ from jax.experimental.pallas import tpu as pltpu
 from paddle_tpu.compat import tpu_compiler_params
 from paddle_tpu.ops.pallas import (mxu_precision as _prec,
                                    time_major_mask as _mask3)
+from paddle_tpu.ops.pallas.lstm import _batch_block, _pad_batch
 
 
 def _gru_gates(xw, h, wh_ref, whc_ref, d):
@@ -51,8 +52,8 @@ def _fwd_kernel(xw_ref, mask_ref, wh_ref, whc_ref, h0_ref,
     else:
         hs_ref, hT_ref, h_scr = rest
         urc_ref = None
-    t = pl.program_id(0)
-    nt = pl.num_programs(0)
+    t = pl.program_id(1)   # time iterates innermost; grid dim 0 blocks B
+    nt = pl.num_programs(1)
 
     @pl.when(t == 0)
     def _init():
@@ -99,8 +100,8 @@ def _bwd_kernel(mask_ref, wh_ref, whc_ref, urc_ref, hs_prev_ref,
                 dhs_ref, dhT_ref,
                 dxw_ref, dh0_ref, dh_scr, *, d):
     """Reverse-time (index maps run t = T-1 .. 0)."""
-    t = pl.program_id(0)
-    nt = pl.num_programs(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
 
     @pl.when(t == 0)
     def _init():
@@ -132,8 +133,8 @@ def _bwd_remat_kernel(xw_ref, mask_ref, wh_ref, whc_ref, hs_prev_ref,
     gates are re-derived from xw (a primal input) and the h stack, then
     round-tripped through the forward's io dtype so remat stays a pure
     memory knob (bit-identical to stored-gates mode per backend)."""
-    t = pl.program_id(0)
-    nt = pl.num_programs(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
 
     @pl.when(t == 0)
     def _init():
@@ -167,30 +168,38 @@ def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret,
     d = dd3 // 3
     io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
     kernel = functools.partial(_fwd_kernel, d=d, emit_gates=emit_gates)
+    # batch-block the grid past one VMEM tile (see lstm._fwd_call)
+    bb, nb, bpad = _batch_block(b)
+    xw = _pad_batch(xw, 1, bpad)
+    mask = _pad_batch(mask, 1, bpad)  # pad rows masked out -> inert
+    h0 = _pad_batch(h0, 0, bpad)
     # reversed index maps instead of flipped HBM copies (see lstm.py)
-    step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
-    out_specs = [pl.BlockSpec((1, b, d), step)]                 # hs
-    out_shape = [jax.ShapeDtypeStruct((t, b, d), io_dtype)]
+    step = ((lambda j, i: (t - 1 - i, j, 0)) if reverse
+            else (lambda j, i: (i, j, 0)))
+    resident = lambda j, i: (0, 0)  # noqa: E731
+    state = lambda j, i: (j, 0)     # noqa: E731
+    out_specs = [pl.BlockSpec((1, bb, d), step)]                # hs
+    out_shape = [jax.ShapeDtypeStruct((t, bpad, d), io_dtype)]
     if emit_gates:
-        out_specs.append(pl.BlockSpec((1, b, dd3), step))       # u,r,c
-        out_shape.append(jax.ShapeDtypeStruct((t, b, dd3), io_dtype))
-    out_specs.append(pl.BlockSpec((b, d), lambda i: (0, 0)))    # h_T
-    out_shape.append(jax.ShapeDtypeStruct((b, d), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, bb, dd3), step))      # u,r,c
+        out_shape.append(jax.ShapeDtypeStruct((t, bpad, dd3), io_dtype))
+    out_specs.append(pl.BlockSpec((bb, d), state))              # h_T
+    out_shape.append(jax.ShapeDtypeStruct((bpad, d), jnp.float32))
     out = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, dd3), step),                    # xw
-            pl.BlockSpec((1, b, 1), step),                      # mask
-            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
-            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
-            pl.BlockSpec((b, d), lambda i: (0, 0)),             # h0
+            pl.BlockSpec((1, bb, dd3), step),                   # xw
+            pl.BlockSpec((1, bb, 1), step),                     # mask
+            pl.BlockSpec((d, 2 * d), resident),                 # w_h
+            pl.BlockSpec((d, d), resident),                     # w_hc
+            pl.BlockSpec((bb, d), state),                       # h0
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((b, d), w_h.dtype)],
+        scratch_shapes=[pltpu.VMEM((bb, d), w_h.dtype)],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(xw, mask, w_h, w_hc, h0)
@@ -198,6 +207,10 @@ def _fwd_call(xw, mask, w_h, w_hc, h0, *, reverse, interpret,
         hs, urc, hT = out
     else:
         (hs, hT), urc = out, None
+    if bpad != b:
+        hs, hT = hs[:, :b], hT[:b]
+        if urc is not None:
+            urc = urc[:, :b]
     return hs, urc, hT
 
 
@@ -206,34 +219,44 @@ def _bwd_call(mask, w_h, w_hc, urc, hs_prev, dhs, dhT, *, reverse,
     t, b, dd3 = urc.shape
     d = dd3 // 3
     kernel = functools.partial(_bwd_kernel, d=d)
-    rev = ((lambda i: (i, 0, 0)) if reverse
-           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
+    bb, nb, bpad = _batch_block(b)
+    mask = _pad_batch(mask, 1, bpad)  # pad rows masked -> zero dxw
+    urc = _pad_batch(urc, 1, bpad)
+    hs_prev = _pad_batch(hs_prev, 1, bpad)
+    dhs = _pad_batch(dhs, 1, bpad)
+    dhT = _pad_batch(dhT, 0, bpad)
+    rev = ((lambda j, i: (i, j, 0)) if reverse
+           else (lambda j, i: (t - 1 - i, j, 0)))  # noqa: E731
+    resident = lambda j, i: (0, 0)  # noqa: E731
+    state = lambda j, i: (j, 0)     # noqa: E731
     dxw, dh0 = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, 1), rev),                       # mask
-            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
-            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
-            pl.BlockSpec((1, b, dd3), rev),                     # u,r,c
-            pl.BlockSpec((1, b, d), rev),                       # h_{t-1}
-            pl.BlockSpec((1, b, d), rev),                       # dh_t
-            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh_T
+            pl.BlockSpec((1, bb, 1), rev),                      # mask
+            pl.BlockSpec((d, 2 * d), resident),                 # w_h
+            pl.BlockSpec((d, d), resident),                     # w_hc
+            pl.BlockSpec((1, bb, dd3), rev),                    # u,r,c
+            pl.BlockSpec((1, bb, d), rev),                      # h_{t-1}
+            pl.BlockSpec((1, bb, d), rev),                      # dh_t
+            pl.BlockSpec((bb, d), state),                       # dh_T
         ],
         out_specs=[
-            pl.BlockSpec((1, b, dd3), rev),                     # dxw
-            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh0
+            pl.BlockSpec((1, bb, dd3), rev),                    # dxw
+            pl.BlockSpec((bb, d), state),                       # dh0
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((t, b, dd3), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
+            jax.ShapeDtypeStruct((t, bpad, dd3), jnp.float32),
+            jax.ShapeDtypeStruct((bpad, d), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bb, d), jnp.float32)],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(mask, w_h, w_hc, urc, hs_prev, dhs, dhT)
+    if bpad != b:
+        dxw, dh0 = dxw[:, :b], dh0[:b]
     return dxw, dh0
 
 
@@ -243,34 +266,44 @@ def _bwd_remat_call(xw, mask, w_h, w_hc, hs_prev, dhs, dhT, *, reverse,
     d = dd3 // 3
     io_dtype = jnp.bfloat16 if hs_prev.dtype == jnp.bfloat16 else jnp.float32
     kernel = functools.partial(_bwd_remat_kernel, d=d, io_dtype=io_dtype)
-    rev = ((lambda i: (i, 0, 0)) if reverse
-           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
+    bb, nb, bpad = _batch_block(b)
+    xw = _pad_batch(xw, 1, bpad)
+    mask = _pad_batch(mask, 1, bpad)
+    hs_prev = _pad_batch(hs_prev, 1, bpad)
+    dhs = _pad_batch(dhs, 1, bpad)
+    dhT = _pad_batch(dhT, 0, bpad)
+    rev = ((lambda j, i: (i, j, 0)) if reverse
+           else (lambda j, i: (t - 1 - i, j, 0)))  # noqa: E731
+    resident = lambda j, i: (0, 0)  # noqa: E731
+    state = lambda j, i: (j, 0)     # noqa: E731
     dxw, dh0 = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, dd3), rev),                     # xw
-            pl.BlockSpec((1, b, 1), rev),                       # mask
-            pl.BlockSpec((d, 2 * d), lambda i: (0, 0)),         # w_h
-            pl.BlockSpec((d, d), lambda i: (0, 0)),             # w_hc
-            pl.BlockSpec((1, b, d), rev),                       # h_{t-1}
-            pl.BlockSpec((1, b, d), rev),                       # dh_t
-            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh_T
+            pl.BlockSpec((1, bb, dd3), rev),                    # xw
+            pl.BlockSpec((1, bb, 1), rev),                      # mask
+            pl.BlockSpec((d, 2 * d), resident),                 # w_h
+            pl.BlockSpec((d, d), resident),                     # w_hc
+            pl.BlockSpec((1, bb, d), rev),                      # h_{t-1}
+            pl.BlockSpec((1, bb, d), rev),                      # dh_t
+            pl.BlockSpec((bb, d), state),                       # dh_T
         ],
         out_specs=[
-            pl.BlockSpec((1, b, dd3), rev),                     # dxw
-            pl.BlockSpec((b, d), lambda i: (0, 0)),             # dh0
+            pl.BlockSpec((1, bb, dd3), rev),                    # dxw
+            pl.BlockSpec((bb, d), state),                       # dh0
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((t, b, dd3), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
+            jax.ShapeDtypeStruct((t, bpad, dd3), jnp.float32),
+            jax.ShapeDtypeStruct((bpad, d), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bb, d), jnp.float32)],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(xw, mask, w_h, w_hc, hs_prev, dhs, dhT)
+    if bpad != b:
+        dxw, dh0 = dxw[:, :b], dh0[:b]
     return dxw, dh0
 
 

@@ -46,6 +46,33 @@ from paddle_tpu.compat import tpu_compiler_params
 from paddle_tpu.ops.pallas import (mxu_precision as _prec,
                                    time_major_mask as _mask3)
 
+#: rows of the batch each grid block carries — past this the [B, 4D]
+#: slabs would outgrow one VMEM tile budget, so the grid blocks B too
+#: (grid=(nb, T); T iterates innermost so the h/c carries still live in
+#: scratch across the whole sequence of each batch block)
+_BATCH_BLOCK = 256
+
+
+def _batch_block(b: int) -> tuple[int, int, int]:
+    """(block_rows, num_blocks, padded_batch) for batch-blocking the
+    sequence grids.  b <= _BATCH_BLOCK keeps a single unpadded block, so
+    small-batch configs compile to exactly the pre-blocking program."""
+    if b <= _BATCH_BLOCK:
+        return b, 1, b
+    nb = -(-b // _BATCH_BLOCK)
+    return _BATCH_BLOCK, nb, nb * _BATCH_BLOCK
+
+
+def _pad_batch(x, axis: int, bpad: int):
+    """Zero-pad the batch dim to the blocked size (zeros ride the freeze
+    mask: padded rows never update state and emit zero cotangents)."""
+    pad = bpad - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
 
 def _sigmoid(x):
     return jax.nn.sigmoid(x)
@@ -71,8 +98,8 @@ def _fwd_kernel(xw_ref, mask_ref, wh_ref, peep_ref, h0_ref, c0_ref,
     else:
         hs_ref, cs_ref, hT_ref, cT_ref, h_scr, c_scr = rest
         gates_ref = None
-    t = pl.program_id(0)
-    nt = pl.num_programs(0)
+    t = pl.program_id(1)   # time iterates innermost; grid dim 0 blocks B
+    nt = pl.num_programs(1)
 
     @pl.when(t == 0)
     def _init():
@@ -124,15 +151,21 @@ def _bwd_kernel(mask_ref, wh_ref, peep_ref, gates_ref, cs_prev_ref, cs_ref,
     """Reverse-time step: carries dh/dc in scratch, emits dgates per step.
 
     The caller's index maps run t from T-1 down to 0, so program 0 sees
-    the LAST time step.
+    the LAST time step.  Grid dim 0 blocks the batch: dh/dc carries reset
+    per block while dpeep accumulates across every (block, step) pair.
     """
-    t = pl.program_id(0)
-    nt = pl.num_programs(0)
+    j = pl.program_id(0)
+    nb = pl.num_programs(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
 
     @pl.when(t == 0)
     def _init():
         dh_scr[...] = dhT_ref[...]
         dc_scr[...] = dcT_ref[...]
+
+    @pl.when((t == 0) & (j == 0))
+    def _init_peep():
         dpeep_scr[...] = jnp.zeros_like(dpeep_scr)
 
     m = mask_ref[0]  # [B, 1]
@@ -170,6 +203,9 @@ def _bwd_kernel(mask_ref, wh_ref, peep_ref, gates_ref, cs_prev_ref, cs_ref,
     def _final():
         dh0_ref[...] = dh_scr[...]
         dc0_ref[...] = dc_scr[...]
+
+    @pl.when((t == nt - 1) & (j == nb - 1))
+    def _final_peep():
         dpeep_ref[...] = dpeep_scr[...]
 
 
@@ -179,49 +215,61 @@ def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret,
     d = dd4 // 4
     io_dtype = jnp.bfloat16 if xw.dtype == jnp.bfloat16 else jnp.float32
     kernel = functools.partial(_fwd_kernel, d=d, emit_gates=emit_gates)
+    # batch-block the grid so large B does not pin a [B, 4D] slab plus two
+    # [B, D] carries in VMEM at once; each block replays the recurrence
+    bb, nb, bpad = _batch_block(b)
+    xw = _pad_batch(xw, 1, bpad)
+    mask = _pad_batch(mask, 1, bpad)  # pad rows masked out -> inert
+    h0 = _pad_batch(h0, 0, bpad)
+    c0 = _pad_batch(c0, 0, bpad)
     # reverse runs the SAME carry recurrence over array indices T-1..0 via
     # reversed index maps — no flipped HBM copies of the sequence
-    step = (lambda i: (t - 1 - i, 0, 0)) if reverse else (lambda i: (i, 0, 0))
+    step = ((lambda j, i: (t - 1 - i, j, 0)) if reverse
+            else (lambda j, i: (i, j, 0)))
+    resident = lambda j, i: (0, 0)  # noqa: E731
+    state = lambda j, i: (j, 0)     # noqa: E731
     out_specs = [
-        pl.BlockSpec((1, b, d), step),                           # hs
-        pl.BlockSpec((1, b, d), step),                           # cs
+        pl.BlockSpec((1, bb, d), step),                          # hs
+        pl.BlockSpec((1, bb, d), step),                          # cs
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((t, b, d), io_dtype),
-        jax.ShapeDtypeStruct((t, b, d), jnp.float32),
+        jax.ShapeDtypeStruct((t, bpad, d), io_dtype),
+        jax.ShapeDtypeStruct((t, bpad, d), jnp.float32),
     ]
     if emit_gates:
         # the gates slab exists only as a backward residual; remat mode
         # drops it entirely and recomputes gates in the reverse kernel
-        out_specs.append(pl.BlockSpec((1, b, dd4), step))        # gates
-        out_shape.append(jax.ShapeDtypeStruct((t, b, dd4), io_dtype))
+        out_specs.append(pl.BlockSpec((1, bb, dd4), step))       # gates
+        out_shape.append(jax.ShapeDtypeStruct((t, bpad, dd4), io_dtype))
     out_specs += [
-        pl.BlockSpec((b, d), lambda i: (0, 0)),                  # h_T
-        pl.BlockSpec((b, d), lambda i: (0, 0)),                  # c_T
+        pl.BlockSpec((bb, d), state),                            # h_T
+        pl.BlockSpec((bb, d), state),                            # c_T
     ]
     out_shape += [
-        jax.ShapeDtypeStruct((b, d), jnp.float32),
-        jax.ShapeDtypeStruct((b, d), jnp.float32),
+        jax.ShapeDtypeStruct((bpad, d), jnp.float32),
+        jax.ShapeDtypeStruct((bpad, d), jnp.float32),
     ]
     out = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, dd4), step),                     # xw [T,B,4D]
-            pl.BlockSpec((1, b, 1), step),                       # mask [T,B,1]
-            pl.BlockSpec((d, dd4), lambda i: (0, 0)),            # w_h resident
-            pl.BlockSpec((3, d), lambda i: (0, 0)),              # peephole
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # h0
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # c0
+            pl.BlockSpec((1, bb, dd4), step),                    # xw [T,B,4D]
+            pl.BlockSpec((1, bb, 1), step),                      # mask [T,B,1]
+            pl.BlockSpec((d, dd4), resident),                    # w_h resident
+            pl.BlockSpec((3, d), resident),                      # peephole
+            pl.BlockSpec((bb, d), state),                        # h0
+            pl.BlockSpec((bb, d), state),                        # c0
         ],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((b, d), w_h.dtype),     # h carry (matmul dtype)
-            pltpu.VMEM((b, d), jnp.float32),   # c carry
+            pltpu.VMEM((bb, d), w_h.dtype),    # h carry (matmul dtype)
+            pltpu.VMEM((bb, d), jnp.float32),  # c carry
         ],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("arbitrary",),
+            # the time dim carries h/c in scratch; the batch dim carries
+            # nothing but must run in order so carries reset per block
+            dimension_semantics=("arbitrary", "arbitrary"),
             # w_h residency at D=1280 needs ~18 MB with the IO slabs;
             # v5e VMEM is 128 MB — raise the conservative 16 MB default
             vmem_limit_bytes=64 * 1024 * 1024),
@@ -232,6 +280,11 @@ def _fwd_call(xw, mask, w_h, peep, h0, c0, *, reverse, interpret,
     else:
         hs, cs, hT, cT = out
         gates = None
+    if bpad != b:
+        hs, cs = hs[:, :b], cs[:, :b]
+        hT, cT = hT[:b], cT[:b]
+        if gates is not None:
+            gates = gates[:, :b]
     return hs, cs, gates, hT, cT
 
 
@@ -240,48 +293,62 @@ def _bwd_call(mask, w_h, peep, gates, cs_prev, cs, dhs, dhT, dcT,
     t, b, dd4 = gates.shape
     d = dd4 // 4
     kernel = functools.partial(_bwd_kernel, d=d)
+    bb, nb, bpad = _batch_block(b)
+    mask = _pad_batch(mask, 1, bpad)  # pad rows masked -> zero dgates
+    gates = _pad_batch(gates, 1, bpad)
+    cs_prev = _pad_batch(cs_prev, 1, bpad)
+    cs = _pad_batch(cs, 1, bpad)
+    dhs = _pad_batch(dhs, 1, bpad)
+    dhT = _pad_batch(dhT, 0, bpad)
+    dcT = _pad_batch(dcT, 0, bpad)
     # iterate computation-reverse: array order T-1..0 for a forward run,
     # 0..T-1 for a reverse run
-    rev = ((lambda i: (i, 0, 0)) if reverse
-           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
+    rev = ((lambda j, i: (i, j, 0)) if reverse
+           else (lambda j, i: (t - 1 - i, j, 0)))  # noqa: E731
+    resident = lambda j, i: (0, 0)  # noqa: E731
+    state = lambda j, i: (j, 0)     # noqa: E731
     dgates, dh0, dc0, dpeep = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, 1), rev),                        # mask
-            pl.BlockSpec((d, dd4), lambda i: (0, 0)),            # w_h
-            pl.BlockSpec((3, d), lambda i: (0, 0)),              # peephole
-            pl.BlockSpec((1, b, dd4), rev),                      # gates
-            pl.BlockSpec((1, b, d), rev),                        # c_{t-1}
-            pl.BlockSpec((1, b, d), rev),                        # c_t
-            pl.BlockSpec((1, b, d), rev),                        # dh_t (ys)
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dh_T
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dc_T
+            pl.BlockSpec((1, bb, 1), rev),                       # mask
+            pl.BlockSpec((d, dd4), resident),                    # w_h
+            pl.BlockSpec((3, d), resident),                      # peephole
+            pl.BlockSpec((1, bb, dd4), rev),                     # gates
+            pl.BlockSpec((1, bb, d), rev),                       # c_{t-1}
+            pl.BlockSpec((1, bb, d), rev),                       # c_t
+            pl.BlockSpec((1, bb, d), rev),                       # dh_t (ys)
+            pl.BlockSpec((bb, d), state),                        # dh_T
+            pl.BlockSpec((bb, d), state),                        # dc_T
         ],
         out_specs=[
-            pl.BlockSpec((1, b, dd4), rev),                      # dgates
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dh0
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dc0
-            pl.BlockSpec((3, d), lambda i: (0, 0)),              # dpeep
+            pl.BlockSpec((1, bb, dd4), rev),                     # dgates
+            pl.BlockSpec((bb, d), state),                        # dh0
+            pl.BlockSpec((bb, d), state),                        # dc0
+            pl.BlockSpec((3, d), resident),                      # dpeep
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((t, b, dd4), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
+            jax.ShapeDtypeStruct((t, bpad, dd4), jnp.float32),
+            jax.ShapeDtypeStruct((bpad, d), jnp.float32),
+            jax.ShapeDtypeStruct((bpad, d), jnp.float32),
             jax.ShapeDtypeStruct((3, d), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((b, d), jnp.float32),   # dh carry
-            pltpu.VMEM((b, d), jnp.float32),   # dc carry
+            pltpu.VMEM((bb, d), jnp.float32),  # dh carry
+            pltpu.VMEM((bb, d), jnp.float32),  # dc carry
             pltpu.VMEM((3, d), jnp.float32),   # dpeep accumulator
         ],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("arbitrary",),
+            # dpeep accumulates across both grid dims -> strictly in-order
+            dimension_semantics=("arbitrary", "arbitrary"),
             # w_h residency at D=1280 needs ~18 MB with the IO slabs;
             # v5e VMEM is 128 MB — raise the conservative 16 MB default
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(mask, w_h, peep, gates, cs_prev, cs, dhs, dhT, dcT)
+    if bpad != b:
+        dgates = dgates[:, :b]
+        dh0, dc0 = dh0[:b], dc0[:b]
     return dgates, dh0, dc0, dpeep
 
 
@@ -296,13 +363,18 @@ def _bwd_remat_kernel(xw_ref, mask_ref, wh_ref, peep_ref, hs_prev_ref,
     round-tripped through the forward's io dtype so remat is a pure
     memory knob, not a numerics change (bit-identical to stored-gates
     mode per backend)."""
-    t = pl.program_id(0)
-    nt = pl.num_programs(0)
+    j = pl.program_id(0)
+    nb = pl.num_programs(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
 
     @pl.when(t == 0)
     def _init():
         dh_scr[...] = dhT_ref[...]
         dc_scr[...] = dcT_ref[...]
+
+    @pl.when((t == 0) & (j == 0))
+    def _init_peep():
         dpeep_scr[...] = jnp.zeros_like(dpeep_scr)
 
     m = mask_ref[0]  # [B, 1]
@@ -345,6 +417,9 @@ def _bwd_remat_kernel(xw_ref, mask_ref, wh_ref, peep_ref, hs_prev_ref,
     def _final():
         dh0_ref[...] = dh_scr[...]
         dc0_ref[...] = dc_scr[...]
+
+    @pl.when((t == nt - 1) & (j == nb - 1))
+    def _final_peep():
         dpeep_ref[...] = dpeep_scr[...]
 
 
@@ -354,45 +429,59 @@ def _bwd_remat_call(xw, mask, w_h, peep, hs_prev, cs_prev, cs, dhs, dhT,
     d = dd4 // 4
     io_dtype = jnp.bfloat16 if hs_prev.dtype == jnp.bfloat16 else jnp.float32
     kernel = functools.partial(_bwd_remat_kernel, d=d, io_dtype=io_dtype)
-    rev = ((lambda i: (i, 0, 0)) if reverse
-           else (lambda i: (t - 1 - i, 0, 0)))  # noqa: E731
+    bb, nb, bpad = _batch_block(b)
+    xw = _pad_batch(xw, 1, bpad)
+    mask = _pad_batch(mask, 1, bpad)
+    hs_prev = _pad_batch(hs_prev, 1, bpad)
+    cs_prev = _pad_batch(cs_prev, 1, bpad)
+    cs = _pad_batch(cs, 1, bpad)
+    dhs = _pad_batch(dhs, 1, bpad)
+    dhT = _pad_batch(dhT, 0, bpad)
+    dcT = _pad_batch(dcT, 0, bpad)
+    rev = ((lambda j, i: (i, j, 0)) if reverse
+           else (lambda j, i: (t - 1 - i, j, 0)))  # noqa: E731
+    resident = lambda j, i: (0, 0)  # noqa: E731
+    state = lambda j, i: (j, 0)     # noqa: E731
     dgates, dh0, dc0, dpeep = pl.pallas_call(
         kernel,
-        grid=(t,),
+        grid=(nb, t),
         in_specs=[
-            pl.BlockSpec((1, b, dd4), rev),                      # xw
-            pl.BlockSpec((1, b, 1), rev),                        # mask
-            pl.BlockSpec((d, dd4), lambda i: (0, 0)),            # w_h
-            pl.BlockSpec((3, d), lambda i: (0, 0)),              # peephole
-            pl.BlockSpec((1, b, d), rev),                        # h_{t-1}
-            pl.BlockSpec((1, b, d), rev),                        # c_{t-1}
-            pl.BlockSpec((1, b, d), rev),                        # c_t
-            pl.BlockSpec((1, b, d), rev),                        # dh_t (ys)
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dh_T
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dc_T
+            pl.BlockSpec((1, bb, dd4), rev),                     # xw
+            pl.BlockSpec((1, bb, 1), rev),                       # mask
+            pl.BlockSpec((d, dd4), resident),                    # w_h
+            pl.BlockSpec((3, d), resident),                      # peephole
+            pl.BlockSpec((1, bb, d), rev),                       # h_{t-1}
+            pl.BlockSpec((1, bb, d), rev),                       # c_{t-1}
+            pl.BlockSpec((1, bb, d), rev),                       # c_t
+            pl.BlockSpec((1, bb, d), rev),                       # dh_t (ys)
+            pl.BlockSpec((bb, d), state),                        # dh_T
+            pl.BlockSpec((bb, d), state),                        # dc_T
         ],
         out_specs=[
-            pl.BlockSpec((1, b, dd4), rev),                      # dgates
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dh0
-            pl.BlockSpec((b, d), lambda i: (0, 0)),              # dc0
-            pl.BlockSpec((3, d), lambda i: (0, 0)),              # dpeep
+            pl.BlockSpec((1, bb, dd4), rev),                     # dgates
+            pl.BlockSpec((bb, d), state),                        # dh0
+            pl.BlockSpec((bb, d), state),                        # dc0
+            pl.BlockSpec((3, d), resident),                      # dpeep
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((t, b, dd4), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, d), jnp.float32),
+            jax.ShapeDtypeStruct((t, bpad, dd4), jnp.float32),
+            jax.ShapeDtypeStruct((bpad, d), jnp.float32),
+            jax.ShapeDtypeStruct((bpad, d), jnp.float32),
             jax.ShapeDtypeStruct((3, d), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((b, d), jnp.float32),   # dh carry
-            pltpu.VMEM((b, d), jnp.float32),   # dc carry
+            pltpu.VMEM((bb, d), jnp.float32),  # dh carry
+            pltpu.VMEM((bb, d), jnp.float32),  # dc carry
             pltpu.VMEM((3, d), jnp.float32),   # dpeep accumulator
         ],
         compiler_params=tpu_compiler_params(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(xw, mask, w_h, peep, hs_prev, cs_prev, cs, dhs, dhT, dcT)
+    if bpad != b:
+        dgates = dgates[:, :b]
+        dh0, dc0 = dh0[:b], dc0[:b]
     return dgates, dh0, dc0, dpeep
 
 

@@ -17,7 +17,11 @@ non-transformer hot paths on it:
 - :mod:`update` — the fused SGD/momentum weight update applied in place
   on the ZeRO-2 optimizer shard (one read-modify-write pass over p/g/v
   instead of the multi-op XLA update; arxiv 2004.13336 motivates fusing
-  the update onto the shard the reduce-scatter already produced).
+  the update onto the shard the reduce-scatter already produced);
+- :mod:`embedding` — the sparse pserver's row machinery: dedup-once
+  gather driven by a scalar-prefetched id list, duplicate-exact
+  scatter-add as a one-hot MXU contraction, and the row-lazy
+  ``SparseRowMatrix`` optimizer update (untouched rows bit-identical).
 
 Every kernel ships a pure-jnp ``*_reference`` twin that is BOTH the CPU
 production path and the test oracle (the ``paged_attention``
@@ -69,6 +73,17 @@ from paddle_tpu.ops.pallas.tpp.update import (  # noqa: E402
     fused_sgd_update_reference,
     fused_shard_apply,
 )
+from paddle_tpu.ops.pallas.tpp.embedding import (  # noqa: E402
+    dedup_ids,
+    dedup_ids_reference,
+    embedding_gather,
+    embedding_gather_reference,
+    embedding_scatter_add,
+    embedding_scatter_add_reference,
+    fused_embedding_lookup,
+    sparse_row_update,
+    sparse_row_update_reference,
+)
 
 __all__ = [
     "fused_enabled",
@@ -79,4 +94,9 @@ __all__ = [
     "fused_momentum_update", "fused_momentum_update_reference",
     "fused_sgd_update", "fused_sgd_update_reference",
     "fused_shard_apply",
+    "dedup_ids", "dedup_ids_reference",
+    "embedding_gather", "embedding_gather_reference",
+    "embedding_scatter_add", "embedding_scatter_add_reference",
+    "fused_embedding_lookup",
+    "sparse_row_update", "sparse_row_update_reference",
 ]

@@ -94,6 +94,27 @@ def make_lr_schedule(base_lr: float, schedule: str = "constant", a: float = 0.0,
 # ---------------------------------------------------------------------------
 
 
+def lazy_sparse_rows(spec, p=None) -> bool:
+    """True when this parameter opted into the reference's
+    ``SparseRowMatrix`` row-lazy contract: ``ParamAttr(sparse_update=True)``
+    on a 2-D [rows, D] table.  Rows whose gradient is all-zero this step
+    keep parameter AND optimizer slot bit-for-bit — no decay fold, no
+    momentum advance — exactly what the reference's sparse updaters did by
+    never visiting untouched rows.  Optimizers that implement the contract
+    set ``lazy_sparse = True`` (SGD/Momentum); others keep dense
+    semantics so decay is never silently dropped."""
+    if spec is None or not getattr(spec, "sparse", False):
+        return False
+    if not getattr(getattr(spec, "attr", None), "sparse_update", False):
+        return False
+    return p is None or getattr(p, "ndim", 0) == 2
+
+
+def _row_mask(g):
+    """[rows, 1] bool — rows this batch actually touched (nonzero grad)."""
+    return jnp.any(g != 0.0, axis=tuple(range(1, g.ndim)), keepdims=True)
+
+
 class Optimizer:
     """Base: subclasses define slot init + per-tensor update rule."""
 
@@ -119,6 +140,12 @@ class Optimizer:
     #: SparseMomentum's beta term) set this so apply() does not also fold
     #: L2 into the gradient (which would double-count the decay)
     handles_decay = False
+
+    #: subclasses whose tensor_update implements the row-lazy
+    #: ``lazy_sparse_rows`` contract (decay folded per *touched* row inside
+    #: the rule; untouched rows bit-identical).  apply() then skips its own
+    #: dense decay fold for those parameters.
+    lazy_sparse = False
 
     # -- subclass hooks -------------------------------------------------------
     def slot_init(self, p: jax.Array, spec: ParamSpec | None = None) -> Any:
@@ -175,11 +202,14 @@ class Optimizer:
                 continue
             g = grads[name].astype(jnp.float32)
             # L2/L1 regularization folded into the gradient
-            # (≅ OptimizerWithRegularizerEveryNumBatches with n=1)
+            # (≅ OptimizerWithRegularizerEveryNumBatches with n=1); lazy
+            # sparse-row params defer the fold to tensor_update, which
+            # applies decay only to touched rows (SparseRowMatrix rule)
+            lazy = self.lazy_sparse and lazy_sparse_rows(spec, p)
             l2 = spec.decay_rate if (spec is not None and spec.decay_rate is not None) else self.l2_rate
-            if l2 and not self.handles_decay:
+            if l2 and not self.handles_decay and not lazy:
                 g = g + l2 * p
-            if self.l1_rate:
+            if self.l1_rate and not lazy:
                 g = g + self.l1_rate * jnp.sign(p)
             g = clip(g, spec)
             plr = lr * (spec.learning_rate if spec is not None else 1.0)
@@ -275,6 +305,7 @@ class SGD(Optimizer):
     only for specs that ask for it, so plain SGD stays slot-free."""
 
     name = "sgd"
+    lazy_sparse = True
 
     def slot_init(self, p, spec=None):
         if spec is not None and getattr(spec, "momentum", None):
@@ -285,12 +316,31 @@ class SGD(Optimizer):
                     "mu": jnp.asarray(spec.momentum, jnp.float32)}
         return ()
 
+    def _lazy_fold(self, g, p, spec):
+        """Row-lazy decay fold: touched rows get g + l2*p, untouched rows
+        keep an exactly-zero gradient (SparseRowMatrix decay-on-touch)."""
+        touched = _row_mask(g)
+        l2 = spec.decay_rate if spec.decay_rate is not None else self.l2_rate
+        if l2:
+            g = jnp.where(touched, g + l2 * p, g)
+        return g, touched
+
     def tensor_update(self, g, p, slots, lr, step, spec=None):
+        lazy = lazy_sparse_rows(spec, p)
+        if lazy:
+            g, touched = self._lazy_fold(g, p, spec)
         if isinstance(slots, dict) and "velocity" in slots:
             m = slots["mu"]
             v = m * slots["velocity"] + g
-            return lr * v, {"velocity": v, "mu": m}
-        return lr * g, slots
+            delta = lr * v
+            if lazy:
+                v = jnp.where(touched, v, slots["velocity"])
+                delta = jnp.where(touched, delta, 0.0)
+            return delta, {"velocity": v, "mu": m}
+        delta = lr * g
+        if lazy:
+            delta = jnp.where(touched, delta, 0.0)
+        return delta, slots
 
 
 class Momentum(Optimizer):
@@ -303,6 +353,7 @@ class Momentum(Optimizer):
     reference update."""
 
     name = "momentum"
+    lazy_sparse = True
 
     def __init__(self, momentum: float = 0.9, use_nesterov: bool = False, **kw):
         super().__init__(**kw)
@@ -319,6 +370,18 @@ class Momentum(Optimizer):
 
     def tensor_update(self, g, p, slots, lr, step, spec=None):
         m = self._coeff(spec)
+        if lazy_sparse_rows(spec, p):
+            # SparseRowMatrix rule: decay + momentum advance only on the
+            # rows this batch touched; everything else is bit-identical
+            touched = _row_mask(g)
+            l2 = (spec.decay_rate if spec.decay_rate is not None
+                  else self.l2_rate)
+            if l2:
+                g = jnp.where(touched, g + l2 * p, g)
+            v = m * slots["velocity"] + g
+            delta = lr * (g + m * v) if self.use_nesterov else lr * v
+            return (jnp.where(touched, delta, 0.0),
+                    {"velocity": jnp.where(touched, v, slots["velocity"])})
         v = m * slots["velocity"] + g
         delta = lr * (g + m * v) if self.use_nesterov else lr * v
         return delta, {"velocity": v}

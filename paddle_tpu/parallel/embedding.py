@@ -5,14 +5,19 @@ sparse pserver ports + ``SparseRemoteParameterUpdater`` + row prefetch,
 prefetching touched rows from a remote host, rows live sharded across the
 mesh and the gather's collective runs over ICI (SURVEY §2.3 row 4).
 
-Two ways to get the same layout:
+Two ways to get the same layout, both wrapped by :class:`ShardedEmbedding`:
 
-1. Declarative (preferred): give the embedding parameter
+1. Declarative (``path="gspmd"``, preferred): give the embedding parameter
    ``sharding=("model", None)`` and let pjit place it — XLA inserts the
    all-gather/psum around the gather automatically.
-2. Explicit (this module): shard_map routines that make the communication
+2. Explicit (``path="shard_map"``): routines that make the communication
    pattern visible and testable — each shard gathers its local rows and the
-   partial one-hot results psum over the axis."""
+   partial one-hot results psum over the axis.  GL-P-COLL's dual-lowering
+   compare holds the two paths to the same collective sequence.
+
+Vocab sizes that don't divide the axis are row-padded
+(:func:`pad_vocab`); ids outside the *logical* vocab clamp-and-zero —
+they never read the pad rows, and they contribute no gradient."""
 
 from __future__ import annotations
 
@@ -25,6 +30,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from paddle_tpu.core.enforce import enforce
 
 
+def pad_vocab(vocab: int, k: int) -> int:
+    """Smallest multiple of ``k`` >= ``vocab`` — the padded row count a
+    [V, D] table needs to row-shard ``k`` ways."""
+    return -(-int(vocab) // int(k)) * int(k)
+
+
 def shard_table(table: jax.Array, mesh, axis: str = "model") -> jax.Array:
     """Place a [V, D] table row-sharded over ``axis``."""
     enforce(table.shape[0] % mesh.shape[axis] == 0,
@@ -33,40 +44,129 @@ def shard_table(table: jax.Array, mesh, axis: str = "model") -> jax.Array:
     return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
 
 
+def _valid_ids(ids: jax.Array, vocab: int | None):
+    """int32 ids + the in-logical-vocab mask (None when no clamp asked)."""
+    ids = ids.astype(jnp.int32)
+    if vocab is None:
+        return ids, None
+    return ids, (ids >= 0) & (ids < vocab)
+
+
 def sharded_lookup(table: jax.Array, ids: jax.Array, mesh,
-                   axis: str = "model") -> jax.Array:
+                   axis: str = "model", vocab: int | None = None) -> jax.Array:
     """Gather from a row-sharded table: every device looks up the ids that
     fall in its shard, others contribute zeros, psum combines.  ids are
     replicated over ``axis`` (they're usually data-sharded on 'data').
     Returns [..., D] with the same sharding as ids.
 
-    The backward pass (via shard_map transpose) scatter-adds each shard's
-    cotangent rows locally — exactly the 'sparse update stays on the shard'
-    behavior the reference got from dedicated sparse pservers."""
+    ``vocab`` is the *logical* row count when the table carries pad rows
+    (``pad_vocab``): ids outside ``[0, vocab)`` clamp-and-zero instead of
+    reading a pad row.  Duplicate ids transpose to exact scatter-add
+    gradients (each shard accumulates its own rows' cotangents locally —
+    the 'sparse update stays on the shard' behavior the reference got
+    from dedicated sparse pservers)."""
     k = mesh.shape[axis]
     v = table.shape[0]
     enforce(v % k == 0, "table rows must divide the mesh axis")
     rows_per = v // k
+    ids, ok = _valid_ids(ids, vocab)
 
-    def body(tbl_shard, ids_local):
+    def body(tbl_shard, ids_local, ok_local):
         idx = lax.axis_index(axis)
         offset = idx * rows_per
-        local = ids_local.astype(jnp.int32) - offset
+        local = ids_local - offset
         in_shard = (local >= 0) & (local < rows_per)
+        if ok_local is not None:
+            in_shard = in_shard & ok_local
         safe = jnp.clip(local, 0, rows_per - 1)
         got = jnp.take(tbl_shard, safe, axis=0)
         got = jnp.where(in_shard[..., None], got, 0.0)
         return lax.psum(got, axis)
 
-    fn = shard_map(body, mesh=mesh, in_specs=(P(axis, None), P()),
+    fn = shard_map(body, mesh=mesh, in_specs=(P(axis, None), P(), P()),
                    out_specs=P(), check_vma=False)
-    return fn(table, ids)
+    return fn(table, ids, ok)
 
 
 def replicated_lookup_sharded_grad(table: jax.Array, ids: jax.Array,
-                                   mesh, axis: str = "model") -> jax.Array:
+                                   mesh, axis: str = "model",
+                                   vocab: int | None = None) -> jax.Array:
     """Convenience jit-level alternative: constrain the table's sharding and
-    let XLA pick the collective (path 1 in the module docstring)."""
+    let XLA pick the collective (path 1 in the module docstring).  Same
+    clamp-and-zero contract as :func:`sharded_lookup`."""
     t = jax.lax.with_sharding_constraint(
         table, NamedSharding(mesh, P(axis, None)))
-    return jnp.take(t, ids.astype(jnp.int32), axis=0)
+    ids, ok = _valid_ids(ids, vocab)
+    got = jnp.take(t, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
+    if ok is not None:
+        got = jnp.where(ok[..., None], got, 0.0)
+    return got
+
+
+class ShardedEmbedding:
+    """A production row-sharded embedding table over one mesh axis.
+
+    Owns the layout math (vocab padding, per-shard row count, per-device
+    bytes) and dispatches lookups through either lowering path.  The
+    table itself stays a plain array in the caller's param tree — this
+    node is the layout + lookup contract, not a parameter store, so it
+    composes with ``parameters``/checkpointing/ZeRO untouched.
+
+    >>> emb = ShardedEmbedding(vocab=10, dim=4, mesh=mesh, axis="model")
+    >>> table = emb.place(dense_table)       # [10,4] -> padded [12,4], sharded
+    >>> out = emb.lookup(table, ids)         # ids outside [0,10) -> zeros
+    """
+
+    def __init__(self, vocab: int, dim: int, mesh, axis: str = "model",
+                 dtype=jnp.float32, path: str = "gspmd"):
+        enforce(axis in mesh.shape,
+                f"mesh has no axis {axis!r} (axes: {tuple(mesh.shape)})")
+        enforce(path in ("gspmd", "shard_map"),
+                f"path must be 'gspmd' or 'shard_map', got {path!r}")
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.mesh = mesh
+        self.axis = axis
+        self.dtype = jnp.dtype(dtype)
+        self.path = path
+        self.shards = int(mesh.shape[axis])
+        self.padded_vocab = pad_vocab(self.vocab, self.shards)
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.padded_vocab // self.shards
+
+    def total_bytes(self) -> int:
+        return self.padded_vocab * self.dim * self.dtype.itemsize
+
+    def per_device_bytes(self) -> int:
+        return self.rows_per_shard * self.dim * self.dtype.itemsize
+
+    def init(self, key, scale: float = 0.01) -> jax.Array:
+        """Fresh N(0, scale) table, pad rows zeroed, placed on the mesh."""
+        dense = scale * jax.random.normal(
+            key, (self.vocab, self.dim), dtype=self.dtype)
+        return self.place(dense)
+
+    def place(self, dense: jax.Array) -> jax.Array:
+        """Pad a dense [vocab, dim] table to the sharded row count and
+        place it P(axis, None).  Pad rows are zero."""
+        enforce(dense.shape == (self.vocab, self.dim),
+                f"expected [{self.vocab}, {self.dim}], got {dense.shape}")
+        pad = self.padded_vocab - self.vocab
+        if pad:
+            dense = jnp.pad(dense, ((0, pad), (0, 0)))
+        return shard_table(dense.astype(self.dtype), self.mesh, self.axis)
+
+    def lookup(self, table: jax.Array, ids: jax.Array,
+               path: str | None = None) -> jax.Array:
+        """[..., dim] rows for ``ids``; out-of-vocab ids clamp-and-zero."""
+        enforce(table.shape == (self.padded_vocab, self.dim),
+                f"expected placed table [{self.padded_vocab}, {self.dim}], "
+                f"got {table.shape}")
+        path = self.path if path is None else path
+        if path == "shard_map":
+            return sharded_lookup(table, ids, self.mesh, self.axis,
+                                  vocab=self.vocab)
+        return replicated_lookup_sharded_grad(table, ids, self.mesh,
+                                              self.axis, vocab=self.vocab)

@@ -593,3 +593,88 @@ def test_bigru_layer_node_matches_composed_pair(rng_np):
     np.testing.assert_allclose(np.asarray(got.data),
                                np.asarray(vals2[cat.name].data),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_seq_batch_blocked_matches_reference(rng_np):
+    """B past _BATCH_BLOCK splits the grid into batch blocks (padded to a
+    block multiple); fwd and vjp must match the scan oracle exactly as in
+    the single-block regime — including the cross-block dpeep
+    accumulator and the remat variant."""
+    from paddle_tpu.ops.pallas import lstm as klstm
+    from paddle_tpu.ops.pallas.lstm import lstm_seq, lstm_seq_reference
+
+    B, T, D = klstm._BATCH_BLOCK + 44, 4, 8  # 2 blocks, ragged pad
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 4 * D)).astype(np.float32) * .4)
+    mask = jnp.asarray(
+        (rng_np.uniform(size=(B, T)) < 0.8).astype(np.float32)
+    ).at[:, 0].set(1.0)
+    wh = jnp.asarray(rng_np.normal(size=(D, 4 * D)).astype(np.float32) * .3)
+    peep = jnp.asarray(rng_np.normal(size=(3, D)).astype(np.float32) * .2)
+    h0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+    c0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+
+    def loss_k(xw, wh, peep, h0, c0, reverse, remat):
+        hs, (hT, cT) = lstm_seq(xw, mask, wh, peep, h0, c0, reverse,
+                                True, remat)
+        return jnp.sum(hs) + jnp.sum(hT) + 0.5 * jnp.sum(cT)
+
+    def loss_r(xw, wh, peep, h0, c0, reverse):
+        hs, (hT, cT) = lstm_seq_reference(xw, mask, wh, peep, h0, c0,
+                                          reverse)
+        return jnp.sum(hs) + jnp.sum(hT) + 0.5 * jnp.sum(cT)
+
+    # (fwd, stored-gates) and (reverse, remat) cover both grid directions
+    # and both backward variants without the full 4-combo sweep
+    for reverse, remat in ((False, False), (True, True)):
+        hs_k, (hT_k, cT_k) = lstm_seq(xw, mask, wh, peep, h0, c0,
+                                      reverse, True, remat)
+        assert hs_k.shape == (B, T, D) and hT_k.shape == (B, D)
+        hs_r, (hT_r, cT_r) = lstm_seq_reference(
+            xw, mask, wh, peep, h0, c0, reverse)
+        np.testing.assert_allclose(hs_k, hs_r, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(cT_k, cT_r, rtol=2e-5, atol=2e-5)
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(
+            xw, wh, peep, h0, c0, reverse, remat)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(
+            xw, wh, peep, h0, c0, reverse)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_gru_seq_batch_blocked_matches_reference(rng_np):
+    """GRU sibling of the blocked-batch LSTM test (no cross-block
+    accumulator, but the same pad-rows-are-inert contract)."""
+    from paddle_tpu.ops.pallas import lstm as klstm
+    from paddle_tpu.ops.pallas.gru import gru_seq, gru_seq_reference
+
+    B, T, D = klstm._BATCH_BLOCK + 44, 4, 8
+    xw = jnp.asarray(rng_np.normal(size=(B, T, 3 * D)).astype(np.float32) * .4)
+    mask = jnp.asarray(
+        (rng_np.uniform(size=(B, T)) < 0.8).astype(np.float32)
+    ).at[:, 0].set(1.0)
+    wh = jnp.asarray(rng_np.normal(size=(D, 2 * D)).astype(np.float32) * .3)
+    whc = jnp.asarray(rng_np.normal(size=(D, D)).astype(np.float32) * .3)
+    h0 = jnp.asarray(rng_np.normal(size=(B, D)).astype(np.float32) * .2)
+
+    def loss_k(xw, wh, whc, h0, reverse, remat):
+        hs, hT = gru_seq(xw, mask, wh, whc, h0, reverse, True, remat)
+        return jnp.sum(hs) + jnp.sum(hT)
+
+    def loss_r(xw, wh, whc, h0, reverse):
+        hs, hT = gru_seq_reference(xw, mask, wh, whc, h0, reverse)
+        return jnp.sum(hs) + jnp.sum(hT)
+
+    for reverse, remat in ((False, False), (True, True)):
+        hs_k, hT_k = gru_seq(xw, mask, wh, whc, h0, reverse, True, remat)
+        assert hs_k.shape == (B, T, D) and hT_k.shape == (B, D)
+        hs_r, hT_r = gru_seq_reference(xw, mask, wh, whc, h0, reverse)
+        np.testing.assert_allclose(hs_k, hs_r, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(hT_k, hT_r, rtol=2e-5, atol=2e-5)
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(
+            xw, wh, whc, h0, reverse, remat)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(
+            xw, wh, whc, h0, reverse)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
